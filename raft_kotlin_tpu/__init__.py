@@ -1,0 +1,21 @@
+"""raft_kotlin_tpu — a TPU-native, vectorized many-group Raft simulation framework.
+
+Built from scratch against the capabilities of arodionov/raft-kotlin (see SURVEY.md):
+the reference's single-group node state machine (elections, RequestVote/AppendEntries,
+log matching, commit advancement — reference RaftServer.kt, Commons.kt) is re-designed
+as pure, `jax.jit`-compiled batched ops stepping all (groups x nodes) in lockstep, with
+a deterministic scalar CPU oracle as the correctness reference: TPU traces must
+bit-match it (SEMANTICS.md is the shared normative spec).
+
+Layout:
+  models/    CPU oracle, batched state schema, simulator driver
+  ops/       vectorized tick kernels (vote/append decision tables, timers, log ops)
+  parallel/  device-mesh sharding, collectives, checkpoint/resume
+  utils/     config, canonical RNG, tracing/metrics
+  api/       client-facing command API (HTTP parity with the reference's ktor server)
+"""
+
+from raft_kotlin_tpu.utils.config import RaftConfig
+
+__version__ = "0.1.0"
+__all__ = ["RaftConfig"]

@@ -1,0 +1,445 @@
+"""The CPU oracle: a deliberately scalar, deliberately boring reference simulator.
+
+This is the ground truth the TPU kernels must bit-match (BASELINE config 1's "CPU
+reference" path). It implements SEMANTICS.md phase-by-phase with plain Python ints and
+lists — no JAX in the inner loop; all randomness is pre-drawn through
+`raft_kotlin_tpu.utils.rng` so the vectorized kernel sees identical values.
+
+Behavioral citations refer to the reference implementation
+(/root/reference/src/main/kotlin/ua/org/kug/raft/): RaftServer.kt for the node state
+machine, Commons.kt for Log/timer/retry semantics. The oracle reproduces its quirks
+verbatim (SEMANTICS.md §8) — it models raft-kotlin, not the Raft paper.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import numpy as np
+
+from raft_kotlin_tpu.utils import rng as rngmod
+from raft_kotlin_tpu.utils.config import RaftConfig
+
+FOLLOWER, CANDIDATE, LEADER = 0, 1, 2
+IDLE, BACKOFF, ACTIVE = 0, 1, 2
+
+_PREDRAW = 4096  # pre-drawn randoms per (node, kind); grown on demand
+
+
+class OracleLog:
+    """The reference's Log<T> (Commons.kt:47-74): a 1-based logical lastIndex over a
+    grow-only physical list. Kotlin's append branch calls MutableList.add(entry), which
+    appends at the PHYSICAL END — after a logical truncation (quirk j) the physical
+    length exceeds lastIndex, so appends become ghost writes and stale slots re-enter
+    the readable window. See SEMANTICS.md §3."""
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self.last_index = 0
+        self.terms: list[int] = []   # physical slots; phys_len == len(self.terms)
+        self.cmds: list[int] = []
+
+    @property
+    def phys_len(self) -> int:
+        return len(self.terms)
+
+    def valid(self, i: int) -> bool:
+        # Commons.kt:53-54 guard + JVM list bounds; negative i must NOT wrap.
+        return 0 <= i < self.last_index
+
+    def get_term(self, i: int) -> int:
+        assert self.valid(i)
+        return self.terms[i]
+
+    def get_cmd(self, i: int) -> int:
+        assert self.valid(i)
+        return self.cmds[i]
+
+    def add(self, i: int, term: int, cmd: int) -> bool:
+        # Commons.kt:56-68
+        if self.last_index == i:
+            if self.phys_len >= self.capacity:
+                return False  # capacity clip [canon], SEMANTICS.md §3
+            self.terms.append(term)  # physical END, not slot i
+            self.cmds.append(cmd)
+            self.last_index += 1
+            return True
+        if self.last_index < i:
+            return False
+        self.terms[i] = term  # overwrite physical slot i
+        self.cmds[i] = cmd
+        self.last_index = i + 1  # logical truncation (quirk j)
+        return True
+
+    def entries(self):
+        return list(zip(self.terms[: self.last_index], self.cmds[: self.last_index]))
+
+
+class OracleNode:
+    """Per-node state (reference RaftServer.kt:35-48 + SEMANTICS.md §2)."""
+
+    def __init__(self, node_id: int, group: int, cfg: RaftConfig, draws):
+        self.id = node_id          # 1-based, like the reference
+        self.g = group
+        self.cfg = cfg
+        self._draws = draws        # {kind: np.ndarray[K]} pre-drawn for (group, node);
+                                   # grown on demand by _draw()
+
+        self.term = 0
+        self.voted_for = -1
+        self.role = FOLLOWER
+        self.commit = 0
+        self.log = OracleLog(cfg.log_capacity)
+
+        self.t_ctr = 0
+        self.b_ctr = 0
+
+        # Election timer: armed at boot (RaftServer.kt:58).
+        self.el_armed = True
+        self.el_left = self._draw_timeout()
+
+        self.round_state = IDLE
+        self.round_left = 0
+        self.round_age = 0
+        self.votes = 0
+        self.responses = 0
+        self.responded = [False] * cfg.n_nodes
+
+        self.bo_left = 0
+        self.next_index = [0] * cfg.n_nodes
+        self.match_index = [0] * cfg.n_nodes
+        self.hb_armed = False
+        self.hb_left = 0
+
+    def _draw(self, kind: int, ctr: int, lo: int, hi: int) -> int:
+        table = self._draws[kind]
+        if ctr >= len(table):  # grow on demand, doubling
+            import jax.numpy as jnp
+
+            base = rngmod.base_key(self.cfg.seed)
+            new_ctrs = jnp.arange(len(table), 2 * len(table), dtype=jnp.int32)
+            ext = np.asarray(
+                rngmod.draw_uniform_counters(base, kind, self.g, self.id, new_ctrs, lo, hi)
+            )
+            table = np.concatenate([table, ext])
+            self._draws[kind] = table
+        return int(table[ctr])
+
+    def _draw_timeout(self) -> int:
+        v = self._draw(rngmod.KIND_TIMEOUT, self.t_ctr, self.cfg.el_lo, self.cfg.el_hi)
+        self.t_ctr += 1
+        return v
+
+    def _draw_backoff(self) -> int:
+        v = self._draw(rngmod.KIND_BACKOFF, self.b_ctr, self.cfg.bo_lo, self.cfg.bo_hi)
+        self.b_ctr += 1
+        return v
+
+    def reset_election_timer(self) -> None:
+        # SEMANTICS.md §7: immediate at the triggering branch; always a fresh draw
+        # (Commons.kt:16-29 cancels and recreates the one-shot timer).
+        self.el_armed = True
+        self.el_left = self._draw_timeout()
+
+    def last_log_term(self) -> int:
+        # RaftServer.kt:202
+        return 0 if self.log.last_index == 0 else self.log.get_term(self.log.last_index - 1)
+
+
+@dataclasses.dataclass
+class VoteReq:
+    term: int
+    cand: int
+    last_log_index: int
+    last_log_term: int
+
+
+@dataclasses.dataclass
+class AppendReq:
+    term: int
+    leader_id: int
+    prev_log_index: int
+    prev_log_term: int
+    entry: Optional[tuple]  # (term, cmd) or None — ≤1 entry per exchange (quirk c)
+    leader_commit: int
+
+
+def vote_handler(p: OracleNode, req: VoteReq) -> tuple[int, bool]:
+    """SEMANTICS.md §6.1 / RaftServer.kt:228-251. Mutates p; returns (term, granted)."""
+    if req.term < p.term:
+        granted = False
+    elif req.term == p.term:
+        granted = p.voted_for == req.cand  # quirk g
+    else:
+        li = p.log.last_index
+        if li >= 1 and req.last_log_term < p.log.get_term(li - 1):
+            granted = False  # no term adopt (quirk f)
+        elif li >= 1 and req.last_log_term == p.log.get_term(li - 1) and req.last_log_index < li:
+            granted = False  # no term adopt (quirk f)
+        else:
+            p.term = req.term
+            p.voted_for = req.cand
+            p.role = FOLLOWER
+            p.reset_election_timer()  # channel.send(FOLLOWER), RaftServer.kt:241
+            granted = True
+    return p.term, granted
+
+
+def append_handler(p: OracleNode, req: AppendReq) -> tuple[int, bool]:
+    """SEMANTICS.md §6.2 / RaftServer.kt:253-287. Mutates p; returns (term, success)."""
+    if req.term > p.term:
+        p.term = req.term
+        p.voted_for = -1
+        p.role = FOLLOWER
+        p.reset_election_timer()
+    if req.leader_id != p.id:  # quirk d: no term guard
+        p.role = FOLLOWER
+        p.reset_election_timer()  # possibly the second reset this exchange
+    if req.leader_commit > p.commit:  # quirk e: BEFORE the consistency check
+        p.commit = min(req.leader_commit, p.log.last_index)
+    success = req.prev_log_index == -1 or (
+        p.log.last_index > req.prev_log_index
+        and req.prev_log_index >= 0
+        and p.log.get_term(req.prev_log_index) == req.prev_log_term
+    )
+    if success and req.entry is not None:
+        p.log.add(req.prev_log_index + 1, req.entry[0], req.entry[1])
+    return p.term, success
+
+
+class OracleGroup:
+    """One Raft group of n_nodes, stepped tick-by-tick per SEMANTICS.md §5."""
+
+    def __init__(self, cfg: RaftConfig, group: int = 0, draws=None):
+        self.cfg = cfg
+        self.g = group
+        if draws is None:
+            draws = predraw(cfg, groups=[group])[group]
+        self.nodes = [
+            OracleNode(i + 1, group, cfg, draws[i]) for i in range(cfg.n_nodes)
+        ]
+        self.tick_count = 0
+        # External command schedule: {tick: [(node_id, cmd), ...]}
+        self.schedule: dict[int, list[tuple[int, int]]] = {}
+
+    def inject(self, tick: int, node_id: int, cmd: int) -> None:
+        self.schedule.setdefault(tick, []).append((node_id, cmd))
+
+    # -- phases ---------------------------------------------------------------
+
+    def tick(self, edge_ok=None) -> None:
+        """Advance one tick. edge_ok: optional (N, N) bool array, [s-1, r-1] = message
+        s->r survives (SEMANTICS.md §4); None = all alive."""
+        cfg = self.cfg
+        t = self.tick_count
+        nodes = self.nodes
+
+        def ok(s: int, r: int) -> bool:
+            if edge_ok is None:
+                return True
+            return bool(edge_ok[s - 1][r - 1])
+
+        # Phase 0 — command injection (RaftServer.kt:100-107, quirk k).
+        if cfg.cmd_period > 0 and t % cfg.cmd_period == 0 and t > 0:
+            n = nodes[cfg.cmd_node - 1]
+            n.log.add(n.log.last_index, n.term, t)
+        for node_id, cmd in self.schedule.get(t, []):
+            n = nodes[node_id - 1]
+            n.log.add(n.log.last_index, n.term, cmd)
+
+        # Phase 1 — timers. The two countdowns are independent: a demoted backing-off
+        # candidate has an armed election timer AND a live delay() (SEMANTICS.md §5).
+        start_round = [False] * cfg.n_nodes
+        for n in nodes:
+            if n.el_armed:
+                n.el_left -= 1
+                if n.el_left <= 0:
+                    n.el_armed = False
+                    n.role = CANDIDATE  # timer action ignores current role
+                    start_round[n.id - 1] = True
+            if n.round_state == BACKOFF:
+                n.bo_left -= 1
+                if n.bo_left <= 0:
+                    n.round_state = IDLE
+                    start_round[n.id - 1] = True
+
+        # Phase 2 — round starts.
+        for n in nodes:
+            if not start_round[n.id - 1]:
+                continue
+            if n.role == CANDIDATE:
+                n.term += 1
+                n.voted_for = n.id
+                n.votes = 0
+                n.responses = 0
+                n.responded = [False] * cfg.n_nodes
+                n.round_left = cfg.round_ticks
+                n.round_age = 0
+                n.round_state = ACTIVE
+            else:
+                # Demoted while backing off: while(state==CANDIDATE) exits,
+                # channel.send(FOLLOWER) resets the timer (RaftServer.kt:225).
+                n.round_state = IDLE
+                n.reset_election_timer()
+
+        # Phase 3 — vote exchanges.
+        for c in nodes:
+            if c.round_state != ACTIVE:
+                continue
+            if c.round_age % cfg.retry_ticks != 0:
+                continue
+            for p in nodes:
+                if c.responded[p.id - 1]:
+                    continue
+                if not (ok(c.id, p.id) and ok(p.id, c.id)):
+                    continue
+                req = VoteReq(c.term, c.id, c.log.last_index, c.last_log_term())
+                resp_term, granted = vote_handler(p, req)
+                c.responded[p.id - 1] = True
+                c.responses += 1
+                if resp_term > c.term:
+                    c.role = FOLLOWER  # quirk f: term not adopted (RaftServer.kt:210)
+                if granted:
+                    c.votes += 1
+
+        # Phase 4 — round conclusions.
+        for n in nodes:
+            if n.round_state != ACTIVE:
+                continue
+            if n.responses >= cfg.majority or n.round_left <= 0:
+                if n.role == CANDIDATE and n.votes >= cfg.majority:
+                    n.role = LEADER
+                    n.next_index = [n.commit + 1] * cfg.n_nodes  # quirk b
+                    n.match_index = [0] * cfg.n_nodes
+                    n.hb_armed = True
+                    n.hb_left = 0  # fixedRateTimer initial delay 0: fires this tick
+                    n.round_state = IDLE
+                elif n.role == CANDIDATE:
+                    n.round_state = BACKOFF
+                    n.bo_left = n._draw_backoff()
+                else:
+                    n.round_state = IDLE
+                    n.reset_election_timer()
+            else:
+                n.round_left -= 1
+                n.round_age += 1
+
+        # Phase 5 — append / heartbeat.
+        for l in nodes:
+            if not l.hb_armed:
+                continue
+            if l.hb_left > 0:
+                l.hb_left -= 1
+                continue
+            if l.role == FOLLOWER:
+                # RaftServer.kt:117 — only FOLLOWER cancels, and TimerTask.cancel()
+                # stops *future* firings only: this round's appends still go out.
+                l.hb_armed = False
+            else:
+                l.hb_left = cfg.hb_ticks - 1
+            for p in nodes:
+                i = l.next_index[p.id - 1]
+                prev_log_index = i - 2
+                if prev_log_index >= 0:
+                    if not l.log.valid(prev_log_index):
+                        continue  # exception -> skip peer (RaftServer.kt:170)
+                    prev_log_term = l.log.get_term(prev_log_index)
+                else:
+                    prev_log_term = -1
+                entry = None
+                if l.log.last_index >= i:
+                    if not l.log.valid(i - 1):
+                        continue  # quirk i: nextIndex underflow -> skip peer
+                    entry = (l.log.get_term(i - 1), l.log.get_cmd(i - 1))
+                if not (ok(l.id, p.id) and ok(p.id, l.id)):
+                    continue  # dropped exchange, exception swallowed
+                req = AppendReq(l.term, l.id, prev_log_index, prev_log_term, entry, l.commit)
+                resp_term, success = append_handler(p, req)
+                if resp_term > l.term:
+                    l.term = resp_term
+                    l.role = FOLLOWER
+                    l.reset_election_timer()  # channel.offer(FOLLOWER) [canon]
+                    continue  # return@launch: skip success processing for this peer
+                if success:
+                    if entry is not None:
+                        l.next_index[p.id - 1] += 1
+                        l.match_index[p.id - 1] += 1
+                        if sum(1 for m in l.match_index if m > l.commit) >= cfg.majority:
+                            l.commit += 1  # quirk a
+                    else:
+                        l.match_index[p.id - 1] = prev_log_index + 1  # quirk h
+                else:
+                    l.next_index[p.id - 1] -= 1  # quirk i: may underflow
+
+        self.tick_count += 1
+
+    # -- introspection --------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        return {
+            "role": [n.role for n in self.nodes],
+            "term": [n.term for n in self.nodes],
+            "commit": [n.commit for n in self.nodes],
+            "last_index": [n.log.last_index for n in self.nodes],
+            "voted_for": [n.voted_for for n in self.nodes],
+        }
+
+    def run(self, n_ticks: int, edge_ok_fn=None, trace: bool = True):
+        """Step n_ticks; returns list of per-tick snapshots (post-tick) if trace."""
+        out = []
+        for _ in range(n_ticks):
+            edge_ok = edge_ok_fn(self.tick_count) if edge_ok_fn is not None else None
+            self.tick(edge_ok)
+            if trace:
+                out.append(self.snapshot())
+        return out
+
+
+def predraw(cfg: RaftConfig, groups=None, k: int = _PREDRAW):
+    """Pre-draw k randoms per (group, node, kind) via the canonical derivation, so the
+    oracle's inner loop is JAX-free. Returns {g: [node0 {kind: array}, ...]}."""
+    import jax.numpy as jnp
+
+    base = rngmod.base_key(cfg.seed)
+    if groups is None:
+        groups = list(range(cfg.n_groups))
+    out = {}
+    ctrs = jnp.arange(k, dtype=jnp.int32)
+    for g in groups:
+        per_node = []
+        for n in range(1, cfg.n_nodes + 1):
+            per_node.append(
+                {
+                    kind: np.asarray(
+                        rngmod.draw_uniform_counters(base, kind, g, n, ctrs, lo, hi)
+                    )
+                    for kind, lo, hi in (
+                        (rngmod.KIND_TIMEOUT, cfg.el_lo, cfg.el_hi),
+                        (rngmod.KIND_BACKOFF, cfg.bo_lo, cfg.bo_hi),
+                    )
+                }
+            )
+        out[g] = per_node
+    return out
+
+
+@functools.lru_cache(maxsize=4)
+def _edge_mask_all_groups(seed: int, tick: int, shape: tuple, p_drop: float):
+    base = rngmod.base_key(seed)
+    return np.asarray(rngmod.edge_ok_mask(base, tick, shape, p_drop))
+
+
+def make_edge_ok_fn(cfg: RaftConfig, group: int):
+    """Per-tick (N, N) edge mask for one group, sliced from the canonical shaped draw
+    (SEMANTICS.md §4) so it matches the kernel's (G, N, N) mask exactly. The full-grid
+    draw is memoized per tick, so running all G oracle groups computes it once."""
+    if cfg.p_drop <= 0.0:
+        return None
+    shape = (cfg.n_groups, cfg.n_nodes, cfg.n_nodes)
+
+    def fn(tick: int):
+        return _edge_mask_all_groups(cfg.seed, tick, shape, cfg.p_drop)[group]
+
+    return fn

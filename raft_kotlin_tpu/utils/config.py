@@ -1,0 +1,65 @@
+"""Simulation configuration.
+
+The reference hard-codes every pacing constant (see BASELINE.md); here they are the
+defaults of a frozen dataclass, expressed in simulation ticks (1 tick = 100 ms of
+reference wall-time). Sources: election timeout 20_000..23_000 ms
+(reference Commons.kt:23), heartbeat period 2_000 ms (RaftServer.kt:115), vote-round
+window 25 s (RaftServer.kt:189,214), vote retry 5_000 ms (Commons.kt:37), candidate
+backoff 2_000..3_000 ms (RaftServer.kt:221).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class RaftConfig:
+    """Static configuration for one simulation (shared by oracle and TPU kernel)."""
+
+    n_groups: int = 1
+    n_nodes: int = 3
+    log_capacity: int = 64
+
+    # Pacing, in ticks. Inclusive uniform ranges match Kotlin's (a..b).random().
+    el_lo: int = 200          # election timeout lower bound
+    el_hi: int = 230          # election timeout upper bound (inclusive)
+    hb_ticks: int = 20        # heartbeat / replication period
+    round_ticks: int = 250    # vote-round window (the 25 s latch)
+    retry_ticks: int = 50     # vote RPC retry period within a round
+    bo_lo: int = 20           # candidate backoff lower bound
+    bo_hi: int = 30           # candidate backoff upper bound (inclusive)
+
+    # Workload: every cmd_period ticks (if > 0), inject command value = tick index
+    # into node cmd_node of every group (reference: GET /cmd/{command} on any node,
+    # RaftServer.kt:87-90 — no leader check).
+    cmd_period: int = 0
+    cmd_node: int = 1
+
+    # Fault injection: per-tick, per-directed-edge message drop probability.
+    p_drop: float = 0.0
+
+    seed: int = 0
+
+    @property
+    def majority(self) -> int:
+        # RaftServer.kt:44
+        return self.n_nodes // 2 + 1
+
+    def stressed(self, factor: int = 10) -> "RaftConfig":
+        """A time-compressed variant: all pacing constants divided by `factor`.
+
+        Preserves the reference's ratios (timeout : heartbeat : backoff) while packing
+        `factor`x more protocol activity into each wall-clock second of simulation —
+        used by election-churn benchmarks.
+        """
+        return dataclasses.replace(
+            self,
+            el_lo=max(1, self.el_lo // factor),
+            el_hi=max(1, self.el_hi // factor),
+            hb_ticks=max(1, self.hb_ticks // factor),
+            round_ticks=max(1, self.round_ticks // factor),
+            retry_ticks=max(1, self.retry_ticks // factor),
+            bo_lo=max(1, self.bo_lo // factor),
+            bo_hi=max(1, self.bo_hi // factor),
+        )

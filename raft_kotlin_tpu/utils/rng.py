@@ -1,0 +1,70 @@
+"""Canonical randomness derivation — the single source of truth for every random draw.
+
+The reference draws from JVM global RNGs (java.util.Random in Commons.kt:33-34, timer
+jitter Commons.kt:23, backoff RaftServer.kt:221), which is irreproducible. Here every
+draw is a counted threefry evaluation keyed by (kind, group, node, per-node counter), so
+the scalar CPU oracle and the vectorized TPU kernel — and any backend, any device —
+see bit-identical values. See SEMANTICS.md §4.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_threefry_partitionable", True)
+
+KIND_TIMEOUT = 0
+KIND_BACKOFF = 1
+KIND_FAULT = 2
+
+
+def base_key(seed: int) -> jax.Array:
+    return jax.random.key(seed)
+
+
+def _key(base: jax.Array, kind, g, n, ctr) -> jax.Array:
+    k = jax.random.fold_in(base, kind)
+    k = jax.random.fold_in(k, g)
+    k = jax.random.fold_in(k, n)
+    k = jax.random.fold_in(k, ctr)
+    return k
+
+
+def draw_uniform(base: jax.Array, kind, g, n, ctr, lo: int, hi: int) -> jax.Array:
+    """One scalar draw, uniform on the inclusive range [lo, hi].
+
+    Inclusivity matches Kotlin's `(a..b).random()` (reference Commons.kt:33-34).
+    """
+    return jax.random.randint(_key(base, kind, g, n, ctr), (), lo, hi + 1, dtype=jnp.int32)
+
+
+def draw_uniform_grid(
+    base: jax.Array, kind: int, ctrs: jax.Array, lo: int, hi: int
+) -> jax.Array:
+    """Vectorized draws over a (G, N) counter grid; element [g, i] equals
+    draw_uniform(base, kind, g, n=i+1, ctrs[g, i], lo, hi) exactly."""
+    G, N = ctrs.shape
+    g_idx = jnp.arange(G, dtype=jnp.int32)[:, None].repeat(N, axis=1)
+    n_idx = jnp.arange(1, N + 1, dtype=jnp.int32)[None, :].repeat(G, axis=0)
+    f = lambda g, n, c: draw_uniform(base, kind, g, n, c, lo, hi)
+    return jax.vmap(jax.vmap(f))(g_idx, n_idx, ctrs)
+
+
+def draw_uniform_counters(
+    base: jax.Array, kind: int, g: int, n: int, ctrs, lo: int, hi: int
+) -> jax.Array:
+    """Vectorized draws for one (group, node) over an array of counters; element [k]
+    equals draw_uniform(base, kind, g, n, ctrs[k], lo, hi) exactly. Used by the oracle's
+    predraw tables — same derivation as the kernel's per-tick draws."""
+    return jax.vmap(lambda c: draw_uniform(base, kind, g, n, c, lo, hi))(ctrs)
+
+
+def edge_ok_mask(base: jax.Array, tick, shape: tuple, p_drop: float) -> jax.Array:
+    """(G, N, N) boolean mask for tick `tick`: element [g, s-1, r-1] is True iff the
+    directed message s -> r in group g survives this tick. One shaped draw per tick,
+    shared verbatim by oracle and kernel (SEMANTICS.md §4)."""
+    if p_drop <= 0.0:
+        return jnp.ones(shape, dtype=bool)
+    k = jax.random.fold_in(jax.random.fold_in(base, KIND_FAULT), tick)
+    return ~jax.random.bernoulli(k, p_drop, shape)

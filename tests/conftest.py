@@ -1,0 +1,15 @@
+"""Test configuration: force CPU with 8 virtual devices so sharding tests run anywhere.
+
+Must set XLA flags before jax initializes (hence before importing the package).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_threefry_partitionable", True)

@@ -1,0 +1,128 @@
+"""Handler decision-table unit tests against SEMANTICS.md §6 (reference
+RaftServer.kt:228-287), exercising every branch including the inherited quirks."""
+
+import pytest
+
+from raft_kotlin_tpu.models.oracle import (
+    CANDIDATE,
+    FOLLOWER,
+    LEADER,
+    AppendReq,
+    OracleGroup,
+    VoteReq,
+    append_handler,
+    vote_handler,
+)
+from raft_kotlin_tpu.utils.config import RaftConfig
+
+
+@pytest.fixture()
+def node():
+    cfg = RaftConfig(n_groups=1, n_nodes=3)
+    return OracleGroup(cfg, group=0).nodes[0]  # node id 1
+
+
+# -- vote handler (RaftServer.kt:228-251) -------------------------------------
+
+
+def test_vote_stale_term_rejected(node):
+    node.term = 5
+    term, granted = vote_handler(node, VoteReq(term=4, cand=2, last_log_index=0, last_log_term=0))
+    assert (term, granted) == (5, False)
+
+
+def test_vote_equal_term_grants_iff_already_voted_for(node):
+    # Quirk g — this is how the reference's loopback self-vote succeeds.
+    node.term = 5
+    node.voted_for = 2
+    _, granted = vote_handler(node, VoteReq(5, 2, 0, 0))
+    assert granted
+    _, granted = vote_handler(node, VoteReq(5, 3, 0, 0))
+    assert not granted
+
+
+def test_vote_higher_term_grants_and_adopts(node):
+    node.term = 1
+    node.role = LEADER
+    t0 = node.t_ctr
+    term, granted = vote_handler(node, VoteReq(3, 2, 0, 0))
+    assert (term, granted) == (3, True)
+    assert node.voted_for == 2 and node.role == FOLLOWER
+    assert node.el_armed and node.t_ctr == t0 + 1  # FOLLOWER transition reset one draw
+
+
+def test_vote_higher_term_rejects_stale_log_without_adopting(node):
+    # Quirk f: up-to-dateness rejection does NOT adopt the higher term.
+    node.term = 1
+    node.log.add(0, 2, 7)  # last log term 2... but node.term=1; contrived is fine
+    term, granted = vote_handler(node, VoteReq(3, 2, last_log_index=1, last_log_term=1))
+    assert (term, granted) == (1, False)
+    assert node.voted_for == -1
+    # Equal last term but shorter log: also rejected without adopting.
+    term, granted = vote_handler(node, VoteReq(3, 2, last_log_index=0, last_log_term=2))
+    assert (term, granted) == (1, False)
+
+
+def test_vote_higher_term_equal_log_grants(node):
+    node.term = 1
+    node.log.add(0, 2, 7)
+    term, granted = vote_handler(node, VoteReq(3, 2, last_log_index=1, last_log_term=2))
+    assert (term, granted) == (3, True)
+
+
+# -- append handler (RaftServer.kt:253-287) -----------------------------------
+
+
+def test_append_higher_term_adopts_and_clears_vote(node):
+    node.term = 1
+    node.voted_for = 3
+    node.role = CANDIDATE
+    term, success = append_handler(node, AppendReq(4, 2, -1, -1, None, 0))
+    assert (term, success) == (4, True)
+    assert node.voted_for == -1 and node.role == FOLLOWER
+
+
+def test_append_stale_term_not_rejected_and_demotes(node):
+    # Quirk d: no `term < currentTerm -> reject` guard; any non-self append demotes.
+    node.term = 9
+    node.role = LEADER
+    term, success = append_handler(node, AppendReq(1, 2, -1, -1, None, 0))
+    assert (term, success) == (9, True)
+    assert node.role == FOLLOWER
+
+
+def test_append_self_keeps_role(node):
+    node.term = 3
+    node.role = LEADER
+    _, _ = append_handler(node, AppendReq(3, node.id, -1, -1, None, 0))
+    assert node.role == LEADER
+
+
+def test_append_commit_advances_before_consistency_check(node):
+    # Quirk e: commit = min(leaderCommit, lastIndex) even when the check then fails.
+    node.log.add(0, 1, 5)
+    term, success = append_handler(
+        node, AppendReq(1, 2, prev_log_index=3, prev_log_term=1, entry=None, leader_commit=2)
+    )
+    assert not success
+    assert node.commit == 1  # min(2, lastIndex=1)
+
+
+def test_append_consistency_and_entry(node):
+    node.log.add(0, 1, 5)
+    term, success = append_handler(node, AppendReq(1, 2, 0, 1, entry=(1, 6), leader_commit=0))
+    assert success
+    assert node.log.entries() == [(1, 5), (1, 6)]
+    # Mismatched prevLogTerm -> fail, no append.
+    term, success = append_handler(node, AppendReq(1, 2, 1, 9, entry=(1, 7), leader_commit=0))
+    assert not success
+    assert node.log.last_index == 2
+
+
+def test_append_two_timer_resets_on_foreign_higher_term(node):
+    # SEMANTICS.md §7: higher-term branch AND leaderId != id branch each reset.
+    node.el_armed = False
+    t0 = node.t_ctr
+    append_handler(node, AppendReq(2, 2, -1, -1, None, 0))
+    assert node.t_ctr == t0 + 2
+    assert node.el_armed

@@ -1,0 +1,65 @@
+"""Log semantics unit tests — the reference's Log<T> decision table (Commons.kt:47-74,
+SEMANTICS.md §3): append-at-end, reject-beyond-end, overwrite-with-logical-truncation."""
+
+from raft_kotlin_tpu.models.oracle import OracleLog
+
+
+def test_append_at_end():
+    log = OracleLog(capacity=8)
+    assert log.last_index == 0
+    assert log.add(0, term=1, cmd=10)
+    assert log.add(1, term=1, cmd=11)
+    assert log.last_index == 2
+    assert log.entries() == [(1, 10), (1, 11)]
+
+
+def test_reject_beyond_end():
+    log = OracleLog(capacity=8)
+    log.add(0, 1, 10)
+    assert not log.add(2, 1, 12)  # lastIndex < i -> false (Commons.kt:62)
+    assert log.last_index == 1
+
+
+def test_overwrite_truncates_logically():
+    # Commons.kt:63-67: overwrite sets lastIndex = i+1; stale tail physically retained.
+    log = OracleLog(capacity=8)
+    for i in range(4):
+        log.add(i, 1, 10 + i)
+    assert log.last_index == 4
+    assert log.add(1, 2, 99)
+    assert log.last_index == 2
+    assert log.phys_len == 4
+    assert log.entries() == [(1, 10), (2, 99)]
+
+
+def test_append_after_truncation_is_ghost_write():
+    # Kotlin's append branch calls MutableList.add -> physical END (Commons.kt:58-60):
+    # after truncation the new entry lands past the readable window and the stale slot
+    # re-enters it (SEMANTICS.md §3).
+    log = OracleLog(capacity=8)
+    for i in range(4):
+        log.add(i, 1, 10 + i)      # [10, 11, 12, 13]
+    log.add(1, 2, 99)              # truncate: lastIndex=2, phys [10, 99, 12, 13]
+    assert log.add(2, 2, 100)      # ghost write: phys [10, 99, 12, 13, 100]
+    assert log.last_index == 3
+    assert log.phys_len == 5
+    assert log.entries() == [(1, 10), (2, 99), (1, 12)]  # stale 12 visible, not 100
+    assert log.get_cmd(2) == 12
+
+
+def test_get_validity_no_negative_wrap():
+    log = OracleLog(capacity=8)
+    log.add(0, 1, 10)
+    assert log.valid(0)
+    assert not log.valid(-1)  # Python wrap must not leak in (SEMANTICS.md §3)
+    assert not log.valid(1)
+
+
+def test_capacity_clip():
+    log = OracleLog(capacity=2)
+    assert log.add(0, 1, 0) and log.add(1, 1, 1)
+    assert not log.add(2, 1, 2)  # physical append at capacity: no-op [canon]
+    assert log.last_index == 2
+    # Overwrite of an existing physical slot is still allowed at capacity.
+    assert log.add(0, 2, 9)
+    assert log.last_index == 1
