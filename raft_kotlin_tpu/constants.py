@@ -1,0 +1,9 @@
+"""Shared spec constants: role and round-state encodings (SEMANTICS.md §2, §5).
+
+Single source of truth for both the scalar oracle and the vectorized kernel — these
+values are part of the trace format the differential tests compare bit-for-bit.
+Roles mirror the reference's `enum class State` ordinal order (RaftServer.kt:24-26).
+"""
+
+FOLLOWER, CANDIDATE, LEADER = 0, 1, 2
+IDLE, BACKOFF, ACTIVE = 0, 1, 2

@@ -22,8 +22,14 @@ import numpy as np
 from raft_kotlin_tpu.utils import rng as rngmod
 from raft_kotlin_tpu.utils.config import RaftConfig
 
-FOLLOWER, CANDIDATE, LEADER = 0, 1, 2
-IDLE, BACKOFF, ACTIVE = 0, 1, 2
+from raft_kotlin_tpu.constants import (  # noqa: F401  (re-exported)
+    ACTIVE,
+    BACKOFF,
+    CANDIDATE,
+    FOLLOWER,
+    IDLE,
+    LEADER,
+)
 
 _PREDRAW = 4096  # pre-drawn randoms per (node, kind); grown on demand
 
@@ -115,7 +121,7 @@ class OracleNode:
 
     def _draw(self, kind: int, ctr: int, lo: int, hi: int) -> int:
         table = self._draws[kind]
-        if ctr >= len(table):  # grow on demand, doubling
+        while ctr >= len(table):  # grow on demand, doubling
             import jax.numpy as jnp
 
             base = rngmod.base_key(self.cfg.seed)
@@ -397,11 +403,13 @@ class OracleGroup:
         return out
 
 
-def predraw(cfg: RaftConfig, groups=None, k: int = _PREDRAW):
+def predraw(cfg: RaftConfig, groups=None, k: int | None = None):
     """Pre-draw k randoms per (group, node, kind) via the canonical derivation, so the
     oracle's inner loop is JAX-free. Returns {g: [node0 {kind: array}, ...]}."""
     import jax.numpy as jnp
 
+    if k is None:
+        k = _PREDRAW
     base = rngmod.base_key(cfg.seed)
     if groups is None:
         groups = list(range(cfg.n_groups))
@@ -425,7 +433,7 @@ def predraw(cfg: RaftConfig, groups=None, k: int = _PREDRAW):
     return out
 
 
-@functools.lru_cache(maxsize=4)
+@functools.lru_cache(maxsize=None)  # masks are small; groups are run sequentially
 def _edge_mask_all_groups(seed: int, tick: int, shape: tuple, p_drop: float):
     base = rngmod.base_key(seed)
     return np.asarray(rngmod.edge_ok_mask(base, tick, shape, p_drop))

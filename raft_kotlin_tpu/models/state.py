@@ -1,0 +1,103 @@
+"""Batched Raft state: struct-of-arrays over (groups, nodes).
+
+This is the TPU-side counterpart of the reference's per-node fields
+(RaftServer.kt:35-48) plus the discretized timer/round/heartbeat machinery of
+SEMANTICS.md §2, laid out so every per-tick op is an elementwise (G,)- or
+(G,N)-wide vector op and the only gathers/scatters are O(G·N) log accesses.
+Node axis index i holds node id i+1 (ids are 1-based, as in the reference).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from raft_kotlin_tpu.utils import rng as rngmod
+from raft_kotlin_tpu.utils.config import RaftConfig
+
+from raft_kotlin_tpu.constants import (  # noqa: F401  (re-exported)
+    ACTIVE,
+    BACKOFF,
+    CANDIDATE,
+    FOLLOWER,
+    IDLE,
+    LEADER,
+)
+
+
+@struct.dataclass
+class RaftState:
+    # Core Raft variables (RaftServer.kt:35-48).
+    term: jax.Array        # (G, N) i32
+    voted_for: jax.Array   # (G, N) i32, -1 = none
+    role: jax.Array        # (G, N) i32 ∈ {FOLLOWER, CANDIDATE, LEADER}
+    commit: jax.Array      # (G, N) i32
+
+    # Log (SEMANTICS.md §3): physical slots + logical last_index ≤ phys_len.
+    last_index: jax.Array  # (G, N) i32
+    phys_len: jax.Array    # (G, N) i32
+    log_term: jax.Array    # (G, N, C) i32
+    log_cmd: jax.Array     # (G, N, C) i32
+
+    # Election timer (one-shot; armed at boot).
+    el_armed: jax.Array    # (G, N) bool
+    el_left: jax.Array     # (G, N) i32
+
+    # Vote-round machinery (the while(CANDIDATE) loop + 25s latch + retries).
+    round_state: jax.Array  # (G, N) i32 ∈ {IDLE, BACKOFF, ACTIVE}
+    round_left: jax.Array   # (G, N) i32
+    round_age: jax.Array    # (G, N) i32
+    votes: jax.Array        # (G, N) i32
+    responses: jax.Array    # (G, N) i32
+    responded: jax.Array    # (G, N, N) bool; [g, c-1, p-1]
+    bo_left: jax.Array      # (G, N) i32
+
+    # Leader machinery (per-stint arrays, RaftServer.kt:112-113).
+    next_index: jax.Array   # (G, N, N) i32; [g, l-1, p-1]
+    match_index: jax.Array  # (G, N, N) i32
+    hb_armed: jax.Array     # (G, N) bool
+    hb_left: jax.Array      # (G, N) i32
+
+    # Counted-draw cursors (SEMANTICS.md §4).
+    t_ctr: jax.Array        # (G, N) i32
+    b_ctr: jax.Array        # (G, N) i32
+
+    tick: jax.Array         # () i32 — global tick counter
+
+
+def init_state(cfg: RaftConfig) -> RaftState:
+    G, N, C = cfg.n_groups, cfg.n_nodes, cfg.log_capacity
+    zi = lambda *s: jnp.zeros(s, dtype=jnp.int32)
+    zb = lambda *s: jnp.zeros(s, dtype=bool)
+    base = rngmod.base_key(cfg.seed)
+    # Boot draw: every node arms its election timer with counter 0 (t_ctr becomes 1).
+    el_left = rngmod.draw_uniform_grid(
+        base, rngmod.KIND_TIMEOUT, zi(G, N), cfg.el_lo, cfg.el_hi
+    )
+    return RaftState(
+        term=zi(G, N),
+        voted_for=jnp.full((G, N), -1, dtype=jnp.int32),
+        role=zi(G, N),
+        commit=zi(G, N),
+        last_index=zi(G, N),
+        phys_len=zi(G, N),
+        log_term=zi(G, N, C),
+        log_cmd=zi(G, N, C),
+        el_armed=jnp.ones((G, N), dtype=bool),
+        el_left=el_left,
+        round_state=zi(G, N),
+        round_left=zi(G, N),
+        round_age=zi(G, N),
+        votes=zi(G, N),
+        responses=zi(G, N),
+        responded=zb(G, N, N),
+        bo_left=zi(G, N),
+        next_index=zi(G, N, N),
+        match_index=zi(G, N, N),
+        hb_armed=zb(G, N),
+        hb_left=zi(G, N),
+        t_ctr=jnp.ones((G, N), dtype=jnp.int32),
+        b_ctr=zi(G, N),
+        tick=jnp.zeros((), dtype=jnp.int32),
+    )

@@ -1,0 +1,337 @@
+"""The vectorized lockstep tick: all (groups x nodes) advance one SEMANTICS.md tick
+inside one jitted, scan-able pure function.
+
+Design (TPU-first, not a port): the reference's threads/timers/RPCs (RaftServer.kt)
+become a fixed phase pipeline of elementwise (G,)- and (G,N)-wide integer ops — the
+node loops are tiny (N ≤ 9) and unrolled at trace time, so group count G is the only
+data axis and XLA sees static shapes throughout. RPC exchanges are in-array mailbox
+transactions: each (candidate, peer) / (leader, peer) pair is one masked vectorized
+read-modify-write over the G axis, applied sequentially in the canonical order so the
+result is bit-identical to the scalar oracle (models/oracle.py). Quorum tallies are
+reductions over the node axis. All randomness is counted threefry (utils/rng.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from raft_kotlin_tpu.models.state import (
+    ACTIVE,
+    BACKOFF,
+    CANDIDATE,
+    FOLLOWER,
+    IDLE,
+    LEADER,
+    RaftState,
+)
+from raft_kotlin_tpu.utils import rng as rngmod
+from raft_kotlin_tpu.utils.config import RaftConfig
+
+_I32 = jnp.int32
+
+
+def make_tick(cfg: RaftConfig):
+    """Build tick(state, inject=None) -> state for a fixed config.
+
+    `inject` is an optional (G, N) int32 array of commands (-1 = none) delivered in
+    phase 0 in addition to the cfg.cmd_period rule — the driver-level equivalent of the
+    reference's GET /cmd/{command} (RaftServer.kt:87-90).
+    """
+    N, C, maj = cfg.n_nodes, cfg.log_capacity, cfg.majority
+    base = rngmod.base_key(cfg.seed)
+
+    def tick(state: RaftState, inject: Optional[jax.Array] = None) -> RaftState:
+        s = {f.name: getattr(state, f.name) for f in dataclasses.fields(state)}
+        G = s["term"].shape[0]
+        g_ids = jnp.arange(G, dtype=_I32)
+        t = s["tick"]
+
+        # -- small helpers over the mutable dict --------------------------------
+
+        def col(name, n):
+            return s[name][:, n - 1]
+
+        def setcol(name, n, mask, vals):
+            cur = s[name][:, n - 1]
+            s[name] = s[name].at[:, n - 1].set(jnp.where(mask, vals, cur))
+
+        def log_gather(name, n, idx):
+            # (G,) gather of physical slot idx from node n; garbage where idx is
+            # invalid — callers must guard with masks.
+            ic = jnp.clip(idx, 0, C - 1)
+            return jnp.take_along_axis(s[name][:, n - 1, :], ic[:, None], axis=1)[:, 0]
+
+        def log_add(n, i, term_v, cmd_v, mask):
+            # SEMANTICS.md §3 add(): physical append / reject / overwrite-truncate.
+            li = col("last_index", n)
+            pl = col("phys_len", n)
+            app = mask & (i == li) & (pl < C)
+            ovw = mask & (i < li) & (i >= 0)
+            wmask = app | ovw
+            slot = jnp.clip(jnp.where(app, pl, i), 0, C - 1)
+            cur_t = log_gather("log_term", n, slot)
+            cur_c = log_gather("log_cmd", n, slot)
+            s["log_term"] = (
+                s["log_term"].at[g_ids, n - 1, slot].set(jnp.where(wmask, term_v, cur_t))
+            )
+            s["log_cmd"] = (
+                s["log_cmd"].at[g_ids, n - 1, slot].set(jnp.where(wmask, cmd_v, cur_c))
+            )
+            setcol("last_index", n, app | ovw, jnp.where(app, li + 1, i + 1))
+            setcol("phys_len", n, app, pl + 1)
+
+        def draw_col(kind, n, ctr, lo, hi):
+            f = lambda g, c: rngmod.draw_uniform(base, kind, g, n, c, lo, hi)
+            return jax.vmap(f)(g_ids, ctr)
+
+        def reset_el_timer_col(n, mask):
+            # SEMANTICS.md §7: one fresh counted draw per reset, mask-gated.
+            ctr = col("t_ctr", n)
+            d = draw_col(rngmod.KIND_TIMEOUT, n, ctr, cfg.el_lo, cfg.el_hi)
+            setcol("el_left", n, mask, d)
+            s["el_armed"] = s["el_armed"].at[:, n - 1].set(col("el_armed", n) | mask)
+            setcol("t_ctr", n, mask, ctr + 1)
+
+        def reset_el_timer_grid(mask):
+            d = rngmod.draw_uniform_grid(
+                base, rngmod.KIND_TIMEOUT, s["t_ctr"], cfg.el_lo, cfg.el_hi
+            )
+            s["el_left"] = jnp.where(mask, d, s["el_left"])
+            s["el_armed"] = s["el_armed"] | mask
+            s["t_ctr"] = s["t_ctr"] + mask.astype(_I32)
+
+        edge = rngmod.edge_ok_mask(base, t, (G, N, N), cfg.p_drop)
+
+        # -- phase 0: command injection (quirk k) -------------------------------
+
+        if cfg.cmd_period > 0:
+            due = (t % cfg.cmd_period == 0) & (t > 0)
+            n = cfg.cmd_node
+            mask = jnp.broadcast_to(due, (G,))
+            log_add(n, col("last_index", n), col("term", n), jnp.broadcast_to(t, (G,)), mask)
+        if inject is not None:
+            for n in range(1, N + 1):
+                cmd = inject[:, n - 1]
+                log_add(n, col("last_index", n), col("term", n), cmd, cmd >= 0)
+
+        # -- phase 1: timers (independent countdowns) ---------------------------
+
+        armed = s["el_armed"]
+        left = s["el_left"] - armed.astype(_I32)
+        fire = armed & (left <= 0)
+        s["el_left"] = left
+        s["el_armed"] = armed & ~fire
+        s["role"] = jnp.where(fire, CANDIDATE, s["role"])
+        start_round = fire
+
+        in_bo = s["round_state"] == BACKOFF
+        bleft = s["bo_left"] - in_bo.astype(_I32)
+        bfire = in_bo & (bleft <= 0)
+        s["bo_left"] = bleft
+        s["round_state"] = jnp.where(bfire, IDLE, s["round_state"])
+        start_round = start_round | bfire
+
+        # -- phase 2: round starts ---------------------------------------------
+
+        is_cand = s["role"] == CANDIDATE
+        init = start_round & is_cand
+        node_ids = jnp.broadcast_to(jnp.arange(1, N + 1, dtype=_I32), (G, N))
+        s["term"] = s["term"] + init.astype(_I32)
+        s["voted_for"] = jnp.where(init, node_ids, s["voted_for"])
+        s["votes"] = jnp.where(init, 0, s["votes"])
+        s["responses"] = jnp.where(init, 0, s["responses"])
+        s["responded"] = jnp.where(init[:, :, None], False, s["responded"])
+        s["round_left"] = jnp.where(init, cfg.round_ticks, s["round_left"])
+        s["round_age"] = jnp.where(init, 0, s["round_age"])
+        s["round_state"] = jnp.where(init, ACTIVE, s["round_state"])
+        demoted_bo = start_round & ~is_cand
+        s["round_state"] = jnp.where(demoted_bo, IDLE, s["round_state"])
+        reset_el_timer_grid(demoted_bo)
+
+        # -- phase 3: vote exchanges --------------------------------------------
+
+        for c in range(1, N + 1):
+            c_attempting = (col("round_state", c) == ACTIVE) & (
+                col("round_age", c) % cfg.retry_ticks == 0
+            )
+            for p in range(1, N + 1):
+                att = (
+                    c_attempting
+                    & ~s["responded"][:, c - 1, p - 1]
+                    & edge[:, c - 1, p - 1]
+                    & edge[:, p - 1, c - 1]
+                )
+                # Request built from c's live state (RaftServer.kt:200-207).
+                c_term = col("term", c)
+                c_li = col("last_index", c)
+                c_llt = jnp.where(c_li == 0, 0, log_gather("log_term", c, c_li - 1))
+                # Vote handler on p (SEMANTICS.md §6.1).
+                p_term = col("term", p)
+                p_vf = col("voted_for", p)
+                p_li = col("last_index", p)
+                p_llt = log_gather("log_term", p, p_li - 1)
+                rej_stale = (p_li >= 1) & (c_llt < p_llt)
+                rej_short = (p_li >= 1) & (c_llt == p_llt) & (c_li < p_li)
+                grant_gt = (c_term > p_term) & ~rej_stale & ~rej_short
+                granted = jnp.where(
+                    c_term < p_term,
+                    False,
+                    jnp.where(c_term == p_term, p_vf == c, grant_gt),
+                )
+                adopt = att & grant_gt
+                setcol("term", p, adopt, c_term)
+                setcol("voted_for", p, adopt, c)
+                setcol("role", p, adopt, FOLLOWER)
+                reset_el_timer_col(p, adopt)
+                resp_term = col("term", p)
+                # Candidate tally (RaftServer.kt:209-211).
+                s["responded"] = (
+                    s["responded"].at[:, c - 1, p - 1].set(s["responded"][:, c - 1, p - 1] | att)
+                )
+                setcol("responses", c, att, col("responses", c) + 1)
+                setcol("role", c, att & (resp_term > c_term), FOLLOWER)  # quirk f
+                setcol("votes", c, att & granted, col("votes", c) + 1)
+
+        # -- phase 4: round conclusions -----------------------------------------
+
+        act = s["round_state"] == ACTIVE
+        concl = act & ((s["responses"] >= maj) | (s["round_left"] <= 0))
+        is_cand = s["role"] == CANDIDATE
+        win = concl & is_cand & (s["votes"] >= maj)
+        lose = concl & is_cand & ~win
+        dem = concl & ~is_cand
+        s["role"] = jnp.where(win, LEADER, s["role"])
+        s["next_index"] = jnp.where(
+            win[:, :, None], (s["commit"] + 1)[:, :, None], s["next_index"]
+        )  # quirk b
+        s["match_index"] = jnp.where(win[:, :, None], 0, s["match_index"])
+        s["hb_armed"] = s["hb_armed"] | win
+        s["hb_left"] = jnp.where(win, 0, s["hb_left"])  # initial delay 0
+        s["round_state"] = jnp.where(win | dem, IDLE, s["round_state"])
+        bdraw = rngmod.draw_uniform_grid(
+            base, rngmod.KIND_BACKOFF, s["b_ctr"], cfg.bo_lo, cfg.bo_hi
+        )
+        s["round_state"] = jnp.where(lose, BACKOFF, s["round_state"])
+        s["bo_left"] = jnp.where(lose, bdraw, s["bo_left"])
+        s["b_ctr"] = s["b_ctr"] + lose.astype(_I32)
+        reset_el_timer_grid(dem)
+        ongoing = act & ~concl
+        s["round_left"] = s["round_left"] - ongoing.astype(_I32)
+        s["round_age"] = s["round_age"] + ongoing.astype(_I32)
+
+        # -- phase 5: append / heartbeat ----------------------------------------
+
+        for l in range(1, N + 1):
+            armed = col("hb_armed", l)
+            waiting = armed & (col("hb_left", l) > 0)
+            fire = armed & ~waiting
+            setcol("hb_left", l, waiting, col("hb_left", l) - 1)
+            l_is_f = col("role", l) == FOLLOWER
+            # FOLLOWER cancels future firings but this round still goes out
+            # (TimerTask.cancel semantics, RaftServer.kt:117).
+            s["hb_armed"] = s["hb_armed"].at[:, l - 1].set(armed & ~(fire & l_is_f))
+            setcol("hb_left", l, fire & ~l_is_f, cfg.hb_ticks - 1)
+            for p in range(1, N + 1):
+                li_l = col("last_index", l)
+                i = s["next_index"][:, l - 1, p - 1]
+                pli = i - 2
+                # prevLogTerm: invalid get -> exception -> skip peer (§6 skip rule).
+                skip = (pli >= 0) & ~(pli < li_l)
+                plt = jnp.where(pli >= 0, log_gather("log_term", l, pli), -1)
+                has_entry = li_l >= i
+                skip = skip | (has_entry & (i <= 0))  # quirk i underflow
+                ent_t = log_gather("log_term", l, i - 1)
+                ent_c = log_gather("log_cmd", l, i - 1)
+                skip = skip | ~edge[:, l - 1, p - 1] | ~edge[:, p - 1, l - 1]
+                act5 = fire & ~skip
+                # --- append handler on p (SEMANTICS.md §6.2) ---
+                req_term = col("term", l)
+                req_commit = col("commit", l)
+                p_term = col("term", p)
+                if p != l:
+                    adopt = act5 & (req_term > p_term)
+                    setcol("term", p, adopt, req_term)
+                    setcol("voted_for", p, adopt, -1)
+                    setcol("role", p, adopt, FOLLOWER)
+                    reset_el_timer_col(p, adopt)
+                    setcol("role", p, act5, FOLLOWER)  # quirk d: any foreign append
+                    reset_el_timer_col(p, act5)
+                p_li = col("last_index", p)
+                p_commit = col("commit", p)
+                cadv = act5 & (req_commit > p_commit)
+                setcol("commit", p, cadv, jnp.minimum(req_commit, p_li))  # quirk e
+                p_plt = log_gather("log_term", p, pli)
+                succ = (pli == -1) | ((p_li > pli) & (pli >= 0) & (p_plt == plt))
+                log_add(p, pli + 1, ent_t, ent_c, act5 & succ & has_entry)
+                resp_term = col("term", p)
+                # --- leader processes the response (RaftServer.kt:146-168) ---
+                if p != l:
+                    l_term = col("term", l)
+                    demote = act5 & (resp_term > l_term)
+                    setcol("term", l, demote, resp_term)
+                    setcol("role", l, demote, FOLLOWER)
+                    reset_el_timer_col(l, demote)
+                else:
+                    demote = jnp.zeros((G,), dtype=bool)
+                proc = act5 & ~demote & succ
+                with_e = proc & has_entry
+                nfail = act5 & ~demote & ~succ
+                ni = s["next_index"][:, l - 1, p - 1]
+                s["next_index"] = (
+                    s["next_index"]
+                    .at[:, l - 1, p - 1]
+                    .set(jnp.where(with_e, ni + 1, jnp.where(nfail, ni - 1, ni)))
+                )
+                mi = s["match_index"][:, l - 1, p - 1]
+                s["match_index"] = (
+                    s["match_index"]
+                    .at[:, l - 1, p - 1]
+                    .set(jnp.where(with_e, mi + 1, jnp.where(proc & ~has_entry, pli + 1, mi)))
+                )
+                # Commit advancement (quirk a), evaluated per response.
+                l_commit = col("commit", l)
+                cnt = jnp.sum(
+                    (s["match_index"][:, l - 1, :] > l_commit[:, None]).astype(_I32), axis=1
+                )
+                setcol("commit", l, with_e & (cnt >= maj), l_commit + 1)
+
+        s["tick"] = t + 1
+        return RaftState(**s)
+
+    return tick
+
+
+def make_run(cfg: RaftConfig, n_ticks: int, trace: bool = True):
+    """jitted runner: state -> (state, trace) stepping n_ticks via lax.scan.
+
+    trace is a dict of (T, G, N) arrays (role/term/commit/last_index/voted_for per
+    tick, post-tick) — the differential-test observable. With trace=False returns
+    per-tick (G,) leader counts only (cheap bench/metrics mode).
+    """
+    tick_fn = make_tick(cfg)
+
+    def body(st, _):
+        st = tick_fn(st)
+        if trace:
+            out = {
+                "role": st.role,
+                "term": st.term,
+                "commit": st.commit,
+                "last_index": st.last_index,
+                "voted_for": st.voted_for,
+            }
+        else:
+            out = jnp.sum((st.role == LEADER).astype(_I32), axis=1)
+        return st, out
+
+    @jax.jit
+    def run(st):
+        return lax.scan(body, st, None, length=n_ticks)
+
+    return run

@@ -1,0 +1,78 @@
+"""Differential tests: the vectorized kernel's per-group (role, term, commit,
+last_index, voted_for) traces must be BIT-IDENTICAL to independent oracle runs fed the
+same seeds/masks (SURVEY.md §4 item 3; SEMANTICS.md is the shared spec).
+
+Any mismatch prints the first diverging (tick, group, field) for debugging.
+"""
+
+import numpy as np
+import pytest
+
+from raft_kotlin_tpu.models.oracle import OracleGroup, make_edge_ok_fn, predraw
+from raft_kotlin_tpu.models.state import init_state
+from raft_kotlin_tpu.ops.tick import make_run
+from raft_kotlin_tpu.utils.config import RaftConfig
+
+FIELDS = ("role", "term", "commit", "last_index", "voted_for")
+
+
+def run_kernel(cfg: RaftConfig, n_ticks: int):
+    run = make_run(cfg, n_ticks, trace=True)
+    state, trace = run(init_state(cfg))
+    return {k: np.asarray(v) for k, v in trace.items()}  # (T, G, N)
+
+
+def run_oracles(cfg: RaftConfig, n_ticks: int):
+    draws = predraw(cfg)
+    out = {k: np.zeros((n_ticks, cfg.n_groups, cfg.n_nodes), dtype=np.int64) for k in FIELDS}
+    for g in range(cfg.n_groups):
+        grp = OracleGroup(cfg, group=g, draws=draws[g])
+        snaps = grp.run(n_ticks, edge_ok_fn=make_edge_ok_fn(cfg, g))
+        for ti, snap in enumerate(snaps):
+            for k in FIELDS:
+                out[k][ti, g] = snap[k]
+    return out
+
+
+def assert_traces_match(cfg: RaftConfig, n_ticks: int):
+    kt = run_kernel(cfg, n_ticks)
+    ot = run_oracles(cfg, n_ticks)
+    for k in FIELDS:
+        if not np.array_equal(kt[k], ot[k]):
+            bad = np.argwhere(kt[k] != ot[k])
+            ti, g, n = bad[0]
+            raise AssertionError(
+                f"field {k} diverges first at tick={ti} group={g} node={n + 1}: "
+                f"kernel={kt[k][ti, g]} oracle={ot[k][ti, g]}\n"
+                f"tick {ti} kernel role/term/commit: "
+                f"{kt['role'][ti, g]}/{kt['term'][ti, g]}/{kt['commit'][ti, g]}\n"
+                f"tick {ti} oracle role/term/commit: "
+                f"{ot['role'][ti, g]}/{ot['term'][ti, g]}/{ot['commit'][ti, g]}"
+            )
+
+
+def test_election_only_bitmatch():
+    # BASELINE config 2 shape: election-only (no commands), several groups.
+    cfg = RaftConfig(n_groups=4, n_nodes=3, seed=17)
+    assert_traces_match(cfg, cfg.el_hi + 40)
+
+
+def test_replication_bitmatch():
+    # BASELINE config 3 shape: elections + periodic client writes + commit advance.
+    cfg = RaftConfig(n_groups=4, n_nodes=5, seed=23, cmd_period=25, cmd_node=2)
+    assert_traces_match(cfg, cfg.el_hi + 150)
+
+
+def test_fault_injection_bitmatch():
+    # BASELINE config 4 shape: message drops force churn, retries, re-elections.
+    cfg = RaftConfig(n_groups=6, n_nodes=3, seed=31, p_drop=0.2)
+    assert_traces_match(cfg, 420)
+
+
+@pytest.mark.slow
+def test_stressed_churn_bitmatch():
+    # Compressed pacing + drops + writes: maximal protocol activity per tick.
+    cfg = RaftConfig(
+        n_groups=8, n_nodes=5, seed=47, p_drop=0.15, cmd_period=7, cmd_node=1
+    ).stressed(10)
+    assert_traces_match(cfg, 400)

@@ -117,26 +117,32 @@ def test_demoted_leader_sends_final_append_round():
     # Demote the leader out-of-band mid-heartbeat-period.
     assert ln.hb_left > 0
     ln.role = FOLLOWER
-    follower_timers = [(n.el_armed, n.el_left) for n in g.nodes if n.id != lead]
-    g.run(ln.hb_left + 1, trace=False)
-    # The final round still went out: peers' election timers were reset afresh...
-    assert [(n.el_armed, n.el_left) for n in g.nodes if n.id != lead] != follower_timers
+    ticks = ln.hb_left + 1
+    expected_decay = {
+        n.id: n.el_left - ticks for n in g.nodes if n.id != lead and n.el_armed
+    }
+    g.run(ticks, trace=False)
+    # The final round still went out: each peer's timer was RESET by the append (a
+    # fresh >= el_lo draw on the firing tick), not merely decremented by `ticks`.
+    for n in g.nodes:
+        if n.id == lead:
+            continue
+        assert n.el_armed
+        assert n.el_left != expected_decay[n.id]
+        assert n.el_left >= cfg.el_lo - 1  # fresh draw, at most 1 post-reset decrement
     # ...and the timer is now disarmed.
     assert not ln.hb_armed
 
 
 def test_draw_table_growth():
     # Force counters past the predraw table length; growth must be bit-stable.
-    from raft_kotlin_tpu.models import oracle as om
+    from raft_kotlin_tpu.models.oracle import predraw
 
-    old = om._PREDRAW
-    try:
-        om._PREDRAW = 4
-        cfg = RaftConfig(n_groups=1, n_nodes=3, seed=5)
-        small = OracleGroup(cfg, group=0)
-        vals_small = [small.nodes[0]._draw_timeout() for _ in range(16)]
-    finally:
-        om._PREDRAW = old
-    big = OracleGroup(RaftConfig(n_groups=1, n_nodes=3, seed=5), group=0)
+    cfg = RaftConfig(n_groups=1, n_nodes=3, seed=5)
+    small = OracleGroup(cfg, group=0, draws=predraw(cfg, groups=[0], k=4)[0])
+    assert len(small.nodes[0]._draws[0]) == 4  # table really is tiny pre-growth
+    vals_small = [small.nodes[0]._draw_timeout() for _ in range(16)]
+    assert len(small.nodes[0]._draws[0]) >= 16  # growth actually fired
+    big = OracleGroup(cfg, group=0, draws=predraw(cfg, groups=[0], k=64)[0])
     vals_big = [big.nodes[0]._draw_timeout() for _ in range(16)]
     assert vals_small == vals_big
