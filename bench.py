@@ -1,0 +1,96 @@
+"""Headline benchmark: vectorized many-group Raft simulation throughput.
+
+Config matches BASELINE.json config 4 — 100k concurrent 5-node Raft groups with
+randomized partitions (fault-injection masks) and a replication workload — stepped in
+lockstep by the jitted tick kernel (raft_kotlin_tpu/ops/tick.py) on one chip.
+
+Headline metric: **Raft group-steps per second per chip** (groups × ticks / elapsed).
+Baseline derivation (the reference publishes no numbers — BASELINE.md): the reference
+advances ONE group in real time at 1 tick = 100 ms of protocol time (heartbeat 2000 ms
+= 20 ticks, reference RaftServer.kt:115), i.e. 10 group-steps/sec. `vs_baseline` is
+the ratio of our throughput to those 10 group-steps/sec.
+
+Also reported (extra keys in the same JSON line): elections/sec (round starts, the
+north-star metric), ticks/sec, and config echo.
+
+Prints exactly ONE JSON line on stdout.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def main() -> None:
+    from raft_kotlin_tpu.models.state import init_state
+    from raft_kotlin_tpu.ops.tick import make_tick
+    from raft_kotlin_tpu.utils.config import RaftConfig
+
+    platform = jax.devices()[0].platform
+    on_accel = platform not in ("cpu",)
+
+    groups = int(os.environ.get("RAFT_BENCH_GROUPS", 100_000 if on_accel else 4_096))
+    ticks = int(os.environ.get("RAFT_BENCH_TICKS", 200 if on_accel else 50))
+    reps = int(os.environ.get("RAFT_BENCH_REPS", 3))
+
+    cfg = RaftConfig(
+        n_groups=groups,
+        n_nodes=5,
+        log_capacity=32,
+        cmd_period=10,
+        p_drop=0.02,
+        seed=0,
+    ).stressed(10)
+
+    tick_fn = make_tick(cfg)
+
+    @jax.jit
+    def run(st):
+        return jax.lax.scan(lambda s, _: (tick_fn(s), None), st, None, length=ticks)[0]
+
+    st = init_state(cfg)
+    jax.block_until_ready(st.term)
+
+    # Warmup / compile.
+    warm = run(st)
+    jax.block_until_ready(warm.term)
+
+    best = float("inf")
+    end_state = warm
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        end_state = run(st)
+        jax.block_until_ready(end_state.term)
+        best = min(best, time.perf_counter() - t0)
+
+    group_steps_per_sec = groups * ticks / best
+    elections = int(jnp.sum(end_state.rounds) - jnp.sum(st.rounds))
+    elections_per_sec = elections / best
+
+    # Reference-equivalent throughput: one group, wall-clock protocol time,
+    # 1 tick = 100 ms -> 10 group-steps/sec (BASELINE.md).
+    baseline_group_steps_per_sec = 10.0
+
+    print(json.dumps({
+        "metric": "raft_group_steps_per_sec_per_chip",
+        "value": round(group_steps_per_sec, 1),
+        "unit": "group-steps/s",
+        "vs_baseline": round(group_steps_per_sec / baseline_group_steps_per_sec, 1),
+        "elections_per_sec": round(elections_per_sec, 1),
+        "ticks_per_sec": round(ticks / best, 2),
+        "groups": groups,
+        "n_nodes": cfg.n_nodes,
+        "ticks": ticks,
+        "platform": platform,
+    }))
+    sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()

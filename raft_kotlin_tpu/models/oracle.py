@@ -114,6 +114,7 @@ class OracleNode:
         self.responded = [False] * cfg.n_nodes
 
         self.bo_left = 0
+        self.rounds = 0
         self.next_index = [0] * cfg.n_nodes
         self.match_index = [0] * cfg.n_nodes
         self.hb_armed = False
@@ -284,6 +285,7 @@ class OracleGroup:
                 n.round_left = cfg.round_ticks
                 n.round_age = 0
                 n.round_state = ACTIVE
+                n.rounds += 1
             else:
                 # Demoted while backing off: while(state==CANDIDATE) exits,
                 # channel.send(FOLLOWER) resets the timer (RaftServer.kt:225).
@@ -390,6 +392,7 @@ class OracleGroup:
             "commit": [n.commit for n in self.nodes],
             "last_index": [n.log.last_index for n in self.nodes],
             "voted_for": [n.voted_for for n in self.nodes],
+            "rounds": [n.rounds for n in self.nodes],
         }
 
     def run(self, n_ticks: int, edge_ok_fn=None, trace: bool = True):

@@ -63,6 +63,10 @@ class RaftState:
     t_ctr: jax.Array        # (G, N) i32
     b_ctr: jax.Array        # (G, N) i32
 
+    # Cumulative election rounds started (metrics; one per while(CANDIDATE) loop
+    # iteration, reference RaftServer.kt:191-223).
+    rounds: jax.Array       # (G, N) i32
+
     tick: jax.Array         # () i32 — global tick counter
 
 
@@ -99,5 +103,6 @@ def init_state(cfg: RaftConfig) -> RaftState:
         hb_left=zi(G, N),
         t_ctr=jnp.ones((G, N), dtype=jnp.int32),
         b_ctr=zi(G, N),
+        rounds=zi(G, N),
         tick=jnp.zeros((), dtype=jnp.int32),
     )

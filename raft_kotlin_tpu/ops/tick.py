@@ -150,6 +150,7 @@ def make_tick(cfg: RaftConfig):
         s["round_left"] = jnp.where(init, cfg.round_ticks, s["round_left"])
         s["round_age"] = jnp.where(init, 0, s["round_age"])
         s["round_state"] = jnp.where(init, ACTIVE, s["round_state"])
+        s["rounds"] = s["rounds"] + init.astype(_I32)
         demoted_bo = start_round & ~is_cand
         s["round_state"] = jnp.where(demoted_bo, IDLE, s["round_state"])
         reset_el_timer_grid(demoted_bo)
@@ -310,8 +311,8 @@ def make_tick(cfg: RaftConfig):
 def make_run(cfg: RaftConfig, n_ticks: int, trace: bool = True):
     """jitted runner: state -> (state, trace) stepping n_ticks via lax.scan.
 
-    trace is a dict of (T, G, N) arrays (role/term/commit/last_index/voted_for per
-    tick, post-tick) — the differential-test observable. With trace=False returns
+    trace is a dict of (T, G, N) arrays (role/term/commit/last_index/voted_for/rounds
+    per tick, post-tick) — the differential-test observable. With trace=False returns
     per-tick (G,) leader counts only (cheap bench/metrics mode).
     """
     tick_fn = make_tick(cfg)
@@ -325,6 +326,7 @@ def make_run(cfg: RaftConfig, n_ticks: int, trace: bool = True):
                 "commit": st.commit,
                 "last_index": st.last_index,
                 "voted_for": st.voted_for,
+                "rounds": st.rounds,
             }
         else:
             out = jnp.sum((st.role == LEADER).astype(_I32), axis=1)

@@ -1,15 +1,19 @@
-"""Test configuration: force CPU with 8 virtual devices so sharding tests run anywhere.
+"""Test configuration: force CPU with 8 virtual devices so sharding tests run anywhere
+(SURVEY.md §4 item 4) and results are host-reproducible.
 
-Must set XLA flags before jax initializes (hence before importing the package).
+The container may pre-register a TPU platform and pre-import jax at interpreter
+startup, in which case setting JAX_PLATFORMS in os.environ here is too late — use
+jax.config.update instead, which wins over the env-baked default. XLA_FLAGS is read
+lazily at first backend init, so setting it here still works.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_threefry_partitionable", True)

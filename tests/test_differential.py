@@ -1,5 +1,5 @@
 """Differential tests: the vectorized kernel's per-group (role, term, commit,
-last_index, voted_for) traces must be BIT-IDENTICAL to independent oracle runs fed the
+last_index, voted_for, rounds) traces must be BIT-IDENTICAL to independent oracle runs fed the
 same seeds/masks (SURVEY.md §4 item 3; SEMANTICS.md is the shared spec).
 
 Any mismatch prints the first diverging (tick, group, field) for debugging.
@@ -13,7 +13,7 @@ from raft_kotlin_tpu.models.state import init_state
 from raft_kotlin_tpu.ops.tick import make_run
 from raft_kotlin_tpu.utils.config import RaftConfig
 
-FIELDS = ("role", "term", "commit", "last_index", "voted_for")
+FIELDS = ("role", "term", "commit", "last_index", "voted_for", "rounds")
 
 
 def run_kernel(cfg: RaftConfig, n_ticks: int):

@@ -1,0 +1,74 @@
+"""Sharded execution must be bit-identical to single-device execution.
+
+Runs on the 8-virtual-CPU-device mesh from conftest.py (SURVEY.md §4 item 4): the
+groups axis is split over a ("dcn", "ici") mesh and the final state must equal the
+unsharded run exactly — the tick kernel is elementwise over groups and the RNG is
+counted threefry, so sharding may not change a single bit.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from raft_kotlin_tpu.models.state import RaftState, init_state
+from raft_kotlin_tpu.ops.tick import make_run
+from raft_kotlin_tpu.parallel.mesh import (
+    init_sharded,
+    make_mesh,
+    make_sharded_run,
+    pad_groups,
+    state_sharding,
+)
+from raft_kotlin_tpu.utils.config import RaftConfig
+
+
+def assert_states_equal(a: RaftState, b: RaftState):
+    for f in dataclasses.fields(RaftState):
+        av, bv = np.asarray(getattr(a, f.name)), np.asarray(getattr(b, f.name))
+        assert np.array_equal(av, bv), f"field {f.name} differs"
+
+
+def test_mesh_shape():
+    mesh = make_mesh()
+    assert mesh.axis_names == ("dcn", "ici")
+    assert len(mesh.devices.flatten()) == len(jax.devices())
+
+
+@pytest.mark.parametrize("dcn", [1, 2])
+def test_sharded_matches_unsharded(dcn):
+    mesh = make_mesh(dcn=dcn)
+    cfg = RaftConfig(n_groups=16, n_nodes=3, log_capacity=16,
+                     cmd_period=25, p_drop=0.02, seed=7).stressed(10)
+    n_ticks = 120
+
+    ref_state, _ = make_run(cfg, n_ticks, trace=False)(init_state(cfg))
+
+    st = init_sharded(cfg, mesh)
+    run = make_sharded_run(cfg, mesh, n_ticks, metrics_every=1)
+    sh_state, metrics = run(st)
+
+    assert_states_equal(jax.device_get(ref_state), jax.device_get(sh_state))
+    assert metrics["leaders"].shape == (n_ticks,)
+    # By the end of a 120-tick stressed run most healthy 16-group sims elected someone.
+    assert int(metrics["leaders"][-1]) > 0
+
+
+def test_pad_groups():
+    mesh = make_mesh()
+    cfg = RaftConfig(n_groups=13)
+    padded = pad_groups(cfg, mesh)
+    m = len(jax.devices())
+    assert padded.n_groups % m == 0 and padded.n_groups >= 13
+
+
+def test_state_actually_sharded():
+    mesh = make_mesh()
+    cfg = RaftConfig(n_groups=8, n_nodes=3, log_capacity=8)
+    st = init_sharded(cfg, mesh)
+    sh = st.term.sharding
+    assert sh.is_equivalent_to(state_sharding(mesh).term, st.term.ndim)
+    # Each device holds 1/8 of the groups axis.
+    assert len(st.term.addressable_shards) == len(jax.devices())
+    assert st.term.addressable_shards[0].data.shape[0] == 1
