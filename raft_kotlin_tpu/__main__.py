@@ -1,0 +1,125 @@
+"""CLI bootstrap — the reference's `raftInstance`/`main` equivalent.
+
+The reference boots one node per process, hard-coding ids/ports/cluster shape in
+`main` (reference RaftServer.kt:290-310); a 3-node cluster means editing `main` and
+running 3 JVMs. Here one process hosts the whole simulation (all groups x nodes) and
+`serve` exposes the reference's HTTP verbs over it:
+
+    python -m raft_kotlin_tpu serve --groups 4 --nodes 3 --port 7000 --tick-hz 10
+    python -m raft_kotlin_tpu run --groups 1024 --nodes 5 --ticks 500
+    python -m raft_kotlin_tpu bench
+
+tick-hz 10 reproduces the reference's real-time pacing (1 tick = 100 ms,
+SEMANTICS.md §1); tick-hz 0 gives a manually-stepped clock via GET /step/{k}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _add_cfg_args(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--groups", type=int, default=1)
+    p.add_argument("--nodes", type=int, default=3)
+    p.add_argument("--log-capacity", type=int, default=64)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--p-drop", type=float, default=0.0)
+    p.add_argument("--cmd-period", type=int, default=0)
+    p.add_argument("--stress", type=int, default=1,
+                   help="divide all pacing constants by this factor")
+
+
+def _cfg_from(args) -> "RaftConfig":
+    from raft_kotlin_tpu.utils.config import RaftConfig
+
+    cfg = RaftConfig(
+        n_groups=args.groups,
+        n_nodes=args.nodes,
+        log_capacity=args.log_capacity,
+        seed=args.seed,
+        p_drop=args.p_drop,
+        cmd_period=args.cmd_period,
+    )
+    return cfg.stressed(args.stress) if args.stress > 1 else cfg
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="raft_kotlin_tpu")
+    sub = ap.add_subparsers(dest="command", required=True)
+
+    serve = sub.add_parser("serve", help="HTTP frontend over a live simulation")
+    _add_cfg_args(serve)
+    serve.add_argument("--port", type=int, default=7000)
+    serve.add_argument("--tick-hz", type=float, default=10.0)
+
+    run = sub.add_parser("run", help="step N ticks, print summary metrics")
+    _add_cfg_args(run)
+    run.add_argument("--ticks", type=int, default=500)
+
+    sub.add_parser("bench", help="run the headline benchmark (bench.py)")
+
+    args = ap.parse_args(argv)
+
+    if args.command == "bench":
+        # bench.py lives at the repo root, not inside the package — load by path so
+        # `python -m raft_kotlin_tpu bench` works from any cwd.
+        import importlib.util
+        import pathlib
+
+        bench_path = pathlib.Path(__file__).resolve().parent.parent / "bench.py"
+        spec = importlib.util.spec_from_file_location("bench", bench_path)
+        bench = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(bench)
+        bench.main()
+        return 0
+
+    from raft_kotlin_tpu.api.simulator import Simulator
+
+    if args.command == "serve":
+        from raft_kotlin_tpu.api.http_api import RaftHTTPServer
+
+        sim = Simulator(_cfg_from(args))
+        srv = RaftHTTPServer(sim, port=args.port, tick_hz=args.tick_hz).start()
+        print(f"raft_kotlin_tpu serving on http://127.0.0.1:{srv.port} "
+              f"({sim.cfg.n_groups} groups x {sim.cfg.n_nodes} nodes, "
+              f"tick_hz={args.tick_hz})", file=sys.stderr)
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            srv.stop()
+        return 0
+
+    if args.command == "run":
+        import numpy as np
+
+        from raft_kotlin_tpu.constants import LEADER
+        from raft_kotlin_tpu.models.state import init_state
+        from raft_kotlin_tpu.ops.tick import make_run
+
+        cfg = _cfg_from(args)
+        t0 = time.perf_counter()
+        state, _ = make_run(cfg, args.ticks, trace=False)(init_state(cfg))
+        import jax
+
+        jax.block_until_ready(state.term)
+        dt = time.perf_counter() - t0
+        roles = np.asarray(state.role)
+        print(json.dumps({
+            "ticks": args.ticks,
+            "groups": cfg.n_groups,
+            "elapsed_s": round(dt, 3),
+            "group_steps_per_sec": round(cfg.n_groups * args.ticks / dt, 1),
+            "groups_with_leader": int(np.sum((roles == LEADER).any(axis=1))),
+            "elections_started": int(np.sum(np.asarray(state.rounds))),
+            "max_commit": int(np.max(np.asarray(state.commit))),
+        }))
+        return 0
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

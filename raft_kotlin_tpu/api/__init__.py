@@ -1,0 +1,4 @@
+from raft_kotlin_tpu.api.simulator import Simulator
+from raft_kotlin_tpu.api.http_api import RaftHTTPServer
+
+__all__ = ["Simulator", "RaftHTTPServer"]

@@ -1,0 +1,146 @@
+"""HTTP frontend: the reference's per-node command API, generalized to many groups.
+
+The reference runs an embedded ktor server per node on port 7000+id with exactly two
+routes — `GET /` ("Server $id log ${entries()}") and `GET /cmd/{command}` (append the
+command to the LOCAL log, no leader check) — see reference RaftServer.kt:81-94 (and
+the dead Javalin twin at :72-79). Here one stdlib HTTP server fronts the whole
+simulation; routes are addressed by (group, node):
+
+    GET /                                  -> simulation status (tick, groups, leaders)
+    GET /{g}/{n}/                          -> "Server n log [...]" (reference GET /)
+    GET /{g}/{n}/cmd/{command}             -> queue command on (g, n) (reference GET /cmd/)
+    GET /{g}/{n}/status                    -> role/term/commit/lastIndex JSON
+    GET /step/{k}                          -> advance k ticks (manual-clock mode)
+
+With tick_hz > 0 a daemon thread advances the simulation in wall-clock time (the
+reference's real-time behavior: 1 tick = 100 ms at tick_hz=10); with tick_hz=0 the
+clock only moves via /step/{k}, which is what tests use.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import unquote
+
+from raft_kotlin_tpu.api.simulator import Simulator
+
+_ROUTE_LOG = re.compile(r"^/(\d+)/(\d+)/?$")
+_ROUTE_CMD = re.compile(r"^/(\d+)/(\d+)/cmd/([^/]+)$")
+_ROUTE_STATUS = re.compile(r"^/(\d+)/(\d+)/status$")
+_ROUTE_STEP = re.compile(r"^/step/(\d+)$")
+
+MAX_STEP_PER_REQUEST = 100_000
+
+
+class RaftHTTPServer:
+    """Own the ThreadingHTTPServer + optional tick thread; `with` or start()/stop()."""
+
+    def __init__(self, sim: Simulator, port: int = 7000, tick_hz: float = 0.0):
+        self.sim = sim
+        self.tick_hz = tick_hz
+        self._stop = threading.Event()
+        self._tick_thread: Optional[threading.Thread] = None
+
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet; observability goes through /status
+                pass
+
+            def _send(self, code: int, body: str, ctype="text/plain"):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                sim = outer.sim
+                try:
+                    if self.path in ("", "/"):
+                        shown = min(sim.cfg.n_groups, 64)
+                        body = json.dumps(
+                            {
+                                "tick": sim.tick_count,
+                                "groups": sim.cfg.n_groups,
+                                "nodes_per_group": sim.cfg.n_nodes,
+                                "leaders": {
+                                    str(g): ls
+                                    for g, ls in sim.leaders_all(shown).items()
+                                },
+                                "leaders_truncated": shown < sim.cfg.n_groups,
+                            }
+                        )
+                        return self._send(200, body, "application/json")
+                    m = _ROUTE_CMD.match(self.path)
+                    if m:
+                        g, n, cmd = int(m[1]), int(m[2]), unquote(m[3])
+                        sim.cmd(g, n, cmd)
+                        # Reference replies with the full log dump after appending
+                        # (RaftServer.kt:88-90) — but the append lands next tick
+                        # here, so reply with the queued ack.
+                        return self._send(200, f"Server {n} queued {cmd!r}")
+                    m = _ROUTE_LOG.match(self.path)
+                    if m:
+                        g, n = int(m[1]), int(m[2])
+                        ents = sim.entries(g, n)
+                        return self._send(200, f"Server {n} log {ents}")
+                    m = _ROUTE_STATUS.match(self.path)
+                    if m:
+                        g, n = int(m[1]), int(m[2])
+                        return self._send(
+                            200, json.dumps(sim.node_status(g, n)), "application/json"
+                        )
+                    m = _ROUTE_STEP.match(self.path)
+                    if m:
+                        k = int(m[1])
+                        if k > MAX_STEP_PER_REQUEST:
+                            return self._send(
+                                400, f"step > {MAX_STEP_PER_REQUEST}; split the request"
+                            )
+                        # One tick per lock hold so concurrent routes (and the tick
+                        # thread) interleave instead of stalling behind a long step.
+                        for _ in range(k):
+                            sim.step(1)
+                        return self._send(200, json.dumps({"tick": sim.tick_count}),
+                                          "application/json")
+                    return self._send(404, "not found")
+                except IndexError as e:
+                    return self._send(400, str(e))
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._httpd.server_address[1]
+
+    def start(self):
+        threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
+        if self.tick_hz > 0:
+            period = 1.0 / self.tick_hz
+
+            def loop():
+                while not self._stop.is_set():
+                    t0 = time.monotonic()
+                    self.sim.step(1)
+                    self._stop.wait(max(0.0, period - (time.monotonic() - t0)))
+
+            self._tick_thread = threading.Thread(target=loop, daemon=True)
+            self._tick_thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._tick_thread is not None:
+            self._tick_thread.join(timeout=5)
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()

@@ -1,0 +1,183 @@
+"""Simulator: the user-facing driver around the vectorized tick.
+
+This is the L4 of the rebuild (SURVEY.md §1): where the reference exposes a per-node
+HTTP API — `GET /` dumps the log, `GET /cmd/{command}` appends a command locally with
+no leader check (reference RaftServer.kt:72-107) — the simulator exposes the same two
+verbs addressed by (group, node): `entries(g, n)` and `cmd(g, n, command)`. Commands
+are strings at this layer, interned to int32 vocabulary ids before they enter the
+kernel (SEMANTICS.md §2), and de-interned on the way out.
+
+Injected commands are queued host-side and delivered in phase 0 of the NEXT tick via
+the kernel's `inject` argument (ops/tick.py) — the discretized equivalent of an HTTP
+write landing between protocol events.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_kotlin_tpu.constants import FOLLOWER, CANDIDATE, LEADER  # noqa: F401
+from raft_kotlin_tpu.models.state import RaftState, init_state
+from raft_kotlin_tpu.ops.tick import make_tick
+from raft_kotlin_tpu.utils.config import RaftConfig
+
+_NO_CMD = -1
+
+# Interned user-command ids live above this base so they can never collide with the
+# cmd_period workload's raw tick values (ops/tick.py phase 0 writes cmd = tick index).
+INTERN_BASE = 1 << 30
+
+
+class Simulator:
+    """One live simulation: all groups x nodes, stepped on demand.
+
+    Thread-safe: every public method takes the instance lock, so an HTTP frontend
+    (api/http_api.py) and a background tick loop can share one Simulator.
+    """
+
+    def __init__(self, cfg: RaftConfig, state: Optional[RaftState] = None):
+        self.cfg = cfg
+        self._lock = threading.RLock()
+        self._state = state if state is not None else init_state(cfg)
+        tick = make_tick(cfg)
+        self._tick_with_inject = jax.jit(tick)
+        self._tick_plain = jax.jit(lambda s: tick(s, None))
+        # Pending phase-0 injections for the next tick: {(g, n): cmd_id} — last write
+        # wins per (group, node), like back-to-back HTTP posts within one tick window.
+        self._pending: Dict[Tuple[int, int], int] = {}
+        # Command vocabulary: string <-> int32 id (ids start at 0; -1 = none).
+        self._vocab: Dict[str, int] = {}
+        self._rvocab: List[str] = []
+
+    # -- vocabulary -----------------------------------------------------------
+
+    def intern(self, command: str) -> int:
+        with self._lock:
+            if command not in self._vocab:
+                self._vocab[command] = INTERN_BASE + len(self._rvocab)
+                self._rvocab.append(command)
+            return self._vocab[command]
+
+    def command_name(self, cmd_id: int) -> str:
+        with self._lock:
+            k = cmd_id - INTERN_BASE
+            if 0 <= k < len(self._rvocab):
+                return self._rvocab[k]
+            return str(cmd_id)  # ids injected by cmd_period workload are raw ticks
+
+    # -- the two reference verbs ---------------------------------------------
+
+    def cmd(self, group: int, node: int, command: str) -> int:
+        """Queue `command` for (group, node) — lands in its LOCAL log next tick at its
+        LOCAL term, exactly like the reference's GET /cmd/{command}
+        (RaftServer.kt:100-107: no leader check, no redirect, no quorum wait)."""
+        self._check_addr(group, node)
+        cid = self.intern(command)
+        with self._lock:
+            self._pending[(group, node)] = cid
+        return cid
+
+    def entries(self, group: int, node: int) -> List[Tuple[int, str]]:
+        """The readable log window of (group, node): [(term, command), ...] —
+        the reference's GET / dump (RaftServer.kt:84-86, 96-97)."""
+        self._check_addr(group, node)
+        with self._lock:
+            st = self._state
+            li = int(st.last_index[group, node - 1])
+            terms = np.asarray(st.log_term[group, node - 1, :li])
+            cmds = np.asarray(st.log_cmd[group, node - 1, :li])
+        return [(int(t), self.command_name(int(c))) for t, c in zip(terms, cmds)]
+
+    # -- stepping -------------------------------------------------------------
+
+    def step(self, n_ticks: int = 1) -> None:
+        with self._lock:
+            for _ in range(n_ticks):
+                if self._pending:
+                    inject = np.full(
+                        (self.cfg.n_groups, self.cfg.n_nodes), _NO_CMD, dtype=np.int32
+                    )
+                    for (g, n), cid in self._pending.items():
+                        inject[g, n - 1] = cid
+                    self._pending.clear()
+                    self._state = self._tick_with_inject(
+                        self._state, jnp.asarray(inject)
+                    )
+                else:
+                    self._state = self._tick_plain(self._state)
+
+    # -- introspection --------------------------------------------------------
+
+    @property
+    def tick_count(self) -> int:
+        with self._lock:
+            return int(self._state.tick)
+
+    @property
+    def state(self) -> RaftState:
+        with self._lock:
+            return self._state
+
+    def node_status(self, group: int, node: int) -> dict:
+        self._check_addr(group, node)
+        with self._lock:
+            st = self._state
+            i = node - 1
+            return {
+                "group": group,
+                "node": node,
+                "role": ["FOLLOWER", "CANDIDATE", "LEADER"][int(st.role[group, i])],
+                "term": int(st.term[group, i]),
+                "voted_for": int(st.voted_for[group, i]),
+                "commit": int(st.commit[group, i]),
+                "last_index": int(st.last_index[group, i]),
+                "tick": int(st.tick),
+            }
+
+    def leaders(self, group: int) -> List[int]:
+        """Node ids currently LEADER in `group` (normally 0 or 1 of them)."""
+        with self._lock:
+            roles = np.asarray(self._state.role[group])
+        return [int(i) + 1 for i in np.nonzero(roles == LEADER)[0]]
+
+    def leaders_all(self, max_groups: Optional[int] = None) -> Dict[int, List[int]]:
+        """{group: [leader node ids]} in ONE lock hold / device read."""
+        with self._lock:
+            roles = np.asarray(self._state.role)
+        ng = roles.shape[0] if max_groups is None else min(roles.shape[0], max_groups)
+        return {
+            g: [int(i) + 1 for i in np.nonzero(roles[g] == LEADER)[0]]
+            for g in range(ng)
+        }
+
+    # -- persistence (state arrays + the host-side vocabulary) ---------------
+
+    def save(self, path: str) -> None:
+        """Checkpoint state AND vocabulary — entries() of a restored Simulator
+        renders identical strings (utils/checkpoint.py carries the extra dict)."""
+        from raft_kotlin_tpu.utils import checkpoint
+
+        with self._lock:
+            checkpoint.save(path, self._state, self.cfg,
+                            extra={"vocab": self._rvocab})
+
+    @classmethod
+    def restore(cls, path: str) -> "Simulator":
+        from raft_kotlin_tpu.utils import checkpoint
+
+        state, cfg, extra = checkpoint.load_with_extra(path)
+        sim = cls(cfg, state=state)
+        for word in extra.get("vocab", []):
+            sim.intern(word)
+        return sim
+
+    def _check_addr(self, group: int, node: int) -> None:
+        if not (0 <= group < self.cfg.n_groups):
+            raise IndexError(f"group {group} out of range [0, {self.cfg.n_groups})")
+        if not (1 <= node <= self.cfg.n_nodes):
+            raise IndexError(f"node {node} out of range [1, {self.cfg.n_nodes}]")
