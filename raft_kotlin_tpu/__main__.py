@@ -27,6 +27,10 @@ def _add_cfg_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--log-capacity", type=int, default=64)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--p-drop", type=float, default=0.0)
+    p.add_argument("--p-crash", type=float, default=0.0)
+    p.add_argument("--p-restart", type=float, default=0.0)
+    p.add_argument("--p-link-fail", type=float, default=0.0)
+    p.add_argument("--p-link-heal", type=float, default=0.0)
     p.add_argument("--cmd-period", type=int, default=0)
     p.add_argument("--stress", type=int, default=1,
                    help="divide all pacing constants by this factor")
@@ -41,6 +45,10 @@ def _cfg_from(args) -> "RaftConfig":
         log_capacity=args.log_capacity,
         seed=args.seed,
         p_drop=args.p_drop,
+        p_crash=args.p_crash,
+        p_restart=args.p_restart,
+        p_link_fail=args.p_link_fail,
+        p_link_heal=args.p_link_heal,
         cmd_period=args.cmd_period,
     )
     return cfg.stressed(args.stress) if args.stress > 1 else cfg

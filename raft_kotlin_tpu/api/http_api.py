@@ -9,7 +9,8 @@ simulation; routes are addressed by (group, node):
     GET /                                  -> simulation status (tick, groups, leaders)
     GET /{g}/{n}/                          -> "Server n log [...]" (reference GET /)
     GET /{g}/{n}/cmd/{command}             -> queue command on (g, n) (reference GET /cmd/)
-    GET /{g}/{n}/status                    -> role/term/commit/lastIndex JSON
+    GET /{g}/{n}/status                    -> up/role/term/commit/lastIndex JSON
+    GET /{g}/{n}/crash, /{g}/{n}/restart   -> queue a §9 fault event on (g, n)
     GET /step/{k}                          -> advance k ticks (manual-clock mode)
 
 With tick_hz > 0 a daemon thread advances the simulation in wall-clock time (the
@@ -32,6 +33,7 @@ from raft_kotlin_tpu.api.simulator import Simulator
 _ROUTE_LOG = re.compile(r"^/(\d+)/(\d+)/?$")
 _ROUTE_CMD = re.compile(r"^/(\d+)/(\d+)/cmd/([^/]+)$")
 _ROUTE_STATUS = re.compile(r"^/(\d+)/(\d+)/status$")
+_ROUTE_FAULT = re.compile(r"^/(\d+)/(\d+)/(crash|restart)$")
 _ROUTE_STEP = re.compile(r"^/step/(\d+)$")
 
 MAX_STEP_PER_REQUEST = 100_000
@@ -97,6 +99,11 @@ class RaftHTTPServer:
                         return self._send(
                             200, json.dumps(sim.node_status(g, n)), "application/json"
                         )
+                    m = _ROUTE_FAULT.match(self.path)
+                    if m:
+                        g, n, verb = int(m[1]), int(m[2]), m[3]
+                        getattr(sim, verb)(g, n)
+                        return self._send(200, f"Server {n} {verb} queued")
                     m = _ROUTE_STEP.match(self.path)
                     if m:
                         k = int(m[1])

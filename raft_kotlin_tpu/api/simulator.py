@@ -45,11 +45,14 @@ class Simulator:
         self._lock = threading.RLock()
         self._state = state if state is not None else init_state(cfg)
         tick = make_tick(cfg)
-        self._tick_with_inject = jax.jit(tick)
-        self._tick_plain = jax.jit(lambda s: tick(s, None))
+        # One jitted callable; None-ness of the optional args is static, so each of
+        # the four (inject?, fault_cmd?) combinations traces once and is cached.
+        self._tick = jax.jit(tick)
         # Pending phase-0 injections for the next tick: {(g, n): cmd_id} — last write
         # wins per (group, node), like back-to-back HTTP posts within one tick window.
         self._pending: Dict[Tuple[int, int], int] = {}
+        # Pending phase-F fault commands for the next tick: {(g, n): 1 crash | 2 restart}.
+        self._pending_faults: Dict[Tuple[int, int], int] = {}
         # Command vocabulary: string <-> int32 id (ids start at 0; -1 = none).
         self._vocab: Dict[str, int] = {}
         self._rvocab: List[str] = []
@@ -95,21 +98,40 @@ class Simulator:
 
     # -- stepping -------------------------------------------------------------
 
+    def crash(self, group: int, node: int) -> None:
+        """Kill (group, node) at the next tick (SEMANTICS.md §9 phase F): it stops
+        participating until restart(); peers see only swallowed RPC failures, exactly
+        like a dead process in the reference (RaftServer.kt:170-172)."""
+        self._check_addr(group, node)
+        with self._lock:
+            self._pending_faults[(group, node)] = 1
+
+    def restart(self, group: int, node: int) -> None:
+        """Restart a crashed (group, node) at the next tick: it rejoins with ALL state
+        wiped (term 0, empty log — reference quirk l, RaftServer.kt:35-48)."""
+        self._check_addr(group, node)
+        with self._lock:
+            self._pending_faults[(group, node)] = 2
+
     def step(self, n_ticks: int = 1) -> None:
         with self._lock:
             for _ in range(n_ticks):
+                inject = fault_cmd = None
                 if self._pending:
-                    inject = np.full(
+                    arr = np.full(
                         (self.cfg.n_groups, self.cfg.n_nodes), _NO_CMD, dtype=np.int32
                     )
                     for (g, n), cid in self._pending.items():
-                        inject[g, n - 1] = cid
+                        arr[g, n - 1] = cid
                     self._pending.clear()
-                    self._state = self._tick_with_inject(
-                        self._state, jnp.asarray(inject)
-                    )
-                else:
-                    self._state = self._tick_plain(self._state)
+                    inject = jnp.asarray(arr)
+                if self._pending_faults:
+                    arr = np.zeros((self.cfg.n_groups, self.cfg.n_nodes), dtype=np.int32)
+                    for (g, n), ev in self._pending_faults.items():
+                        arr[g, n - 1] = ev
+                    self._pending_faults.clear()
+                    fault_cmd = jnp.asarray(arr)
+                self._state = self._tick(self._state, inject, fault_cmd)
 
     # -- introspection --------------------------------------------------------
 
@@ -131,6 +153,7 @@ class Simulator:
             return {
                 "group": group,
                 "node": node,
+                "up": bool(st.up[group, i]),
                 "role": ["FOLLOWER", "CANDIDATE", "LEADER"][int(st.role[group, i])],
                 "term": int(st.term[group, i]),
                 "voted_for": int(st.voted_for[group, i]),
@@ -141,6 +164,7 @@ class Simulator:
 
     def leaders(self, group: int) -> List[int]:
         """Node ids currently LEADER in `group` (normally 0 or 1 of them)."""
+        self._check_addr(group, 1)
         with self._lock:
             roles = np.asarray(self._state.role[group])
         return [int(i) + 1 for i in np.nonzero(roles == LEADER)[0]]

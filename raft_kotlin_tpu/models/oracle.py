@@ -93,6 +93,7 @@ class OracleNode:
         self._draws = draws        # {kind: np.ndarray[K]} pre-drawn for (group, node);
                                    # grown on demand by _draw()
 
+        self.up = True             # SEMANTICS.md §9 process liveness
         self.term = 0
         self.voted_for = -1
         self.role = FOLLOWER
@@ -153,6 +154,28 @@ class OracleNode:
     def last_log_term(self) -> int:
         # RaftServer.kt:202
         return 0 if self.log.last_index == 0 else self.log.get_term(self.log.last_index - 1)
+
+    def restart(self) -> None:
+        """SEMANTICS.md §9 restart: wipe everything except the RNG counters (quirk l —
+        the reference persists nothing, RaftServer.kt:35-48); re-arm the timer."""
+        self.term = 0
+        self.voted_for = -1
+        self.role = FOLLOWER
+        self.commit = 0
+        self.log = OracleLog(self.cfg.log_capacity)
+        self.round_state = IDLE
+        self.round_left = 0
+        self.round_age = 0
+        self.votes = 0
+        self.responses = 0
+        self.responded = [False] * self.cfg.n_nodes
+        self.bo_left = 0
+        self.next_index = [0] * self.cfg.n_nodes
+        self.match_index = [0] * self.cfg.n_nodes
+        self.hb_armed = False
+        self.hb_left = 0
+        self.up = True
+        self.reset_election_timer()
 
 
 @dataclasses.dataclass
@@ -228,38 +251,77 @@ class OracleGroup:
             OracleNode(i + 1, group, cfg, draws[i]) for i in range(cfg.n_nodes)
         ]
         self.tick_count = 0
+        # Persistent directed-link health (SEMANTICS.md §9); [s-1][r-1].
+        self.link_up = [[True] * cfg.n_nodes for _ in range(cfg.n_nodes)]
         # External command schedule: {tick: [(node_id, cmd), ...]}
         self.schedule: dict[int, list[tuple[int, int]]] = {}
+        # Driver fault commands: {tick: [(node_id, "crash"|"restart"), ...]}
+        self.fault_schedule: dict[int, list[tuple[int, str]]] = {}
 
     def inject(self, tick: int, node_id: int, cmd: int) -> None:
         self.schedule.setdefault(tick, []).append((node_id, cmd))
 
+    def crash(self, tick: int, node_id: int) -> None:
+        self.fault_schedule.setdefault(tick, []).append((node_id, "crash"))
+
+    def restart(self, tick: int, node_id: int) -> None:
+        self.fault_schedule.setdefault(tick, []).append((node_id, "restart"))
+
     # -- phases ---------------------------------------------------------------
 
-    def tick(self, edge_ok=None) -> None:
+    def tick(self, edge_ok=None, faults=None) -> None:
         """Advance one tick. edge_ok: optional (N, N) bool array, [s-1, r-1] = message
-        s->r survives (SEMANTICS.md §4); None = all alive."""
+        s->r survives the iid drop (SEMANTICS.md §4); None = all survive. faults:
+        optional dict of random event masks (SEMANTICS.md §9) with keys
+        "crash"/"restart" ((N,) bool) and "link_fail"/"link_heal" ((N, N) bool)."""
         cfg = self.cfg
         t = self.tick_count
         nodes = self.nodes
 
         def ok(s: int, r: int) -> bool:
+            # §9 effective edge health: iid survival ∧ link health ∧ both ends up.
+            if not (nodes[s - 1].up and nodes[r - 1].up and self.link_up[s - 1][r - 1]):
+                return False
             if edge_ok is None:
                 return True
             return bool(edge_ok[s - 1][r - 1])
 
+        # Phase F — fault events (SEMANTICS.md §9), against pre-phase `up`.
+        cmds = {n_id: kind for n_id, kind in self.fault_schedule.get(t, [])}
+        if faults is not None or cmds:
+            was_up = [n.up for n in nodes]
+            for n in nodes:
+                crash_m = bool(faults["crash"][n.id - 1]) if faults else False
+                restart_m = bool(faults["restart"][n.id - 1]) if faults else False
+                cmd = cmds.get(n.id)
+                if was_up[n.id - 1] and (crash_m or cmd == "crash"):
+                    n.up = False
+                elif not was_up[n.id - 1] and (restart_m or cmd == "restart"):
+                    n.restart()
+        if faults is not None:
+            for si in range(cfg.n_nodes):
+                for ri in range(cfg.n_nodes):
+                    if self.link_up[si][ri]:
+                        self.link_up[si][ri] = not bool(faults["link_fail"][si][ri])
+                    else:
+                        self.link_up[si][ri] = bool(faults["link_heal"][si][ri])
+
         # Phase 0 — command injection (RaftServer.kt:100-107, quirk k).
         if cfg.cmd_period > 0 and t % cfg.cmd_period == 0 and t > 0:
             n = nodes[cfg.cmd_node - 1]
-            n.log.add(n.log.last_index, n.term, t)
+            if n.up:
+                n.log.add(n.log.last_index, n.term, t)
         for node_id, cmd in self.schedule.get(t, []):
             n = nodes[node_id - 1]
-            n.log.add(n.log.last_index, n.term, cmd)
+            if n.up:
+                n.log.add(n.log.last_index, n.term, cmd)
 
         # Phase 1 — timers. The two countdowns are independent: a demoted backing-off
         # candidate has an armed election timer AND a live delay() (SEMANTICS.md §5).
         start_round = [False] * cfg.n_nodes
         for n in nodes:
+            if not n.up:
+                continue  # §9: a dead process's timers are frozen
             if n.el_armed:
                 n.el_left -= 1
                 if n.el_left <= 0:
@@ -314,7 +376,7 @@ class OracleGroup:
 
         # Phase 4 — round conclusions.
         for n in nodes:
-            if n.round_state != ACTIVE:
+            if n.round_state != ACTIVE or not n.up:
                 continue
             if n.responses >= cfg.majority or n.round_left <= 0:
                 if n.role == CANDIDATE and n.votes >= cfg.majority:
@@ -336,7 +398,7 @@ class OracleGroup:
 
         # Phase 5 — append / heartbeat.
         for l in nodes:
-            if not l.hb_armed:
+            if not (l.hb_armed and l.up):
                 continue
             if l.hb_left > 0:
                 l.hb_left -= 1
@@ -393,14 +455,17 @@ class OracleGroup:
             "last_index": [n.log.last_index for n in self.nodes],
             "voted_for": [n.voted_for for n in self.nodes],
             "rounds": [n.rounds for n in self.nodes],
+            "up": [int(n.up) for n in self.nodes],
         }
 
-    def run(self, n_ticks: int, edge_ok_fn=None, trace: bool = True):
-        """Step n_ticks; returns list of per-tick snapshots (post-tick) if trace."""
+    def run(self, n_ticks: int, edge_ok_fn=None, faults_fn=None, trace: bool = True):
+        """Step n_ticks; returns list of per-tick snapshots (post-tick) if trace.
+        edge_ok_fn/faults_fn map tick -> the corresponding tick() argument."""
         out = []
         for _ in range(n_ticks):
             edge_ok = edge_ok_fn(self.tick_count) if edge_ok_fn is not None else None
-            self.tick(edge_ok)
+            faults = faults_fn(self.tick_count) if faults_fn is not None else None
+            self.tick(edge_ok, faults)
             if trace:
                 out.append(self.snapshot())
         return out
@@ -440,6 +505,41 @@ def predraw(cfg: RaftConfig, groups=None, k: int | None = None):
 def _edge_mask_all_groups(seed: int, tick: int, shape: tuple, p_drop: float):
     base = rngmod.base_key(seed)
     return np.asarray(rngmod.edge_ok_mask(base, tick, shape, p_drop))
+
+
+@functools.lru_cache(maxsize=None)
+def _fault_masks_all_groups(seed: int, tick: int, G: int, N: int, p_crash: float,
+                            p_restart: float, p_link_fail: float, p_link_heal: float):
+    base = rngmod.base_key(seed)
+    return {
+        "crash": np.asarray(rngmod.event_mask(base, rngmod.KIND_CRASH, tick, (G, N), p_crash)),
+        "restart": np.asarray(
+            rngmod.event_mask(base, rngmod.KIND_RESTART, tick, (G, N), p_restart)
+        ),
+        "link_fail": np.asarray(
+            rngmod.event_mask(base, rngmod.KIND_LINK_FAIL, tick, (G, N, N), p_link_fail)
+        ),
+        "link_heal": np.asarray(
+            rngmod.event_mask(base, rngmod.KIND_LINK_HEAL, tick, (G, N, N), p_link_heal)
+        ),
+    }
+
+
+def make_faults_fn(cfg: RaftConfig, group: int):
+    """Per-tick §9 fault-event masks for one group, sliced from the canonical shaped
+    draws so they match the kernel's bit-for-bit (same pattern as make_edge_ok_fn)."""
+    if not (cfg.p_crash > 0 or cfg.p_restart > 0
+            or cfg.p_link_fail > 0 or cfg.p_link_heal > 0):
+        return None
+
+    def fn(tick: int):
+        m = _fault_masks_all_groups(
+            cfg.seed, tick, cfg.n_groups, cfg.n_nodes,
+            cfg.p_crash, cfg.p_restart, cfg.p_link_fail, cfg.p_link_heal,
+        )
+        return {k: v[group] for k, v in m.items()}
+
+    return fn
 
 
 def make_edge_ok_fn(cfg: RaftConfig, group: int):

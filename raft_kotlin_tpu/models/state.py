@@ -59,6 +59,11 @@ class RaftState:
     hb_armed: jax.Array     # (G, N) bool
     hb_left: jax.Array      # (G, N) i32
 
+    # Fault-model state (SEMANTICS.md §9): process liveness + persistent directed-link
+    # health. Both all-True at boot.
+    up: jax.Array           # (G, N) bool
+    link_up: jax.Array      # (G, N, N) bool; [g, s-1, r-1]
+
     # Counted-draw cursors (SEMANTICS.md §4).
     t_ctr: jax.Array        # (G, N) i32
     b_ctr: jax.Array        # (G, N) i32
@@ -101,6 +106,8 @@ def init_state(cfg: RaftConfig) -> RaftState:
         match_index=zi(G, N, N),
         hb_armed=zb(G, N),
         hb_left=zi(G, N),
+        up=jnp.ones((G, N), dtype=bool),
+        link_up=jnp.ones((G, N, N), dtype=bool),
         t_ctr=jnp.ones((G, N), dtype=jnp.int32),
         b_ctr=zi(G, N),
         rounds=zi(G, N),

@@ -45,11 +45,22 @@ def make_tick(cfg: RaftConfig):
     """
     N, C, maj = cfg.n_nodes, cfg.log_capacity, cfg.majority
     base = rngmod.base_key(cfg.seed)
+    # Static key prefixes, computed once per simulation (rng.grid_keys): the per-draw
+    # cost inside the tick drops to fold_in(counter) + randint.
+    tkeys = rngmod.grid_keys(base, rngmod.KIND_TIMEOUT, cfg.n_groups, N)
+    bkeys = rngmod.grid_keys(base, rngmod.KIND_BACKOFF, cfg.n_groups, N)
 
-    def tick(state: RaftState, inject: Optional[jax.Array] = None) -> RaftState:
+    def tick(
+        state: RaftState,
+        inject: Optional[jax.Array] = None,
+        fault_cmd: Optional[jax.Array] = None,
+    ) -> RaftState:
         s = {f.name: getattr(state, f.name) for f in dataclasses.fields(state)}
         G = s["term"].shape[0]
-        g_ids = jnp.arange(G, dtype=_I32)
+        assert G == cfg.n_groups, (
+            f"state has {G} groups but make_tick was built for {cfg.n_groups}"
+        )
+        lane = jnp.arange(C, dtype=_I32)
         t = s["tick"]
 
         # -- small helpers over the mutable dict --------------------------------
@@ -62,75 +73,121 @@ def make_tick(cfg: RaftConfig):
             s[name] = s[name].at[:, n - 1].set(jnp.where(mask, vals, cur))
 
         def log_gather(name, n, idx):
-            # (G,) gather of physical slot idx from node n; garbage where idx is
-            # invalid — callers must guard with masks.
-            ic = jnp.clip(idx, 0, C - 1)
-            return jnp.take_along_axis(s[name][:, n - 1, :], ic[:, None], axis=1)[:, 0]
+            # (G,) read of physical slot idx from node n, as a one-hot contraction
+            # over the C lane axis (no per-row gather op — TPU-friendly); 0 where idx
+            # is out of [0, C) — callers must guard with masks.
+            arr = s[name][:, n - 1, :]
+            oh = lane[None, :] == idx[:, None]
+            return jnp.sum(jnp.where(oh, arr, 0), axis=1)
 
         def log_add(n, i, term_v, cmd_v, mask):
             # SEMANTICS.md §3 add(): physical append / reject / overwrite-truncate.
+            # One-hot masked write over the C lane axis instead of a scatter; the
+            # write slot is always in-range where the write mask holds (append needs
+            # phys_len < C; overwrite needs i < last_index <= C).
             li = col("last_index", n)
             pl = col("phys_len", n)
             app = mask & (i == li) & (pl < C)
             ovw = mask & (i < li) & (i >= 0)
-            wmask = app | ovw
-            slot = jnp.clip(jnp.where(app, pl, i), 0, C - 1)
-            cur_t = log_gather("log_term", n, slot)
-            cur_c = log_gather("log_cmd", n, slot)
-            s["log_term"] = (
-                s["log_term"].at[g_ids, n - 1, slot].set(jnp.where(wmask, term_v, cur_t))
+            slot = jnp.where(app, pl, i)
+            oh = (lane[None, :] == slot[:, None]) & (app | ovw)[:, None]
+            lt = s["log_term"][:, n - 1, :]
+            lc = s["log_cmd"][:, n - 1, :]
+            s["log_term"] = s["log_term"].at[:, n - 1, :].set(
+                jnp.where(oh, term_v[:, None], lt)
             )
-            s["log_cmd"] = (
-                s["log_cmd"].at[g_ids, n - 1, slot].set(jnp.where(wmask, cmd_v, cur_c))
+            s["log_cmd"] = s["log_cmd"].at[:, n - 1, :].set(
+                jnp.where(oh, cmd_v[:, None], lc)
             )
             setcol("last_index", n, app | ovw, jnp.where(app, li + 1, i + 1))
             setcol("phys_len", n, app, pl + 1)
 
-        def draw_col(kind, n, ctr, lo, hi):
-            f = lambda g, c: rngmod.draw_uniform(base, kind, g, n, c, lo, hi)
-            return jax.vmap(f)(g_ids, ctr)
-
         def reset_el_timer_col(n, mask):
             # SEMANTICS.md §7: one fresh counted draw per reset, mask-gated.
             ctr = col("t_ctr", n)
-            d = draw_col(rngmod.KIND_TIMEOUT, n, ctr, cfg.el_lo, cfg.el_hi)
+            d = rngmod.draw_uniform_keyed(tkeys[:, n - 1], ctr, cfg.el_lo, cfg.el_hi)
             setcol("el_left", n, mask, d)
             s["el_armed"] = s["el_armed"].at[:, n - 1].set(col("el_armed", n) | mask)
             setcol("t_ctr", n, mask, ctr + 1)
 
         def reset_el_timer_grid(mask):
-            d = rngmod.draw_uniform_grid(
-                base, rngmod.KIND_TIMEOUT, s["t_ctr"], cfg.el_lo, cfg.el_hi
-            )
+            d = rngmod.draw_uniform_keyed(tkeys, s["t_ctr"], cfg.el_lo, cfg.el_hi)
             s["el_left"] = jnp.where(mask, d, s["el_left"])
             s["el_armed"] = s["el_armed"] | mask
             s["t_ctr"] = s["t_ctr"] + mask.astype(_I32)
 
+        # -- phase F: fault events (SEMANTICS.md §9) ----------------------------
+        # `fault_cmd` is an optional (G, N) int32 of driver-scheduled events
+        # (0 = none, 1 = crash, 2 = restart) OR-ed with the random masks.
+
+        has_faults = (
+            cfg.p_crash > 0 or cfg.p_restart > 0 or fault_cmd is not None
+        )
+        if has_faults:
+            crash_m = rngmod.event_mask(base, rngmod.KIND_CRASH, t, (G, N), cfg.p_crash)
+            restart_m = rngmod.event_mask(
+                base, rngmod.KIND_RESTART, t, (G, N), cfg.p_restart
+            )
+            if fault_cmd is not None:
+                crash_m = crash_m | (fault_cmd == 1)
+                restart_m = restart_m | (fault_cmd == 2)
+            crash_ev = s["up"] & crash_m
+            restart_ev = ~s["up"] & restart_m
+            s["up"] = (s["up"] & ~crash_ev) | restart_ev
+            rst = restart_ev
+            zero = jnp.zeros((), _I32)
+            s["term"] = jnp.where(rst, zero, s["term"])
+            s["voted_for"] = jnp.where(rst, -1, s["voted_for"])
+            s["role"] = jnp.where(rst, FOLLOWER, s["role"])
+            s["commit"] = jnp.where(rst, zero, s["commit"])
+            s["last_index"] = jnp.where(rst, zero, s["last_index"])
+            s["phys_len"] = jnp.where(rst, zero, s["phys_len"])
+            s["round_state"] = jnp.where(rst, IDLE, s["round_state"])
+            for f in ("votes", "responses", "round_left", "round_age", "bo_left"):
+                s[f] = jnp.where(rst, zero, s[f])
+            s["responded"] = jnp.where(rst[:, :, None], False, s["responded"])
+            s["next_index"] = jnp.where(rst[:, :, None], zero, s["next_index"])
+            s["match_index"] = jnp.where(rst[:, :, None], zero, s["match_index"])
+            s["hb_armed"] = s["hb_armed"] & ~rst
+            s["hb_left"] = jnp.where(rst, zero, s["hb_left"])
+            reset_el_timer_grid(rst)
+        if cfg.p_link_fail > 0 or cfg.p_link_heal > 0:
+            lf = rngmod.event_mask(
+                base, rngmod.KIND_LINK_FAIL, t, (G, N, N), cfg.p_link_fail
+            )
+            lh = rngmod.event_mask(
+                base, rngmod.KIND_LINK_HEAL, t, (G, N, N), cfg.p_link_heal
+            )
+            s["link_up"] = jnp.where(s["link_up"], ~lf, lh)
+
+        # Effective edge health (§9): iid survival ∧ link health ∧ both ends up.
         edge = rngmod.edge_ok_mask(base, t, (G, N, N), cfg.p_drop)
+        edge = edge & s["link_up"] & s["up"][:, :, None] & s["up"][:, None, :]
+        up = s["up"]
 
         # -- phase 0: command injection (quirk k) -------------------------------
 
         if cfg.cmd_period > 0:
             due = (t % cfg.cmd_period == 0) & (t > 0)
             n = cfg.cmd_node
-            mask = jnp.broadcast_to(due, (G,))
+            mask = jnp.broadcast_to(due, (G,)) & col("up", n)
             log_add(n, col("last_index", n), col("term", n), jnp.broadcast_to(t, (G,)), mask)
         if inject is not None:
             for n in range(1, N + 1):
                 cmd = inject[:, n - 1]
-                log_add(n, col("last_index", n), col("term", n), cmd, cmd >= 0)
+                log_add(n, col("last_index", n), col("term", n), cmd, (cmd >= 0) & col("up", n))
 
         # -- phase 1: timers (independent countdowns) ---------------------------
 
-        armed = s["el_armed"]
+        armed = s["el_armed"] & up
         left = s["el_left"] - armed.astype(_I32)
         fire = armed & (left <= 0)
         s["el_left"] = left
-        s["el_armed"] = armed & ~fire
+        s["el_armed"] = s["el_armed"] & ~fire
         s["role"] = jnp.where(fire, CANDIDATE, s["role"])
         start_round = fire
 
-        in_bo = s["round_state"] == BACKOFF
+        in_bo = (s["round_state"] == BACKOFF) & up
         bleft = s["bo_left"] - in_bo.astype(_I32)
         bfire = in_bo & (bleft <= 0)
         s["bo_left"] = bleft
@@ -201,7 +258,7 @@ def make_tick(cfg: RaftConfig):
 
         # -- phase 4: round conclusions -----------------------------------------
 
-        act = s["round_state"] == ACTIVE
+        act = (s["round_state"] == ACTIVE) & up
         concl = act & ((s["responses"] >= maj) | (s["round_left"] <= 0))
         is_cand = s["role"] == CANDIDATE
         win = concl & is_cand & (s["votes"] >= maj)
@@ -215,9 +272,7 @@ def make_tick(cfg: RaftConfig):
         s["hb_armed"] = s["hb_armed"] | win
         s["hb_left"] = jnp.where(win, 0, s["hb_left"])  # initial delay 0
         s["round_state"] = jnp.where(win | dem, IDLE, s["round_state"])
-        bdraw = rngmod.draw_uniform_grid(
-            base, rngmod.KIND_BACKOFF, s["b_ctr"], cfg.bo_lo, cfg.bo_hi
-        )
+        bdraw = rngmod.draw_uniform_keyed(bkeys, s["b_ctr"], cfg.bo_lo, cfg.bo_hi)
         s["round_state"] = jnp.where(lose, BACKOFF, s["round_state"])
         s["bo_left"] = jnp.where(lose, bdraw, s["bo_left"])
         s["b_ctr"] = s["b_ctr"] + lose.astype(_I32)
@@ -229,14 +284,15 @@ def make_tick(cfg: RaftConfig):
         # -- phase 5: append / heartbeat ----------------------------------------
 
         for l in range(1, N + 1):
-            armed = col("hb_armed", l)
+            raw_armed = col("hb_armed", l)
+            armed = raw_armed & col("up", l)
             waiting = armed & (col("hb_left", l) > 0)
             fire = armed & ~waiting
             setcol("hb_left", l, waiting, col("hb_left", l) - 1)
             l_is_f = col("role", l) == FOLLOWER
             # FOLLOWER cancels future firings but this round still goes out
             # (TimerTask.cancel semantics, RaftServer.kt:117).
-            s["hb_armed"] = s["hb_armed"].at[:, l - 1].set(armed & ~(fire & l_is_f))
+            s["hb_armed"] = s["hb_armed"].at[:, l - 1].set(raw_armed & ~(fire & l_is_f))
             setcol("hb_left", l, fire & ~l_is_f, cfg.hb_ticks - 1)
             for p in range(1, N + 1):
                 li_l = col("last_index", l)
@@ -327,6 +383,7 @@ def make_run(cfg: RaftConfig, n_ticks: int, trace: bool = True):
                 "last_index": st.last_index,
                 "voted_for": st.voted_for,
                 "rounds": st.rounds,
+                "up": st.up,
             }
         else:
             out = jnp.sum((st.role == LEADER).astype(_I32), axis=1)

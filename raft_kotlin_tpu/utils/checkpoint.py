@@ -32,7 +32,7 @@ from raft_kotlin_tpu.utils.config import RaftConfig
 _HEADER_KEY = "__raft_config_json__"
 _EXTRA_KEY = "__raft_extra_json__"
 _VERSION_KEY = "__raft_ckpt_version__"
-_VERSION = 1
+_VERSION = 2  # v2: +up/+link_up fault-model state fields (SEMANTICS.md §9)
 
 
 def save(path: str, state: RaftState, cfg: RaftConfig, extra: Optional[dict] = None) -> None:
@@ -94,7 +94,7 @@ def load_with_extra(
 def _load_impl(path, expect_cfg, sharding):
     with np.load(path) as z:
         version = int(z[_VERSION_KEY])
-        if version != _VERSION:
+        if version not in (1, _VERSION):
             raise ValueError(f"checkpoint version {version} != supported {_VERSION}")
         cfg_dict = json.loads(bytes(z[_HEADER_KEY].tobytes()).decode())
         extra = (
@@ -103,8 +103,16 @@ def _load_impl(path, expect_cfg, sharding):
             else {}
         )
         arrays = {
-            f.name: z[f.name] for f in dataclasses.fields(RaftState)
+            f.name: z[f.name]
+            for f in dataclasses.fields(RaftState)
+            if f.name in z
         }
+    if version == 1:
+        # v1 predates the fault-model fields; their boot values (everything healthy,
+        # matching init_state) are the only state a v1 run can have been in.
+        G, N = arrays["term"].shape
+        arrays.setdefault("up", np.ones((G, N), dtype=bool))
+        arrays.setdefault("link_up", np.ones((G, N, N), dtype=bool))
     cfg = RaftConfig(**cfg_dict)
     if expect_cfg is not None and expect_cfg != cfg:
         raise ValueError(

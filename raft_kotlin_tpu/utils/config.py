@@ -36,8 +36,16 @@ class RaftConfig:
     cmd_period: int = 0
     cmd_node: int = 1
 
-    # Fault injection: per-tick, per-directed-edge message drop probability.
+    # Fault injection (SEMANTICS.md §§4, 9). p_drop: per-tick iid drop probability per
+    # directed edge. p_crash/p_restart: per-tick process crash / rejoin probability per
+    # node (restart wipes all node state — reference quirk l, RaftServer.kt:35-48).
+    # p_link_fail/p_link_heal: per-tick transition probabilities of the persistent
+    # directed-link health mask (partitions).
     p_drop: float = 0.0
+    p_crash: float = 0.0
+    p_restart: float = 0.0
+    p_link_fail: float = 0.0
+    p_link_heal: float = 0.0
 
     seed: int = 0
 

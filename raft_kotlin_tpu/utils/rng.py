@@ -17,6 +17,10 @@ jax.config.update("jax_threefry_partitionable", True)
 KIND_TIMEOUT = 0
 KIND_BACKOFF = 1
 KIND_FAULT = 2
+KIND_CRASH = 3
+KIND_RESTART = 4
+KIND_LINK_FAIL = 5
+KIND_LINK_HEAL = 6
 
 
 def base_key(seed: int) -> jax.Array:
@@ -51,6 +55,34 @@ def draw_uniform_grid(
     return jax.vmap(jax.vmap(f))(g_idx, n_idx, ctrs)
 
 
+def grid_keys(base: jax.Array, kind: int, G: int, N: int) -> jax.Array:
+    """(G, N) array of the STATIC key prefix of §4's derivation:
+    grid_keys[g, i] == fold_in(fold_in(fold_in(base, kind), g), i+1).
+
+    fold_in composes one argument at a time, so folding the per-draw counter into
+    grid_keys[g, i] afterwards yields bit-identical keys to the full chain — this
+    precomputes the 3 static fold_ins once per simulation instead of per draw (the
+    hot tick kernel then pays 1 fold_in + 1 randint per draw instead of 4 + 1).
+    """
+    g_idx = jnp.arange(G, dtype=jnp.int32)
+    n_idx = jnp.arange(1, N + 1, dtype=jnp.int32)
+    kk = jax.random.fold_in(base, kind)
+    f = lambda g, n: jax.random.fold_in(jax.random.fold_in(kk, g), n)
+    return jax.vmap(lambda g: jax.vmap(lambda n: f(g, n))(n_idx))(g_idx)
+
+
+def draw_uniform_keyed(keys: jax.Array, ctrs: jax.Array, lo: int, hi: int) -> jax.Array:
+    """Inclusive-uniform draws from precomputed static-prefix keys (see grid_keys);
+    element [..] == draw_uniform(base, kind, g, n, ctrs[..], lo, hi) exactly.
+    Shape-polymorphic: keys and ctrs must have equal shapes."""
+    f = lambda k, c: jax.random.randint(
+        jax.random.fold_in(k, c), (), lo, hi + 1, dtype=jnp.int32
+    )
+    for _ in range(ctrs.ndim):
+        f = jax.vmap(f)
+    return f(keys, ctrs)
+
+
 def draw_uniform_counters(
     base: jax.Array, kind: int, g: int, n: int, ctrs, lo: int, hi: int
 ) -> jax.Array:
@@ -68,3 +100,13 @@ def edge_ok_mask(base: jax.Array, tick, shape: tuple, p_drop: float) -> jax.Arra
         return jnp.ones(shape, dtype=bool)
     k = jax.random.fold_in(jax.random.fold_in(base, KIND_FAULT), tick)
     return ~jax.random.bernoulli(k, p_drop, shape)
+
+
+def event_mask(base: jax.Array, kind: int, tick, shape: tuple, p: float) -> jax.Array:
+    """Shaped boolean event draw for tick `tick` (True = event fires). One draw per
+    (kind, tick), shared verbatim by oracle and kernel — the fault-event analogue of
+    `edge_ok_mask` (SEMANTICS.md §9: crash/restart/link-fail/link-heal events)."""
+    if p <= 0.0:
+        return jnp.zeros(shape, dtype=bool)
+    k = jax.random.fold_in(jax.random.fold_in(base, kind), tick)
+    return jax.random.bernoulli(k, p, shape)

@@ -6,11 +6,12 @@ the identical draw sequence. (The reference persists nothing; see checkpoint.py.
 """
 
 import dataclasses
+import jax
 
-import numpy as np
+from conftest import assert_states_equal
 import pytest
 
-from raft_kotlin_tpu.models.state import RaftState, init_state
+from raft_kotlin_tpu.models.state import init_state
 from raft_kotlin_tpu.ops.tick import make_run
 from raft_kotlin_tpu.utils import checkpoint
 from raft_kotlin_tpu.utils.config import RaftConfig
@@ -18,12 +19,6 @@ from raft_kotlin_tpu.utils.config import RaftConfig
 CFG = RaftConfig(
     n_groups=6, n_nodes=3, log_capacity=16, cmd_period=7, p_drop=0.1, seed=11
 ).stressed(10)
-
-
-def assert_states_equal(a: RaftState, b: RaftState):
-    for f in dataclasses.fields(RaftState):
-        av, bv = np.asarray(getattr(a, f.name)), np.asarray(getattr(b, f.name))
-        assert np.array_equal(av, bv), f"field {f.name} differs"
 
 
 def test_roundtrip_and_bit_exact_resume(tmp_path):
@@ -70,4 +65,24 @@ def test_load_with_sharding(tmp_path):
     assert restored.term.sharding.is_equivalent_to(
         state_sharding(mesh).term, restored.term.ndim
     )
+    assert_states_equal(st, restored)
+
+
+def test_v1_checkpoint_forward_migration(tmp_path):
+    # A v1 checkpoint (pre-fault-model) must load with up/link_up defaulted to
+    # all-healthy boot values (utils/checkpoint._load_impl migration).
+    import numpy as np
+
+    path = str(tmp_path / "ckpt.npz")
+    st = init_state(CFG)
+    checkpoint.save(path, st, CFG)
+    with np.load(path) as z:
+        arrays = dict(z)
+    del arrays["up"], arrays["link_up"]
+    arrays["__raft_ckpt_version__"] = np.asarray(1, dtype=np.int32)
+    np.savez_compressed(path, **arrays)
+
+    restored, cfg = checkpoint.load(path, expect_cfg=CFG)
+    assert bool(np.all(np.asarray(restored.up)))
+    assert bool(np.all(np.asarray(restored.link_up)))
     assert_states_equal(st, restored)

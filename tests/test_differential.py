@@ -8,12 +8,17 @@ Any mismatch prints the first diverging (tick, group, field) for debugging.
 import numpy as np
 import pytest
 
-from raft_kotlin_tpu.models.oracle import OracleGroup, make_edge_ok_fn, predraw
+from raft_kotlin_tpu.models.oracle import (
+    OracleGroup,
+    make_edge_ok_fn,
+    make_faults_fn,
+    predraw,
+)
 from raft_kotlin_tpu.models.state import init_state
 from raft_kotlin_tpu.ops.tick import make_run
 from raft_kotlin_tpu.utils.config import RaftConfig
 
-FIELDS = ("role", "term", "commit", "last_index", "voted_for", "rounds")
+FIELDS = ("role", "term", "commit", "last_index", "voted_for", "rounds", "up")
 
 
 def run_kernel(cfg: RaftConfig, n_ticks: int):
@@ -27,7 +32,8 @@ def run_oracles(cfg: RaftConfig, n_ticks: int):
     out = {k: np.zeros((n_ticks, cfg.n_groups, cfg.n_nodes), dtype=np.int64) for k in FIELDS}
     for g in range(cfg.n_groups):
         grp = OracleGroup(cfg, group=g, draws=draws[g])
-        snaps = grp.run(n_ticks, edge_ok_fn=make_edge_ok_fn(cfg, g))
+        snaps = grp.run(n_ticks, edge_ok_fn=make_edge_ok_fn(cfg, g),
+                        faults_fn=make_faults_fn(cfg, g))
         for ti, snap in enumerate(snaps):
             for k in FIELDS:
                 out[k][ti, g] = snap[k]

@@ -1,0 +1,146 @@
+"""Fault-model tests (SEMANTICS.md §9): random crash/restart and link faults must
+bit-match the oracle; deterministic driver-scheduled faults must produce the expected
+failover / rejoin behavior end-to-end."""
+
+import numpy as np
+
+from raft_kotlin_tpu.constants import FOLLOWER, LEADER
+from raft_kotlin_tpu.api.simulator import Simulator
+from raft_kotlin_tpu.models.oracle import OracleGroup, make_faults_fn, predraw
+from raft_kotlin_tpu.utils.config import RaftConfig
+
+from test_differential import assert_traces_match
+
+
+def test_crash_restart_bitmatch():
+    cfg = RaftConfig(
+        n_groups=6, n_nodes=3, seed=11, p_drop=0.05,
+        p_crash=0.02, p_restart=0.10, cmd_period=9,
+    ).stressed(10)
+    assert_traces_match(cfg, 300)
+
+
+def test_link_fault_bitmatch():
+    cfg = RaftConfig(
+        n_groups=6, n_nodes=3, seed=13,
+        p_link_fail=0.03, p_link_heal=0.15, cmd_period=11,
+    ).stressed(10)
+    assert_traces_match(cfg, 300)
+
+
+def _step_until(sim, pred, max_ticks, chunk=5):
+    for _ in range(0, max_ticks, chunk):
+        sim.step(chunk)
+        if pred():
+            return True
+    return pred()
+
+
+def test_leader_crash_failover_and_rejoin():
+    cfg = RaftConfig(n_groups=2, n_nodes=3, log_capacity=32, seed=2).stressed(10)
+    sim = Simulator(cfg)
+    assert _step_until(sim, lambda: sim.leaders(0), cfg.el_hi + 60), "no initial leader"
+    old = sim.leaders(0)[0]
+
+    sim.crash(0, old)
+    sim.step(1)
+    st = sim.node_status(0, old)
+    assert st["up"] is False
+
+    # Failover: a NEW leader (not `old`) within ~timeout + round window.
+    deadline = cfg.el_hi + cfg.round_ticks + 40
+    assert _step_until(
+        sim, lambda: any(l != old for l in sim.leaders(0)), deadline
+    ), "no failover leader"
+    new = [l for l in sim.leaders(0) if l != old][0]
+    assert sim.node_status(0, old)["up"] is False  # still down
+
+    # Rejoin: restart wipes state (quirk l) and the node catches back up.
+    sim.restart(0, old)
+    sim.step(1)
+    st = sim.node_status(0, old)
+    # Phase F wipes the node to term 0 / empty log, but the new leader's phase-5
+    # heartbeat in the SAME tick may already make it adopt the leader's term — so
+    # only liveness and demotion are deterministic here (the oracle test pins the
+    # wipe itself at phase-F granularity).
+    assert st["up"] is True
+    assert st["role"] == "FOLLOWER"
+
+    lead_term = sim.node_status(0, new)["term"]
+    assert _step_until(
+        sim, lambda: sim.node_status(0, old)["term"] >= lead_term, 3 * cfg.hb_ticks + 20
+    ), "restarted node did not adopt the leader's term"
+    # Group 1 was never touched: the fault addressing is per-(group, node).
+    assert all(sim.node_status(1, n)["up"] for n in range(1, 4))
+
+
+def test_oracle_scheduled_crash_freezes_node():
+    cfg = RaftConfig(n_groups=1, n_nodes=3, seed=5).stressed(10)
+    grp = OracleGroup(cfg, group=0, draws=predraw(cfg)[0])
+    grp.run(cfg.el_hi + 40, trace=False)
+    leaders = [n.id for n in grp.nodes if n.role == LEADER]
+    assert leaders
+    lead = leaders[0]
+    t = grp.tick_count
+    grp.crash(t, lead)
+    grp.tick()
+    down = grp.nodes[lead - 1]
+    assert not down.up
+    frozen = (down.term, down.role, down.log.last_index, down.el_left)
+    grp.run(30, trace=False)
+    assert (down.term, down.role, down.log.last_index, down.el_left) == frozen
+
+    # Crash the remaining nodes so the rejoining node's wiped state can't be
+    # overwritten by a live leader's same-tick heartbeat (see failover test).
+    for n in grp.nodes:
+        if n.up:
+            grp.crash(grp.tick_count, n.id)
+    grp.tick()
+    grp.restart(grp.tick_count, lead)
+    grp.tick()
+    assert down.up and down.term == 0 and down.role == FOLLOWER
+    assert down.log.last_index == 0 and down.log.phys_len == 0
+
+
+def test_link_partition_forces_reelection():
+    # Deterministically partition the leader from everyone (keep self-links) by
+    # driving the oracle's link_up directly: peers stop hearing heartbeats and a new
+    # leader emerges among the connected majority; the old leader, cut off, keeps
+    # believing it leads (classic split-brain — §9 makes it reproducible).
+    cfg = RaftConfig(n_groups=1, n_nodes=3, seed=8).stressed(10)
+    grp = OracleGroup(cfg, group=0, draws=predraw(cfg)[0])
+    grp.run(cfg.el_hi + 40, trace=False)
+    lead = [n.id for n in grp.nodes if n.role == LEADER][0]
+    for other in range(1, 4):
+        if other != lead:
+            grp.link_up[lead - 1][other - 1] = False
+            grp.link_up[other - 1][lead - 1] = False
+    grp.run(cfg.el_hi + cfg.round_ticks + 60, trace=False)
+    others = [n for n in grp.nodes if n.id != lead]
+    assert any(n.role == LEADER for n in others), "no re-election behind the partition"
+    new_lead = [n for n in others if n.role == LEADER][0]
+    assert new_lead.term > grp.nodes[lead - 1].term or grp.nodes[lead - 1].role != LEADER
+
+
+def test_http_fault_routes():
+    import urllib.request
+
+    from raft_kotlin_tpu.api.http_api import RaftHTTPServer
+
+    cfg = RaftConfig(n_groups=1, n_nodes=3, seed=0).stressed(10)
+    sim = Simulator(cfg)
+    with RaftHTTPServer(sim, port=0, tick_hz=0.0) as srv:
+        base = f"http://127.0.0.1:{srv.port}"
+
+        def get(path):
+            with urllib.request.urlopen(base + path) as r:
+                return r.read().decode()
+
+        assert "crash queued" in get("/0/2/crash")
+        get("/step/1")
+        import json
+
+        assert json.loads(get("/0/2/status"))["up"] is False
+        assert "restart queued" in get("/0/2/restart")
+        get("/step/1")
+        assert json.loads(get("/0/2/status"))["up"] is True

@@ -6,13 +6,12 @@ unsharded run exactly — the tick kernel is elementwise over groups and the RNG
 counted threefry, so sharding may not change a single bit.
 """
 
-import dataclasses
-
 import jax
-import numpy as np
+
+from conftest import assert_states_equal
 import pytest
 
-from raft_kotlin_tpu.models.state import RaftState, init_state
+from raft_kotlin_tpu.models.state import init_state
 from raft_kotlin_tpu.ops.tick import make_run
 from raft_kotlin_tpu.parallel.mesh import (
     init_sharded,
@@ -22,12 +21,6 @@ from raft_kotlin_tpu.parallel.mesh import (
     state_sharding,
 )
 from raft_kotlin_tpu.utils.config import RaftConfig
-
-
-def assert_states_equal(a: RaftState, b: RaftState):
-    for f in dataclasses.fields(RaftState):
-        av, bv = np.asarray(getattr(a, f.name)), np.asarray(getattr(b, f.name))
-        assert np.array_equal(av, bv), f"field {f.name} differs"
 
 
 def test_mesh_shape():
