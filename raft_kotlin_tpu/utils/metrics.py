@@ -1,0 +1,212 @@
+"""Observability: on-device metric reductions, invariant checks, trace streaming.
+
+The reference's only observability is stdout logging (kotlin-logging over slf4j,
+reference RaftServer.kt:33,56,110,134-135 and a raw println at RaftServer.kt:134) plus
+the HTTP `GET /` log dump (RaftServer.kt:84-86). Here observability is a first-class
+subsystem designed for 100k concurrent groups: everything is computed ON DEVICE as O(1)
+scalar reductions per tick (never materialize (G, N) arrays on the host), fetched at
+low frequency, and streamed as JSONL.
+
+Three pieces:
+- `tick_metrics(prev, cur)` — pure, jittable: scalar reductions over a tick transition
+  (leaders, elections started, commit throughput, safety telemetry).
+- `check_invariants(prev, cur, cfg)` — pure, jittable: violation COUNTS for properties
+  the SEMANTICS.md tick machine guarantees. This is the rebuild's "race detector": the
+  reference has real data races (unsynchronized commitIndex/nextIndex/matchIndex,
+  RaftServer.kt:112-167, @Volatile-only fields RaftServer.kt:35-42); the lockstep kernel
+  makes races structurally impossible, and these checks prove the state machine stays
+  inside its lattice. Any nonzero count is a framework bug, not a simulation outcome.
+- `MetricsRecorder` — host-side JSONL streaming + optional jax.profiler wrapping.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from typing import Dict, IO, Optional
+
+import jax
+import jax.numpy as jnp
+
+from raft_kotlin_tpu.constants import ACTIVE, BACKOFF, CANDIDATE, LEADER
+from raft_kotlin_tpu.models.state import RaftState
+from raft_kotlin_tpu.utils.config import RaftConfig
+
+_I32 = jnp.int32
+
+
+def tick_metrics(prev: RaftState, cur: RaftState) -> Dict[str, jax.Array]:
+    """Scalar on-device reductions for the transition prev -> cur (one tick apart).
+
+    Keys (all () int32 unless noted):
+    - leaders:            groups with >= 1 LEADER node
+    - multi_leader:       groups with >= 2 LEADER nodes (any terms)
+    - split_leaders:      groups with two leaders in the SAME term — classical Raft's
+                          Election Safety violation; reachable in the reference's
+                          semantics (quirks d/f/g), so it is telemetry, not an error
+    - elections:          nodes that entered a new vote round this tick
+    - rounds_active:      nodes currently in an ACTIVE vote round
+    - candidates:         nodes currently CANDIDATE
+    - commit_advanced:    sum over (g, n) of commit increase (clipped at 0) — the
+                          commit-throughput numerator
+    - commit_total:       sum over groups of the max node commit
+    - term_max:           max term anywhere
+    - log_bytes_used:     total readable log slots (sum of last_index)
+    """
+    is_leader = cur.role == LEADER
+    n_lead = jnp.sum(is_leader.astype(_I32), axis=1)  # (G,)
+
+    # Same-term leader pairs, O(N^2) on the tiny node axis.
+    lt = jnp.where(is_leader, cur.term, -jnp.arange(cur.term.shape[1], dtype=_I32) - 1)
+    same = (lt[:, :, None] == lt[:, None, :]) & is_leader[:, :, None] & is_leader[:, None, :]
+    same = same & ~jnp.eye(cur.term.shape[1], dtype=bool)[None]
+    split = jnp.any(same, axis=(1, 2))
+
+    d_commit = jnp.maximum(cur.commit - prev.commit, 0)
+    return {
+        "tick": cur.tick,
+        "leaders": jnp.sum((n_lead >= 1).astype(_I32)),
+        "multi_leader": jnp.sum((n_lead >= 2).astype(_I32)),
+        "split_leaders": jnp.sum(split.astype(_I32)),
+        "elections": jnp.sum((cur.rounds - prev.rounds).astype(_I32)),
+        "rounds_active": jnp.sum((cur.round_state == ACTIVE).astype(_I32)),
+        "candidates": jnp.sum((cur.role == CANDIDATE).astype(_I32)),
+        "commit_advanced": jnp.sum(d_commit),
+        "commit_total": jnp.sum(jnp.max(cur.commit, axis=1)),
+        "term_max": jnp.max(cur.term),
+        "log_bytes_used": jnp.sum(cur.last_index),
+    }
+
+
+def check_invariants(prev: RaftState, cur: RaftState, cfg: RaftConfig) -> Dict[str, jax.Array]:
+    """Violation counts for properties the tick machine (SEMANTICS.md §5) guarantees.
+
+    Nonzero => kernel bug. Checked:
+    - term_monotone:     per-node term never decreases (every term write in §5/§6 is
+                         either +=1 or adoption of a strictly higher term) — except
+                         across a §9 restart, which wipes term to 0 (a node that came
+                         up this tick is exempt)
+    - log_window:        0 <= last_index <= phys_len <= capacity  (SEMANTICS.md §3)
+    - role_range:        role in {F, C, L}; round_state in {IDLE, BACKOFF, ACTIVE}
+    - vote_accounting:   0 <= votes <= responses <= N, and responses ==
+                         count(responded) for nodes in an ACTIVE round
+    - rng_counters:      t_ctr/b_ctr nonnegative and nondecreasing
+    - commit_in_window:  0 <= commit (commit may exceed last_index transiently per
+                         quirk e semantics? no — commit is always min'd against
+                         last_index when advanced, and last_index only shrinks via
+                         truncation which does not touch commit... truncation CAN
+                         strand commit > last_index, so only nonnegativity is owed)
+
+    Note commit monotonicity is deliberately NOT here: quirk e
+    (reference RaftServer.kt:270-272) computes min(leaderCommit, last_index), which
+    after a log truncation can legitimately LOWER a stale follower's commit.
+    """
+    N = cfg.n_nodes
+
+    def cnt(bad) -> jax.Array:
+        return jnp.sum(bad.astype(_I32))
+
+    resp_cnt = jnp.sum(cur.responded.astype(_I32), axis=2)
+    in_round = cur.round_state == ACTIVE
+    restarted = cur.up & ~prev.up
+    return {
+        "term_monotone": cnt((cur.term < prev.term) & ~restarted),
+        "log_window": cnt(
+            (cur.last_index < 0)
+            | (cur.last_index > cur.phys_len)
+            | (cur.phys_len > cfg.log_capacity)
+        ),
+        "role_range": cnt((cur.role < 0) | (cur.role > LEADER))
+        + cnt((cur.round_state < 0) | (cur.round_state > ACTIVE)),
+        "vote_accounting": cnt(
+            (cur.votes < 0) | (cur.votes > cur.responses) | (cur.responses > N)
+        )
+        + cnt(in_round & (cur.responses != resp_cnt)),
+        "rng_counters": cnt(cur.t_ctr < prev.t_ctr) + cnt(cur.b_ctr < prev.b_ctr),
+        "commit_in_window": cnt(cur.commit < 0),
+    }
+
+
+def make_instrumented_run(
+    cfg: RaftConfig,
+    n_ticks: int,
+    invariants: bool = False,
+):
+    """jitted run(state) -> (state, metrics) where metrics is a dict of (n_ticks,)
+    arrays from `tick_metrics` (plus `check_invariants` counts when invariants=True —
+    the debug mode; ~free, but adds a few reductions per tick)."""
+    from raft_kotlin_tpu.ops.tick import make_tick
+
+    tick_fn = make_tick(cfg)
+
+    def body(st, _):
+        nxt = tick_fn(st)
+        out = tick_metrics(st, nxt)
+        if invariants:
+            out.update({f"inv_{k}": v for k, v in check_invariants(st, nxt, cfg).items()})
+        return nxt, out
+
+    @jax.jit
+    def run(st):
+        return jax.lax.scan(body, st, None, length=n_ticks)
+
+    return run
+
+
+class MetricsRecorder:
+    """Streams per-window metric dicts to JSONL; one line per fetch window.
+
+    Usage: run a chunk of ticks with `make_instrumented_run`, then
+    `rec.record(metrics)` — device->host transfer happens here, once per chunk, never
+    per tick. `summary()` aggregates everything recorded so far.
+    """
+
+    def __init__(self, path: Optional[str] = None):
+        self._fh: Optional[IO[str]] = open(path, "a") if path else None
+        self._t0 = time.time()
+        self.windows: list[dict] = []
+
+    def record(self, metrics: Dict[str, jax.Array]) -> dict:
+        host = {k: jax.device_get(v) for k, v in metrics.items()}
+        window = {}
+        for k, v in host.items():
+            v = v.tolist() if hasattr(v, "tolist") else v
+            if isinstance(v, list) and v:
+                window[k] = {"first": v[0], "last": v[-1], "sum": int(sum(v)),
+                             "max": int(max(v)), "n": len(v)}
+            else:
+                window[k] = v
+        window["wall_s"] = round(time.time() - self._t0, 3)
+        self.windows.append(window)
+        if self._fh:
+            self._fh.write(json.dumps(window) + "\n")
+            self._fh.flush()
+        return window
+
+    def summary(self) -> dict:
+        out: dict = {"windows": len(self.windows)}
+        for w in self.windows:
+            for k, v in w.items():
+                if isinstance(v, dict) and "sum" in v:
+                    agg = out.setdefault(k, {"sum": 0, "max": 0, "n": 0})
+                    agg["sum"] += v["sum"]
+                    agg["max"] = max(agg["max"], v["max"])
+                    agg["n"] += v["n"]
+        return out
+
+    def close(self) -> None:
+        if self._fh:
+            self._fh.close()
+            self._fh = None
+
+
+@contextlib.contextmanager
+def profile(logdir: str):
+    """jax.profiler trace around a block — TensorBoard-compatible XLA traces, the
+    rebuild's answer to the reference's printf profiling."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

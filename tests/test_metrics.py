@@ -1,0 +1,107 @@
+"""Observability subsystem (utils/metrics.py): on-device metrics must agree with
+host-side numpy recomputation from full traces, and invariant counts must be zero on
+real simulations (and nonzero on deliberately corrupted states)."""
+
+import dataclasses
+
+import numpy as np
+
+from raft_kotlin_tpu.constants import LEADER
+from raft_kotlin_tpu.models.state import init_state
+from raft_kotlin_tpu.ops.tick import make_run
+from raft_kotlin_tpu.utils.config import RaftConfig
+from raft_kotlin_tpu.utils.metrics import (
+    MetricsRecorder,
+    check_invariants,
+    make_instrumented_run,
+    tick_metrics,
+)
+
+CFG = RaftConfig(n_groups=16, n_nodes=3, log_capacity=16, cmd_period=7,
+                 p_drop=0.05, seed=3).stressed(10)
+TICKS = 120
+
+
+def test_metrics_match_trace_recomputation():
+    run = make_run(CFG, TICKS, trace=True)
+    _, trace = run(init_state(CFG))
+    role = np.asarray(trace["role"])        # (T, G, N)
+    rounds = np.asarray(trace["rounds"])
+    commit = np.asarray(trace["commit"])
+
+    inst = make_instrumented_run(CFG, TICKS)
+    _, m = inst(init_state(CFG))
+
+    lead_per_group = (role == LEADER).sum(axis=2)          # (T, G)
+    assert np.array_equal(np.asarray(m["leaders"]), (lead_per_group >= 1).sum(axis=1))
+    assert np.array_equal(np.asarray(m["multi_leader"]), (lead_per_group >= 2).sum(axis=1))
+
+    prev_rounds = np.concatenate([np.zeros_like(rounds[:1]), rounds[:-1]])
+    assert np.array_equal(np.asarray(m["elections"]),
+                          (rounds - prev_rounds).sum(axis=(1, 2)))
+
+    prev_commit = np.concatenate([np.zeros_like(commit[:1]), commit[:-1]])
+    adv = np.maximum(commit - prev_commit, 0).sum(axis=(1, 2))
+    assert np.array_equal(np.asarray(m["commit_advanced"]), adv)
+    assert np.array_equal(np.asarray(m["commit_total"]), commit.max(axis=2).sum(axis=1))
+    # Ticks are 1-based post-step.
+    assert np.asarray(m["tick"])[0] == 1 and np.asarray(m["tick"])[-1] == TICKS
+
+
+def test_invariants_zero_on_real_run():
+    run = make_instrumented_run(CFG, TICKS, invariants=True)
+    _, m = run(init_state(CFG))
+    for k, v in m.items():
+        if k.startswith("inv_"):
+            assert int(np.asarray(v).sum()) == 0, f"{k} nonzero on a real run"
+
+
+def test_invariants_catch_corruption():
+    st = init_state(CFG)
+    run = make_run(CFG, 40, trace=False)
+    st2, _ = run(st)
+    # Corrupt: term decreases and last_index overruns phys_len.
+    bad = dataclasses.replace(
+        st2,
+        term=st2.term - 5,
+        last_index=st2.phys_len + 1,
+    )
+    viol = {k: int(np.asarray(v)) for k, v in check_invariants(st2, bad, CFG).items()}
+    assert viol["term_monotone"] > 0
+    assert viol["log_window"] > 0
+    ok = {k: int(np.asarray(v)) for k, v in check_invariants(st, st2, CFG).items()}
+    assert all(v == 0 for v in ok.values())
+
+
+def test_recorder_roundtrip(tmp_path):
+    path = str(tmp_path / "metrics.jsonl")
+    rec = MetricsRecorder(path)
+    run = make_instrumented_run(CFG, 30)
+    st = init_state(CFG)
+    for _ in range(3):
+        st, m = run(st)
+        rec.record(m)
+    rec.close()
+    import json
+
+    lines = [json.loads(l) for l in open(path)]
+    assert len(lines) == 3
+    assert lines[0]["leaders"]["n"] == 30
+    s = rec.summary()
+    assert s["windows"] == 3 and s["elections"]["n"] == 90
+
+
+def test_split_leader_telemetry_counts_same_term_pairs():
+    # Hand-build a state with two same-term leaders in group 0 and two
+    # different-term leaders in group 1.
+    st = init_state(CFG)
+    role = np.asarray(st.role).copy()
+    term = np.asarray(st.term).copy()
+    role[0, 0] = role[0, 1] = LEADER
+    term[0, 0] = term[0, 1] = 7
+    role[1, 0] = role[1, 2] = LEADER
+    term[1, 0], term[1, 2] = 3, 4
+    bad = dataclasses.replace(st, role=np.asarray(role), term=np.asarray(term))
+    m = tick_metrics(st, bad)
+    assert int(np.asarray(m["multi_leader"])) == 2
+    assert int(np.asarray(m["split_leaders"])) == 1
