@@ -1,0 +1,438 @@
+// Native (C++) reference simulator for the SEMANTICS.md tick machine.
+//
+// This is the framework's native-runtime component: a scalar, deterministic
+// implementation of the same normative spec as the Python oracle
+// (raft_kotlin_tpu/models/oracle.py) and the JAX kernel (raft_kotlin_tpu/ops/tick.py)
+// — behavioral citations for every rule live in those files and in SEMANTICS.md;
+// the reference implementation being modeled is
+// /root/reference/src/main/kotlin/ua/org/kug/raft/ (RaftServer.kt, Commons.kt).
+//
+// Design: all randomness is injected by the host as pre-drawn tables (counted
+// threefry draws, utils/rng.py) and per-tick event masks, so this file is pure
+// integer logic — bit-identical to both other implementations by construction,
+// an order of magnitude faster than the Python oracle, and usable as the ground
+// truth for large-G differential sweeps (tests/test_native_oracle.py).
+//
+// Build: g++ -O2 -shared -fPIC -o libraft_oracle.so raft_oracle.cpp
+// ABI: C, single entry point raft_run; all arrays are C-order, caller-owned.
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr int32_t FOLLOWER = 0, CANDIDATE = 1, LEADER = 2;
+constexpr int32_t IDLE = 0, BACKOFF = 1, ACTIVE = 2;
+
+// Error codes (returned by raft_run): 0 ok.
+constexpr int ERR_DRAW_EXHAUSTED = 1;  // a t_ctr/b_ctr ran past its table
+
+struct Dims {
+  int32_t G, N, C;             // groups, nodes/group, log capacity
+  int32_t hb_ticks, round_ticks, retry_ticks, majority;
+  int32_t cmd_period, cmd_node;  // phase-0 workload (cmd_node is 1-based)
+  int32_t t0, T;               // first tick index, number of ticks to run
+  int32_t Kt, Kb;              // timeout / backoff draw-table depths
+};
+
+// All per-(group,node) state, flattened C-order. Caller-owned, mutated in place.
+struct State {
+  int32_t *term, *voted_for, *role, *commit;          // [G][N]
+  int32_t *last_index, *phys_len;                     // [G][N]
+  int32_t *log_term, *log_cmd;                        // [G][N][C]
+  uint8_t *el_armed; int32_t *el_left;                // [G][N]
+  int32_t *round_state, *round_left, *round_age;      // [G][N]
+  int32_t *votes, *responses;                         // [G][N]
+  uint8_t *responded;                                 // [G][N][N]  [g][c-1][p-1]
+  int32_t *bo_left;                                   // [G][N]
+  int32_t *next_index, *match_index;                  // [G][N][N]  [g][l-1][p-1]
+  uint8_t *hb_armed; int32_t *hb_left;                // [G][N]
+  uint8_t *up;                                        // [G][N]
+  uint8_t *link_up;                                   // [G][N][N]  [g][s-1][r-1]
+  int32_t *t_ctr, *b_ctr, *rounds;                    // [G][N]
+};
+
+// Host-supplied randomness + schedules. Any pointer may be null (= feature off).
+struct Inputs {
+  const int32_t *timeout_draws;  // [G][N][Kt]
+  const int32_t *backoff_draws;  // [G][N][Kb]
+  const uint8_t *edge_ok;        // [T][G][N][N] iid survive (SEMANTICS.md §4)
+  const uint8_t *crash_m;        // [T][G][N]    §9 event masks
+  const uint8_t *restart_m;      // [T][G][N]
+  const uint8_t *link_fail;      // [T][G][N][N]
+  const uint8_t *link_heal;      // [T][G][N][N]
+  const int32_t *inject;         // [T][G][N] command id, -1 = none (phase 0)
+  const uint8_t *fault_cmd;      // [T][G][N] 0 none / 1 crash / 2 restart (phase F)
+};
+
+// Post-tick trace sink, [T][G][N] each; any may be null.
+struct Trace {
+  int32_t *role, *term, *commit, *last_index, *voted_for, *rounds, *up;
+};
+
+// Per-group view: strides into the flat arrays for group g.
+struct Group {
+  const Dims& d;
+  State& s;
+  int32_t g;
+  int err = 0;
+
+  int32_t* f(int32_t* base, int n) const { return base + (g * d.N + (n - 1)); }
+  uint8_t* f(uint8_t* base, int n) const { return base + (g * d.N + (n - 1)); }
+  int32_t* nn(int32_t* base, int a, int b) const {
+    return base + ((g * d.N + (a - 1)) * d.N + (b - 1));
+  }
+  uint8_t* nn(uint8_t* base, int a, int b) const {
+    return base + ((g * d.N + (a - 1)) * d.N + (b - 1));
+  }
+  int32_t* slot(int32_t* base, int n, int i) const {
+    return base + ((g * d.N + (n - 1)) * d.C + i);
+  }
+
+  // -- Log semantics (SEMANTICS.md §3; Commons.kt:47-74) --------------------
+  bool log_valid(int n, int32_t i) const {
+    return 0 <= i && i < *f(s.last_index, n);
+  }
+  int32_t log_get_term(int n, int32_t i) const { return *slot(s.log_term, n, i); }
+  int32_t log_get_cmd(int n, int32_t i) const { return *slot(s.log_cmd, n, i); }
+  void log_add(int n, int32_t i, int32_t term_v, int32_t cmd_v) {
+    int32_t li = *f(s.last_index, n), pl = *f(s.phys_len, n);
+    if (i == li) {                    // physical append at slot phys_len
+      if (pl >= d.C) return;          // capacity clip [canon]
+      *slot(s.log_term, n, pl) = term_v;
+      *slot(s.log_cmd, n, pl) = cmd_v;
+      *f(s.phys_len, n) = pl + 1;
+      *f(s.last_index, n) = li + 1;
+    } else if (i < li && i >= 0) {    // overwrite + logical truncation (quirk j)
+      *slot(s.log_term, n, i) = term_v;
+      *slot(s.log_cmd, n, i) = cmd_v;
+      *f(s.last_index, n) = i + 1;
+    }                                 // i > li: reject
+  }
+  int32_t last_log_term(int n) const {
+    int32_t li = *f(s.last_index, n);
+    return li == 0 ? 0 : log_get_term(n, li - 1);
+  }
+
+  // -- Counted draws (tables injected by host; SEMANTICS.md §4/§7) ----------
+  int32_t draw_timeout(const Inputs& in, int n) {
+    int32_t& ctr = *f(s.t_ctr, n);
+    if (ctr >= d.Kt) { err = ERR_DRAW_EXHAUSTED; return 1; }
+    return in.timeout_draws[((int64_t)g * d.N + (n - 1)) * d.Kt + ctr++];
+  }
+  int32_t draw_backoff(const Inputs& in, int n) {
+    int32_t& ctr = *f(s.b_ctr, n);
+    if (ctr >= d.Kb) { err = ERR_DRAW_EXHAUSTED; return 1; }
+    return in.backoff_draws[((int64_t)g * d.N + (n - 1)) * d.Kb + ctr++];
+  }
+  void reset_el_timer(const Inputs& in, int n) {
+    *f(s.el_armed, n) = 1;
+    *f(s.el_left, n) = draw_timeout(in, n);
+  }
+
+  // §9 restart: wipe everything except the RNG counters.
+  void restart_node(const Inputs& in, int n) {
+    *f(s.term, n) = 0; *f(s.voted_for, n) = -1; *f(s.role, n) = FOLLOWER;
+    *f(s.commit, n) = 0; *f(s.last_index, n) = 0; *f(s.phys_len, n) = 0;
+    *f(s.round_state, n) = IDLE;
+    *f(s.round_left, n) = 0; *f(s.round_age, n) = 0;
+    *f(s.votes, n) = 0; *f(s.responses, n) = 0; *f(s.bo_left, n) = 0;
+    for (int p = 1; p <= d.N; p++) {
+      *nn(s.responded, n, p) = 0;
+      *nn(s.next_index, n, p) = 0;
+      *nn(s.match_index, n, p) = 0;
+    }
+    *f(s.hb_armed, n) = 0; *f(s.hb_left, n) = 0;
+    *f(s.up, n) = 1;
+    reset_el_timer(in, n);
+  }
+};
+
+// Vote handler on p (SEMANTICS.md §6.1; RaftServer.kt:228-251).
+static bool vote_handler(Group& gr, const Inputs& in, int p,
+                         int32_t req_term, int32_t cand,
+                         int32_t req_lli, int32_t req_llt, int32_t* resp_term) {
+  const Dims& d = gr.d; State& s = gr.s;
+  bool granted;
+  int32_t p_term = *gr.f(s.term, p);
+  if (req_term < p_term) {
+    granted = false;
+  } else if (req_term == p_term) {
+    granted = (*gr.f(s.voted_for, p) == cand);               // quirk g
+  } else {
+    int32_t li = *gr.f(s.last_index, p);
+    if (li >= 1 && req_llt < gr.log_get_term(p, li - 1)) {
+      granted = false;                                       // no term adopt (quirk f)
+    } else if (li >= 1 && req_llt == gr.log_get_term(p, li - 1) && req_lli < li) {
+      granted = false;
+    } else {
+      *gr.f(s.term, p) = req_term;
+      *gr.f(s.voted_for, p) = cand;
+      *gr.f(s.role, p) = FOLLOWER;
+      gr.reset_el_timer(in, p);
+      granted = true;
+    }
+  }
+  (void)d;
+  *resp_term = *gr.f(s.term, p);
+  return granted;
+}
+
+// Append handler on p (SEMANTICS.md §6.2; RaftServer.kt:253-287).
+static bool append_handler(Group& gr, const Inputs& in, int p,
+                           int32_t req_term, int32_t leader_id,
+                           int32_t prev_li, int32_t prev_lt,
+                           bool has_entry, int32_t ent_term, int32_t ent_cmd,
+                           int32_t leader_commit, int32_t* resp_term) {
+  State& s = gr.s;
+  if (req_term > *gr.f(s.term, p)) {
+    *gr.f(s.term, p) = req_term;
+    *gr.f(s.voted_for, p) = -1;
+    *gr.f(s.role, p) = FOLLOWER;
+    gr.reset_el_timer(in, p);
+  }
+  if (leader_id != p) {                                      // quirk d: no term guard
+    *gr.f(s.role, p) = FOLLOWER;
+    gr.reset_el_timer(in, p);
+  }
+  if (leader_commit > *gr.f(s.commit, p)) {                  // quirk e: BEFORE check
+    int32_t li = *gr.f(s.last_index, p);
+    *gr.f(s.commit, p) = leader_commit < li ? leader_commit : li;
+  }
+  int32_t li = *gr.f(s.last_index, p);
+  bool success = (prev_li == -1) ||
+                 (li > prev_li && prev_li >= 0 && gr.log_get_term(p, prev_li) == prev_lt);
+  if (success && has_entry) gr.log_add(p, prev_li + 1, ent_term, ent_cmd);
+  *resp_term = *gr.f(s.term, p);
+  return success;
+}
+
+// One tick of one group (SEMANTICS.md §5 phase order + §9 phase F).
+static void tick_group(Group& gr, const Dims& d, const Inputs& in, int32_t t,
+                       int32_t rel_t) {
+  State& s = gr.s;
+  const int N = d.N;
+  const int64_t gNN = ((int64_t)rel_t * d.G + gr.g) * N * N;
+  const int64_t gN = ((int64_t)rel_t * d.G + gr.g) * N;
+
+  auto iid_ok = [&](int a, int b) -> bool {
+    return !in.edge_ok || in.edge_ok[gNN + (a - 1) * N + (b - 1)];
+  };
+  auto ok = [&](int a, int b) -> bool {   // §9 effective edge health
+    return *gr.f(s.up, a) && *gr.f(s.up, b) && *gr.nn(s.link_up, a, b) && iid_ok(a, b);
+  };
+
+  // Phase F — fault events (§9), against pre-phase up.
+  if (in.crash_m || in.restart_m || in.fault_cmd) {
+    uint8_t was_up[64];
+    for (int n = 1; n <= N; n++) was_up[n - 1] = *gr.f(s.up, n);
+    for (int n = 1; n <= N; n++) {
+      bool cm = in.crash_m && in.crash_m[gN + (n - 1)];
+      bool rm = in.restart_m && in.restart_m[gN + (n - 1)];
+      uint8_t cmd = in.fault_cmd ? in.fault_cmd[gN + (n - 1)] : 0;
+      if (was_up[n - 1] && (cm || cmd == 1)) {
+        *gr.f(s.up, n) = 0;
+      } else if (!was_up[n - 1] && (rm || cmd == 2)) {
+        gr.restart_node(in, n);
+      }
+    }
+  }
+  if (in.link_fail || in.link_heal) {
+    for (int a = 1; a <= N; a++)
+      for (int b = 1; b <= N; b++) {
+        uint8_t& lu = *gr.nn(s.link_up, a, b);
+        bool lf = in.link_fail && in.link_fail[gNN + (a - 1) * N + (b - 1)];
+        bool lh = in.link_heal && in.link_heal[gNN + (a - 1) * N + (b - 1)];
+        lu = lu ? !lf : lh;
+      }
+  }
+
+  // Phase 0 — command injection (quirk k).
+  if (d.cmd_period > 0 && t % d.cmd_period == 0 && t > 0) {
+    int n = d.cmd_node;
+    if (*gr.f(s.up, n))
+      gr.log_add(n, *gr.f(s.last_index, n), *gr.f(s.term, n), t);
+  }
+  if (in.inject) {
+    for (int n = 1; n <= N; n++) {
+      int32_t cmd = in.inject[gN + (n - 1)];
+      if (cmd >= 0 && *gr.f(s.up, n))
+        gr.log_add(n, *gr.f(s.last_index, n), *gr.f(s.term, n), cmd);
+    }
+  }
+
+  // Phase 1 — timers (independent countdowns; frozen while down).
+  bool start_round[64] = {false};
+  for (int n = 1; n <= N; n++) {
+    if (!*gr.f(s.up, n)) continue;
+    if (*gr.f(s.el_armed, n)) {
+      if (--*gr.f(s.el_left, n) <= 0) {
+        *gr.f(s.el_armed, n) = 0;
+        *gr.f(s.role, n) = CANDIDATE;      // timer action ignores current role
+        start_round[n - 1] = true;
+      }
+    }
+    if (*gr.f(s.round_state, n) == BACKOFF) {
+      if (--*gr.f(s.bo_left, n) <= 0) {
+        *gr.f(s.round_state, n) = IDLE;
+        start_round[n - 1] = true;
+      }
+    }
+  }
+
+  // Phase 2 — round starts.
+  for (int n = 1; n <= N; n++) {
+    if (!start_round[n - 1]) continue;
+    if (*gr.f(s.role, n) == CANDIDATE) {
+      (*gr.f(s.term, n))++;
+      *gr.f(s.voted_for, n) = n;
+      *gr.f(s.votes, n) = 0;
+      *gr.f(s.responses, n) = 0;
+      for (int p = 1; p <= N; p++) *gr.nn(s.responded, n, p) = 0;
+      *gr.f(s.round_left, n) = d.round_ticks;
+      *gr.f(s.round_age, n) = 0;
+      *gr.f(s.round_state, n) = ACTIVE;
+      (*gr.f(s.rounds, n))++;
+    } else {
+      *gr.f(s.round_state, n) = IDLE;
+      gr.reset_el_timer(in, n);
+    }
+  }
+
+  // Phase 3 — vote exchanges.
+  for (int c = 1; c <= N; c++) {
+    if (*gr.f(s.round_state, c) != ACTIVE) continue;
+    if (*gr.f(s.round_age, c) % d.retry_ticks != 0) continue;
+    for (int p = 1; p <= N; p++) {
+      if (*gr.nn(s.responded, c, p)) continue;
+      if (!(ok(c, p) && ok(p, c))) continue;
+      int32_t c_term = *gr.f(s.term, c);
+      int32_t resp_term;
+      bool granted = vote_handler(gr, in, p, c_term, c,
+                                  *gr.f(s.last_index, c), gr.last_log_term(c),
+                                  &resp_term);
+      *gr.nn(s.responded, c, p) = 1;
+      (*gr.f(s.responses, c))++;
+      if (resp_term > c_term) *gr.f(s.role, c) = FOLLOWER;   // quirk f
+      if (granted) (*gr.f(s.votes, c))++;
+    }
+  }
+
+  // Phase 4 — round conclusions.
+  for (int n = 1; n <= N; n++) {
+    if (*gr.f(s.round_state, n) != ACTIVE || !*gr.f(s.up, n)) continue;
+    if (*gr.f(s.responses, n) >= d.majority || *gr.f(s.round_left, n) <= 0) {
+      if (*gr.f(s.role, n) == CANDIDATE && *gr.f(s.votes, n) >= d.majority) {
+        *gr.f(s.role, n) = LEADER;
+        for (int p = 1; p <= N; p++) {
+          *gr.nn(s.next_index, n, p) = *gr.f(s.commit, n) + 1;  // quirk b
+          *gr.nn(s.match_index, n, p) = 0;
+        }
+        *gr.f(s.hb_armed, n) = 1;
+        *gr.f(s.hb_left, n) = 0;         // fixedRateTimer initial delay 0
+        *gr.f(s.round_state, n) = IDLE;
+      } else if (*gr.f(s.role, n) == CANDIDATE) {
+        *gr.f(s.round_state, n) = BACKOFF;
+        *gr.f(s.bo_left, n) = gr.draw_backoff(in, n);
+      } else {
+        *gr.f(s.round_state, n) = IDLE;
+        gr.reset_el_timer(in, n);
+      }
+    } else {
+      (*gr.f(s.round_left, n))--;
+      (*gr.f(s.round_age, n))++;
+    }
+  }
+
+  // Phase 5 — append / heartbeat.
+  for (int l = 1; l <= N; l++) {
+    if (!(*gr.f(s.hb_armed, l) && *gr.f(s.up, l))) continue;
+    if (*gr.f(s.hb_left, l) > 0) { (*gr.f(s.hb_left, l))--; continue; }
+    if (*gr.f(s.role, l) == FOLLOWER) {
+      *gr.f(s.hb_armed, l) = 0;          // cancel() stops FUTURE firings only
+    } else {
+      *gr.f(s.hb_left, l) = d.hb_ticks - 1;
+    }
+    for (int p = 1; p <= N; p++) {
+      int32_t i = *gr.nn(s.next_index, l, p);
+      int32_t prev_li = i - 2, prev_lt;
+      if (prev_li >= 0) {
+        if (!gr.log_valid(l, prev_li)) continue;   // exception -> skip peer
+        prev_lt = gr.log_get_term(l, prev_li);
+      } else {
+        prev_lt = -1;
+      }
+      bool has_entry = false;
+      int32_t ent_term = 0, ent_cmd = 0;
+      if (*gr.f(s.last_index, l) >= i) {
+        if (!gr.log_valid(l, i - 1)) continue;     // quirk i underflow -> skip
+        has_entry = true;
+        ent_term = gr.log_get_term(l, i - 1);
+        ent_cmd = gr.log_get_cmd(l, i - 1);
+      }
+      if (!(ok(l, p) && ok(p, l))) continue;       // dropped exchange
+      int32_t resp_term;
+      bool success = append_handler(gr, in, p, *gr.f(s.term, l), l, prev_li,
+                                    prev_lt, has_entry, ent_term, ent_cmd,
+                                    *gr.f(s.commit, l), &resp_term);
+      if (resp_term > *gr.f(s.term, l)) {
+        *gr.f(s.term, l) = resp_term;
+        *gr.f(s.role, l) = FOLLOWER;
+        gr.reset_el_timer(in, l);
+        continue;                                  // return@launch
+      }
+      if (success) {
+        if (has_entry) {
+          (*gr.nn(s.next_index, l, p))++;
+          (*gr.nn(s.match_index, l, p))++;
+          int cnt = 0;
+          for (int q = 1; q <= N; q++)
+            if (*gr.nn(s.match_index, l, q) > *gr.f(s.commit, l)) cnt++;
+          if (cnt >= d.majority) (*gr.f(s.commit, l))++;  // quirk a
+        } else {
+          *gr.nn(s.match_index, l, p) = prev_li + 1;      // quirk h
+        }
+      } else {
+        (*gr.nn(s.next_index, l, p))--;                   // quirk i
+      }
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Step all G groups T ticks. Returns 0 on success, else an ERR_* code.
+// Trace arrays (if non-null) receive the post-tick values at [rel_t][g][n].
+int raft_run(const Dims* dims, State* state, const Inputs* inputs, Trace* trace) {
+  const Dims d = *dims;
+  if (d.N > 64) return 2;  // start_round/was_up stack buffers
+  for (int32_t g = 0; g < d.G; g++) {
+    Group gr{d, *state, g};
+    for (int32_t rel_t = 0; rel_t < d.T; rel_t++) {
+      int32_t t = d.t0 + rel_t;
+      tick_group(gr, d, *inputs, t, rel_t);
+      if (gr.err) return gr.err;
+      if (trace) {
+        int64_t off = ((int64_t)rel_t * d.G + g) * d.N;
+        for (int n = 0; n < d.N; n++) {
+          if (trace->role) trace->role[off + n] = state->role[g * d.N + n];
+          if (trace->term) trace->term[off + n] = state->term[g * d.N + n];
+          if (trace->commit) trace->commit[off + n] = state->commit[g * d.N + n];
+          if (trace->last_index)
+            trace->last_index[off + n] = state->last_index[g * d.N + n];
+          if (trace->voted_for)
+            trace->voted_for[off + n] = state->voted_for[g * d.N + n];
+          if (trace->rounds) trace->rounds[off + n] = state->rounds[g * d.N + n];
+          if (trace->up) trace->up[off + n] = state->up[g * d.N + n];
+        }
+      }
+    }
+  }
+  return 0;
+}
+
+int raft_abi_version() { return 1; }
+
+}  // extern "C"

@@ -1,0 +1,270 @@
+"""ctypes binding for the native C++ reference simulator (native/raft_oracle.cpp).
+
+The C++ engine implements the same SEMANTICS.md tick machine as the Python oracle and
+the JAX kernel, but is pure integer logic: all randomness (counted timeout/backoff
+draws, §4 iid edge masks, §9 fault-event masks) is pre-drawn HERE through the canonical
+`utils/rng.py` derivation and handed over as flat tables, so all three implementations
+are bit-identical by construction. Use this one for large-G differential sweeps — it
+steps thousands of groups per second per core where the Python oracle does tens.
+
+Build: `g++ -O2 -shared -fPIC` at first use (cached next to the source, rebuilt when
+the .cpp is newer). No pybind11 — plain C ABI + ctypes.
+"""
+
+from __future__ import annotations
+
+import ctypes as ct
+import dataclasses
+import os
+import subprocess
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from raft_kotlin_tpu.utils import rng as rngmod
+from raft_kotlin_tpu.utils.config import RaftConfig
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "raft_oracle.cpp")
+_LIB = os.path.join(_REPO_ROOT, "native", "libraft_oracle.so")
+_BUILD_LOCK = threading.Lock()
+
+_I32P = ct.POINTER(ct.c_int32)
+_U8P = ct.POINTER(ct.c_uint8)
+
+
+class _Dims(ct.Structure):
+    _fields_ = [(k, ct.c_int32) for k in (
+        "G", "N", "C", "hb_ticks", "round_ticks", "retry_ticks", "majority",
+        "cmd_period", "cmd_node", "t0", "T", "Kt", "Kb")]
+
+
+_STATE_FIELDS_I32 = (
+    "term", "voted_for", "role", "commit", "last_index", "phys_len",
+    "log_term", "log_cmd", "el_left", "round_state", "round_left", "round_age",
+    "votes", "responses", "bo_left", "next_index", "match_index", "hb_left",
+    "t_ctr", "b_ctr", "rounds",
+)
+_STATE_FIELDS_U8 = ("el_armed", "responded", "hb_armed", "up", "link_up")
+
+# Must mirror struct State's member ORDER in raft_oracle.cpp exactly.
+_STATE_ORDER = (
+    ("term", _I32P), ("voted_for", _I32P), ("role", _I32P), ("commit", _I32P),
+    ("last_index", _I32P), ("phys_len", _I32P),
+    ("log_term", _I32P), ("log_cmd", _I32P),
+    ("el_armed", _U8P), ("el_left", _I32P),
+    ("round_state", _I32P), ("round_left", _I32P), ("round_age", _I32P),
+    ("votes", _I32P), ("responses", _I32P), ("responded", _U8P),
+    ("bo_left", _I32P),
+    ("next_index", _I32P), ("match_index", _I32P),
+    ("hb_armed", _U8P), ("hb_left", _I32P),
+    ("up", _U8P), ("link_up", _U8P),
+    ("t_ctr", _I32P), ("b_ctr", _I32P), ("rounds", _I32P),
+)
+
+
+class _State(ct.Structure):
+    _fields_ = list(_STATE_ORDER)
+
+
+class _Inputs(ct.Structure):
+    _fields_ = [
+        ("timeout_draws", _I32P), ("backoff_draws", _I32P),
+        ("edge_ok", _U8P), ("crash_m", _U8P), ("restart_m", _U8P),
+        ("link_fail", _U8P), ("link_heal", _U8P),
+        ("inject", _I32P), ("fault_cmd", _U8P),
+    ]
+
+
+class _Trace(ct.Structure):
+    _fields_ = [(k, _I32P) for k in (
+        "role", "term", "commit", "last_index", "voted_for", "rounds", "up")]
+
+
+TRACE_FIELDS = tuple(k for k, _ in _Trace._fields_)
+
+
+def build_lib(force: bool = False) -> str:
+    """Compile the shared library if missing or stale; returns its path."""
+    with _BUILD_LOCK:
+        if (not force and os.path.exists(_LIB)
+                and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC)):
+            return _LIB
+        tmp = _LIB + ".tmp"
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", tmp, _SRC],
+            check=True, capture_output=True, text=True,
+        )
+        os.replace(tmp, _LIB)
+        return _LIB
+
+
+_lib_handle = None
+
+
+def _lib() -> ct.CDLL:
+    global _lib_handle
+    if _lib_handle is None:
+        lib = ct.CDLL(build_lib())
+        lib.raft_run.restype = ct.c_int
+        lib.raft_run.argtypes = [
+            ct.POINTER(_Dims), ct.POINTER(_State), ct.POINTER(_Inputs),
+            ct.POINTER(_Trace),
+        ]
+        assert lib.raft_abi_version() == 1
+        _lib_handle = lib
+    return _lib_handle
+
+
+def _ptr(arr: Optional[np.ndarray], typ):
+    if arr is None:
+        return ct.cast(None, typ)
+    return arr.ctypes.data_as(typ)
+
+
+def _draw_tables(cfg: RaftConfig, kind: int, K: int, lo: int, hi: int) -> np.ndarray:
+    """(G, N, K) int32 of the first K counted draws per (group, node) — the canonical
+    §4 derivation, computed in one jitted JAX call."""
+    import jax
+    import jax.numpy as jnp
+
+    base = rngmod.base_key(cfg.seed)
+    keys = rngmod.grid_keys(base, kind, cfg.n_groups, cfg.n_nodes)
+
+    @jax.jit
+    def draw():
+        f = lambda c: rngmod.draw_uniform_keyed(
+            keys, jnp.full((cfg.n_groups, cfg.n_nodes), c, jnp.int32), lo, hi
+        )
+        out = jax.lax.map(f, jnp.arange(K, dtype=jnp.int32))  # (K, G, N)
+        return jnp.transpose(out, (1, 2, 0))
+
+    return np.ascontiguousarray(np.asarray(draw(), dtype=np.int32))
+
+
+def _tick_masks(cfg: RaftConfig, t0: int, T: int) -> Dict[str, Optional[np.ndarray]]:
+    """Per-tick §4/§9 masks for ticks [t0, t0+T), shaped (T, ...); None when off."""
+    import jax
+    import jax.numpy as jnp
+
+    base = rngmod.base_key(cfg.seed)
+    G, N = cfg.n_groups, cfg.n_nodes
+    ticks = jnp.arange(t0, t0 + T, dtype=jnp.int32)
+
+    def stack(fn):
+        return np.ascontiguousarray(
+            np.asarray(jax.jit(lambda: jax.lax.map(fn, ticks))(), dtype=np.uint8)
+        )
+
+    out: Dict[str, Optional[np.ndarray]] = {
+        "edge_ok": None, "crash_m": None, "restart_m": None,
+        "link_fail": None, "link_heal": None,
+    }
+    if cfg.p_drop > 0:
+        out["edge_ok"] = stack(
+            lambda t: rngmod.edge_ok_mask(base, t, (G, N, N), cfg.p_drop))
+    if cfg.p_crash > 0 or cfg.p_restart > 0:
+        out["crash_m"] = stack(
+            lambda t: rngmod.event_mask(base, rngmod.KIND_CRASH, t, (G, N), cfg.p_crash))
+        out["restart_m"] = stack(
+            lambda t: rngmod.event_mask(base, rngmod.KIND_RESTART, t, (G, N),
+                                        cfg.p_restart))
+    if cfg.p_link_fail > 0 or cfg.p_link_heal > 0:
+        out["link_fail"] = stack(
+            lambda t: rngmod.event_mask(base, rngmod.KIND_LINK_FAIL, t, (G, N, N),
+                                        cfg.p_link_fail))
+        out["link_heal"] = stack(
+            lambda t: rngmod.event_mask(base, rngmod.KIND_LINK_HEAL, t, (G, N, N),
+                                        cfg.p_link_heal))
+    return out
+
+
+class NativeOracle:
+    """All-groups scalar simulation in C++; same trace contract as the JAX kernel's
+    make_run(trace=True) and the Python OracleGroup (bit-identical, SEMANTICS.md)."""
+
+    def __init__(self, cfg: RaftConfig, draw_depth: Optional[int] = None):
+        self.cfg = cfg
+        self.t = 0
+        # Boot state comes from the SAME init as the kernel (models/state.init_state)
+        # so even the boot timer draws are shared.
+        from raft_kotlin_tpu.models.state import init_state
+
+        st = init_state(cfg)
+        self.arrays: Dict[str, np.ndarray] = {}
+        for f in dataclasses.fields(st):
+            if f.name == "tick":
+                continue
+            a = np.asarray(getattr(st, f.name))
+            dt = np.uint8 if f.name in _STATE_FIELDS_U8 else np.int32
+            self.arrays[f.name] = np.ascontiguousarray(a.astype(dt))
+        # Counted-draw tables; grown on exhaustion (ERR_DRAW_EXHAUSTED retry).
+        self._Kt = self._Kb = 0
+        self._timeout = self._backoff = None
+        self._ensure_tables(draw_depth or 256)
+
+    def _ensure_tables(self, K: int) -> None:
+        if K <= self._Kt:
+            return
+        self._Kt = self._Kb = K
+        self._timeout = _draw_tables(
+            self.cfg, rngmod.KIND_TIMEOUT, K, self.cfg.el_lo, self.cfg.el_hi)
+        self._backoff = _draw_tables(
+            self.cfg, rngmod.KIND_BACKOFF, K, self.cfg.bo_lo, self.cfg.bo_hi)
+
+    def run(self, n_ticks: int, inject: Optional[np.ndarray] = None,
+            fault_cmd: Optional[np.ndarray] = None, trace: bool = True):
+        """Advance n_ticks; returns {field: (T, G, N) int32} if trace else None.
+        inject: optional (T, G, N) int32 command ids (-1 = none); fault_cmd:
+        optional (T, G, N) uint8 (1 = crash, 2 = restart)."""
+        cfg = self.cfg
+        G, N = cfg.n_groups, cfg.n_nodes
+        masks = _tick_masks(cfg, self.t, n_ticks)
+        if inject is not None:
+            inject = np.ascontiguousarray(inject, dtype=np.int32)
+            assert inject.shape == (n_ticks, G, N)
+        if fault_cmd is not None:
+            fault_cmd = np.ascontiguousarray(fault_cmd, dtype=np.uint8)
+            assert fault_cmd.shape == (n_ticks, G, N)
+
+        tr = {k: np.empty((n_ticks, G, N), dtype=np.int32) for k in TRACE_FIELDS} \
+            if trace else None
+
+        while True:
+            snapshot = {k: a.copy() for k, a in self.arrays.items()}
+            dims = _Dims(
+                G=G, N=N, C=cfg.log_capacity, hb_ticks=cfg.hb_ticks,
+                round_ticks=cfg.round_ticks, retry_ticks=cfg.retry_ticks,
+                majority=cfg.majority, cmd_period=cfg.cmd_period,
+                cmd_node=cfg.cmd_node, t0=self.t, T=n_ticks,
+                Kt=self._Kt, Kb=self._Kb,
+            )
+            state = _State(**{
+                k: _ptr(self.arrays[k], typ) for k, typ in _STATE_ORDER
+            })
+            inputs = _Inputs(
+                timeout_draws=_ptr(self._timeout, _I32P),
+                backoff_draws=_ptr(self._backoff, _I32P),
+                edge_ok=_ptr(masks["edge_ok"], _U8P),
+                crash_m=_ptr(masks["crash_m"], _U8P),
+                restart_m=_ptr(masks["restart_m"], _U8P),
+                link_fail=_ptr(masks["link_fail"], _U8P),
+                link_heal=_ptr(masks["link_heal"], _U8P),
+                inject=_ptr(inject, _I32P),
+                fault_cmd=_ptr(fault_cmd, _U8P),
+            )
+            trace_s = _Trace(**({k: _ptr(tr[k], _I32P) for k in TRACE_FIELDS}
+                                if trace else {}))
+            rc = _lib().raft_run(ct.byref(dims), ct.byref(state), ct.byref(inputs),
+                                 ct.byref(trace_s) if trace else None)
+            if rc == 0:
+                break
+            if rc == 1:  # draws exhausted: restore the pre-run state, deepen, retry
+                self.arrays = snapshot
+                self._ensure_tables(self._Kt * 2)
+                continue
+            raise RuntimeError(f"raft_run failed with code {rc}")
+
+        self.t += n_ticks
+        return tr
