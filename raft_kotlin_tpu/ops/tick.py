@@ -102,15 +102,29 @@ def make_tick(cfg: RaftConfig):
             setcol("last_index", n, app | ovw, jnp.where(app, li + 1, i + 1))
             setcol("phys_len", n, app, pl + 1)
 
+        # Election-timer resets (SEMANTICS.md §7): each reset consumes one counted
+        # draw and leaves el_left at the LAST consumed draw's value. In phases 2-5
+        # nothing reads el_left (phase 1 is its only reader), so the draws there are
+        # DEFERRED: resets just advance t_ctr and mark the node dirty, and one grid
+        # draw at counter t_ctr-1 materializes el_left at end of tick — identical
+        # bits, ~50x fewer threefry evaluations per tick. Phase F resets must stay
+        # immediate (they precede phase 1 within the same tick).
+        aux = {"el_dirty": jnp.zeros((G, N), dtype=bool)}
+
         def reset_el_timer_col(n, mask):
-            # SEMANTICS.md §7: one fresh counted draw per reset, mask-gated.
             ctr = col("t_ctr", n)
-            d = rngmod.draw_uniform_keyed(tkeys[:, n - 1], ctr, cfg.el_lo, cfg.el_hi)
-            setcol("el_left", n, mask, d)
             s["el_armed"] = s["el_armed"].at[:, n - 1].set(col("el_armed", n) | mask)
             setcol("t_ctr", n, mask, ctr + 1)
+            aux["el_dirty"] = aux["el_dirty"].at[:, n - 1].set(
+                aux["el_dirty"][:, n - 1] | mask
+            )
 
         def reset_el_timer_grid(mask):
+            s["el_armed"] = s["el_armed"] | mask
+            s["t_ctr"] = s["t_ctr"] + mask.astype(_I32)
+            aux["el_dirty"] = aux["el_dirty"] | mask
+
+        def reset_el_timer_grid_now(mask):
             d = rngmod.draw_uniform_keyed(tkeys, s["t_ctr"], cfg.el_lo, cfg.el_hi)
             s["el_left"] = jnp.where(mask, d, s["el_left"])
             s["el_armed"] = s["el_armed"] | mask
@@ -150,7 +164,7 @@ def make_tick(cfg: RaftConfig):
             s["match_index"] = jnp.where(rst[:, :, None], zero, s["match_index"])
             s["hb_armed"] = s["hb_armed"] & ~rst
             s["hb_left"] = jnp.where(rst, zero, s["hb_left"])
-            reset_el_timer_grid(rst)
+            reset_el_timer_grid_now(rst)  # phase 1 reads el_left this same tick
         if cfg.p_link_fail > 0 or cfg.p_link_heal > 0:
             lf = rngmod.event_mask(
                 base, rngmod.KIND_LINK_FAIL, t, (G, N, N), cfg.p_link_fail
@@ -357,6 +371,13 @@ def make_tick(cfg: RaftConfig):
                     (s["match_index"][:, l - 1, :] > l_commit[:, None]).astype(_I32), axis=1
                 )
                 setcol("commit", l, with_e & (cnt >= maj), l_commit + 1)
+
+        # Materialize the deferred election-timer draws (see reset helpers above):
+        # for every node that reset in phases 2-5, el_left = the draw at its last
+        # consumed counter.
+        dirty = aux["el_dirty"]
+        d = rngmod.draw_uniform_keyed(tkeys, s["t_ctr"] - 1, cfg.el_lo, cfg.el_hi)
+        s["el_left"] = jnp.where(dirty, d, s["el_left"])
 
         s["tick"] = t + 1
         return RaftState(**s)
