@@ -121,7 +121,7 @@ def main(argv=None) -> int:
             "groups": cfg.n_groups,
             "elapsed_s": round(dt, 3),
             "group_steps_per_sec": round(cfg.n_groups * args.ticks / dt, 1),
-            "groups_with_leader": int(np.sum((roles == LEADER).any(axis=1))),
+            "groups_with_leader": int(np.sum((roles == LEADER).any(axis=0))),
             "elections_started": int(np.sum(np.asarray(state.rounds))),
             "max_commit": int(np.max(np.asarray(state.commit))),
         }))

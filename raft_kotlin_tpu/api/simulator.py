@@ -91,9 +91,9 @@ class Simulator:
         self._check_addr(group, node)
         with self._lock:
             st = self._state
-            li = int(st.last_index[group, node - 1])
-            terms = np.asarray(st.log_term[group, node - 1, :li])
-            cmds = np.asarray(st.log_cmd[group, node - 1, :li])
+            li = int(st.last_index[node - 1, group])
+            terms = np.asarray(st.log_term[node - 1, :li, group])
+            cmds = np.asarray(st.log_cmd[node - 1, :li, group])
         return [(int(t), self.command_name(int(c))) for t, c in zip(terms, cmds)]
 
     # -- stepping -------------------------------------------------------------
@@ -153,12 +153,12 @@ class Simulator:
             return {
                 "group": group,
                 "node": node,
-                "up": bool(st.up[group, i]),
-                "role": ["FOLLOWER", "CANDIDATE", "LEADER"][int(st.role[group, i])],
-                "term": int(st.term[group, i]),
-                "voted_for": int(st.voted_for[group, i]),
-                "commit": int(st.commit[group, i]),
-                "last_index": int(st.last_index[group, i]),
+                "up": bool(st.up[i, group]),
+                "role": ["FOLLOWER", "CANDIDATE", "LEADER"][int(st.role[i, group])],
+                "term": int(st.term[i, group]),
+                "voted_for": int(st.voted_for[i, group]),
+                "commit": int(st.commit[i, group]),
+                "last_index": int(st.last_index[i, group]),
                 "tick": int(st.tick),
             }
 
@@ -166,16 +166,16 @@ class Simulator:
         """Node ids currently LEADER in `group` (normally 0 or 1 of them)."""
         self._check_addr(group, 1)
         with self._lock:
-            roles = np.asarray(self._state.role[group])
+            roles = np.asarray(self._state.role[:, group])
         return [int(i) + 1 for i in np.nonzero(roles == LEADER)[0]]
 
     def leaders_all(self, max_groups: Optional[int] = None) -> Dict[int, List[int]]:
         """{group: [leader node ids]} in ONE lock hold / device read."""
         with self._lock:
-            roles = np.asarray(self._state.role)
-        ng = roles.shape[0] if max_groups is None else min(roles.shape[0], max_groups)
+            roles = np.asarray(self._state.role)  # (N, G)
+        ng = roles.shape[1] if max_groups is None else min(roles.shape[1], max_groups)
         return {
-            g: [int(i) + 1 for i in np.nonzero(roles[g] == LEADER)[0]]
+            g: [int(i) + 1 for i in np.nonzero(roles[:, g] == LEADER)[0]]
             for g in range(ng)
         }
 

@@ -1,10 +1,13 @@
-"""Batched Raft state: struct-of-arrays over (groups, nodes).
+"""Batched Raft state: struct-of-arrays over (nodes, groups) — groups-minor.
 
 This is the TPU-side counterpart of the reference's per-node fields
 (RaftServer.kt:35-48) plus the discretized timer/round/heartbeat machinery of
-SEMANTICS.md §2, laid out so every per-tick op is an elementwise (G,)- or
-(G,N)-wide vector op and the only gathers/scatters are O(G·N) log accesses.
-Node axis index i holds node id i+1 (ids are 1-based, as in the reference).
+SEMANTICS.md §2. The large groups axis is the LAST (minor) axis of every array so it
+rides the TPU lane dimension: per-node "columns" are contiguous (N, G)[n] rows, the
+log is (N, C, G) so a one-hot over capacity C is a sublane op, and a Pallas kernel can
+tile G directly into VMEM lanes. Node axis index i holds node id i+1 (ids are
+1-based, as in the reference). RNG draws keep their canonical (G, ...) §4 shapes and
+are transposed at the boundary, so the layout change never touches a single drawn bit.
 """
 
 from __future__ import annotations
@@ -29,48 +32,48 @@ from raft_kotlin_tpu.constants import (  # noqa: F401  (re-exported)
 @struct.dataclass
 class RaftState:
     # Core Raft variables (RaftServer.kt:35-48).
-    term: jax.Array        # (G, N) i32
-    voted_for: jax.Array   # (G, N) i32, -1 = none
-    role: jax.Array        # (G, N) i32 ∈ {FOLLOWER, CANDIDATE, LEADER}
-    commit: jax.Array      # (G, N) i32
+    term: jax.Array        # (N, G) i32
+    voted_for: jax.Array   # (N, G) i32, -1 = none
+    role: jax.Array        # (N, G) i32 ∈ {FOLLOWER, CANDIDATE, LEADER}
+    commit: jax.Array      # (N, G) i32
 
     # Log (SEMANTICS.md §3): physical slots + logical last_index ≤ phys_len.
-    last_index: jax.Array  # (G, N) i32
-    phys_len: jax.Array    # (G, N) i32
-    log_term: jax.Array    # (G, N, C) i32
-    log_cmd: jax.Array     # (G, N, C) i32
+    last_index: jax.Array  # (N, G) i32
+    phys_len: jax.Array    # (N, G) i32
+    log_term: jax.Array    # (N, C, G) i32
+    log_cmd: jax.Array     # (N, C, G) i32
 
     # Election timer (one-shot; armed at boot).
-    el_armed: jax.Array    # (G, N) bool
-    el_left: jax.Array     # (G, N) i32
+    el_armed: jax.Array    # (N, G) bool
+    el_left: jax.Array     # (N, G) i32
 
     # Vote-round machinery (the while(CANDIDATE) loop + 25s latch + retries).
-    round_state: jax.Array  # (G, N) i32 ∈ {IDLE, BACKOFF, ACTIVE}
-    round_left: jax.Array   # (G, N) i32
-    round_age: jax.Array    # (G, N) i32
-    votes: jax.Array        # (G, N) i32
-    responses: jax.Array    # (G, N) i32
-    responded: jax.Array    # (G, N, N) bool; [g, c-1, p-1]
-    bo_left: jax.Array      # (G, N) i32
+    round_state: jax.Array  # (N, G) i32 ∈ {IDLE, BACKOFF, ACTIVE}
+    round_left: jax.Array   # (N, G) i32
+    round_age: jax.Array    # (N, G) i32
+    votes: jax.Array        # (N, G) i32
+    responses: jax.Array    # (N, G) i32
+    responded: jax.Array    # (N, N, G) bool; [c-1, p-1, g]
+    bo_left: jax.Array      # (N, G) i32
 
     # Leader machinery (per-stint arrays, RaftServer.kt:112-113).
-    next_index: jax.Array   # (G, N, N) i32; [g, l-1, p-1]
-    match_index: jax.Array  # (G, N, N) i32
-    hb_armed: jax.Array     # (G, N) bool
-    hb_left: jax.Array      # (G, N) i32
+    next_index: jax.Array   # (N, N, G) i32; [l-1, p-1, g]
+    match_index: jax.Array  # (N, N, G) i32
+    hb_armed: jax.Array     # (N, G) bool
+    hb_left: jax.Array      # (N, G) i32
 
     # Fault-model state (SEMANTICS.md §9): process liveness + persistent directed-link
     # health. Both all-True at boot.
-    up: jax.Array           # (G, N) bool
-    link_up: jax.Array      # (G, N, N) bool; [g, s-1, r-1]
+    up: jax.Array           # (N, G) bool
+    link_up: jax.Array      # (N, N, G) bool; [s-1, r-1, g]
 
     # Counted-draw cursors (SEMANTICS.md §4).
-    t_ctr: jax.Array        # (G, N) i32
-    b_ctr: jax.Array        # (G, N) i32
+    t_ctr: jax.Array        # (N, G) i32
+    b_ctr: jax.Array        # (N, G) i32
 
     # Cumulative election rounds started (metrics; one per while(CANDIDATE) loop
     # iteration, reference RaftServer.kt:191-223).
-    rounds: jax.Array       # (G, N) i32
+    rounds: jax.Array       # (N, G) i32
 
     tick: jax.Array         # () i32 — global tick counter
 
@@ -81,35 +84,36 @@ def init_state(cfg: RaftConfig) -> RaftState:
     zb = lambda *s: jnp.zeros(s, dtype=bool)
     base = rngmod.base_key(cfg.seed)
     # Boot draw: every node arms its election timer with counter 0 (t_ctr becomes 1).
+    # Drawn in the canonical (G, N) shape (SEMANTICS.md §4), then transposed.
     el_left = rngmod.draw_uniform_grid(
         base, rngmod.KIND_TIMEOUT, zi(G, N), cfg.el_lo, cfg.el_hi
-    )
+    ).T
     return RaftState(
-        term=zi(G, N),
-        voted_for=jnp.full((G, N), -1, dtype=jnp.int32),
-        role=zi(G, N),
-        commit=zi(G, N),
-        last_index=zi(G, N),
-        phys_len=zi(G, N),
-        log_term=zi(G, N, C),
-        log_cmd=zi(G, N, C),
-        el_armed=jnp.ones((G, N), dtype=bool),
+        term=zi(N, G),
+        voted_for=jnp.full((N, G), -1, dtype=jnp.int32),
+        role=zi(N, G),
+        commit=zi(N, G),
+        last_index=zi(N, G),
+        phys_len=zi(N, G),
+        log_term=zi(N, C, G),
+        log_cmd=zi(N, C, G),
+        el_armed=jnp.ones((N, G), dtype=bool),
         el_left=el_left,
-        round_state=zi(G, N),
-        round_left=zi(G, N),
-        round_age=zi(G, N),
-        votes=zi(G, N),
-        responses=zi(G, N),
-        responded=zb(G, N, N),
-        bo_left=zi(G, N),
-        next_index=zi(G, N, N),
-        match_index=zi(G, N, N),
-        hb_armed=zb(G, N),
-        hb_left=zi(G, N),
-        up=jnp.ones((G, N), dtype=bool),
-        link_up=jnp.ones((G, N, N), dtype=bool),
-        t_ctr=jnp.ones((G, N), dtype=jnp.int32),
-        b_ctr=zi(G, N),
-        rounds=zi(G, N),
+        round_state=zi(N, G),
+        round_left=zi(N, G),
+        round_age=zi(N, G),
+        votes=zi(N, G),
+        responses=zi(N, G),
+        responded=zb(N, N, G),
+        bo_left=zi(N, G),
+        next_index=zi(N, N, G),
+        match_index=zi(N, N, G),
+        hb_armed=zb(N, G),
+        hb_left=zi(N, G),
+        up=jnp.ones((N, G), dtype=bool),
+        link_up=jnp.ones((N, N, G), dtype=bool),
+        t_ctr=jnp.ones((N, G), dtype=jnp.int32),
+        b_ctr=zi(N, G),
+        rounds=zi(N, G),
         tick=jnp.zeros((), dtype=jnp.int32),
     )

@@ -188,7 +188,9 @@ class NativeOracle:
         self.cfg = cfg
         self.t = 0
         # Boot state comes from the SAME init as the kernel (models/state.init_state)
-        # so even the boot timer draws are shared.
+        # so even the boot timer draws are shared. The kernel is groups-minor
+        # (models/state.py); the C ABI is groups-major ([G][N]... row-major), so
+        # arrays transpose at this boundary.
         from raft_kotlin_tpu.models.state import init_state
 
         st = init_state(cfg)
@@ -197,6 +199,7 @@ class NativeOracle:
             if f.name == "tick":
                 continue
             a = np.asarray(getattr(st, f.name))
+            a = a.T if a.ndim == 2 else a.transpose(2, 0, 1)
             dt = np.uint8 if f.name in _STATE_FIELDS_U8 else np.int32
             self.arrays[f.name] = np.ascontiguousarray(a.astype(dt))
         # Counted-draw tables; grown on exhaustion (ERR_DRAW_EXHAUSTED retry).

@@ -2,19 +2,21 @@
 inside one jitted, scan-able pure function.
 
 Design (TPU-first, not a port): the reference's threads/timers/RPCs (RaftServer.kt)
-become a fixed phase pipeline of elementwise (G,)- and (G,N)-wide integer ops — the
-node loops are tiny (N ≤ 9) and unrolled at trace time, so group count G is the only
-data axis and XLA sees static shapes throughout. RPC exchanges are in-array mailbox
-transactions: each (candidate, peer) / (leader, peer) pair is one masked vectorized
-read-modify-write over the G axis, applied sequentially in the canonical order so the
-result is bit-identical to the scalar oracle (models/oracle.py). Quorum tallies are
-reductions over the node axis. All randomness is counted threefry (utils/rng.py).
+become a fixed phase pipeline of elementwise (G,)-wide integer ops — the node loops are
+tiny (N ≤ 9) and unrolled at trace time, so group count G is the only data axis and XLA
+sees static shapes throughout. State is laid out groups-minor ((N, G), (N, N, G),
+(N, C, G) — models/state.py) so every per-node access is a contiguous lane-aligned row.
+RPC exchanges are in-array mailbox transactions: each (candidate, peer) /
+(leader, peer) pair is one masked vectorized read-modify-write over the G axis, applied
+sequentially in the canonical order so the result is bit-identical to the scalar oracle
+(models/oracle.py). Quorum tallies are reductions over the node axis. All randomness is
+counted threefry (utils/rng.py), drawn in the canonical (G, ...) shapes and transposed
+at the boundary.
 """
 
 from __future__ import annotations
 
 import dataclasses
-import functools
 from typing import Optional
 
 import jax
@@ -37,18 +39,22 @@ _I32 = jnp.int32
 
 
 def make_tick(cfg: RaftConfig):
-    """Build tick(state, inject=None) -> state for a fixed config.
+    """Build tick(state, inject=None, fault_cmd=None) -> state for a fixed config.
 
     `inject` is an optional (G, N) int32 array of commands (-1 = none) delivered in
     phase 0 in addition to the cfg.cmd_period rule — the driver-level equivalent of the
-    reference's GET /cmd/{command} (RaftServer.kt:87-90).
+    reference's GET /cmd/{command} (RaftServer.kt:87-90). `fault_cmd` is an optional
+    (G, N) int32 of driver-scheduled §9 events (0 none / 1 crash / 2 restart). Both use
+    the driver-canonical (G, N) shape; they are transposed internally.
     """
     N, C, maj = cfg.n_nodes, cfg.log_capacity, cfg.majority
     base = rngmod.base_key(cfg.seed)
     # Static key prefixes, computed once per simulation (rng.grid_keys): the per-draw
-    # cost inside the tick drops to fold_in(counter) + randint.
-    tkeys = rngmod.grid_keys(base, rngmod.KIND_TIMEOUT, cfg.n_groups, N)
-    bkeys = rngmod.grid_keys(base, rngmod.KIND_BACKOFF, cfg.n_groups, N)
+    # cost inside the tick drops to fold_in(counter) + randint. grid_keys is (G, N)
+    # canonical; transposed here so keyed draws line up with (N, G) counter grids
+    # (the derivation is per-element, so the draw bits are unchanged).
+    tkeys = rngmod.grid_keys(base, rngmod.KIND_TIMEOUT, cfg.n_groups, N).T
+    bkeys = rngmod.grid_keys(base, rngmod.KIND_BACKOFF, cfg.n_groups, N).T
 
     def tick(
         state: RaftState,
@@ -56,7 +62,7 @@ def make_tick(cfg: RaftConfig):
         fault_cmd: Optional[jax.Array] = None,
     ) -> RaftState:
         s = {f.name: getattr(state, f.name) for f in dataclasses.fields(state)}
-        G = s["term"].shape[0]
+        G = s["term"].shape[-1]
         assert G == cfg.n_groups, (
             f"state has {G} groups but make_tick was built for {cfg.n_groups}"
         )
@@ -66,23 +72,23 @@ def make_tick(cfg: RaftConfig):
         # -- small helpers over the mutable dict --------------------------------
 
         def col(name, n):
-            return s[name][:, n - 1]
+            return s[name][n - 1]
 
         def setcol(name, n, mask, vals):
-            cur = s[name][:, n - 1]
-            s[name] = s[name].at[:, n - 1].set(jnp.where(mask, vals, cur))
+            cur = s[name][n - 1]
+            s[name] = s[name].at[n - 1].set(jnp.where(mask, vals, cur))
 
         def log_gather(name, n, idx):
             # (G,) read of physical slot idx from node n, as a one-hot contraction
-            # over the C lane axis (no per-row gather op — TPU-friendly); 0 where idx
-            # is out of [0, C) — callers must guard with masks.
-            arr = s[name][:, n - 1, :]
-            oh = lane[None, :] == idx[:, None]
-            return jnp.sum(jnp.where(oh, arr, 0), axis=1)
+            # over the C sublane axis (no per-lane gather op — TPU-friendly); 0 where
+            # idx is out of [0, C) — callers must guard with masks.
+            arr = s[name][n - 1]                      # (C, G)
+            oh = lane[:, None] == idx[None, :]
+            return jnp.sum(jnp.where(oh, arr, 0), axis=0)
 
         def log_add(n, i, term_v, cmd_v, mask):
             # SEMANTICS.md §3 add(): physical append / reject / overwrite-truncate.
-            # One-hot masked write over the C lane axis instead of a scatter; the
+            # One-hot masked write over the C sublane axis instead of a scatter; the
             # write slot is always in-range where the write mask holds (append needs
             # phys_len < C; overwrite needs i < last_index <= C).
             li = col("last_index", n)
@@ -90,14 +96,14 @@ def make_tick(cfg: RaftConfig):
             app = mask & (i == li) & (pl < C)
             ovw = mask & (i < li) & (i >= 0)
             slot = jnp.where(app, pl, i)
-            oh = (lane[None, :] == slot[:, None]) & (app | ovw)[:, None]
-            lt = s["log_term"][:, n - 1, :]
-            lc = s["log_cmd"][:, n - 1, :]
-            s["log_term"] = s["log_term"].at[:, n - 1, :].set(
-                jnp.where(oh, term_v[:, None], lt)
+            oh = (lane[:, None] == slot[None, :]) & (app | ovw)[None, :]
+            lt = s["log_term"][n - 1]                 # (C, G)
+            lc = s["log_cmd"][n - 1]
+            s["log_term"] = s["log_term"].at[n - 1].set(
+                jnp.where(oh, term_v[None, :], lt)
             )
-            s["log_cmd"] = s["log_cmd"].at[:, n - 1, :].set(
-                jnp.where(oh, cmd_v[:, None], lc)
+            s["log_cmd"] = s["log_cmd"].at[n - 1].set(
+                jnp.where(oh, cmd_v[None, :], lc)
             )
             setcol("last_index", n, app | ovw, jnp.where(app, li + 1, i + 1))
             setcol("phys_len", n, app, pl + 1)
@@ -109,14 +115,14 @@ def make_tick(cfg: RaftConfig):
         # draw at counter t_ctr-1 materializes el_left at end of tick — identical
         # bits, ~50x fewer threefry evaluations per tick. Phase F resets must stay
         # immediate (they precede phase 1 within the same tick).
-        aux = {"el_dirty": jnp.zeros((G, N), dtype=bool)}
+        aux = {"el_dirty": jnp.zeros((N, G), dtype=bool)}
 
         def reset_el_timer_col(n, mask):
             ctr = col("t_ctr", n)
-            s["el_armed"] = s["el_armed"].at[:, n - 1].set(col("el_armed", n) | mask)
+            s["el_armed"] = s["el_armed"].at[n - 1].set(col("el_armed", n) | mask)
             setcol("t_ctr", n, mask, ctr + 1)
-            aux["el_dirty"] = aux["el_dirty"].at[:, n - 1].set(
-                aux["el_dirty"][:, n - 1] | mask
+            aux["el_dirty"] = aux["el_dirty"].at[n - 1].set(
+                aux["el_dirty"][n - 1] | mask
             )
 
         def reset_el_timer_grid(mask):
@@ -131,20 +137,18 @@ def make_tick(cfg: RaftConfig):
             s["t_ctr"] = s["t_ctr"] + mask.astype(_I32)
 
         # -- phase F: fault events (SEMANTICS.md §9) ----------------------------
-        # `fault_cmd` is an optional (G, N) int32 of driver-scheduled events
-        # (0 = none, 1 = crash, 2 = restart) OR-ed with the random masks.
 
         has_faults = (
             cfg.p_crash > 0 or cfg.p_restart > 0 or fault_cmd is not None
         )
         if has_faults:
-            crash_m = rngmod.event_mask(base, rngmod.KIND_CRASH, t, (G, N), cfg.p_crash)
+            crash_m = rngmod.event_mask(
+                base, rngmod.KIND_CRASH, t, (G, N), cfg.p_crash).T
             restart_m = rngmod.event_mask(
-                base, rngmod.KIND_RESTART, t, (G, N), cfg.p_restart
-            )
+                base, rngmod.KIND_RESTART, t, (G, N), cfg.p_restart).T
             if fault_cmd is not None:
-                crash_m = crash_m | (fault_cmd == 1)
-                restart_m = restart_m | (fault_cmd == 2)
+                crash_m = crash_m | (fault_cmd.T == 1)
+                restart_m = restart_m | (fault_cmd.T == 2)
             crash_ev = s["up"] & crash_m
             restart_ev = ~s["up"] & restart_m
             s["up"] = (s["up"] & ~crash_ev) | restart_ev
@@ -159,24 +163,26 @@ def make_tick(cfg: RaftConfig):
             s["round_state"] = jnp.where(rst, IDLE, s["round_state"])
             for f in ("votes", "responses", "round_left", "round_age", "bo_left"):
                 s[f] = jnp.where(rst, zero, s[f])
-            s["responded"] = jnp.where(rst[:, :, None], False, s["responded"])
-            s["next_index"] = jnp.where(rst[:, :, None], zero, s["next_index"])
-            s["match_index"] = jnp.where(rst[:, :, None], zero, s["match_index"])
+            # (N, N, G) arrays are owned by their FIRST node axis (candidate/leader).
+            s["responded"] = jnp.where(rst[:, None, :], False, s["responded"])
+            s["next_index"] = jnp.where(rst[:, None, :], zero, s["next_index"])
+            s["match_index"] = jnp.where(rst[:, None, :], zero, s["match_index"])
             s["hb_armed"] = s["hb_armed"] & ~rst
             s["hb_left"] = jnp.where(rst, zero, s["hb_left"])
             reset_el_timer_grid_now(rst)  # phase 1 reads el_left this same tick
         if cfg.p_link_fail > 0 or cfg.p_link_heal > 0:
             lf = rngmod.event_mask(
                 base, rngmod.KIND_LINK_FAIL, t, (G, N, N), cfg.p_link_fail
-            )
+            ).transpose(1, 2, 0)
             lh = rngmod.event_mask(
                 base, rngmod.KIND_LINK_HEAL, t, (G, N, N), cfg.p_link_heal
-            )
+            ).transpose(1, 2, 0)
             s["link_up"] = jnp.where(s["link_up"], ~lf, lh)
 
         # Effective edge health (§9): iid survival ∧ link health ∧ both ends up.
-        edge = rngmod.edge_ok_mask(base, t, (G, N, N), cfg.p_drop)
-        edge = edge & s["link_up"] & s["up"][:, :, None] & s["up"][:, None, :]
+        # edge[s-1, r-1, g]; drawn canonically as (G, N, N) then transposed.
+        edge = rngmod.edge_ok_mask(base, t, (G, N, N), cfg.p_drop).transpose(1, 2, 0)
+        edge = edge & s["link_up"] & s["up"][:, None, :] & s["up"][None, :, :]
         up = s["up"]
 
         # -- phase 0: command injection (quirk k) -------------------------------
@@ -212,12 +218,12 @@ def make_tick(cfg: RaftConfig):
 
         is_cand = s["role"] == CANDIDATE
         init = start_round & is_cand
-        node_ids = jnp.broadcast_to(jnp.arange(1, N + 1, dtype=_I32), (G, N))
+        node_ids = jnp.broadcast_to(jnp.arange(1, N + 1, dtype=_I32)[:, None], (N, G))
         s["term"] = s["term"] + init.astype(_I32)
         s["voted_for"] = jnp.where(init, node_ids, s["voted_for"])
         s["votes"] = jnp.where(init, 0, s["votes"])
         s["responses"] = jnp.where(init, 0, s["responses"])
-        s["responded"] = jnp.where(init[:, :, None], False, s["responded"])
+        s["responded"] = jnp.where(init[:, None, :], False, s["responded"])
         s["round_left"] = jnp.where(init, cfg.round_ticks, s["round_left"])
         s["round_age"] = jnp.where(init, 0, s["round_age"])
         s["round_state"] = jnp.where(init, ACTIVE, s["round_state"])
@@ -235,9 +241,9 @@ def make_tick(cfg: RaftConfig):
             for p in range(1, N + 1):
                 att = (
                     c_attempting
-                    & ~s["responded"][:, c - 1, p - 1]
-                    & edge[:, c - 1, p - 1]
-                    & edge[:, p - 1, c - 1]
+                    & ~s["responded"][c - 1, p - 1]
+                    & edge[c - 1, p - 1]
+                    & edge[p - 1, c - 1]
                 )
                 # Request built from c's live state (RaftServer.kt:200-207).
                 c_term = col("term", c)
@@ -264,7 +270,7 @@ def make_tick(cfg: RaftConfig):
                 resp_term = col("term", p)
                 # Candidate tally (RaftServer.kt:209-211).
                 s["responded"] = (
-                    s["responded"].at[:, c - 1, p - 1].set(s["responded"][:, c - 1, p - 1] | att)
+                    s["responded"].at[c - 1, p - 1].set(s["responded"][c - 1, p - 1] | att)
                 )
                 setcol("responses", c, att, col("responses", c) + 1)
                 setcol("role", c, att & (resp_term > c_term), FOLLOWER)  # quirk f
@@ -280,9 +286,9 @@ def make_tick(cfg: RaftConfig):
         dem = concl & ~is_cand
         s["role"] = jnp.where(win, LEADER, s["role"])
         s["next_index"] = jnp.where(
-            win[:, :, None], (s["commit"] + 1)[:, :, None], s["next_index"]
+            win[:, None, :], (s["commit"] + 1)[:, None, :], s["next_index"]
         )  # quirk b
-        s["match_index"] = jnp.where(win[:, :, None], 0, s["match_index"])
+        s["match_index"] = jnp.where(win[:, None, :], 0, s["match_index"])
         s["hb_armed"] = s["hb_armed"] | win
         s["hb_left"] = jnp.where(win, 0, s["hb_left"])  # initial delay 0
         s["round_state"] = jnp.where(win | dem, IDLE, s["round_state"])
@@ -306,11 +312,11 @@ def make_tick(cfg: RaftConfig):
             l_is_f = col("role", l) == FOLLOWER
             # FOLLOWER cancels future firings but this round still goes out
             # (TimerTask.cancel semantics, RaftServer.kt:117).
-            s["hb_armed"] = s["hb_armed"].at[:, l - 1].set(raw_armed & ~(fire & l_is_f))
+            s["hb_armed"] = s["hb_armed"].at[l - 1].set(raw_armed & ~(fire & l_is_f))
             setcol("hb_left", l, fire & ~l_is_f, cfg.hb_ticks - 1)
             for p in range(1, N + 1):
                 li_l = col("last_index", l)
-                i = s["next_index"][:, l - 1, p - 1]
+                i = s["next_index"][l - 1, p - 1]
                 pli = i - 2
                 # prevLogTerm: invalid get -> exception -> skip peer (§6 skip rule).
                 skip = (pli >= 0) & ~(pli < li_l)
@@ -319,7 +325,7 @@ def make_tick(cfg: RaftConfig):
                 skip = skip | (has_entry & (i <= 0))  # quirk i underflow
                 ent_t = log_gather("log_term", l, i - 1)
                 ent_c = log_gather("log_cmd", l, i - 1)
-                skip = skip | ~edge[:, l - 1, p - 1] | ~edge[:, p - 1, l - 1]
+                skip = skip | ~edge[l - 1, p - 1] | ~edge[p - 1, l - 1]
                 act5 = fire & ~skip
                 # --- append handler on p (SEMANTICS.md §6.2) ---
                 req_term = col("term", l)
@@ -353,22 +359,22 @@ def make_tick(cfg: RaftConfig):
                 proc = act5 & ~demote & succ
                 with_e = proc & has_entry
                 nfail = act5 & ~demote & ~succ
-                ni = s["next_index"][:, l - 1, p - 1]
+                ni = s["next_index"][l - 1, p - 1]
                 s["next_index"] = (
                     s["next_index"]
-                    .at[:, l - 1, p - 1]
+                    .at[l - 1, p - 1]
                     .set(jnp.where(with_e, ni + 1, jnp.where(nfail, ni - 1, ni)))
                 )
-                mi = s["match_index"][:, l - 1, p - 1]
+                mi = s["match_index"][l - 1, p - 1]
                 s["match_index"] = (
                     s["match_index"]
-                    .at[:, l - 1, p - 1]
+                    .at[l - 1, p - 1]
                     .set(jnp.where(with_e, mi + 1, jnp.where(proc & ~has_entry, pli + 1, mi)))
                 )
                 # Commit advancement (quirk a), evaluated per response.
                 l_commit = col("commit", l)
                 cnt = jnp.sum(
-                    (s["match_index"][:, l - 1, :] > l_commit[:, None]).astype(_I32), axis=1
+                    (s["match_index"][l - 1] > l_commit[None, :]).astype(_I32), axis=0
                 )
                 setcol("commit", l, with_e & (cnt >= maj), l_commit + 1)
 
@@ -388,9 +394,9 @@ def make_tick(cfg: RaftConfig):
 def make_run(cfg: RaftConfig, n_ticks: int, trace: bool = True):
     """jitted runner: state -> (state, trace) stepping n_ticks via lax.scan.
 
-    trace is a dict of (T, G, N) arrays (role/term/commit/last_index/voted_for/rounds
-    per tick, post-tick) — the differential-test observable. With trace=False returns
-    per-tick (G,) leader counts only (cheap bench/metrics mode).
+    trace is a dict of (T, N, G) arrays (role/term/commit/last_index/voted_for/rounds/
+    up per tick, post-tick) — the differential-test observable. With trace=False
+    returns per-tick (G,) leader counts only (cheap bench/metrics mode).
     """
     tick_fn = make_tick(cfg)
 
@@ -407,7 +413,7 @@ def make_run(cfg: RaftConfig, n_ticks: int, trace: bool = True):
                 "up": st.up,
             }
         else:
-            out = jnp.sum((st.role == LEADER).astype(_I32), axis=1)
+            out = jnp.sum((st.role == LEADER).astype(_I32), axis=0)
         return st, out
 
     @jax.jit

@@ -23,6 +23,7 @@ to a full pod without touching the kernel.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import math
 from typing import Optional, Sequence
 
@@ -56,15 +57,27 @@ def make_mesh(devices: Optional[Sequence[jax.Device]] = None,
     return Mesh(np.asarray(devices).reshape(dcn, ici), ("dcn", "ici"))
 
 
+@functools.lru_cache(maxsize=1)
+def _field_ndims() -> dict:
+    """Per-field array rank, derived from init_state itself (eval_shape traces the
+    init without allocating) so new RaftState fields shard correctly on their last
+    axis by construction."""
+    shapes = jax.eval_shape(
+        lambda: init_state(RaftConfig(n_groups=1, n_nodes=2, log_capacity=2))
+    )
+    return {f.name: getattr(shapes, f.name).ndim for f in dataclasses.fields(RaftState)}
+
+
 def state_sharding(mesh: Mesh) -> RaftState:
-    """A RaftState-shaped pytree of NamedShardings: every (G, ...) array sharded over
-    the flattened ("dcn", "ici") mesh on its leading groups axis; the scalar tick
-    counter replicated."""
-    grouped = NamedSharding(mesh, P(("dcn", "ici")))
-    replicated = NamedSharding(mesh, P())
+    """A RaftState-shaped pytree of NamedShardings: every array sharded over the
+    flattened ("dcn", "ici") mesh on its LAST (groups) axis — state is groups-minor
+    (models/state.py); rank-0 scalars (the tick counter) replicated."""
+    ndims = _field_ndims()
     fields = {}
     for f in dataclasses.fields(RaftState):
-        fields[f.name] = replicated if f.name == "tick" else grouped
+        nd = ndims[f.name]
+        spec = P(*([None] * (nd - 1)), ("dcn", "ici")) if nd else P()
+        fields[f.name] = NamedSharding(mesh, spec)
     return RaftState(**fields)
 
 
@@ -105,14 +118,14 @@ def make_sharded_run(cfg: RaftConfig, mesh: Mesh, n_ticks: int,
         if metrics_every:
             out = {
                 "leaders": jnp.sum(
-                    jnp.any(st.role == LEADER, axis=1).astype(jnp.int32)
+                    jnp.any(st.role == LEADER, axis=0).astype(jnp.int32)
                 ),
                 "elections": jnp.sum(
                     ((prev_role != st.role) & (st.role == 1)).astype(jnp.int32)
                 ),
-                "commit_total": jnp.sum(jnp.max(st.commit, axis=1).astype(jnp.int64)
+                "commit_total": jnp.sum(jnp.max(st.commit, axis=0).astype(jnp.int64)
                                         if jax.config.jax_enable_x64
-                                        else jnp.max(st.commit, axis=1)),
+                                        else jnp.max(st.commit, axis=0)),
             }
         else:
             out = None

@@ -32,7 +32,7 @@ from raft_kotlin_tpu.utils.config import RaftConfig
 _HEADER_KEY = "__raft_config_json__"
 _EXTRA_KEY = "__raft_extra_json__"
 _VERSION_KEY = "__raft_ckpt_version__"
-_VERSION = 2  # v2: +up/+link_up fault-model state fields (SEMANTICS.md §9)
+_VERSION = 3  # v2: +up/+link_up fault-model fields; v3: groups-minor array layout
 
 
 def save(path: str, state: RaftState, cfg: RaftConfig, extra: Optional[dict] = None) -> None:
@@ -94,7 +94,7 @@ def load_with_extra(
 def _load_impl(path, expect_cfg, sharding):
     with np.load(path) as z:
         version = int(z[_VERSION_KEY])
-        if version not in (1, _VERSION):
+        if version not in (1, 2, _VERSION):
             raise ValueError(f"checkpoint version {version} != supported {_VERSION}")
         cfg_dict = json.loads(bytes(z[_HEADER_KEY].tobytes()).decode())
         extra = (
@@ -107,12 +107,19 @@ def _load_impl(path, expect_cfg, sharding):
             for f in dataclasses.fields(RaftState)
             if f.name in z
         }
+    if version < 3:
+        # v1/v2 stored groups-MAJOR arrays ((G, N), (G, N, N), (G, N, C)); v3 is
+        # groups-minor (models/state.py). Pure relabeling — transpose losslessly.
+        arrays = {
+            k: (a if a.ndim == 0 else a.T if a.ndim == 2 else a.transpose(1, 2, 0))
+            for k, a in arrays.items()
+        }
     if version == 1:
-        # v1 predates the fault-model fields; their boot values (everything healthy,
-        # matching init_state) are the only state a v1 run can have been in.
-        G, N = arrays["term"].shape
-        arrays.setdefault("up", np.ones((G, N), dtype=bool))
-        arrays.setdefault("link_up", np.ones((G, N, N), dtype=bool))
+        # v1 also predates the fault-model fields; their boot values (everything
+        # healthy, matching init_state) are the only state a v1 run can have been in.
+        N, G = arrays["term"].shape
+        arrays.setdefault("up", np.ones((N, G), dtype=bool))
+        arrays.setdefault("link_up", np.ones((N, N, G), dtype=bool))
     cfg = RaftConfig(**cfg_dict)
     if expect_cfg is not None and expect_cfg != cfg:
         raise ValueError(

@@ -54,14 +54,16 @@ def tick_metrics(prev: RaftState, cur: RaftState) -> Dict[str, jax.Array]:
     - term_max:           max term anywhere
     - log_bytes_used:     total readable log slots (sum of last_index)
     """
+    # State is groups-minor: role/term are (N, G); node axis = 0.
     is_leader = cur.role == LEADER
-    n_lead = jnp.sum(is_leader.astype(_I32), axis=1)  # (G,)
+    n_lead = jnp.sum(is_leader.astype(_I32), axis=0)  # (G,)
 
     # Same-term leader pairs, O(N^2) on the tiny node axis.
-    lt = jnp.where(is_leader, cur.term, -jnp.arange(cur.term.shape[1], dtype=_I32) - 1)
-    same = (lt[:, :, None] == lt[:, None, :]) & is_leader[:, :, None] & is_leader[:, None, :]
-    same = same & ~jnp.eye(cur.term.shape[1], dtype=bool)[None]
-    split = jnp.any(same, axis=(1, 2))
+    N = cur.term.shape[0]
+    lt = jnp.where(is_leader, cur.term, -jnp.arange(N, dtype=_I32)[:, None] - 1)
+    same = (lt[:, None, :] == lt[None, :, :]) & is_leader[:, None, :] & is_leader[None, :, :]
+    same = same & ~jnp.eye(N, dtype=bool)[:, :, None]
+    split = jnp.any(same, axis=(0, 1))
 
     d_commit = jnp.maximum(cur.commit - prev.commit, 0)
     return {
@@ -73,7 +75,7 @@ def tick_metrics(prev: RaftState, cur: RaftState) -> Dict[str, jax.Array]:
         "rounds_active": jnp.sum((cur.round_state == ACTIVE).astype(_I32)),
         "candidates": jnp.sum((cur.role == CANDIDATE).astype(_I32)),
         "commit_advanced": jnp.sum(d_commit),
-        "commit_total": jnp.sum(jnp.max(cur.commit, axis=1)),
+        "commit_total": jnp.sum(jnp.max(cur.commit, axis=0)),
         "term_max": jnp.max(cur.term),
         "log_bytes_used": jnp.sum(cur.last_index),
     }
@@ -107,7 +109,8 @@ def check_invariants(prev: RaftState, cur: RaftState, cfg: RaftConfig) -> Dict[s
     def cnt(bad) -> jax.Array:
         return jnp.sum(bad.astype(_I32))
 
-    resp_cnt = jnp.sum(cur.responded.astype(_I32), axis=2)
+    # responded is (N, N, G) [c-1, p-1, g]: count responses over the peer axis.
+    resp_cnt = jnp.sum(cur.responded.astype(_I32), axis=1)
     in_round = cur.round_state == ACTIVE
     restarted = cur.up & ~prev.up
     return {

@@ -79,6 +79,10 @@ def test_v1_checkpoint_forward_migration(tmp_path):
     with np.load(path) as z:
         arrays = dict(z)
     del arrays["up"], arrays["link_up"]
+    # v1 stored groups-MAJOR arrays: transpose each field back to the old layout.
+    for k, a in arrays.items():
+        if not k.startswith("__") and a.ndim >= 2:
+            arrays[k] = a.T if a.ndim == 2 else a.transpose(2, 0, 1)
     arrays["__raft_ckpt_version__"] = np.asarray(1, dtype=np.int32)
     np.savez_compressed(path, **arrays)
 

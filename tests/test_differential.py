@@ -24,7 +24,8 @@ FIELDS = ("role", "term", "commit", "last_index", "voted_for", "rounds", "up")
 def run_kernel(cfg: RaftConfig, n_ticks: int):
     run = make_run(cfg, n_ticks, trace=True)
     state, trace = run(init_state(cfg))
-    return {k: np.asarray(v) for k, v in trace.items()}  # (T, G, N)
+    # Kernel traces are (T, N, G) groups-minor; canonicalize to (T, G, N).
+    return {k: np.asarray(v).transpose(0, 2, 1) for k, v in trace.items()}
 
 
 def run_oracles(cfg: RaftConfig, n_ticks: int):

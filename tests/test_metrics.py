@@ -25,14 +25,14 @@ TICKS = 120
 def test_metrics_match_trace_recomputation():
     run = make_run(CFG, TICKS, trace=True)
     _, trace = run(init_state(CFG))
-    role = np.asarray(trace["role"])        # (T, G, N)
+    role = np.asarray(trace["role"])        # (T, N, G) groups-minor
     rounds = np.asarray(trace["rounds"])
     commit = np.asarray(trace["commit"])
 
     inst = make_instrumented_run(CFG, TICKS)
     _, m = inst(init_state(CFG))
 
-    lead_per_group = (role == LEADER).sum(axis=2)          # (T, G)
+    lead_per_group = (role == LEADER).sum(axis=1)          # (T, G)
     assert np.array_equal(np.asarray(m["leaders"]), (lead_per_group >= 1).sum(axis=1))
     assert np.array_equal(np.asarray(m["multi_leader"]), (lead_per_group >= 2).sum(axis=1))
 
@@ -43,7 +43,7 @@ def test_metrics_match_trace_recomputation():
     prev_commit = np.concatenate([np.zeros_like(commit[:1]), commit[:-1]])
     adv = np.maximum(commit - prev_commit, 0).sum(axis=(1, 2))
     assert np.array_equal(np.asarray(m["commit_advanced"]), adv)
-    assert np.array_equal(np.asarray(m["commit_total"]), commit.max(axis=2).sum(axis=1))
+    assert np.array_equal(np.asarray(m["commit_total"]), commit.max(axis=1).sum(axis=1))
     # Ticks are 1-based post-step.
     assert np.asarray(m["tick"])[0] == 1 and np.asarray(m["tick"])[-1] == TICKS
 
@@ -95,12 +95,12 @@ def test_split_leader_telemetry_counts_same_term_pairs():
     # Hand-build a state with two same-term leaders in group 0 and two
     # different-term leaders in group 1.
     st = init_state(CFG)
-    role = np.asarray(st.role).copy()
+    role = np.asarray(st.role).copy()   # (N, G) groups-minor
     term = np.asarray(st.term).copy()
-    role[0, 0] = role[0, 1] = LEADER
-    term[0, 0] = term[0, 1] = 7
-    role[1, 0] = role[1, 2] = LEADER
-    term[1, 0], term[1, 2] = 3, 4
+    role[0, 0] = role[1, 0] = LEADER    # group 0: nodes 1+2 lead, same term
+    term[0, 0] = term[1, 0] = 7
+    role[0, 1] = role[2, 1] = LEADER    # group 1: nodes 1+3 lead, different terms
+    term[0, 1], term[2, 1] = 3, 4
     bad = dataclasses.replace(st, role=np.asarray(role), term=np.asarray(term))
     m = tick_metrics(st, bad)
     assert int(np.asarray(m["multi_leader"])) == 2

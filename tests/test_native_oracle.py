@@ -17,7 +17,7 @@ def assert_native_matches_kernel(cfg: RaftConfig, n_ticks: int):
     _, ktr = run(init_state(cfg))
     ntr = NativeOracle(cfg).run(n_ticks)
     for k in TRACE_FIELDS:
-        kv = np.asarray(ktr[k]).astype(np.int32)
+        kv = np.asarray(ktr[k]).transpose(0, 2, 1).astype(np.int32)
         if not np.array_equal(kv, ntr[k]):
             bad = np.argwhere(kv != ntr[k])
             ti, g, n = bad[0]
@@ -61,7 +61,7 @@ def test_inject_and_fault_cmd_bitmatch():
     for t in range(T):
         st = tick(st, jnp.asarray(inject[t]), jnp.asarray(fault[t]))
         for k in TRACE_FIELDS:
-            kt[k].append(np.asarray(getattr(st, k if k != "last_index" else "last_index")))
+            kt[k].append(np.asarray(getattr(st, k)).T)  # (N, G) -> (G, N)
     ntr = NativeOracle(cfg).run(T, inject=inject, fault_cmd=fault)
     for k in TRACE_FIELDS:
         kv = np.stack(kt[k]).astype(np.int32)

@@ -64,4 +64,4 @@ def test_state_actually_sharded():
     assert sh.is_equivalent_to(state_sharding(mesh).term, st.term.ndim)
     # Each device holds 1/8 of the groups axis.
     assert len(st.term.addressable_shards) == len(jax.devices())
-    assert st.term.addressable_shards[0].data.shape[0] == 1
+    assert st.term.addressable_shards[0].data.shape[-1] == 1  # groups axis is last
