@@ -32,10 +32,53 @@ def main() -> None:
     from raft_kotlin_tpu.ops.tick import make_tick
     from raft_kotlin_tpu.utils.config import RaftConfig
 
+    # Prefer the Pallas megakernel (ops/pallas_tick.py) on real hardware; fall back
+    # to the XLA tick if the group count is not lane-aligned or Mosaic rejects the
+    # kernel. Mosaic compiles lazily at the first run, so the fallback must wrap the
+    # warmup, not just kernel construction — see measure().
+    def tick_candidates(cfg2):
+        from raft_kotlin_tpu.ops.pallas_tick import make_pallas_tick, pick_tile
+
+        if on_accel and pick_tile(cfg2.n_groups) is not None:
+            try:
+                yield make_pallas_tick(cfg2, interpret=False), "pallas"
+            except Exception:
+                pass
+        yield make_tick(cfg2), "xla"
+
+    def measure(cfg2, n_ticks, n_reps):
+        """-> (best_seconds, end_state, start_state, impl); warms up each candidate
+        and falls back if compilation (lazy, at warmup) fails."""
+        st0 = init_state(cfg2)
+        jax.block_until_ready(st0.term)
+        last_err = None
+        for tick_fn, impl in tick_candidates(cfg2):
+            @jax.jit
+            def run(st):
+                return jax.lax.scan(
+                    lambda s, _: (tick_fn(s), None), st, None, length=n_ticks)[0]
+
+            try:
+                warm = run(st0)
+                jax.block_until_ready(warm.term)
+            except Exception as e:  # Mosaic rejection etc. -> next candidate
+                last_err = e
+                continue
+            best = float("inf")
+            end = warm
+            for _ in range(n_reps):
+                t0 = time.perf_counter()
+                end = run(st0)
+                jax.block_until_ready(end.term)
+                best = min(best, time.perf_counter() - t0)
+            return best, end, st0, impl
+        raise last_err
+
     platform = jax.devices()[0].platform
     on_accel = platform not in ("cpu",)
 
-    groups = int(os.environ.get("RAFT_BENCH_GROUPS", 100_000 if on_accel else 4_096))
+    # 102_400 = 100k rounded up to the Pallas lane tile (ops/pallas_tick.py).
+    groups = int(os.environ.get("RAFT_BENCH_GROUPS", 102_400 if on_accel else 4_096))
     ticks = int(os.environ.get("RAFT_BENCH_TICKS", 200 if on_accel else 50))
     reps = int(os.environ.get("RAFT_BENCH_REPS", 3))
 
@@ -48,26 +91,7 @@ def main() -> None:
         seed=0,
     ).stressed(10)
 
-    tick_fn = make_tick(cfg)
-
-    @jax.jit
-    def run(st):
-        return jax.lax.scan(lambda s, _: (tick_fn(s), None), st, None, length=ticks)[0]
-
-    st = init_state(cfg)
-    jax.block_until_ready(st.term)
-
-    # Warmup / compile.
-    warm = run(st)
-    jax.block_until_ready(warm.term)
-
-    best = float("inf")
-    end_state = warm
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        end_state = run(st)
-        jax.block_until_ready(end_state.term)
-        best = min(best, time.perf_counter() - t0)
+    best, end_state, st, impl = measure(cfg, ticks, reps)
 
     group_steps_per_sec = groups * ticks / best
     elections = int(jnp.sum(end_state.rounds) - jnp.sum(st.rounds))
@@ -83,23 +107,7 @@ def main() -> None:
         el_lo=2, el_hi=3, hb_ticks=2, round_ticks=3, retry_ticks=2,
         bo_lo=2, bo_hi=3,
     )
-    churn_tick = make_tick(churn_cfg)
-
-    @jax.jit
-    def churn_run(st2):
-        return jax.lax.scan(
-            lambda s, _: (churn_tick(s), None), st2, None, length=ticks)[0]
-
-    st2 = init_state(churn_cfg)
-    warm2 = churn_run(st2)
-    jax.block_until_ready(warm2.term)
-    tbest = float("inf")
-    out2 = warm2
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        out2 = churn_run(st2)
-        jax.block_until_ready(out2.term)
-        tbest = min(tbest, time.perf_counter() - t0)
+    tbest, out2, st2, churn_impl = measure(churn_cfg, ticks, reps)
     churn_elections = int(jnp.sum(out2.rounds) - jnp.sum(st2.rounds))
     churn_elections_per_sec = churn_elections / tbest
 
@@ -115,6 +123,8 @@ def main() -> None:
         "elections_per_sec": round(elections_per_sec, 1),
         "elections_per_sec_churn": round(churn_elections_per_sec, 1),
         "ticks_per_sec": round(ticks / best, 2),
+        "impl": impl,
+        "impl_churn": churn_impl,
         "groups": groups,
         "n_nodes": cfg.n_nodes,
         "ticks": ticks,

@@ -1,0 +1,199 @@
+"""Pallas TPU megakernel for the lockstep tick.
+
+The XLA tick (ops/tick.py) compiles to dozens of fusion islands, each round-tripping
+the full (N, G) state through HBM; at 100k groups that HBM traffic, not compute, is
+the throughput ceiling. This kernel runs the ENTIRE phase lattice (SEMANTICS.md
+§9 phase F + §5 phases 0-5) for a tile of groups in one pallas_call: each state array
+is read from HBM once, lives in VMEM across all phases, and is written back once.
+
+Division of labor (bit-compatibility by construction):
+- The phase logic is literally ops/tick.phase_body — the same function object the XLA
+  tick runs; this module only changes where its inputs/outputs live.
+- ALL randomness stays outside the kernel in ordinary XLA jax.random ops
+  (ops/tick.make_aux / finish_tick): every draw phase_body needs is derivable from
+  pre-tick state, except the deferred election draws, which the kernel reports back
+  via an el_dirty output and finish_tick materializes. No threefry in Mosaic, no
+  bit-replication risk.
+- Bool state is passed to Mosaic as int32 (i1 memrefs are poorly supported) and
+  converted at the kernel boundary.
+
+The groups axis is the minor/lane axis of every array (models/state.py), so a tile is
+a contiguous (…, tile_g) lane slab. tile_g defaults to the largest of 1024/512/256/128
+dividing G; on TPU, G must be lane-aligned (pad_groups_for_pallas rounds a config up).
+On CPU the kernel runs in interpreter mode automatically (tests), with any G.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from raft_kotlin_tpu.models.state import RaftState
+from raft_kotlin_tpu.ops import tick as tick_mod
+from raft_kotlin_tpu.ops.tick import AUX_FIELDS, STATE_FIELDS, BodyFlags
+from raft_kotlin_tpu.utils import rng as rngmod
+from raft_kotlin_tpu.utils.config import RaftConfig
+
+_I32 = jnp.int32
+# Bool<->int32 conversion happens only for (N, G) grids; pair-shaped fields
+# (responded/link_up) and pair aux masks travel as int32 end to end — phase_body's
+# contract (no i1 tensors at pair shape).
+_BOOL_STATE = ("el_armed", "hb_armed", "up")
+_BOOL_AUX = ("crash_m", "restart_m")
+_TILES = (1024, 512, 256, 128)
+
+
+def pick_tile(G: int, total_rows: int = 0) -> Optional[int]:
+    """Largest supported tile dividing G that fits the Mosaic scoped-VMEM budget.
+
+    Empirical cost model: the kernel's VMEM stack (inputs + outputs + live
+    temporaries across the unrolled phase lattice) measures ~30 bytes per
+    (row, lane) element — the N=5, C=32 config hits 34 MB at ~1120 rows x 1024
+    lanes against the 16 MB scoped limit. Budget 12 MB for headroom.
+    """
+    budget = 12e6
+    for t in _TILES:
+        if G % t == 0 and (not total_rows or total_rows * t * 30 <= budget):
+            return t
+    return None
+
+
+def pad_groups_for_pallas(cfg: RaftConfig, tile: int = 256) -> RaftConfig:
+    """Round n_groups up to a lane-aligned multiple (extra groups are real
+    simulations, just surplus — same convention as parallel.mesh.pad_groups)."""
+    g = ((cfg.n_groups + tile - 1) // tile) * tile
+    return dataclasses.replace(cfg, n_groups=g)
+
+
+def make_pallas_tick(cfg: RaftConfig, tile_g: Optional[int] = None,
+                     interpret: Optional[bool] = None):
+    """Build tick(state, inject=None, fault_cmd=None) -> state — same contract and
+    same bits as ops.tick.make_tick(cfg), different compilation strategy."""
+    N, C, G = cfg.n_nodes, cfg.log_capacity, cfg.n_groups
+    base = rngmod.base_key(cfg.seed)
+    tkeys = rngmod.grid_keys(base, rngmod.KIND_TIMEOUT, G, N).T
+    bkeys = rngmod.grid_keys(base, rngmod.KIND_BACKOFF, G, N).T
+
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    if tile_g is None:
+        # Rows across all in/out blocks (see field/aux shapes below): 2x state
+        # (in + aliased out) + worst-case aux + el_dirty.
+        n_2d = sum(1 for k in STATE_FIELDS
+                   if k not in ("log_term", "log_cmd", "responded",
+                                "next_index", "match_index", "link_up"))
+        rows = 2 * (n_2d * N + 4 * N * N + 2 * N * C) + (3 * N * N + 5 * N + 1) + N
+        tile_g = pick_tile(G, rows) if not interpret else min(G, 256)
+    if tile_g is None and not interpret:
+        if pick_tile(G) is None:
+            raise ValueError(
+                f"n_groups={G} is not a multiple of any supported tile {_TILES}; "
+                "pad with pad_groups_for_pallas()")
+        raise ValueError(
+            f"no tile in {_TILES} dividing n_groups={G} fits the scoped-VMEM "
+            f"budget for n_nodes={N}, log_capacity={C}; shrink the config or "
+            "pass tile_g explicitly")
+    assert interpret or G % tile_g == 0
+    if interpret and G % tile_g:
+        tile_g = G  # interpreter: one tile, no alignment constraints
+
+    # Per-tile block shapes. Everything is RANK-2 (rows, tile_g): phase_body's flat
+    # layout (ops/tick.py) — pair grids (N*N, ·), logs (N*C, ·) — which is also what
+    # Mosaic wants (no rank-3 i1 vectors, lane axis minor).
+    field_shapes = {
+        **{k: (N, tile_g) for k in STATE_FIELDS},
+        "log_term": (N * C, tile_g), "log_cmd": (N * C, tile_g),
+        "responded": (N * N, tile_g), "next_index": (N * N, tile_g),
+        "match_index": (N * N, tile_g), "link_up": (N * N, tile_g),
+    }
+    aux_shapes = {
+        "edge_iid": (N * N, tile_g), "crash_m": (N, tile_g),
+        "restart_m": (N, tile_g), "link_fail": (N * N, tile_g),
+        "link_heal": (N * N, tile_g), "el_draw_f": (N, tile_g),
+        "bdraw": (N, tile_g), "periodic": (1, tile_g), "inject": (N, tile_g),
+    }
+
+    def block_spec(shape):
+        return pl.BlockSpec(shape, lambda i: (0, i))
+
+    @functools.lru_cache(maxsize=None)
+    def build_call(flags: BodyFlags):
+        aux_names = tuple(
+            k for k in AUX_FIELDS
+            if (k in ("edge_iid", "bdraw"))
+            or (k in ("crash_m", "restart_m", "el_draw_f") and flags.faults)
+            or (k in ("link_fail", "link_heal") and flags.links)
+            or (k == "periodic" and flags.periodic)
+            or (k == "inject" and flags.inject)
+        )
+
+        def kernel(*refs):
+            n_in = len(STATE_FIELDS) + len(aux_names)
+            ins = dict(zip(STATE_FIELDS + aux_names, refs[:n_in]))
+            outs = dict(zip(STATE_FIELDS + ("el_dirty",), refs[n_in:]))
+            s = {}
+            for k in STATE_FIELDS:
+                v = ins[k][...]
+                s[k] = (v != 0) if k in _BOOL_STATE else v
+            aux = {}
+            for k in aux_names:
+                v = ins[k][...]
+                aux[k] = (v != 0) if k in _BOOL_AUX else v
+            el_dirty = tick_mod.phase_body(cfg, s, aux, flags)
+            for k in STATE_FIELDS:
+                outs[k][...] = s[k].astype(_I32) if k in _BOOL_STATE else s[k]
+            outs["el_dirty"][...] = el_dirty.astype(_I32)
+
+        in_specs = [block_spec(field_shapes[k]) for k in STATE_FIELDS]
+        in_specs += [block_spec(aux_shapes[k]) for k in aux_names]
+        out_shapes = [
+            jax.ShapeDtypeStruct(tuple(field_shapes[k][:-1]) + (G,), _I32)
+            for k in STATE_FIELDS
+        ] + [jax.ShapeDtypeStruct((N, G), _I32)]
+        out_specs = [block_spec(field_shapes[k]) for k in STATE_FIELDS]
+        out_specs += [block_spec((N, tile_g))]
+
+        call = pl.pallas_call(
+            kernel,
+            grid=(G // tile_g,),
+            in_specs=in_specs,
+            out_specs=out_specs,
+            out_shape=out_shapes,
+            input_output_aliases={i: i for i in range(len(STATE_FIELDS))},
+            interpret=interpret,
+        )
+        return call, aux_names
+
+    def tick(
+        state: RaftState,
+        inject: Optional[jax.Array] = None,
+        fault_cmd: Optional[jax.Array] = None,
+    ) -> RaftState:
+        assert state.term.shape[-1] == G, (
+            f"state has {state.term.shape[-1]} groups, kernel built for {G}"
+        )
+        aux, flags = tick_mod.make_aux(
+            cfg, base, tkeys, bkeys, state, inject, fault_cmd)
+        call, aux_names = build_call(flags)
+        flat = tick_mod.flatten_state(cfg, state)
+        ins = []
+        for k in STATE_FIELDS:
+            v = flat[k]
+            ins.append(v.astype(_I32) if k in _BOOL_STATE else v)
+        for k in aux_names:
+            v = aux[k]
+            ins.append(v.astype(_I32) if k in _BOOL_AUX else v)
+        outs = call(*ins)
+        s = {}
+        for k, v in zip(STATE_FIELDS, outs[: len(STATE_FIELDS)]):
+            s[k] = (v != 0) if k in _BOOL_STATE else v
+        el_dirty = outs[-1] != 0
+        return tick_mod.finish_tick(
+            cfg, tkeys, tick_mod.unflatten_state(cfg, s), el_dirty, state.tick)
+
+    return tick

@@ -9,9 +9,19 @@ sees static shapes throughout. State is laid out groups-minor ((N, G), (N, N, G)
 RPC exchanges are in-array mailbox transactions: each (candidate, peer) /
 (leader, peer) pair is one masked vectorized read-modify-write over the G axis, applied
 sequentially in the canonical order so the result is bit-identical to the scalar oracle
-(models/oracle.py). Quorum tallies are reductions over the node axis. All randomness is
-counted threefry (utils/rng.py), drawn in the canonical (G, ...) shapes and transposed
-at the boundary.
+(models/oracle.py). Quorum tallies are reductions over the node axis.
+
+The tick is split into two pieces so one implementation of the protocol serves two
+compilation paths:
+- `phase_body(cfg, s, aux, flags)` — the ENTIRE phase lattice (F, 0-5) as pure jnp ops
+  on a dict of (N, G)-shaped values. It consumes NO randomness: every draw it needs
+  arrives pre-drawn in `aux` (all derivable from pre-tick state, except the deferred
+  election draws which it reports back via the returned el_dirty mask).
+- `make_tick(cfg)` — the XLA wrapper: draws the aux inputs (counted threefry,
+  utils/rng.py, canonical (G, ...) shapes transposed at the boundary), runs
+  phase_body, then materializes the deferred election-timer draws.
+The Pallas megakernel (ops/pallas_tick.py) wraps the SAME phase_body, so the two
+backends are bit-identical by construction.
 """
 
 from __future__ import annotations
@@ -37,6 +47,461 @@ from raft_kotlin_tpu.utils.config import RaftConfig
 
 _I32 = jnp.int32
 
+# The phase_body state fields, in canonical order (everything except the tick scalar).
+STATE_FIELDS = tuple(
+    f.name for f in dataclasses.fields(RaftState) if f.name != "tick"
+)
+# Pre-drawn randomness + driver inputs consumed by phase_body.
+AUX_FIELDS = (
+    "edge_iid",    # (N*N, G) bool — §4 iid survival, row (s-1)*N + r-1
+    "crash_m",     # (N, G) bool — §9 crash events (random ∨ driver cmd)
+    "restart_m",   # (N, G) bool
+    "link_fail",   # (N*N, G) bool
+    "link_heal",   # (N*N, G) bool
+    "el_draw_f",   # (N, G) i32 — timeout draw at pre-tick t_ctr (phase-F restarts)
+    "bdraw",       # (N, G) i32 — backoff draw at pre-tick b_ctr (phase 4)
+    "periodic",    # (1, G) i32 — phase-0 workload command value, -1 = none
+    "inject",      # (N, G) i32 — driver commands, -1 = none
+)
+
+
+
+# The phase lattice works exclusively on RANK-2 (rows, G) arrays: (N, G) per-node
+# grids, (N*N, G) flattened pair grids (row = (a-1)*N + b-1), (N*C, G) flattened logs
+# (row = (n-1)*C + slot). Rationale: Pallas/Mosaic TC kernels implement neither
+# scatter nor dynamic_update_slice on values and mishandle rank-3 i1 vectors, so all
+# static-index updates are one-hot row selects (iota + compare + where — primitives
+# both XLA and Mosaic handle; XLA folds the constant one-hots) and rank never
+# exceeds 2. Flattening (N, N, G) -> (N*N, G) at the wrapper boundary is free.
+_PAIR_FIELDS = ("responded", "next_index", "match_index", "link_up")
+_LOG_FIELDS = ("log_term", "log_cmd")
+
+
+def _set_row(arr, i, vals):
+    """arr[i] = vals for a static row index i; vals has arr.shape[1:].
+    Bool arrays route through int32: Mosaic lowers select-of-i1-VALUES via an i8
+    widening it then cannot truncate back (i1 conditions are fine)."""
+    if arr.dtype == jnp.bool_:
+        return _set_row(arr.astype(_I32), i, vals.astype(_I32)) != 0
+    hot = lax.broadcasted_iota(_I32, arr.shape, 0) == i
+    return jnp.where(hot, vals[None], arr)
+
+
+def _rep_rows(vals, N):
+    """(N, G) -> (N*N, G) owner replication: output row (a-1)*N + b-1 carries
+    vals[a-1] — the pair-grid broadcast `vals[:, None, :]`, built rank-2-only.
+    Bool inputs concatenate as int32 and compare back: Mosaic lowers i1 concat
+    through an i8 widening it then cannot truncate."""
+    if vals.dtype == jnp.bool_:
+        return _rep_rows(vals.astype(_I32), N) != 0
+    return jnp.concatenate(
+        [jnp.broadcast_to(vals[a][None], (N,) + vals.shape[1:]) for a in range(N)],
+        axis=0,
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class BodyFlags:
+    """Static switches: which optional phases the compiled body includes."""
+    faults: bool = False
+    links: bool = False
+    periodic: bool = False
+    inject: bool = False
+
+
+def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
+    """Advance the phase lattice F,0-5 one tick, mutating `s` in place.
+
+    `s` maps STATE_FIELDS to RANK-2 values: (N, G) per-node grids, (N*N, G) pair
+    grids (_PAIR_FIELDS, row (a-1)*N + b-1), (N*C, G) logs (_LOG_FIELDS, row
+    (n-1)*C + slot) — see flatten_state. Bool fields are real bools.
+    `aux` maps AUX_FIELDS to values (only the ones the flags enable are read).
+    Returns el_dirty (N, G) bool: nodes whose election timer reset in phases 2-5 and
+    whose el_left must be materialized by the caller as the draw at t_ctr - 1
+    (SEMANTICS.md §7 deferral — el_left's only reader is phase 1).
+    """
+    N, C, maj = cfg.n_nodes, cfg.log_capacity, cfg.majority
+    G = s["term"].shape[-1]
+    logrow = jax.lax.broadcasted_iota(_I32, (N * C, G), 0)
+
+    def pair(a, b):
+        # Flat pair-grid row for (owner a, peer b), both 1-based.
+        return (a - 1) * N + (b - 1)
+
+    def col(name, n):
+        return s[name][n - 1]
+
+    def setcol(name, n, mask, vals):
+        cur = s[name][n - 1]
+        s[name] = _set_row(s[name], n - 1, jnp.where(mask, vals, cur))
+
+    def log_gather(name, n, idx):
+        # (G,) read of node n's physical slot idx, as a one-hot contraction over the
+        # flat (N*C, G) log (no gather op — TPU-friendly); 0 where idx is out of
+        # [0, C) — callers must guard with masks.
+        oh = logrow == ((n - 1) * C + idx)[None, :]
+        return jnp.sum(jnp.where(oh, s[name], 0), axis=0)
+
+    def log_add(n, i, term_v, cmd_v, mask):
+        # SEMANTICS.md §3 add(): physical append / reject / overwrite-truncate.
+        # One-hot masked write over the flat log instead of a scatter; the write
+        # slot is always in-range where the write mask holds (append needs
+        # phys_len < C; overwrite needs i < last_index <= C).
+        li = col("last_index", n)
+        pl = col("phys_len", n)
+        app = mask & (i == li) & (pl < C)
+        ovw = mask & (i < li) & (i >= 0)
+        slot = (n - 1) * C + jnp.where(app, pl, i)
+        oh = (logrow == slot[None, :]) & (app | ovw)[None, :]
+        s["log_term"] = jnp.where(oh, term_v[None, :], s["log_term"])
+        s["log_cmd"] = jnp.where(oh, cmd_v[None, :], s["log_cmd"])
+        setcol("last_index", n, app | ovw, jnp.where(app, li + 1, i + 1))
+        setcol("phys_len", n, app, pl + 1)
+
+    # Election-timer resets (SEMANTICS.md §7): each reset consumes one counted draw
+    # and leaves el_left at the LAST consumed draw's value. In phases 2-5 nothing
+    # reads el_left (phase 1 is its only reader), so those draws are DEFERRED:
+    # resets just advance t_ctr and mark the node dirty; the caller materializes
+    # el_left afterwards — identical bits, ~50x fewer threefry evaluations per tick.
+    # Phase-F restarts must reset immediately (phase 1 reads them this same tick);
+    # their draw (at pre-tick t_ctr, which phase F consumes first) is aux.el_draw_f.
+    # (Constant built by comparison, not a dense bool literal — Mosaic-safe.)
+    aux_dirty = {"m": jnp.zeros((N, G), dtype=_I32) > 0}
+
+    def reset_el_timer_col(n, mask):
+        ctr = col("t_ctr", n)
+        s["el_armed"] = _set_row(s["el_armed"], n - 1, col("el_armed", n) | mask)
+        setcol("t_ctr", n, mask, ctr + 1)
+        aux_dirty["m"] = _set_row(aux_dirty["m"], n - 1, aux_dirty["m"][n - 1] | mask)
+
+    def reset_el_timer_grid(mask):
+        s["el_armed"] = s["el_armed"] | mask
+        s["t_ctr"] = s["t_ctr"] + mask.astype(_I32)
+        aux_dirty["m"] = aux_dirty["m"] | mask
+
+    # -- phase F: fault events (SEMANTICS.md §9) ----------------------------
+
+    if flags.faults:
+        crash_ev = s["up"] & aux["crash_m"]
+        restart_ev = ~s["up"] & aux["restart_m"]
+        s["up"] = (s["up"] & ~crash_ev) | restart_ev
+        rst = restart_ev
+        zero = jnp.zeros((), _I32)
+        s["term"] = jnp.where(rst, zero, s["term"])
+        s["voted_for"] = jnp.where(rst, -1, s["voted_for"])
+        s["role"] = jnp.where(rst, FOLLOWER, s["role"])
+        s["commit"] = jnp.where(rst, zero, s["commit"])
+        s["last_index"] = jnp.where(rst, zero, s["last_index"])
+        s["phys_len"] = jnp.where(rst, zero, s["phys_len"])
+        s["round_state"] = jnp.where(rst, IDLE, s["round_state"])
+        for f in ("votes", "responses", "round_left", "round_age", "bo_left"):
+            s[f] = jnp.where(rst, zero, s[f])
+        # Pair grids are owned by their FIRST node index (candidate/leader).
+        # Arithmetic selects: pair-shaped tensors never hold i1 (Mosaic limits).
+        keep = 1 - _rep_rows(rst.astype(_I32), N)
+        s["responded"] = s["responded"] * keep
+        s["next_index"] = s["next_index"] * keep
+        s["match_index"] = s["match_index"] * keep
+        s["hb_armed"] = s["hb_armed"] & ~rst
+        s["hb_left"] = jnp.where(rst, zero, s["hb_left"])
+        # Immediate reset: el_draw_f is the draw at pre-tick t_ctr, consumed here.
+        s["el_left"] = jnp.where(rst, aux["el_draw_f"], s["el_left"])
+        s["el_armed"] = s["el_armed"] | rst
+        s["t_ctr"] = s["t_ctr"] + rst.astype(_I32)
+    if flags.links:
+        lu = s["link_up"]
+        s["link_up"] = lu * (1 - aux["link_fail"]) + (1 - lu) * aux["link_heal"]
+
+    # Effective edge health (§9): iid survival ∧ link health ∧ both ends up.
+    # Evaluated lazily per (a, b) pair so no rank-3 mask is ever built.
+    up = s["up"]
+
+    def edge_ok(a, b):
+        return (
+            (aux["edge_iid"][pair(a, b)] != 0)
+            & (s["link_up"][pair(a, b)] != 0)
+            & up[a - 1]
+            & up[b - 1]
+        )
+
+    # -- phase 0: command injection (quirk k) -------------------------------
+
+    if flags.periodic:
+        n = cfg.cmd_node
+        cmd = aux["periodic"][0]
+        log_add(n, col("last_index", n), col("term", n), cmd,
+                (cmd >= 0) & col("up", n))
+    if flags.inject:
+        for n in range(1, N + 1):
+            cmd = aux["inject"][n - 1]
+            log_add(n, col("last_index", n), col("term", n), cmd,
+                    (cmd >= 0) & col("up", n))
+
+    # -- phase 1: timers (independent countdowns) ---------------------------
+
+    armed = s["el_armed"] & up
+    left = s["el_left"] - armed.astype(_I32)
+    fire = armed & (left <= 0)
+    s["el_left"] = left
+    s["el_armed"] = s["el_armed"] & ~fire
+    s["role"] = jnp.where(fire, CANDIDATE, s["role"])
+    start_round = fire
+
+    in_bo = (s["round_state"] == BACKOFF) & up
+    bleft = s["bo_left"] - in_bo.astype(_I32)
+    bfire = in_bo & (bleft <= 0)
+    s["bo_left"] = bleft
+    s["round_state"] = jnp.where(bfire, IDLE, s["round_state"])
+    start_round = start_round | bfire
+
+    # -- phase 2: round starts ---------------------------------------------
+
+    is_cand = s["role"] == CANDIDATE
+    init = start_round & is_cand
+    node_ids = jax.lax.broadcasted_iota(_I32, (N, G), 0) + 1
+    s["term"] = s["term"] + init.astype(_I32)
+    s["voted_for"] = jnp.where(init, node_ids, s["voted_for"])
+    s["votes"] = jnp.where(init, 0, s["votes"])
+    s["responses"] = jnp.where(init, 0, s["responses"])
+    s["responded"] = s["responded"] * (1 - _rep_rows(init.astype(_I32), N))
+    s["round_left"] = jnp.where(init, cfg.round_ticks, s["round_left"])
+    s["round_age"] = jnp.where(init, 0, s["round_age"])
+    s["round_state"] = jnp.where(init, ACTIVE, s["round_state"])
+    s["rounds"] = s["rounds"] + init.astype(_I32)
+    demoted_bo = start_round & ~is_cand
+    s["round_state"] = jnp.where(demoted_bo, IDLE, s["round_state"])
+    reset_el_timer_grid(demoted_bo)
+
+    # -- phase 3: vote exchanges --------------------------------------------
+
+    for c in range(1, N + 1):
+        c_attempting = (col("round_state", c) == ACTIVE) & (
+            col("round_age", c) % cfg.retry_ticks == 0
+        )
+        for p in range(1, N + 1):
+            att = (
+                c_attempting
+                & (s["responded"][pair(c, p)] == 0)
+                & edge_ok(c, p)
+                & edge_ok(p, c)
+            )
+            # Request built from c's live state (RaftServer.kt:200-207).
+            c_term = col("term", c)
+            c_li = col("last_index", c)
+            c_llt = jnp.where(c_li == 0, 0, log_gather("log_term", c, c_li - 1))
+            # Vote handler on p (SEMANTICS.md §6.1).
+            p_term = col("term", p)
+            p_vf = col("voted_for", p)
+            p_li = col("last_index", p)
+            p_llt = log_gather("log_term", p, p_li - 1)
+            rej_stale = (p_li >= 1) & (c_llt < p_llt)
+            rej_short = (p_li >= 1) & (c_llt == p_llt) & (c_li < p_li)
+            grant_gt = (c_term > p_term) & ~rej_stale & ~rej_short
+            # Boolean algebra, not where-of-bools (Mosaic i1-select limits):
+            # term < p.term -> False; == -> votedFor check (quirk g); > -> log check.
+            granted = ((c_term == p_term) & (p_vf == c)) | grant_gt
+            adopt = att & grant_gt
+            setcol("term", p, adopt, c_term)
+            setcol("voted_for", p, adopt, c)
+            setcol("role", p, adopt, FOLLOWER)
+            reset_el_timer_col(p, adopt)
+            resp_term = col("term", p)
+            # Candidate tally (RaftServer.kt:209-211).
+            s["responded"] = _set_row(
+                s["responded"], pair(c, p),
+                jnp.where(att, 1, s["responded"][pair(c, p)]),
+            )
+            setcol("responses", c, att, col("responses", c) + 1)
+            setcol("role", c, att & (resp_term > c_term), FOLLOWER)  # quirk f
+            setcol("votes", c, att & granted, col("votes", c) + 1)
+
+    # -- phase 4: round conclusions -----------------------------------------
+
+    act = (s["round_state"] == ACTIVE) & up
+    concl = act & ((s["responses"] >= maj) | (s["round_left"] <= 0))
+    is_cand = s["role"] == CANDIDATE
+    win = concl & is_cand & (s["votes"] >= maj)
+    lose = concl & is_cand & ~win
+    dem = concl & ~is_cand
+    s["role"] = jnp.where(win, LEADER, s["role"])
+    win_rep = _rep_rows(win.astype(_I32), N)
+    s["next_index"] = (
+        win_rep * _rep_rows(s["commit"] + 1, N) + (1 - win_rep) * s["next_index"]
+    )  # quirk b
+    s["match_index"] = (1 - win_rep) * s["match_index"]
+    s["hb_armed"] = s["hb_armed"] | win
+    s["hb_left"] = jnp.where(win, 0, s["hb_left"])  # initial delay 0
+    s["round_state"] = jnp.where(win | dem, IDLE, s["round_state"])
+    s["round_state"] = jnp.where(lose, BACKOFF, s["round_state"])
+    s["bo_left"] = jnp.where(lose, aux["bdraw"], s["bo_left"])
+    s["b_ctr"] = s["b_ctr"] + lose.astype(_I32)
+    reset_el_timer_grid(dem)
+    ongoing = act & ~concl
+    s["round_left"] = s["round_left"] - ongoing.astype(_I32)
+    s["round_age"] = s["round_age"] + ongoing.astype(_I32)
+
+    # -- phase 5: append / heartbeat ----------------------------------------
+
+    for l in range(1, N + 1):
+        raw_armed = col("hb_armed", l)
+        armed = raw_armed & col("up", l)
+        waiting = armed & (col("hb_left", l) > 0)
+        fire = armed & ~waiting
+        setcol("hb_left", l, waiting, col("hb_left", l) - 1)
+        l_is_f = col("role", l) == FOLLOWER
+        # FOLLOWER cancels future firings but this round still goes out
+        # (TimerTask.cancel semantics, RaftServer.kt:117).
+        s["hb_armed"] = _set_row(s["hb_armed"], l - 1, raw_armed & ~(fire & l_is_f))
+        setcol("hb_left", l, fire & ~l_is_f, cfg.hb_ticks - 1)
+        for p in range(1, N + 1):
+            li_l = col("last_index", l)
+            i = s["next_index"][pair(l, p)]
+            pli = i - 2
+            # prevLogTerm: invalid get -> exception -> skip peer (§6 skip rule).
+            skip = (pli >= 0) & ~(pli < li_l)
+            plt = jnp.where(pli >= 0, log_gather("log_term", l, pli), -1)
+            has_entry = li_l >= i
+            skip = skip | (has_entry & (i <= 0))  # quirk i underflow
+            ent_t = log_gather("log_term", l, i - 1)
+            ent_c = log_gather("log_cmd", l, i - 1)
+            skip = skip | ~edge_ok(l, p) | ~edge_ok(p, l)
+            act5 = fire & ~skip
+            # --- append handler on p (SEMANTICS.md §6.2) ---
+            req_term = col("term", l)
+            req_commit = col("commit", l)
+            p_term = col("term", p)
+            if p != l:
+                adopt = act5 & (req_term > p_term)
+                setcol("term", p, adopt, req_term)
+                setcol("voted_for", p, adopt, -1)
+                setcol("role", p, adopt, FOLLOWER)
+                reset_el_timer_col(p, adopt)
+                setcol("role", p, act5, FOLLOWER)  # quirk d: any foreign append
+                reset_el_timer_col(p, act5)
+            p_li = col("last_index", p)
+            p_commit = col("commit", p)
+            cadv = act5 & (req_commit > p_commit)
+            setcol("commit", p, cadv, jnp.minimum(req_commit, p_li))  # quirk e
+            p_plt = log_gather("log_term", p, pli)
+            succ = (pli == -1) | ((p_li > pli) & (pli >= 0) & (p_plt == plt))
+            log_add(p, pli + 1, ent_t, ent_c, act5 & succ & has_entry)
+            resp_term = col("term", p)
+            # --- leader processes the response (RaftServer.kt:146-168) ---
+            if p != l:
+                l_term = col("term", l)
+                demote = act5 & (resp_term > l_term)
+                setcol("term", l, demote, resp_term)
+                setcol("role", l, demote, FOLLOWER)
+                reset_el_timer_col(l, demote)
+            else:
+                demote = jnp.zeros((G,), dtype=_I32) > 0
+            proc = act5 & ~demote & succ
+            with_e = proc & has_entry
+            nfail = act5 & ~demote & ~succ
+            ni = s["next_index"][pair(l, p)]
+            s["next_index"] = _set_row(
+                s["next_index"], pair(l, p),
+                jnp.where(with_e, ni + 1, jnp.where(nfail, ni - 1, ni)),
+            )
+            mi = s["match_index"][pair(l, p)]
+            s["match_index"] = _set_row(
+                s["match_index"], pair(l, p),
+                jnp.where(with_e, mi + 1, jnp.where(proc & ~has_entry, pli + 1, mi)),
+            )
+            # Commit advancement (quirk a), evaluated per response.
+            l_commit = col("commit", l)
+            cnt = jnp.sum(
+                (s["match_index"][(l - 1) * N:l * N] > l_commit[None, :]).astype(_I32),
+                axis=0,
+            )
+            setcol("commit", l, with_e & (cnt >= maj), l_commit + 1)
+
+    return aux_dirty["m"]
+
+
+def make_aux(cfg: RaftConfig, base, tkeys, bkeys, state: RaftState,
+             inject, fault_cmd):
+    """Draw/assemble the phase_body aux inputs from pre-tick state (XLA ops).
+
+    Randomness is drawn in the canonical (G, ...) §4 shapes and transposed, so no
+    drawn bit depends on the groups-minor layout. Returns (aux dict, flags).
+    """
+    G, N = cfg.n_groups, cfg.n_nodes
+    t = state.tick
+    aux = {}
+    flags = BodyFlags(
+        faults=cfg.p_crash > 0 or cfg.p_restart > 0 or fault_cmd is not None,
+        links=cfg.p_link_fail > 0 or cfg.p_link_heal > 0,
+        periodic=cfg.cmd_period > 0,
+        inject=inject is not None,
+    )
+    aux["edge_iid"] = rngmod.edge_ok_mask(
+        base, t, (G, N, N), cfg.p_drop
+    ).transpose(1, 2, 0).reshape(N * N, G).astype(jnp.int32)
+    if flags.faults:
+        crash_m = rngmod.event_mask(
+            base, rngmod.KIND_CRASH, t, (G, N), cfg.p_crash).T
+        restart_m = rngmod.event_mask(
+            base, rngmod.KIND_RESTART, t, (G, N), cfg.p_restart).T
+        if fault_cmd is not None:
+            crash_m = crash_m | (fault_cmd.T == 1)
+            restart_m = restart_m | (fault_cmd.T == 2)
+        aux["crash_m"], aux["restart_m"] = crash_m, restart_m
+        aux["el_draw_f"] = rngmod.draw_uniform_keyed(
+            tkeys, state.t_ctr, cfg.el_lo, cfg.el_hi)
+    if flags.links:
+        aux["link_fail"] = rngmod.event_mask(
+            base, rngmod.KIND_LINK_FAIL, t, (G, N, N), cfg.p_link_fail
+        ).transpose(1, 2, 0).reshape(N * N, G).astype(jnp.int32)
+        aux["link_heal"] = rngmod.event_mask(
+            base, rngmod.KIND_LINK_HEAL, t, (G, N, N), cfg.p_link_heal
+        ).transpose(1, 2, 0).reshape(N * N, G).astype(jnp.int32)
+    aux["bdraw"] = rngmod.draw_uniform_keyed(bkeys, state.b_ctr, cfg.bo_lo, cfg.bo_hi)
+    if flags.periodic:
+        due = (t % cfg.cmd_period == 0) & (t > 0)
+        aux["periodic"] = jnp.where(
+            due, jnp.broadcast_to(t, (1, G)), -jnp.ones((1, G), _I32))
+    if flags.inject:
+        aux["inject"] = inject.T
+    return aux, flags
+
+
+def flatten_state(cfg: RaftConfig, state: RaftState) -> dict:
+    """RaftState -> the rank-2 dict phase_body operates on (free reshapes)."""
+    N, C, G = cfg.n_nodes, cfg.log_capacity, cfg.n_groups
+    s = {}
+    for k in STATE_FIELDS:
+        v = getattr(state, k)
+        if k in _PAIR_FIELDS:
+            v = v.reshape(N * N, G)
+            if v.dtype == jnp.bool_:
+                v = v.astype(_I32)  # no i1 tensors at pair shape (Mosaic limits)
+        elif k in _LOG_FIELDS:
+            v = v.reshape(N * C, G)
+        s[k] = v
+    return s
+
+
+def unflatten_state(cfg: RaftConfig, s: dict) -> dict:
+    """Inverse of flatten_state (still a dict; add the tick scalar to build RaftState)."""
+    N, C, G = cfg.n_nodes, cfg.log_capacity, cfg.n_groups
+    out = dict(s)
+    for k in _PAIR_FIELDS:
+        v = out[k].reshape(N, N, G)
+        if k in ("responded", "link_up"):
+            v = v != 0
+        out[k] = v
+    for k in _LOG_FIELDS:
+        out[k] = out[k].reshape(N, C, G)
+    return out
+
+
+def finish_tick(cfg: RaftConfig, tkeys, s: dict, el_dirty, t):
+    """Materialize the deferred election draws and bump the tick counter."""
+    d = rngmod.draw_uniform_keyed(tkeys, s["t_ctr"] - 1, cfg.el_lo, cfg.el_hi)
+    s["el_left"] = jnp.where(el_dirty, d, s["el_left"])
+    return RaftState(**s, tick=t + 1)
+
 
 def make_tick(cfg: RaftConfig):
     """Build tick(state, inject=None, fault_cmd=None) -> state for a fixed config.
@@ -47,7 +512,7 @@ def make_tick(cfg: RaftConfig):
     (G, N) int32 of driver-scheduled §9 events (0 none / 1 crash / 2 restart). Both use
     the driver-canonical (G, N) shape; they are transposed internally.
     """
-    N, C, maj = cfg.n_nodes, cfg.log_capacity, cfg.majority
+    N = cfg.n_nodes
     base = rngmod.base_key(cfg.seed)
     # Static key prefixes, computed once per simulation (rng.grid_keys): the per-draw
     # cost inside the tick drops to fold_in(counter) + randint. grid_keys is (G, N)
@@ -61,344 +526,32 @@ def make_tick(cfg: RaftConfig):
         inject: Optional[jax.Array] = None,
         fault_cmd: Optional[jax.Array] = None,
     ) -> RaftState:
-        s = {f.name: getattr(state, f.name) for f in dataclasses.fields(state)}
-        G = s["term"].shape[-1]
+        G = state.term.shape[-1]
         assert G == cfg.n_groups, (
             f"state has {G} groups but make_tick was built for {cfg.n_groups}"
         )
-        lane = jnp.arange(C, dtype=_I32)
-        t = s["tick"]
-
-        # -- small helpers over the mutable dict --------------------------------
-
-        def col(name, n):
-            return s[name][n - 1]
-
-        def setcol(name, n, mask, vals):
-            cur = s[name][n - 1]
-            s[name] = s[name].at[n - 1].set(jnp.where(mask, vals, cur))
-
-        def log_gather(name, n, idx):
-            # (G,) read of physical slot idx from node n, as a one-hot contraction
-            # over the C sublane axis (no per-lane gather op — TPU-friendly); 0 where
-            # idx is out of [0, C) — callers must guard with masks.
-            arr = s[name][n - 1]                      # (C, G)
-            oh = lane[:, None] == idx[None, :]
-            return jnp.sum(jnp.where(oh, arr, 0), axis=0)
-
-        def log_add(n, i, term_v, cmd_v, mask):
-            # SEMANTICS.md §3 add(): physical append / reject / overwrite-truncate.
-            # One-hot masked write over the C sublane axis instead of a scatter; the
-            # write slot is always in-range where the write mask holds (append needs
-            # phys_len < C; overwrite needs i < last_index <= C).
-            li = col("last_index", n)
-            pl = col("phys_len", n)
-            app = mask & (i == li) & (pl < C)
-            ovw = mask & (i < li) & (i >= 0)
-            slot = jnp.where(app, pl, i)
-            oh = (lane[:, None] == slot[None, :]) & (app | ovw)[None, :]
-            lt = s["log_term"][n - 1]                 # (C, G)
-            lc = s["log_cmd"][n - 1]
-            s["log_term"] = s["log_term"].at[n - 1].set(
-                jnp.where(oh, term_v[None, :], lt)
-            )
-            s["log_cmd"] = s["log_cmd"].at[n - 1].set(
-                jnp.where(oh, cmd_v[None, :], lc)
-            )
-            setcol("last_index", n, app | ovw, jnp.where(app, li + 1, i + 1))
-            setcol("phys_len", n, app, pl + 1)
-
-        # Election-timer resets (SEMANTICS.md §7): each reset consumes one counted
-        # draw and leaves el_left at the LAST consumed draw's value. In phases 2-5
-        # nothing reads el_left (phase 1 is its only reader), so the draws there are
-        # DEFERRED: resets just advance t_ctr and mark the node dirty, and one grid
-        # draw at counter t_ctr-1 materializes el_left at end of tick — identical
-        # bits, ~50x fewer threefry evaluations per tick. Phase F resets must stay
-        # immediate (they precede phase 1 within the same tick).
-        aux = {"el_dirty": jnp.zeros((N, G), dtype=bool)}
-
-        def reset_el_timer_col(n, mask):
-            ctr = col("t_ctr", n)
-            s["el_armed"] = s["el_armed"].at[n - 1].set(col("el_armed", n) | mask)
-            setcol("t_ctr", n, mask, ctr + 1)
-            aux["el_dirty"] = aux["el_dirty"].at[n - 1].set(
-                aux["el_dirty"][n - 1] | mask
-            )
-
-        def reset_el_timer_grid(mask):
-            s["el_armed"] = s["el_armed"] | mask
-            s["t_ctr"] = s["t_ctr"] + mask.astype(_I32)
-            aux["el_dirty"] = aux["el_dirty"] | mask
-
-        def reset_el_timer_grid_now(mask):
-            d = rngmod.draw_uniform_keyed(tkeys, s["t_ctr"], cfg.el_lo, cfg.el_hi)
-            s["el_left"] = jnp.where(mask, d, s["el_left"])
-            s["el_armed"] = s["el_armed"] | mask
-            s["t_ctr"] = s["t_ctr"] + mask.astype(_I32)
-
-        # -- phase F: fault events (SEMANTICS.md §9) ----------------------------
-
-        has_faults = (
-            cfg.p_crash > 0 or cfg.p_restart > 0 or fault_cmd is not None
-        )
-        if has_faults:
-            crash_m = rngmod.event_mask(
-                base, rngmod.KIND_CRASH, t, (G, N), cfg.p_crash).T
-            restart_m = rngmod.event_mask(
-                base, rngmod.KIND_RESTART, t, (G, N), cfg.p_restart).T
-            if fault_cmd is not None:
-                crash_m = crash_m | (fault_cmd.T == 1)
-                restart_m = restart_m | (fault_cmd.T == 2)
-            crash_ev = s["up"] & crash_m
-            restart_ev = ~s["up"] & restart_m
-            s["up"] = (s["up"] & ~crash_ev) | restart_ev
-            rst = restart_ev
-            zero = jnp.zeros((), _I32)
-            s["term"] = jnp.where(rst, zero, s["term"])
-            s["voted_for"] = jnp.where(rst, -1, s["voted_for"])
-            s["role"] = jnp.where(rst, FOLLOWER, s["role"])
-            s["commit"] = jnp.where(rst, zero, s["commit"])
-            s["last_index"] = jnp.where(rst, zero, s["last_index"])
-            s["phys_len"] = jnp.where(rst, zero, s["phys_len"])
-            s["round_state"] = jnp.where(rst, IDLE, s["round_state"])
-            for f in ("votes", "responses", "round_left", "round_age", "bo_left"):
-                s[f] = jnp.where(rst, zero, s[f])
-            # (N, N, G) arrays are owned by their FIRST node axis (candidate/leader).
-            s["responded"] = jnp.where(rst[:, None, :], False, s["responded"])
-            s["next_index"] = jnp.where(rst[:, None, :], zero, s["next_index"])
-            s["match_index"] = jnp.where(rst[:, None, :], zero, s["match_index"])
-            s["hb_armed"] = s["hb_armed"] & ~rst
-            s["hb_left"] = jnp.where(rst, zero, s["hb_left"])
-            reset_el_timer_grid_now(rst)  # phase 1 reads el_left this same tick
-        if cfg.p_link_fail > 0 or cfg.p_link_heal > 0:
-            lf = rngmod.event_mask(
-                base, rngmod.KIND_LINK_FAIL, t, (G, N, N), cfg.p_link_fail
-            ).transpose(1, 2, 0)
-            lh = rngmod.event_mask(
-                base, rngmod.KIND_LINK_HEAL, t, (G, N, N), cfg.p_link_heal
-            ).transpose(1, 2, 0)
-            s["link_up"] = jnp.where(s["link_up"], ~lf, lh)
-
-        # Effective edge health (§9): iid survival ∧ link health ∧ both ends up.
-        # edge[s-1, r-1, g]; drawn canonically as (G, N, N) then transposed.
-        edge = rngmod.edge_ok_mask(base, t, (G, N, N), cfg.p_drop).transpose(1, 2, 0)
-        edge = edge & s["link_up"] & s["up"][:, None, :] & s["up"][None, :, :]
-        up = s["up"]
-
-        # -- phase 0: command injection (quirk k) -------------------------------
-
-        if cfg.cmd_period > 0:
-            due = (t % cfg.cmd_period == 0) & (t > 0)
-            n = cfg.cmd_node
-            mask = jnp.broadcast_to(due, (G,)) & col("up", n)
-            log_add(n, col("last_index", n), col("term", n), jnp.broadcast_to(t, (G,)), mask)
-        if inject is not None:
-            for n in range(1, N + 1):
-                cmd = inject[:, n - 1]
-                log_add(n, col("last_index", n), col("term", n), cmd, (cmd >= 0) & col("up", n))
-
-        # -- phase 1: timers (independent countdowns) ---------------------------
-
-        armed = s["el_armed"] & up
-        left = s["el_left"] - armed.astype(_I32)
-        fire = armed & (left <= 0)
-        s["el_left"] = left
-        s["el_armed"] = s["el_armed"] & ~fire
-        s["role"] = jnp.where(fire, CANDIDATE, s["role"])
-        start_round = fire
-
-        in_bo = (s["round_state"] == BACKOFF) & up
-        bleft = s["bo_left"] - in_bo.astype(_I32)
-        bfire = in_bo & (bleft <= 0)
-        s["bo_left"] = bleft
-        s["round_state"] = jnp.where(bfire, IDLE, s["round_state"])
-        start_round = start_round | bfire
-
-        # -- phase 2: round starts ---------------------------------------------
-
-        is_cand = s["role"] == CANDIDATE
-        init = start_round & is_cand
-        node_ids = jnp.broadcast_to(jnp.arange(1, N + 1, dtype=_I32)[:, None], (N, G))
-        s["term"] = s["term"] + init.astype(_I32)
-        s["voted_for"] = jnp.where(init, node_ids, s["voted_for"])
-        s["votes"] = jnp.where(init, 0, s["votes"])
-        s["responses"] = jnp.where(init, 0, s["responses"])
-        s["responded"] = jnp.where(init[:, None, :], False, s["responded"])
-        s["round_left"] = jnp.where(init, cfg.round_ticks, s["round_left"])
-        s["round_age"] = jnp.where(init, 0, s["round_age"])
-        s["round_state"] = jnp.where(init, ACTIVE, s["round_state"])
-        s["rounds"] = s["rounds"] + init.astype(_I32)
-        demoted_bo = start_round & ~is_cand
-        s["round_state"] = jnp.where(demoted_bo, IDLE, s["round_state"])
-        reset_el_timer_grid(demoted_bo)
-
-        # -- phase 3: vote exchanges --------------------------------------------
-
-        for c in range(1, N + 1):
-            c_attempting = (col("round_state", c) == ACTIVE) & (
-                col("round_age", c) % cfg.retry_ticks == 0
-            )
-            for p in range(1, N + 1):
-                att = (
-                    c_attempting
-                    & ~s["responded"][c - 1, p - 1]
-                    & edge[c - 1, p - 1]
-                    & edge[p - 1, c - 1]
-                )
-                # Request built from c's live state (RaftServer.kt:200-207).
-                c_term = col("term", c)
-                c_li = col("last_index", c)
-                c_llt = jnp.where(c_li == 0, 0, log_gather("log_term", c, c_li - 1))
-                # Vote handler on p (SEMANTICS.md §6.1).
-                p_term = col("term", p)
-                p_vf = col("voted_for", p)
-                p_li = col("last_index", p)
-                p_llt = log_gather("log_term", p, p_li - 1)
-                rej_stale = (p_li >= 1) & (c_llt < p_llt)
-                rej_short = (p_li >= 1) & (c_llt == p_llt) & (c_li < p_li)
-                grant_gt = (c_term > p_term) & ~rej_stale & ~rej_short
-                granted = jnp.where(
-                    c_term < p_term,
-                    False,
-                    jnp.where(c_term == p_term, p_vf == c, grant_gt),
-                )
-                adopt = att & grant_gt
-                setcol("term", p, adopt, c_term)
-                setcol("voted_for", p, adopt, c)
-                setcol("role", p, adopt, FOLLOWER)
-                reset_el_timer_col(p, adopt)
-                resp_term = col("term", p)
-                # Candidate tally (RaftServer.kt:209-211).
-                s["responded"] = (
-                    s["responded"].at[c - 1, p - 1].set(s["responded"][c - 1, p - 1] | att)
-                )
-                setcol("responses", c, att, col("responses", c) + 1)
-                setcol("role", c, att & (resp_term > c_term), FOLLOWER)  # quirk f
-                setcol("votes", c, att & granted, col("votes", c) + 1)
-
-        # -- phase 4: round conclusions -----------------------------------------
-
-        act = (s["round_state"] == ACTIVE) & up
-        concl = act & ((s["responses"] >= maj) | (s["round_left"] <= 0))
-        is_cand = s["role"] == CANDIDATE
-        win = concl & is_cand & (s["votes"] >= maj)
-        lose = concl & is_cand & ~win
-        dem = concl & ~is_cand
-        s["role"] = jnp.where(win, LEADER, s["role"])
-        s["next_index"] = jnp.where(
-            win[:, None, :], (s["commit"] + 1)[:, None, :], s["next_index"]
-        )  # quirk b
-        s["match_index"] = jnp.where(win[:, None, :], 0, s["match_index"])
-        s["hb_armed"] = s["hb_armed"] | win
-        s["hb_left"] = jnp.where(win, 0, s["hb_left"])  # initial delay 0
-        s["round_state"] = jnp.where(win | dem, IDLE, s["round_state"])
-        bdraw = rngmod.draw_uniform_keyed(bkeys, s["b_ctr"], cfg.bo_lo, cfg.bo_hi)
-        s["round_state"] = jnp.where(lose, BACKOFF, s["round_state"])
-        s["bo_left"] = jnp.where(lose, bdraw, s["bo_left"])
-        s["b_ctr"] = s["b_ctr"] + lose.astype(_I32)
-        reset_el_timer_grid(dem)
-        ongoing = act & ~concl
-        s["round_left"] = s["round_left"] - ongoing.astype(_I32)
-        s["round_age"] = s["round_age"] + ongoing.astype(_I32)
-
-        # -- phase 5: append / heartbeat ----------------------------------------
-
-        for l in range(1, N + 1):
-            raw_armed = col("hb_armed", l)
-            armed = raw_armed & col("up", l)
-            waiting = armed & (col("hb_left", l) > 0)
-            fire = armed & ~waiting
-            setcol("hb_left", l, waiting, col("hb_left", l) - 1)
-            l_is_f = col("role", l) == FOLLOWER
-            # FOLLOWER cancels future firings but this round still goes out
-            # (TimerTask.cancel semantics, RaftServer.kt:117).
-            s["hb_armed"] = s["hb_armed"].at[l - 1].set(raw_armed & ~(fire & l_is_f))
-            setcol("hb_left", l, fire & ~l_is_f, cfg.hb_ticks - 1)
-            for p in range(1, N + 1):
-                li_l = col("last_index", l)
-                i = s["next_index"][l - 1, p - 1]
-                pli = i - 2
-                # prevLogTerm: invalid get -> exception -> skip peer (§6 skip rule).
-                skip = (pli >= 0) & ~(pli < li_l)
-                plt = jnp.where(pli >= 0, log_gather("log_term", l, pli), -1)
-                has_entry = li_l >= i
-                skip = skip | (has_entry & (i <= 0))  # quirk i underflow
-                ent_t = log_gather("log_term", l, i - 1)
-                ent_c = log_gather("log_cmd", l, i - 1)
-                skip = skip | ~edge[l - 1, p - 1] | ~edge[p - 1, l - 1]
-                act5 = fire & ~skip
-                # --- append handler on p (SEMANTICS.md §6.2) ---
-                req_term = col("term", l)
-                req_commit = col("commit", l)
-                p_term = col("term", p)
-                if p != l:
-                    adopt = act5 & (req_term > p_term)
-                    setcol("term", p, adopt, req_term)
-                    setcol("voted_for", p, adopt, -1)
-                    setcol("role", p, adopt, FOLLOWER)
-                    reset_el_timer_col(p, adopt)
-                    setcol("role", p, act5, FOLLOWER)  # quirk d: any foreign append
-                    reset_el_timer_col(p, act5)
-                p_li = col("last_index", p)
-                p_commit = col("commit", p)
-                cadv = act5 & (req_commit > p_commit)
-                setcol("commit", p, cadv, jnp.minimum(req_commit, p_li))  # quirk e
-                p_plt = log_gather("log_term", p, pli)
-                succ = (pli == -1) | ((p_li > pli) & (pli >= 0) & (p_plt == plt))
-                log_add(p, pli + 1, ent_t, ent_c, act5 & succ & has_entry)
-                resp_term = col("term", p)
-                # --- leader processes the response (RaftServer.kt:146-168) ---
-                if p != l:
-                    l_term = col("term", l)
-                    demote = act5 & (resp_term > l_term)
-                    setcol("term", l, demote, resp_term)
-                    setcol("role", l, demote, FOLLOWER)
-                    reset_el_timer_col(l, demote)
-                else:
-                    demote = jnp.zeros((G,), dtype=bool)
-                proc = act5 & ~demote & succ
-                with_e = proc & has_entry
-                nfail = act5 & ~demote & ~succ
-                ni = s["next_index"][l - 1, p - 1]
-                s["next_index"] = (
-                    s["next_index"]
-                    .at[l - 1, p - 1]
-                    .set(jnp.where(with_e, ni + 1, jnp.where(nfail, ni - 1, ni)))
-                )
-                mi = s["match_index"][l - 1, p - 1]
-                s["match_index"] = (
-                    s["match_index"]
-                    .at[l - 1, p - 1]
-                    .set(jnp.where(with_e, mi + 1, jnp.where(proc & ~has_entry, pli + 1, mi)))
-                )
-                # Commit advancement (quirk a), evaluated per response.
-                l_commit = col("commit", l)
-                cnt = jnp.sum(
-                    (s["match_index"][l - 1] > l_commit[None, :]).astype(_I32), axis=0
-                )
-                setcol("commit", l, with_e & (cnt >= maj), l_commit + 1)
-
-        # Materialize the deferred election-timer draws (see reset helpers above):
-        # for every node that reset in phases 2-5, el_left = the draw at its last
-        # consumed counter.
-        dirty = aux["el_dirty"]
-        d = rngmod.draw_uniform_keyed(tkeys, s["t_ctr"] - 1, cfg.el_lo, cfg.el_hi)
-        s["el_left"] = jnp.where(dirty, d, s["el_left"])
-
-        s["tick"] = t + 1
-        return RaftState(**s)
+        aux, flags = make_aux(cfg, base, tkeys, bkeys, state, inject, fault_cmd)
+        s = flatten_state(cfg, state)
+        el_dirty = phase_body(cfg, s, aux, flags)
+        return finish_tick(cfg, tkeys, unflatten_state(cfg, s), el_dirty, state.tick)
 
     return tick
 
 
-def make_run(cfg: RaftConfig, n_ticks: int, trace: bool = True):
+def make_run(cfg: RaftConfig, n_ticks: int, trace: bool = True, impl: str = "xla"):
     """jitted runner: state -> (state, trace) stepping n_ticks via lax.scan.
 
     trace is a dict of (T, N, G) arrays (role/term/commit/last_index/voted_for/rounds/
     up per tick, post-tick) — the differential-test observable. With trace=False
     returns per-tick (G,) leader counts only (cheap bench/metrics mode).
+    impl: "xla" (default) or "pallas" (the ops/pallas_tick.py megakernel).
     """
-    tick_fn = make_tick(cfg)
+    if impl == "pallas":
+        from raft_kotlin_tpu.ops.pallas_tick import make_pallas_tick
+
+        tick_fn = make_pallas_tick(cfg)
+    else:
+        tick_fn = make_tick(cfg)
 
     def body(st, _):
         st = tick_fn(st)

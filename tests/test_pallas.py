@@ -1,0 +1,84 @@
+"""The Pallas megakernel (ops/pallas_tick.py) must be bit-identical to the XLA tick —
+they share phase_body, so this validates only the kernel plumbing (flat layouts,
+bool<->int32 boundaries, tiling, aliasing). Runs in interpreter mode on CPU (slow —
+most cases are marked slow; one smoke test runs by default); real Mosaic compilation
+is exercised on TPU by bench.py every round."""
+
+import dataclasses
+
+import jax
+import numpy as np
+
+from conftest import assert_states_equal
+import pytest
+
+from raft_kotlin_tpu.models.state import init_state
+from raft_kotlin_tpu.ops.pallas_tick import make_pallas_tick, pick_tile
+from raft_kotlin_tpu.ops.tick import make_tick
+from raft_kotlin_tpu.utils.config import RaftConfig
+
+
+def assert_pallas_matches_xla(cfg: RaftConfig, n_ticks: int, **kw):
+    tx = jax.jit(make_tick(cfg))
+    tp = jax.jit(make_pallas_tick(cfg, interpret=True, **kw))
+    sx = sp = init_state(cfg)
+    for _ in range(n_ticks):
+        sx = tx(sx)
+        sp = tp(sp)
+    assert_states_equal(jax.device_get(sx), jax.device_get(sp))
+
+
+def test_election_replication():
+    cfg = RaftConfig(n_groups=8, n_nodes=3, log_capacity=8, cmd_period=5,
+                     seed=7).stressed(10)
+    assert_pallas_matches_xla(cfg, cfg.el_hi + 20)
+
+
+@pytest.mark.slow
+def test_full_fault_soup():
+    cfg = RaftConfig(n_groups=8, n_nodes=5, log_capacity=8, cmd_period=5, p_drop=0.1,
+                     p_crash=0.02, p_restart=0.1, p_link_fail=0.02, p_link_heal=0.1,
+                     seed=9).stressed(10)
+    assert_pallas_matches_xla(cfg, 60)
+
+
+@pytest.mark.slow
+def test_multi_tile():
+    # More groups than one tile: grid > 1 even in interpreter mode.
+    cfg = RaftConfig(n_groups=96, n_nodes=3, log_capacity=8, seed=3).stressed(10)
+    assert_pallas_matches_xla(cfg, 40, tile_g=32)
+
+
+@pytest.mark.slow
+def test_inject_and_fault_cmd():
+    import jax.numpy as jnp
+
+    cfg = RaftConfig(n_groups=4, n_nodes=3, seed=5).stressed(10)
+    tx = jax.jit(make_tick(cfg))
+    tp = jax.jit(make_pallas_tick(cfg, interpret=True))
+    sx = sp = init_state(cfg)
+    rng = np.random.default_rng(1)
+    for t in range(50):
+        inject = fault = None
+        if t % 9 == 0:
+            inject = np.full((cfg.n_groups, cfg.n_nodes), -1, dtype=np.int32)
+            inject[rng.integers(4), rng.integers(3)] = 500 + t
+            inject = jnp.asarray(inject)
+        if t == 20:
+            fault = np.zeros((cfg.n_groups, cfg.n_nodes), dtype=np.int32)
+            fault[0, 0] = 1
+            fault = jnp.asarray(fault)
+        if t == 40:
+            fault = np.zeros((cfg.n_groups, cfg.n_nodes), dtype=np.int32)
+            fault[0, 0] = 2
+            fault = jnp.asarray(fault)
+        sx = tx(sx, inject, fault)
+        sp = tp(sp, inject, fault)
+    assert_states_equal(jax.device_get(sx), jax.device_get(sp))
+    assert bool(np.asarray(sp.up)[0, 0])
+
+
+def test_pick_tile_vmem_model():
+    assert pick_tile(102_400, total_rows=1146) == 256  # measured N=5 C=32 config
+    assert pick_tile(1024, total_rows=300) == 1024
+    assert pick_tile(100_000, total_rows=300) is None  # not lane-aligned
