@@ -37,13 +37,10 @@ def main() -> None:
     # kernel. Mosaic compiles lazily at the first run, so the fallback must wrap the
     # warmup, not just kernel construction — see measure().
     def tick_candidates(cfg2):
-        from raft_kotlin_tpu.ops.pallas_tick import make_pallas_tick, pick_tile
+        from raft_kotlin_tpu.ops.pallas_tick import choose_impl, make_pallas_tick
 
-        if on_accel and pick_tile(cfg2.n_groups) is not None:
-            try:
-                yield make_pallas_tick(cfg2, interpret=False), "pallas"
-            except Exception:
-                pass
+        if choose_impl(cfg2) == "pallas":
+            yield make_pallas_tick(cfg2, interpret=False), "pallas"
         yield make_tick(cfg2), "xla"
 
     def measure(cfg2, n_ticks, n_reps):

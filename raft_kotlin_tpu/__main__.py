@@ -34,6 +34,8 @@ def _add_cfg_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--cmd-period", type=int, default=0)
     p.add_argument("--stress", type=int, default=1,
                    help="divide all pacing constants by this factor")
+    p.add_argument("--impl", choices=["auto", "xla", "pallas"], default="auto",
+                   help="tick backend (pallas = the TPU megakernel)")
 
 
 def _cfg_from(args) -> "RaftConfig":
@@ -89,7 +91,7 @@ def main(argv=None) -> int:
     if args.command == "serve":
         from raft_kotlin_tpu.api.http_api import RaftHTTPServer
 
-        sim = Simulator(_cfg_from(args))
+        sim = Simulator(_cfg_from(args), impl=args.impl)
         srv = RaftHTTPServer(sim, port=args.port, tick_hz=args.tick_hz).start()
         print(f"raft_kotlin_tpu serving on http://127.0.0.1:{srv.port} "
               f"({sim.cfg.n_groups} groups x {sim.cfg.n_nodes} nodes, "
@@ -109,8 +111,13 @@ def main(argv=None) -> int:
         from raft_kotlin_tpu.ops.tick import make_run
 
         cfg = _cfg_from(args)
+        impl = args.impl
+        if impl == "auto":
+            from raft_kotlin_tpu.ops.pallas_tick import choose_impl
+
+            impl = choose_impl(cfg)
         t0 = time.perf_counter()
-        state, _ = make_run(cfg, args.ticks, trace=False)(init_state(cfg))
+        state, _ = make_run(cfg, args.ticks, trace=False, impl=impl)(init_state(cfg))
         import jax
 
         jax.block_until_ready(state.term)
@@ -121,6 +128,7 @@ def main(argv=None) -> int:
             "groups": cfg.n_groups,
             "elapsed_s": round(dt, 3),
             "group_steps_per_sec": round(cfg.n_groups * args.ticks / dt, 1),
+            "impl": impl,
             "groups_with_leader": int(np.sum((roles == LEADER).any(axis=0))),
             "elections_started": int(np.sum(np.asarray(state.rounds))),
             "max_commit": int(np.max(np.asarray(state.commit))),

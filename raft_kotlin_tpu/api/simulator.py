@@ -40,11 +40,25 @@ class Simulator:
     (api/http_api.py) and a background tick loop can share one Simulator.
     """
 
-    def __init__(self, cfg: RaftConfig, state: Optional[RaftState] = None):
+    def __init__(self, cfg: RaftConfig, state: Optional[RaftState] = None,
+                 impl: str = "auto"):
+        """impl: "xla", "pallas" (ops/pallas_tick.py megakernel), or "auto" —
+        pallas when running on an accelerator with a lane-aligned group count,
+        else xla. Both backends are bit-identical (shared phase_body)."""
         self.cfg = cfg
         self._lock = threading.RLock()
         self._state = state if state is not None else init_state(cfg)
-        tick = make_tick(cfg)
+        if impl == "auto":
+            from raft_kotlin_tpu.ops.pallas_tick import choose_impl
+
+            impl = choose_impl(cfg)
+        if impl == "pallas":
+            from raft_kotlin_tpu.ops.pallas_tick import make_pallas_tick
+
+            tick = make_pallas_tick(cfg)
+        else:
+            tick = make_tick(cfg)
+        self.impl = impl
         # One jitted callable; None-ness of the optional args is static, so each of
         # the four (inject?, fault_cmd?) combinations traces once and is cached.
         self._tick = jax.jit(tick)

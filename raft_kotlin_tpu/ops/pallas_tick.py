@@ -63,6 +63,23 @@ def pick_tile(G: int, total_rows: int = 0) -> Optional[int]:
     return None
 
 
+def choose_impl(cfg: RaftConfig) -> str:
+    """Canonical backend auto-selection (Simulator, CLI, bench all use this):
+    "pallas" when running on an accelerator AND the megakernel is buildable for
+    cfg.n_groups (lane alignment + the VMEM tile model), else "xla". Both backends
+    are bit-identical; this only picks the faster compilable one. Note Mosaic
+    compiles lazily — a pathological config could still fail at the first step, in
+    which case callers wanting hard guarantees should warm up and fall back
+    (see bench.py measure())."""
+    if jax.default_backend() == "cpu":
+        return "xla"
+    try:
+        default_tile(cfg, cfg.n_groups, interpret=False)
+    except ValueError:
+        return "xla"
+    return "pallas"
+
+
 def pad_groups_for_pallas(cfg: RaftConfig, tile: int = 256) -> RaftConfig:
     """Round n_groups up to a lane-aligned multiple (extra groups are real
     simulations, just surplus — same convention as parallel.mesh.pad_groups)."""
@@ -70,37 +87,14 @@ def pad_groups_for_pallas(cfg: RaftConfig, tile: int = 256) -> RaftConfig:
     return dataclasses.replace(cfg, n_groups=g)
 
 
-def make_pallas_tick(cfg: RaftConfig, tile_g: Optional[int] = None,
-                     interpret: Optional[bool] = None):
-    """Build tick(state, inject=None, fault_cmd=None) -> state — same contract and
-    same bits as ops.tick.make_tick(cfg), different compilation strategy."""
-    N, C, G = cfg.n_nodes, cfg.log_capacity, cfg.n_groups
-    base = rngmod.base_key(cfg.seed)
-    tkeys = rngmod.grid_keys(base, rngmod.KIND_TIMEOUT, G, N).T
-    bkeys = rngmod.grid_keys(base, rngmod.KIND_BACKOFF, G, N).T
-
-    if interpret is None:
-        interpret = jax.default_backend() == "cpu"
-    if tile_g is None:
-        # Rows across all in/out blocks (see field/aux shapes below): 2x state
-        # (in + aliased out) + worst-case aux + el_dirty.
-        n_2d = sum(1 for k in STATE_FIELDS
-                   if k not in ("log_term", "log_cmd", "responded",
-                                "next_index", "match_index", "link_up"))
-        rows = 2 * (n_2d * N + 4 * N * N + 2 * N * C) + (3 * N * N + 5 * N + 1) + N
-        tile_g = pick_tile(G, rows) if not interpret else min(G, 256)
-    if tile_g is None and not interpret:
-        if pick_tile(G) is None:
-            raise ValueError(
-                f"n_groups={G} is not a multiple of any supported tile {_TILES}; "
-                "pad with pad_groups_for_pallas()")
-        raise ValueError(
-            f"no tile in {_TILES} dividing n_groups={G} fits the scoped-VMEM "
-            f"budget for n_nodes={N}, log_capacity={C}; shrink the config or "
-            "pass tile_g explicitly")
-    assert interpret or G % tile_g == 0
-    if interpret and G % tile_g:
-        tile_g = G  # interpreter: one tile, no alignment constraints
+def make_pallas_core(cfg: RaftConfig, lanes: int, tile_g: int, interpret: bool):
+    """Per-flags builder of the raw megakernel over arrays with `lanes` lane columns
+    (the flat phase_body layout). Used with lanes = n_groups for single-device runs
+    (make_pallas_tick) and lanes = the per-device shard width under shard_map
+    (parallel.mesh.make_sharded_run(impl="pallas")). Returns build_call(flags) ->
+    (callable(*flat_int32_arrays) -> flat outputs + el_dirty, aux_names)."""
+    N, C = cfg.n_nodes, cfg.log_capacity
+    assert lanes % tile_g == 0, (lanes, tile_g)
 
     # Per-tile block shapes. Everything is RANK-2 (rows, tile_g): phase_body's flat
     # layout (ops/tick.py) — pair grids (N*N, ·), logs (N*C, ·) — which is also what
@@ -152,15 +146,15 @@ def make_pallas_tick(cfg: RaftConfig, tile_g: Optional[int] = None,
         in_specs = [block_spec(field_shapes[k]) for k in STATE_FIELDS]
         in_specs += [block_spec(aux_shapes[k]) for k in aux_names]
         out_shapes = [
-            jax.ShapeDtypeStruct(tuple(field_shapes[k][:-1]) + (G,), _I32)
+            jax.ShapeDtypeStruct(tuple(field_shapes[k][:-1]) + (lanes,), _I32)
             for k in STATE_FIELDS
-        ] + [jax.ShapeDtypeStruct((N, G), _I32)]
+        ] + [jax.ShapeDtypeStruct((N, lanes), _I32)]
         out_specs = [block_spec(field_shapes[k]) for k in STATE_FIELDS]
         out_specs += [block_spec((N, tile_g))]
 
         call = pl.pallas_call(
             kernel,
-            grid=(G // tile_g,),
+            grid=(lanes // tile_g,),
             in_specs=in_specs,
             out_specs=out_specs,
             out_shape=out_shapes,
@@ -168,6 +162,47 @@ def make_pallas_tick(cfg: RaftConfig, tile_g: Optional[int] = None,
             interpret=interpret,
         )
         return call, aux_names
+
+    return build_call
+
+
+def cast_flat_in(flat: dict, aux: dict, aux_names):
+    """Order + int32-cast the kernel operands from the flat state/aux dicts."""
+    ins = []
+    for k in STATE_FIELDS:
+        v = flat[k]
+        ins.append(v.astype(_I32) if k in _BOOL_STATE else v)
+    for k in aux_names:
+        v = aux[k]
+        ins.append(v.astype(_I32) if k in _BOOL_AUX else v)
+    return ins
+
+
+def cast_flat_out(outs):
+    """Inverse of cast_flat_in for the kernel outputs -> (flat state dict, el_dirty)."""
+    s = {}
+    for k, v in zip(STATE_FIELDS, outs[: len(STATE_FIELDS)]):
+        s[k] = (v != 0) if k in _BOOL_STATE else v
+    return s, outs[-1] != 0
+
+
+def make_pallas_tick(cfg: RaftConfig, tile_g: Optional[int] = None,
+                     interpret: Optional[bool] = None):
+    """Build tick(state, inject=None, fault_cmd=None) -> state — same contract and
+    same bits as ops.tick.make_tick(cfg), different compilation strategy."""
+    N, C, G = cfg.n_nodes, cfg.log_capacity, cfg.n_groups
+    base = rngmod.base_key(cfg.seed)
+    tkeys = rngmod.grid_keys(base, rngmod.KIND_TIMEOUT, G, N).T
+    bkeys = rngmod.grid_keys(base, rngmod.KIND_BACKOFF, G, N).T
+
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    if tile_g is None:
+        tile_g = default_tile(cfg, G, interpret)
+    if interpret and G % tile_g:
+        tile_g = G  # interpreter: one tile, no alignment constraints
+
+    build_call = make_pallas_core(cfg, G, tile_g, interpret)
 
     def tick(
         state: RaftState,
@@ -181,19 +216,33 @@ def make_pallas_tick(cfg: RaftConfig, tile_g: Optional[int] = None,
             cfg, base, tkeys, bkeys, state, inject, fault_cmd)
         call, aux_names = build_call(flags)
         flat = tick_mod.flatten_state(cfg, state)
-        ins = []
-        for k in STATE_FIELDS:
-            v = flat[k]
-            ins.append(v.astype(_I32) if k in _BOOL_STATE else v)
-        for k in aux_names:
-            v = aux[k]
-            ins.append(v.astype(_I32) if k in _BOOL_AUX else v)
-        outs = call(*ins)
-        s = {}
-        for k, v in zip(STATE_FIELDS, outs[: len(STATE_FIELDS)]):
-            s[k] = (v != 0) if k in _BOOL_STATE else v
-        el_dirty = outs[-1] != 0
+        outs = call(*cast_flat_in(flat, aux, aux_names))
+        s, el_dirty = cast_flat_out(outs)
         return tick_mod.finish_tick(
             cfg, tkeys, tick_mod.unflatten_state(cfg, s), el_dirty, state.tick)
 
     return tick
+
+
+def default_tile(cfg: RaftConfig, lanes: int, interpret: bool) -> int:
+    """VMEM-model tile choice for `lanes` lane columns (raises if none fits)."""
+    N, C = cfg.n_nodes, cfg.log_capacity
+    if interpret:
+        return min(lanes, 256)
+    # Rows across all in/out blocks: 2x state (in + aliased out) + worst-case aux
+    # + el_dirty.
+    n_2d = sum(1 for k in STATE_FIELDS
+               if k not in ("log_term", "log_cmd", "responded",
+                            "next_index", "match_index", "link_up"))
+    rows = 2 * (n_2d * N + 4 * N * N + 2 * N * C) + (3 * N * N + 5 * N + 1) + N
+    t = pick_tile(lanes, rows)
+    if t is None:
+        if pick_tile(lanes) is None:
+            raise ValueError(
+                f"{lanes} lanes is not a multiple of any supported tile {_TILES}; "
+                "pad with pad_groups_for_pallas()")
+        raise ValueError(
+            f"no tile in {_TILES} dividing {lanes} lanes fits the scoped-VMEM "
+            f"budget for n_nodes={N}, log_capacity={C}; shrink the config or "
+            "pass tile_g explicitly")
+    return t

@@ -8,11 +8,13 @@ op inside one jitted tick — and the *groups axis* is sharded over the device m
 the only cross-device traffic is metrics aggregation (psum-style reductions XLA lowers
 onto ICI/DCN). Within a tick there are ZERO collectives.
 
-Why plain `jit` + `NamedSharding` instead of `shard_map`: every per-tick op is
-elementwise over groups and all randomness is counted threefry
-(`jax_threefry_partitionable`), so XLA's SPMD partitioner splits the whole tick
-shard-locally with no communication; `shard_map` would force us to hand-plumb global
-group offsets into the RNG, for no gain.
+Two execution paths (make_sharded_run's `impl`):
+- "xla": plain `jit` + `NamedSharding` — every per-tick op is elementwise over groups
+  and all randomness is counted threefry (`jax_threefry_partitionable`), so XLA's
+  SPMD partitioner splits the whole tick shard-locally with no communication.
+- "pallas": the ops/pallas_tick.py megakernel per shard via `jax.shard_map`; the
+  RNG/aux pre/post passes stay globally-sharded XLA (same partitioning argument), so
+  the kernel needs no global group offsets.
 
 The mesh is 2-D, ("dcn", "ici"): the outer axis models the multi-host/DCN dimension
 and the inner axis the within-host ICI dimension, matching how a v4 pod slice is
@@ -98,8 +100,77 @@ def init_sharded(cfg: RaftConfig, mesh: Mesh) -> RaftState:
     return fn()
 
 
+def _make_shardmap_pallas_tick(cfg: RaftConfig, mesh: Mesh,
+                               interpret: Optional[bool] = None):
+    """The Pallas megakernel applied per device shard via jax.shard_map.
+
+    Division of labor mirrors ops/pallas_tick.make_pallas_tick: the RNG/aux
+    pre-pass and the deferred-draw post-pass stay ordinary (globally sharded) XLA
+    ops; only the pure flat-state kernel runs inside shard_map, each device
+    processing its own (rows, G/n_dev) lane slab. Zero collectives inside the tick.
+    """
+    from raft_kotlin_tpu.ops import tick as tick_mod
+    from raft_kotlin_tpu.ops.pallas_tick import (
+        _TILES,
+        cast_flat_in,
+        cast_flat_out,
+        default_tile,
+        make_pallas_core,
+    )
+    from raft_kotlin_tpu.utils import rng as rngmod
+
+    N, G = cfg.n_nodes, cfg.n_groups
+    n_dev = math.prod(mesh.devices.shape)
+    assert G % n_dev == 0, "pad_groups first"
+    g_local = G // n_dev
+    if interpret is None:
+        # Resolve from the mesh's own devices: jax.default_backend() can report a
+        # plugin backend even when this run targets the virtual CPU device pool.
+        interpret = mesh.devices.flatten()[0].platform == "cpu"
+    if interpret:
+        tile = min(g_local, 256)
+        if g_local % tile:
+            tile = math.gcd(g_local, tile) or 1
+    else:
+        try:
+            tile = default_tile(cfg, g_local, False)
+        except ValueError as e:
+            raise ValueError(
+                f"sharded pallas needs the PER-DEVICE shard ({g_local} = "
+                f"n_groups // {n_dev} devices) lane-aligned and within VMEM: "
+                f"choose n_groups as a multiple of n_dev * tile for a tile in "
+                f"{_TILES} that fits the config, or use impl='xla'"
+            ) from e
+    build_call = make_pallas_core(cfg, g_local, tile, interpret)
+
+    base = rngmod.base_key(cfg.seed)
+    tkeys = rngmod.grid_keys(base, rngmod.KIND_TIMEOUT, G, N).T
+    bkeys = rngmod.grid_keys(base, rngmod.KIND_BACKOFF, G, N).T
+    lanes_spec = P(None, ("dcn", "ici"))
+
+    def tick(state: RaftState) -> RaftState:
+        aux, flags = tick_mod.make_aux(cfg, base, tkeys, bkeys, state, None, None)
+        call, aux_names = build_call(flags)
+        flat = tick_mod.flatten_state(cfg, state)
+        ins = cast_flat_in(flat, aux, aux_names)
+        shard_call = jax.shard_map(
+            lambda *a: call(*a),
+            mesh=mesh,
+            in_specs=(lanes_spec,) * len(ins),
+            out_specs=lanes_spec,
+            # pallas_call out_shapes carry no vma annotations; the kernel is
+            # embarrassingly parallel over lanes, so the check adds nothing.
+            check_vma=False,
+        )
+        s, el_dirty = cast_flat_out(shard_call(*ins))
+        return tick_mod.finish_tick(
+            cfg, tkeys, tick_mod.unflatten_state(cfg, s), el_dirty, state.tick)
+
+    return tick
+
+
 def make_sharded_run(cfg: RaftConfig, mesh: Mesh, n_ticks: int,
-                     metrics_every: int = 0):
+                     metrics_every: int = 0, impl: str = "xla"):
     """Compile run(state [, inject]) -> (state, metrics) sharded over `mesh`.
 
     metrics: dict of per-tick cross-group reductions, each a (n_ticks,) array —
@@ -107,8 +178,14 @@ def make_sharded_run(cfg: RaftConfig, mesh: Mesh, n_ticks: int,
     `commit_total` (sum over groups of max node commit). These are the only
     cross-device ops (XLA inserts the reductions over ICI/DCN); set metrics_every=0
     to keep even those out and return state only.
+
+    impl: "xla" (default — the SPMD partitioner splits the tick shard-locally) or
+    "pallas" (the megakernel per shard via shard_map).
     """
-    tick_fn = make_tick(cfg)
+    if impl == "pallas":
+        tick_fn = _make_shardmap_pallas_tick(cfg, mesh)
+    else:
+        tick_fn = make_tick(cfg)
     sh = state_sharding(mesh)
     rep = NamedSharding(mesh, P())
 
