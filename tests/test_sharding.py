@@ -7,6 +7,7 @@ counted threefry, so sharding may not change a single bit.
 """
 
 import jax
+import numpy as np
 
 from conftest import assert_states_equal
 import pytest
