@@ -90,3 +90,32 @@ def test_v1_checkpoint_forward_migration(tmp_path):
     assert bool(np.all(np.asarray(restored.up)))
     assert bool(np.all(np.asarray(restored.link_up)))
     assert_states_equal(st, restored)
+
+
+def test_resume_across_backends(tmp_path):
+    # A checkpoint taken mid-run under one tick backend must resume bit-exactly
+    # under the other — the backends share phase_body, and the counted RNG keys off
+    # on-state counters, so the trace cannot tell which backend produced which half.
+    import jax
+
+    from raft_kotlin_tpu.ops.pallas_tick import make_pallas_tick
+    from raft_kotlin_tpu.ops.tick import make_tick
+
+    cfg = dataclasses.replace(CFG, n_groups=8)
+    tx = jax.jit(make_tick(cfg))
+    tp = jax.jit(make_pallas_tick(cfg, interpret=True))
+    T1, T2 = 37, 41
+
+    st = init_state(cfg)
+    for _ in range(T1):
+        st = tp(st)                      # first half under pallas
+    path = str(tmp_path / "xover.npz")
+    checkpoint.save(path, st, cfg)
+    resumed, _ = checkpoint.load(path, expect_cfg=cfg)
+    for _ in range(T2):
+        resumed = tx(resumed)            # second half under xla
+
+    straight = init_state(cfg)
+    for _ in range(T1 + T2):
+        straight = tx(straight)          # uninterrupted, single backend
+    assert_states_equal(jax.device_get(straight), jax.device_get(resumed))
