@@ -152,7 +152,7 @@ struct Group {
 static bool vote_handler(Group& gr, const Inputs& in, int p,
                          int32_t req_term, int32_t cand,
                          int32_t req_lli, int32_t req_llt, int32_t* resp_term) {
-  const Dims& d = gr.d; State& s = gr.s;
+  State& s = gr.s;
   bool granted;
   int32_t p_term = *gr.f(s.term, p);
   if (req_term < p_term) {
@@ -173,7 +173,6 @@ static bool vote_handler(Group& gr, const Inputs& in, int p,
       granted = true;
     }
   }
-  (void)d;
   *resp_term = *gr.f(s.term, p);
   return granted;
 }

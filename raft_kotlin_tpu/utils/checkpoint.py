@@ -95,7 +95,8 @@ def _load_impl(path, expect_cfg, sharding):
     with np.load(path) as z:
         version = int(z[_VERSION_KEY])
         if version not in (1, 2, _VERSION):
-            raise ValueError(f"checkpoint version {version} != supported {_VERSION}")
+            raise ValueError(
+                f"checkpoint version {version} not supported (can load 1-{_VERSION})")
         cfg_dict = json.loads(bytes(z[_HEADER_KEY].tobytes()).decode())
         extra = (
             json.loads(bytes(z[_EXTRA_KEY].tobytes()).decode())

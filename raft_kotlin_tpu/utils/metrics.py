@@ -58,10 +58,11 @@ def tick_metrics(prev: RaftState, cur: RaftState) -> Dict[str, jax.Array]:
     is_leader = cur.role == LEADER
     n_lead = jnp.sum(is_leader.astype(_I32), axis=0)  # (G,)
 
-    # Same-term leader pairs, O(N^2) on the tiny node axis.
+    # Same-term leader pairs, O(N^2) on the tiny node axis (the is_leader factors
+    # already restrict the comparison to leader-leader pairs).
     N = cur.term.shape[0]
-    lt = jnp.where(is_leader, cur.term, -jnp.arange(N, dtype=_I32)[:, None] - 1)
-    same = (lt[:, None, :] == lt[None, :, :]) & is_leader[:, None, :] & is_leader[None, :, :]
+    t = cur.term
+    same = (t[:, None, :] == t[None, :, :]) & is_leader[:, None, :] & is_leader[None, :, :]
     same = same & ~jnp.eye(N, dtype=bool)[:, :, None]
     split = jnp.any(same, axis=(0, 1))
 
@@ -135,13 +136,24 @@ def make_instrumented_run(
     cfg: RaftConfig,
     n_ticks: int,
     invariants: bool = False,
+    impl: str = "auto",
 ):
     """jitted run(state) -> (state, metrics) where metrics is a dict of (n_ticks,)
     arrays from `tick_metrics` (plus `check_invariants` counts when invariants=True —
-    the debug mode; ~free, but adds a few reductions per tick)."""
+    the debug mode; ~free, but adds a few reductions per tick). impl as in
+    Simulator: "xla", "pallas", or "auto" (ops/pallas_tick.choose_impl)."""
     from raft_kotlin_tpu.ops.tick import make_tick
 
-    tick_fn = make_tick(cfg)
+    if impl == "auto":
+        from raft_kotlin_tpu.ops.pallas_tick import choose_impl
+
+        impl = choose_impl(cfg)
+    if impl == "pallas":
+        from raft_kotlin_tpu.ops.pallas_tick import make_pallas_tick
+
+        tick_fn = make_pallas_tick(cfg)
+    else:
+        tick_fn = make_tick(cfg)
 
     def body(st, _):
         nxt = tick_fn(st)

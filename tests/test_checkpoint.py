@@ -96,8 +96,6 @@ def test_resume_across_backends(tmp_path):
     # A checkpoint taken mid-run under one tick backend must resume bit-exactly
     # under the other — the backends share phase_body, and the counted RNG keys off
     # on-state counters, so the trace cannot tell which backend produced which half.
-    import jax
-
     from raft_kotlin_tpu.ops.pallas_tick import make_pallas_tick
     from raft_kotlin_tpu.ops.tick import make_tick
 
