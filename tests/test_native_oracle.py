@@ -71,6 +71,18 @@ def test_inject_and_fault_cmd_bitmatch():
 
 
 @pytest.mark.slow
+def test_native_soak_deep():
+    # 3M node-ticks of full fault soup: the deepest differential evidence in the
+    # suite (kernel ~66s + native ~42s with a warm compile cache).
+    cfg = RaftConfig(
+        n_groups=1024, n_nodes=5, seed=1234, p_drop=0.08, cmd_period=6,
+        p_crash=0.015, p_restart=0.08, p_link_fail=0.01, p_link_heal=0.1,
+        log_capacity=48,
+    ).stressed(10)
+    assert_native_matches_kernel(cfg, 600)
+
+
+@pytest.mark.slow
 def test_native_scale_sweep():
     # The point of the native engine: a differential sweep the Python oracle cannot
     # afford. 512 groups x 400 stressed ticks with full fault soup.
