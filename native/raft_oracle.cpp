@@ -33,6 +33,8 @@ struct Dims {
   int32_t cmd_period, cmd_node;  // phase-0 workload (cmd_node is 1-based)
   int32_t t0, T;               // first tick index, number of ticks to run
   int32_t Kt, Kb;              // timeout / backoff draw-table depths
+  int32_t delay_lo, delay_hi;  // SEMANTICS.md §10 send-delay range; 0/0 = sync
+  int32_t mailbox;             // nonzero: route exchanges through the §10 mailbox
 };
 
 // All per-(group,node) state, flattened C-order. Caller-owned, mutated in place.
@@ -50,6 +52,12 @@ struct State {
   uint8_t *up;                                        // [G][N]
   uint8_t *link_up;                                   // [G][N][N]  [g][s-1][r-1]
   int32_t *t_ctr, *b_ctr, *rounds;                    // [G][N]
+  // §10 mailbox slots (null unless Dims.mailbox): all [G][N][N], [g][owner-1][p-1].
+  // *_due is the relative delivery countdown (-1 = empty); the rest are the
+  // request snapshot taken at send (mirrors models/state.py MAILBOX_FIELDS).
+  int32_t *vq_due, *vq_term, *vq_lli, *vq_llt, *vq_round;
+  int32_t *aq_due, *aq_term, *aq_pli, *aq_plt, *aq_hase, *aq_ent_t, *aq_ent_c,
+          *aq_commit;
 };
 
 // Host-supplied randomness + schedules. Any pointer may be null (= feature off).
@@ -63,6 +71,7 @@ struct Inputs {
   const uint8_t *link_heal;      // [T][G][N][N]
   const int32_t *inject;         // [T][G][N] command id, -1 = none (phase 0)
   const uint8_t *fault_cmd;      // [T][G][N] 0 none / 1 crash / 2 restart (phase F)
+  const int32_t *delay;          // [T][G][N][N] §10 send delays (null if lo == hi)
 };
 
 // Post-tick trace sink, [T][G][N] each; any may be null.
@@ -143,6 +152,12 @@ struct Group {
       *nn(s.match_index, n, p) = 0;
     }
     *f(s.hb_armed, n) = 0; *f(s.hb_left, n) = 0;
+    if (d.mailbox) {  // §10: owned slots die with the process
+      for (int p = 1; p <= d.N; p++) {
+        *nn(s.vq_due, n, p) = -1;
+        *nn(s.aq_due, n, p) = -1;
+      }
+    }
     *f(s.up, n) = 1;
     reset_el_timer(in, n);
   }
@@ -298,22 +313,65 @@ static void tick_group(Group& gr, const Dims& d, const Inputs& in, int32_t t,
     }
   }
 
+  // §10 per-pair send delay at this tick (constant when lo == hi).
+  auto delay_of = [&](int a, int b) -> int32_t {
+    return in.delay ? in.delay[gNN + (a - 1) * N + (b - 1)] : d.delay_lo;
+  };
+
+  // §10 delivery of a vote slot (response leg at the delivery tick; candidate
+  // tally guarded by the round stamp — straggler cancellation).
+  auto vote_deliver = [&](int c, int p) {
+    if (*gr.nn(s.vq_due, c, p) != 0) return;  // empty (-1) or still in flight
+    *gr.nn(s.vq_due, c, p) = -1;
+    if (!ok(p, c)) return;                    // voids the whole exchange
+    int32_t req_term = *gr.nn(s.vq_term, c, p);
+    int32_t resp_term;
+    bool granted = vote_handler(gr, in, p, req_term, c,
+                                *gr.nn(s.vq_lli, c, p), *gr.nn(s.vq_llt, c, p),
+                                &resp_term);
+    if (!(*gr.f(s.round_state, c) == ACTIVE &&
+          *gr.nn(s.vq_round, c, p) == *gr.f(s.rounds, c)))
+      return;  // straggler: p mutated, candidate never sees it
+    *gr.nn(s.responded, c, p) = 1;
+    (*gr.f(s.responses, c))++;
+    if (resp_term > *gr.f(s.term, c)) *gr.f(s.role, c) = FOLLOWER;  // quirk f
+    if (granted) (*gr.f(s.votes, c))++;
+  };
+
   // Phase 3 — vote exchanges.
-  for (int c = 1; c <= N; c++) {
-    if (*gr.f(s.round_state, c) != ACTIVE) continue;
-    if (*gr.f(s.round_age, c) % d.retry_ticks != 0) continue;
-    for (int p = 1; p <= N; p++) {
-      if (*gr.nn(s.responded, c, p)) continue;
-      if (!(ok(c, p) && ok(p, c))) continue;
-      int32_t c_term = *gr.f(s.term, c);
-      int32_t resp_term;
-      bool granted = vote_handler(gr, in, p, c_term, c,
-                                  *gr.f(s.last_index, c), gr.last_log_term(c),
-                                  &resp_term);
-      *gr.nn(s.responded, c, p) = 1;
-      (*gr.f(s.responses, c))++;
-      if (resp_term > c_term) *gr.f(s.role, c) = FOLLOWER;   // quirk f
-      if (granted) (*gr.f(s.votes, c))++;
+  if (d.mailbox) {
+    for (int c = 1; c <= N; c++) {
+      bool attempting = *gr.f(s.round_state, c) == ACTIVE &&
+                        *gr.f(s.round_age, c) % d.retry_ticks == 0;
+      for (int p = 1; p <= N; p++) {
+        vote_deliver(c, p);
+        if (attempting && !*gr.nn(s.responded, c, p) && ok(c, p)) {
+          *gr.nn(s.vq_term, c, p) = *gr.f(s.term, c);
+          *gr.nn(s.vq_lli, c, p) = *gr.f(s.last_index, c);
+          *gr.nn(s.vq_llt, c, p) = gr.last_log_term(c);
+          *gr.nn(s.vq_round, c, p) = *gr.f(s.rounds, c);
+          *gr.nn(s.vq_due, c, p) = delay_of(c, p);
+        }
+        if (d.delay_lo == 0) vote_deliver(c, p);  // τ=0: same iteration
+      }
+    }
+  } else {
+    for (int c = 1; c <= N; c++) {
+      if (*gr.f(s.round_state, c) != ACTIVE) continue;
+      if (*gr.f(s.round_age, c) % d.retry_ticks != 0) continue;
+      for (int p = 1; p <= N; p++) {
+        if (*gr.nn(s.responded, c, p)) continue;
+        if (!(ok(c, p) && ok(p, c))) continue;
+        int32_t c_term = *gr.f(s.term, c);
+        int32_t resp_term;
+        bool granted = vote_handler(gr, in, p, c_term, c,
+                                    *gr.f(s.last_index, c), gr.last_log_term(c),
+                                    &resp_term);
+        *gr.nn(s.responded, c, p) = 1;
+        (*gr.f(s.responses, c))++;
+        if (resp_term > c_term) *gr.f(s.role, c) = FOLLOWER;   // quirk f
+        if (granted) (*gr.f(s.votes, c))++;
+      }
     }
   }
 
@@ -343,56 +401,139 @@ static void tick_group(Group& gr, const Dims& d, const Inputs& in, int32_t t,
     }
   }
 
-  // Phase 5 — append / heartbeat.
-  for (int l = 1; l <= N; l++) {
-    if (!(*gr.f(s.hb_armed, l) && *gr.f(s.up, l))) continue;
-    if (*gr.f(s.hb_left, l) > 0) { (*gr.f(s.hb_left, l))--; continue; }
-    if (*gr.f(s.role, l) == FOLLOWER) {
-      *gr.f(s.hb_armed, l) = 0;          // cancel() stops FUTURE firings only
-    } else {
-      *gr.f(s.hb_left, l) = d.hb_ticks - 1;
+  // Leader-side processing of an append response (RaftServer.kt:146-168), against
+  // l's LIVE state; shared by the synchronous and §10 delivery paths.
+  auto append_process = [&](int l, int p, int32_t resp_term, bool success,
+                            bool has_entry, int32_t prev_li) {
+    if (resp_term > *gr.f(s.term, l)) {
+      *gr.f(s.term, l) = resp_term;
+      *gr.f(s.role, l) = FOLLOWER;
+      gr.reset_el_timer(in, l);
+      return;                                  // return@launch
     }
-    for (int p = 1; p <= N; p++) {
-      int32_t i = *gr.nn(s.next_index, l, p);
-      int32_t prev_li = i - 2, prev_lt;
-      if (prev_li >= 0) {
-        if (!gr.log_valid(l, prev_li)) continue;   // exception -> skip peer
-        prev_lt = gr.log_get_term(l, prev_li);
+    if (success) {
+      if (has_entry) {
+        (*gr.nn(s.next_index, l, p))++;
+        (*gr.nn(s.match_index, l, p))++;
+        int cnt = 0;
+        for (int q = 1; q <= N; q++)
+          if (*gr.nn(s.match_index, l, q) > *gr.f(s.commit, l)) cnt++;
+        if (cnt >= d.majority) (*gr.f(s.commit, l))++;  // quirk a
       } else {
-        prev_lt = -1;
+        *gr.nn(s.match_index, l, p) = prev_li + 1;      // quirk h
       }
-      bool has_entry = false;
-      int32_t ent_term = 0, ent_cmd = 0;
-      if (*gr.f(s.last_index, l) >= i) {
-        if (!gr.log_valid(l, i - 1)) continue;     // quirk i underflow -> skip
-        has_entry = true;
-        ent_term = gr.log_get_term(l, i - 1);
-        ent_cmd = gr.log_get_cmd(l, i - 1);
-      }
-      if (!(ok(l, p) && ok(p, l))) continue;       // dropped exchange
-      int32_t resp_term;
-      bool success = append_handler(gr, in, p, *gr.f(s.term, l), l, prev_li,
-                                    prev_lt, has_entry, ent_term, ent_cmd,
-                                    *gr.f(s.commit, l), &resp_term);
-      if (resp_term > *gr.f(s.term, l)) {
-        *gr.f(s.term, l) = resp_term;
-        *gr.f(s.role, l) = FOLLOWER;
-        gr.reset_el_timer(in, l);
-        continue;                                  // return@launch
-      }
-      if (success) {
-        if (has_entry) {
-          (*gr.nn(s.next_index, l, p))++;
-          (*gr.nn(s.match_index, l, p))++;
-          int cnt = 0;
-          for (int q = 1; q <= N; q++)
-            if (*gr.nn(s.match_index, l, q) > *gr.f(s.commit, l)) cnt++;
-          if (cnt >= d.majority) (*gr.f(s.commit, l))++;  // quirk a
+    } else {
+      (*gr.nn(s.next_index, l, p))--;                   // quirk i
+    }
+  };
+
+  // §10 delivery of an append slot (no straggler guard — append responses always
+  // process against live leader state; the reference never cancels them).
+  auto append_deliver = [&](int l, int p) {
+    if (*gr.nn(s.aq_due, l, p) != 0) return;
+    *gr.nn(s.aq_due, l, p) = -1;
+    if (!ok(p, l)) return;
+    bool has_entry = *gr.nn(s.aq_hase, l, p) != 0;
+    int32_t prev_li = *gr.nn(s.aq_pli, l, p);
+    int32_t resp_term;
+    bool success = append_handler(
+        gr, in, p, *gr.nn(s.aq_term, l, p), l, prev_li,
+        *gr.nn(s.aq_plt, l, p), has_entry, *gr.nn(s.aq_ent_t, l, p),
+        *gr.nn(s.aq_ent_c, l, p), *gr.nn(s.aq_commit, l, p), &resp_term);
+    append_process(l, p, resp_term, success, has_entry, prev_li);
+  };
+
+  // Phase 5 — append / heartbeat.
+  if (d.mailbox) {
+    for (int l = 1; l <= N; l++) {
+      bool fire = false;
+      if (*gr.f(s.hb_armed, l) && *gr.f(s.up, l)) {
+        if (*gr.f(s.hb_left, l) > 0) {
+          (*gr.f(s.hb_left, l))--;
         } else {
-          *gr.nn(s.match_index, l, p) = prev_li + 1;      // quirk h
+          fire = true;
+          if (*gr.f(s.role, l) == FOLLOWER) {
+            *gr.f(s.hb_armed, l) = 0;   // cancel() stops FUTURE firings only
+          } else {
+            *gr.f(s.hb_left, l) = d.hb_ticks - 1;
+          }
         }
+      }
+      for (int p = 1; p <= N; p++) {
+        append_deliver(l, p);           // in-flight slots, even when hb idle
+        if (fire) {
+          // Request construction + §5 skip rules at the send tick
+          // (post-delivery: the delivery above may have advanced next_index).
+          int32_t i = *gr.nn(s.next_index, l, p);
+          int32_t prev_li = i - 2, prev_lt = -1;
+          bool skip = false;
+          if (prev_li >= 0) {
+            if (gr.log_valid(l, prev_li)) prev_lt = gr.log_get_term(l, prev_li);
+            else skip = true;           // exception -> skip peer
+          }
+          bool has_entry = false;
+          int32_t ent_term = 0, ent_cmd = 0;
+          if (!skip && *gr.f(s.last_index, l) >= i) {
+            if (gr.log_valid(l, i - 1)) {
+              has_entry = true;
+              ent_term = gr.log_get_term(l, i - 1);
+              ent_cmd = gr.log_get_cmd(l, i - 1);
+            } else {
+              skip = true;              // quirk i underflow
+            }
+          }
+          if (!skip && ok(l, p)) {      // request leg
+            *gr.nn(s.aq_term, l, p) = *gr.f(s.term, l);
+            *gr.nn(s.aq_pli, l, p) = prev_li;
+            *gr.nn(s.aq_plt, l, p) = prev_lt;
+            *gr.nn(s.aq_hase, l, p) = has_entry ? 1 : 0;
+            *gr.nn(s.aq_ent_t, l, p) = ent_term;
+            *gr.nn(s.aq_ent_c, l, p) = ent_cmd;
+            *gr.nn(s.aq_commit, l, p) = *gr.f(s.commit, l);
+            *gr.nn(s.aq_due, l, p) = delay_of(l, p);
+          }
+        }
+        if (d.delay_lo == 0) append_deliver(l, p);  // τ=0: same iteration
+      }
+    }
+    // §10 end-of-tick: in-flight countdowns advance.
+    for (int a = 1; a <= N; a++)
+      for (int b = 1; b <= N; b++) {
+        if (*gr.nn(s.vq_due, a, b) > 0) (*gr.nn(s.vq_due, a, b))--;
+        if (*gr.nn(s.aq_due, a, b) > 0) (*gr.nn(s.aq_due, a, b))--;
+      }
+  } else {
+    for (int l = 1; l <= N; l++) {
+      if (!(*gr.f(s.hb_armed, l) && *gr.f(s.up, l))) continue;
+      if (*gr.f(s.hb_left, l) > 0) { (*gr.f(s.hb_left, l))--; continue; }
+      if (*gr.f(s.role, l) == FOLLOWER) {
+        *gr.f(s.hb_armed, l) = 0;          // cancel() stops FUTURE firings only
       } else {
-        (*gr.nn(s.next_index, l, p))--;                   // quirk i
+        *gr.f(s.hb_left, l) = d.hb_ticks - 1;
+      }
+      for (int p = 1; p <= N; p++) {
+        int32_t i = *gr.nn(s.next_index, l, p);
+        int32_t prev_li = i - 2, prev_lt;
+        if (prev_li >= 0) {
+          if (!gr.log_valid(l, prev_li)) continue;   // exception -> skip peer
+          prev_lt = gr.log_get_term(l, prev_li);
+        } else {
+          prev_lt = -1;
+        }
+        bool has_entry = false;
+        int32_t ent_term = 0, ent_cmd = 0;
+        if (*gr.f(s.last_index, l) >= i) {
+          if (!gr.log_valid(l, i - 1)) continue;     // quirk i underflow -> skip
+          has_entry = true;
+          ent_term = gr.log_get_term(l, i - 1);
+          ent_cmd = gr.log_get_cmd(l, i - 1);
+        }
+        if (!(ok(l, p) && ok(p, l))) continue;       // dropped exchange
+        int32_t resp_term;
+        bool success = append_handler(gr, in, p, *gr.f(s.term, l), l, prev_li,
+                                      prev_lt, has_entry, ent_term, ent_cmd,
+                                      *gr.f(s.commit, l), &resp_term);
+        append_process(l, p, resp_term, success, has_entry, prev_li);
       }
     }
   }
@@ -432,6 +573,7 @@ int raft_run(const Dims* dims, State* state, const Inputs* inputs, Trace* trace)
   return 0;
 }
 
-int raft_abi_version() { return 1; }
+int raft_abi_version() { return 2; }  // v2: §10 mailbox (Dims.delay_*/mailbox,
+                                      // State.vq_*/aq_*, Inputs.delay)
 
 }  // extern "C"

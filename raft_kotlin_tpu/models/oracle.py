@@ -121,6 +121,12 @@ class OracleNode:
         self.hb_armed = False
         self.hb_left = 0
 
+        # §10 mailbox: capacity-1 in-flight slots per peer this node OWNS (sent).
+        # vq[p-1]: dict(due, term, lli, llt, round); aq[p-1]: dict(due, term, pli,
+        # plt, entry, commit); None = empty.
+        self.vq: list[Optional[dict]] = [None] * cfg.n_nodes
+        self.aq: list[Optional[dict]] = [None] * cfg.n_nodes
+
     def _draw(self, kind: int, ctr: int, lo: int, hi: int) -> int:
         table = self._draws[kind]
         while ctr >= len(table):  # grow on demand, doubling
@@ -174,6 +180,8 @@ class OracleNode:
         self.match_index = [0] * self.cfg.n_nodes
         self.hb_armed = False
         self.hb_left = 0
+        self.vq = [None] * self.cfg.n_nodes  # §10: owned slots die with the process
+        self.aq = [None] * self.cfg.n_nodes
         self.up = True
         self.reset_election_timer()
 
@@ -355,24 +363,64 @@ class OracleGroup:
                 n.reset_election_timer()
 
         # Phase 3 — vote exchanges.
-        for c in nodes:
-            if c.round_state != ACTIVE:
-                continue
-            if c.round_age % cfg.retry_ticks != 0:
-                continue
-            for p in nodes:
-                if c.responded[p.id - 1]:
-                    continue
-                if not (ok(c.id, p.id) and ok(p.id, c.id)):
-                    continue
-                req = VoteReq(c.term, c.id, c.log.last_index, c.last_log_term())
+        mailbox = cfg.uses_mailbox
+        if mailbox:
+            delay_of = self._make_delay_of(t)
+
+            def vote_deliver(c: OracleNode, p: OracleNode) -> None:
+                # §10 delivery: response leg at the delivery tick; either-end
+                # failure voids the whole exchange. Candidate tally guarded by the
+                # round stamp (straggler cancellation, RaftServer.kt:214-215).
+                slot = c.vq[p.id - 1]
+                if slot is None or slot["due"] != 0:
+                    return
+                c.vq[p.id - 1] = None
+                if not ok(p.id, c.id):
+                    return
+                req = VoteReq(slot["term"], c.id, slot["lli"], slot["llt"])
                 resp_term, granted = vote_handler(p, req)
+                if not (c.round_state == ACTIVE and c.rounds == slot["round"]):
+                    return  # straggler: p mutated, candidate never sees it
                 c.responded[p.id - 1] = True
                 c.responses += 1
                 if resp_term > c.term:
-                    c.role = FOLLOWER  # quirk f: term not adopted (RaftServer.kt:210)
+                    c.role = FOLLOWER  # quirk f (live term, RaftServer.kt:210)
                 if granted:
                     c.votes += 1
+
+            for c in nodes:
+                attempting = (c.round_state == ACTIVE
+                              and c.round_age % cfg.retry_ticks == 0)
+                for p in nodes:
+                    vote_deliver(c, p)
+                    if (attempting and not c.responded[p.id - 1]
+                            and ok(c.id, p.id)):  # request leg at send tick
+                        c.vq[p.id - 1] = {
+                            "due": delay_of(c.id, p.id), "term": c.term,
+                            "lli": c.log.last_index, "llt": c.last_log_term(),
+                            "round": c.rounds,
+                        }
+                    if cfg.delay_lo == 0:
+                        vote_deliver(c, p)  # τ=0: same-iteration delivery
+        else:
+            for c in nodes:
+                if c.round_state != ACTIVE:
+                    continue
+                if c.round_age % cfg.retry_ticks != 0:
+                    continue
+                for p in nodes:
+                    if c.responded[p.id - 1]:
+                        continue
+                    if not (ok(c.id, p.id) and ok(p.id, c.id)):
+                        continue
+                    req = VoteReq(c.term, c.id, c.log.last_index, c.last_log_term())
+                    resp_term, granted = vote_handler(p, req)
+                    c.responded[p.id - 1] = True
+                    c.responses += 1
+                    if resp_term > c.term:
+                        c.role = FOLLOWER  # quirk f: term not adopted (RaftServer.kt:210)
+                    if granted:
+                        c.votes += 1
 
         # Phase 4 — round conclusions.
         for n in nodes:
@@ -397,53 +445,144 @@ class OracleGroup:
                 n.round_age += 1
 
         # Phase 5 — append / heartbeat.
-        for l in nodes:
-            if not (l.hb_armed and l.up):
-                continue
-            if l.hb_left > 0:
-                l.hb_left -= 1
-                continue
-            if l.role == FOLLOWER:
-                # RaftServer.kt:117 — only FOLLOWER cancels, and TimerTask.cancel()
-                # stops *future* firings only: this round's appends still go out.
-                l.hb_armed = False
-            else:
-                l.hb_left = cfg.hb_ticks - 1
-            for p in nodes:
-                i = l.next_index[p.id - 1]
-                prev_log_index = i - 2
-                if prev_log_index >= 0:
-                    if not l.log.valid(prev_log_index):
-                        continue  # exception -> skip peer (RaftServer.kt:170)
-                    prev_log_term = l.log.get_term(prev_log_index)
-                else:
-                    prev_log_term = -1
-                entry = None
-                if l.log.last_index >= i:
-                    if not l.log.valid(i - 1):
-                        continue  # quirk i: nextIndex underflow -> skip peer
-                    entry = (l.log.get_term(i - 1), l.log.get_cmd(i - 1))
-                if not (ok(l.id, p.id) and ok(p.id, l.id)):
-                    continue  # dropped exchange, exception swallowed
-                req = AppendReq(l.term, l.id, prev_log_index, prev_log_term, entry, l.commit)
+        if mailbox:
+            def append_deliver(l: OracleNode, p: OracleNode) -> None:
+                # §10 delivery; no straggler guard — append responses process
+                # against live leader state (the reference never cancels them).
+                slot = l.aq[p.id - 1]
+                if slot is None or slot["due"] != 0:
+                    return
+                l.aq[p.id - 1] = None
+                if not ok(p.id, l.id):
+                    return
+                req = AppendReq(slot["term"], l.id, slot["pli"], slot["plt"],
+                                slot["entry"], slot["commit"])
                 resp_term, success = append_handler(p, req)
                 if resp_term > l.term:
                     l.term = resp_term
                     l.role = FOLLOWER
-                    l.reset_election_timer()  # channel.offer(FOLLOWER) [canon]
-                    continue  # return@launch: skip success processing for this peer
+                    l.reset_election_timer()
+                    return  # return@launch
                 if success:
-                    if entry is not None:
+                    if slot["entry"] is not None:
                         l.next_index[p.id - 1] += 1
                         l.match_index[p.id - 1] += 1
                         if sum(1 for m in l.match_index if m > l.commit) >= cfg.majority:
                             l.commit += 1  # quirk a
                     else:
-                        l.match_index[p.id - 1] = prev_log_index + 1  # quirk h
+                        l.match_index[p.id - 1] = slot["pli"] + 1  # quirk h
                 else:
-                    l.next_index[p.id - 1] -= 1  # quirk i: may underflow
+                    l.next_index[p.id - 1] -= 1  # quirk i
+
+            for l in nodes:
+                fire = False
+                if l.hb_armed and l.up:
+                    if l.hb_left > 0:
+                        l.hb_left -= 1
+                    else:
+                        fire = True
+                        if l.role == FOLLOWER:
+                            l.hb_armed = False  # cancel() stops FUTURE firings only
+                        else:
+                            l.hb_left = cfg.hb_ticks - 1
+                for p in nodes:
+                    append_deliver(l, p)  # in-flight slots, even when hb idle
+                    if fire:
+                        # Request construction + §5 skip rules at the send tick
+                        # (post-delivery: the delivery above may have advanced
+                        # next_index).
+                        i = l.next_index[p.id - 1]
+                        pli = i - 2
+                        skip = False
+                        plt = -1
+                        if pli >= 0:
+                            if l.log.valid(pli):
+                                plt = l.log.get_term(pli)
+                            else:
+                                skip = True  # exception -> skip peer
+                        entry = None
+                        if not skip and l.log.last_index >= i:
+                            if l.log.valid(i - 1):
+                                entry = (l.log.get_term(i - 1), l.log.get_cmd(i - 1))
+                            else:
+                                skip = True  # quirk i underflow
+                        if not skip and ok(l.id, p.id):  # request leg
+                            l.aq[p.id - 1] = {
+                                "due": delay_of(l.id, p.id), "term": l.term,
+                                "pli": pli, "plt": plt, "entry": entry,
+                                "commit": l.commit,
+                            }
+                    if cfg.delay_lo == 0:
+                        append_deliver(l, p)  # τ=0: same-iteration delivery
+
+            # §10 end-of-tick: in-flight countdowns advance.
+            for n in nodes:
+                for q in (n.vq, n.aq):
+                    for slot in q:
+                        if slot is not None and slot["due"] > 0:
+                            slot["due"] -= 1
+        else:
+            for l in nodes:
+                if not (l.hb_armed and l.up):
+                    continue
+                if l.hb_left > 0:
+                    l.hb_left -= 1
+                    continue
+                if l.role == FOLLOWER:
+                    # RaftServer.kt:117 — only FOLLOWER cancels, and TimerTask.cancel()
+                    # stops *future* firings only: this round's appends still go out.
+                    l.hb_armed = False
+                else:
+                    l.hb_left = cfg.hb_ticks - 1
+                for p in nodes:
+                    i = l.next_index[p.id - 1]
+                    prev_log_index = i - 2
+                    if prev_log_index >= 0:
+                        if not l.log.valid(prev_log_index):
+                            continue  # exception -> skip peer (RaftServer.kt:170)
+                        prev_log_term = l.log.get_term(prev_log_index)
+                    else:
+                        prev_log_term = -1
+                    entry = None
+                    if l.log.last_index >= i:
+                        if not l.log.valid(i - 1):
+                            continue  # quirk i: nextIndex underflow -> skip peer
+                        entry = (l.log.get_term(i - 1), l.log.get_cmd(i - 1))
+                    if not (ok(l.id, p.id) and ok(p.id, l.id)):
+                        continue  # dropped exchange, exception swallowed
+                    req = AppendReq(l.term, l.id, prev_log_index, prev_log_term, entry, l.commit)
+                    resp_term, success = append_handler(p, req)
+                    if resp_term > l.term:
+                        l.term = resp_term
+                        l.role = FOLLOWER
+                        l.reset_election_timer()  # channel.offer(FOLLOWER) [canon]
+                        continue  # return@launch: skip success processing for this peer
+                    if success:
+                        if entry is not None:
+                            l.next_index[p.id - 1] += 1
+                            l.match_index[p.id - 1] += 1
+                            if sum(1 for m in l.match_index if m > l.commit) >= cfg.majority:
+                                l.commit += 1  # quirk a
+                        else:
+                            l.match_index[p.id - 1] = prev_log_index + 1  # quirk h
+                    else:
+                        l.next_index[p.id - 1] -= 1  # quirk i: may underflow
 
         self.tick_count += 1
+
+    def _make_delay_of(self, tick: int):
+        """delay_of(sender_id, receiver_id) for sends at `tick` — the §10 per-pair
+        draw, sliced from the canonical (G, N, N) shaped mask so it matches the
+        kernel's aux["delay"] bit-for-bit (same pattern as make_edge_ok_fn)."""
+        cfg = self.cfg
+        if cfg.delay_lo == cfg.delay_hi:
+            lo = cfg.delay_lo
+            return lambda a, b: lo
+        m = _delay_all_groups(
+            cfg.seed, tick, (cfg.n_groups, cfg.n_nodes, cfg.n_nodes),
+            cfg.delay_lo, cfg.delay_hi,
+        )[self.g]
+        return lambda a, b: int(m[a - 1][b - 1])
 
     # -- introspection --------------------------------------------------------
 
@@ -505,6 +644,12 @@ def predraw(cfg: RaftConfig, groups=None, k: int | None = None):
 def _edge_mask_all_groups(seed: int, tick: int, shape: tuple, p_drop: float):
     base = rngmod.base_key(seed)
     return np.asarray(rngmod.edge_ok_mask(base, tick, shape, p_drop))
+
+
+@functools.lru_cache(maxsize=None)
+def _delay_all_groups(seed: int, tick: int, shape: tuple, lo: int, hi: int):
+    base = rngmod.base_key(seed)
+    return np.asarray(rngmod.delay_mask(base, tick, shape, lo, hi))
 
 
 @functools.lru_cache(maxsize=None)

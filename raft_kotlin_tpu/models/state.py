@@ -12,6 +12,8 @@ are transposed at the boundary, so the layout change never touches a single draw
 
 from __future__ import annotations
 
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
 from flax import struct
@@ -77,11 +79,33 @@ class RaftState:
 
     tick: jax.Array         # () i32 — global tick counter
 
+    # §10 mailbox (present only when cfg.uses_mailbox; None otherwise): capacity-1
+    # in-flight exchange slots per directed (owner, peer) pair, all (N, N, G) i32,
+    # [owner-1, peer-1, g]. *_due is the relative delivery countdown (-1 = empty,
+    # 0 = deliverable this tick); the rest are the request snapshot taken at send.
+    vq_due: Optional[jax.Array] = None    # vote slots (owner = candidate)
+    vq_term: Optional[jax.Array] = None
+    vq_lli: Optional[jax.Array] = None    # lastLogIndex
+    vq_llt: Optional[jax.Array] = None    # lastLogTerm
+    vq_round: Optional[jax.Array] = None  # c.rounds stamp (straggler guard, §10)
+    aq_due: Optional[jax.Array] = None    # append slots (owner = leader)
+    aq_term: Optional[jax.Array] = None
+    aq_pli: Optional[jax.Array] = None    # prevLogIndex
+    aq_plt: Optional[jax.Array] = None    # prevLogTerm
+    aq_hase: Optional[jax.Array] = None   # 1 iff an entry is attached
+    aq_ent_t: Optional[jax.Array] = None  # the <=1 entry (term, cmd)
+    aq_ent_c: Optional[jax.Array] = None
+    aq_commit: Optional[jax.Array] = None  # leaderCommit
+
 
 def init_state(cfg: RaftConfig) -> RaftState:
     G, N, C = cfg.n_groups, cfg.n_nodes, cfg.log_capacity
     zi = lambda *s: jnp.zeros(s, dtype=jnp.int32)
     zb = lambda *s: jnp.zeros(s, dtype=bool)
+    # Log storage dtype (cfg.log_dtype): int16 halves the dominant deep-log HBM
+    # cost (BASELINE config 5); all handler arithmetic widens to int32 at read
+    # (ops/tick.log_gather) and narrows at write (log_add).
+    ldt = jnp.int16 if cfg.log_dtype == "int16" else jnp.int32
     base = rngmod.base_key(cfg.seed)
     # Boot draw: every node arms its election timer with counter 0 (t_ctr becomes 1).
     # Drawn in the canonical (G, N) shape (SEMANTICS.md §4), then transposed.
@@ -95,8 +119,8 @@ def init_state(cfg: RaftConfig) -> RaftState:
         commit=zi(N, G),
         last_index=zi(N, G),
         phys_len=zi(N, G),
-        log_term=zi(N, C, G),
-        log_cmd=zi(N, C, G),
+        log_term=jnp.zeros((N, C, G), dtype=ldt),
+        log_cmd=jnp.zeros((N, C, G), dtype=ldt),
         el_armed=jnp.ones((N, G), dtype=bool),
         el_left=el_left,
         round_state=zi(N, G),
@@ -116,4 +140,24 @@ def init_state(cfg: RaftConfig) -> RaftState:
         b_ctr=zi(N, G),
         rounds=zi(N, G),
         tick=jnp.zeros((), dtype=jnp.int32),
+        **(
+            {
+                "vq_due": jnp.full((N, N, G), -1, dtype=jnp.int32),
+                "aq_due": jnp.full((N, N, G), -1, dtype=jnp.int32),
+                **{k: zi(N, N, G) for k in (
+                    "vq_term", "vq_lli", "vq_llt", "vq_round",
+                    "aq_term", "aq_pli", "aq_plt", "aq_hase",
+                    "aq_ent_t", "aq_ent_c", "aq_commit",
+                )},
+            }
+            if cfg.uses_mailbox
+            else {}
+        ),
     )
+
+
+MAILBOX_FIELDS = (
+    "vq_due", "vq_term", "vq_lli", "vq_llt", "vq_round",
+    "aq_due", "aq_term", "aq_pli", "aq_plt", "aq_hase",
+    "aq_ent_t", "aq_ent_c", "aq_commit",
+)

@@ -37,7 +37,8 @@ _U8P = ct.POINTER(ct.c_uint8)
 class _Dims(ct.Structure):
     _fields_ = [(k, ct.c_int32) for k in (
         "G", "N", "C", "hb_ticks", "round_ticks", "retry_ticks", "majority",
-        "cmd_period", "cmd_node", "t0", "T", "Kt", "Kb")]
+        "cmd_period", "cmd_node", "t0", "T", "Kt", "Kb",
+        "delay_lo", "delay_hi", "mailbox")]
 
 
 _STATE_FIELDS_I32 = (
@@ -47,6 +48,12 @@ _STATE_FIELDS_I32 = (
     "t_ctr", "b_ctr", "rounds",
 )
 _STATE_FIELDS_U8 = ("el_armed", "responded", "hb_armed", "up", "link_up")
+
+_MAILBOX_ORDER = (
+    "vq_due", "vq_term", "vq_lli", "vq_llt", "vq_round",
+    "aq_due", "aq_term", "aq_pli", "aq_plt", "aq_hase", "aq_ent_t", "aq_ent_c",
+    "aq_commit",
+)
 
 # Must mirror struct State's member ORDER in raft_oracle.cpp exactly.
 _STATE_ORDER = (
@@ -61,7 +68,7 @@ _STATE_ORDER = (
     ("hb_armed", _U8P), ("hb_left", _I32P),
     ("up", _U8P), ("link_up", _U8P),
     ("t_ctr", _I32P), ("b_ctr", _I32P), ("rounds", _I32P),
-)
+) + tuple((k, _I32P) for k in _MAILBOX_ORDER)
 
 
 class _State(ct.Structure):
@@ -73,7 +80,7 @@ class _Inputs(ct.Structure):
         ("timeout_draws", _I32P), ("backoff_draws", _I32P),
         ("edge_ok", _U8P), ("crash_m", _U8P), ("restart_m", _U8P),
         ("link_fail", _U8P), ("link_heal", _U8P),
-        ("inject", _I32P), ("fault_cmd", _U8P),
+        ("inject", _I32P), ("fault_cmd", _U8P), ("delay", _I32P),
     ]
 
 
@@ -112,7 +119,7 @@ def _lib() -> ct.CDLL:
             ct.POINTER(_Dims), ct.POINTER(_State), ct.POINTER(_Inputs),
             ct.POINTER(_Trace),
         ]
-        assert lib.raft_abi_version() == 1
+        assert lib.raft_abi_version() == 2
         _lib_handle = lib
     return _lib_handle
 
@@ -159,8 +166,14 @@ def _tick_masks(cfg: RaftConfig, t0: int, T: int) -> Dict[str, Optional[np.ndarr
 
     out: Dict[str, Optional[np.ndarray]] = {
         "edge_ok": None, "crash_m": None, "restart_m": None,
-        "link_fail": None, "link_heal": None,
+        "link_fail": None, "link_heal": None, "delay": None,
     }
+    if cfg.uses_mailbox and cfg.delay_lo < cfg.delay_hi:
+        out["delay"] = np.ascontiguousarray(np.asarray(
+            jax.jit(lambda: jax.lax.map(
+                lambda t: rngmod.delay_mask(base, t, (G, N, N),
+                                            cfg.delay_lo, cfg.delay_hi),
+                ticks))(), dtype=np.int32))
     if cfg.p_drop > 0:
         out["edge_ok"] = stack(
             lambda t: rngmod.edge_ok_mask(base, t, (G, N, N), cfg.p_drop))
@@ -196,8 +209,8 @@ class NativeOracle:
         st = init_state(cfg)
         self.arrays: Dict[str, np.ndarray] = {}
         for f in dataclasses.fields(st):
-            if f.name == "tick":
-                continue
+            if f.name == "tick" or getattr(st, f.name) is None:
+                continue  # §10 mailbox fields absent unless cfg.uses_mailbox
             a = np.asarray(getattr(st, f.name))
             a = a.T if a.ndim == 2 else a.transpose(2, 0, 1)
             dt = np.uint8 if f.name in _STATE_FIELDS_U8 else np.int32
@@ -242,9 +255,11 @@ class NativeOracle:
                 majority=cfg.majority, cmd_period=cfg.cmd_period,
                 cmd_node=cfg.cmd_node, t0=self.t, T=n_ticks,
                 Kt=self._Kt, Kb=self._Kb,
+                delay_lo=cfg.delay_lo, delay_hi=cfg.delay_hi,
+                mailbox=1 if cfg.uses_mailbox else 0,
             )
             state = _State(**{
-                k: _ptr(self.arrays[k], typ) for k, typ in _STATE_ORDER
+                k: _ptr(self.arrays.get(k), typ) for k, typ in _STATE_ORDER
             })
             inputs = _Inputs(
                 timeout_draws=_ptr(self._timeout, _I32P),
@@ -256,6 +271,7 @@ class NativeOracle:
                 link_heal=_ptr(masks["link_heal"], _U8P),
                 inject=_ptr(inject, _I32P),
                 fault_cmd=_ptr(fault_cmd, _U8P),
+                delay=_ptr(masks["delay"], _I32P),
             )
             trace_s = _Trace(**({k: _ptr(tr[k], _I32P) for k in TRACE_FIELDS}
                                 if trace else {}))

@@ -33,9 +33,9 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from raft_kotlin_tpu.models.state import RaftState
+from raft_kotlin_tpu.models.state import MAILBOX_FIELDS, RaftState
 from raft_kotlin_tpu.ops import tick as tick_mod
-from raft_kotlin_tpu.ops.tick import AUX_FIELDS, STATE_FIELDS, BodyFlags
+from raft_kotlin_tpu.ops.tick import AUX_FIELDS, STATE_FIELDS, BodyFlags, state_fields
 from raft_kotlin_tpu.utils import rng as rngmod
 from raft_kotlin_tpu.utils.config import RaftConfig
 
@@ -73,6 +73,8 @@ def choose_impl(cfg: RaftConfig) -> str:
     (see bench.py measure())."""
     if jax.default_backend() == "cpu":
         return "xla"
+    if cfg.log_dtype != "int32":
+        return "xla"  # narrow-log configs are deep-log configs: XLA path
     try:
         default_tile(cfg, cfg.n_groups, interpret=False)
     except ValueError:
@@ -95,6 +97,10 @@ def make_pallas_core(cfg: RaftConfig, lanes: int, tile_g: int, interpret: bool):
     (callable(*flat_int32_arrays) -> flat outputs + el_dirty, aux_names)."""
     N, C = cfg.n_nodes, cfg.log_capacity
     assert lanes % tile_g == 0, (lanes, tile_g)
+    if cfg.log_dtype != "int32":
+        raise ValueError(
+            "the Pallas megakernel moves all state as int32; narrow-log "
+            "(deep-log) configs use the XLA tick — see choose_impl")
 
     # Per-tile block shapes. Everything is RANK-2 (rows, tile_g): phase_body's flat
     # layout (ops/tick.py) — pair grids (N*N, ·), logs (N*C, ·) — which is also what
@@ -104,12 +110,14 @@ def make_pallas_core(cfg: RaftConfig, lanes: int, tile_g: int, interpret: bool):
         "log_term": (N * C, tile_g), "log_cmd": (N * C, tile_g),
         "responded": (N * N, tile_g), "next_index": (N * N, tile_g),
         "match_index": (N * N, tile_g), "link_up": (N * N, tile_g),
+        **{k: (N * N, tile_g) for k in MAILBOX_FIELDS},
     }
     aux_shapes = {
         "edge_iid": (N * N, tile_g), "crash_m": (N, tile_g),
         "restart_m": (N, tile_g), "link_fail": (N * N, tile_g),
         "link_heal": (N * N, tile_g), "el_draw_f": (N, tile_g),
         "bdraw": (N, tile_g), "periodic": (1, tile_g), "inject": (N, tile_g),
+        "delay": (N * N, tile_g),
     }
 
     def block_spec(shape):
@@ -117,6 +125,7 @@ def make_pallas_core(cfg: RaftConfig, lanes: int, tile_g: int, interpret: bool):
 
     @functools.lru_cache(maxsize=None)
     def build_call(flags: BodyFlags):
+        sfields = state_fields(flags)
         aux_names = tuple(
             k for k in AUX_FIELDS
             if (k in ("edge_iid", "bdraw"))
@@ -124,14 +133,15 @@ def make_pallas_core(cfg: RaftConfig, lanes: int, tile_g: int, interpret: bool):
             or (k in ("link_fail", "link_heal") and flags.links)
             or (k == "periodic" and flags.periodic)
             or (k == "inject" and flags.inject)
+            or (k == "delay" and flags.delay and cfg.delay_lo < cfg.delay_hi)
         )
 
         def kernel(*refs):
-            n_in = len(STATE_FIELDS) + len(aux_names)
-            ins = dict(zip(STATE_FIELDS + aux_names, refs[:n_in]))
-            outs = dict(zip(STATE_FIELDS + ("el_dirty",), refs[n_in:]))
+            n_in = len(sfields) + len(aux_names)
+            ins = dict(zip(sfields + aux_names, refs[:n_in]))
+            outs = dict(zip(sfields + ("el_dirty",), refs[n_in:]))
             s = {}
-            for k in STATE_FIELDS:
+            for k in sfields:
                 v = ins[k][...]
                 s[k] = (v != 0) if k in _BOOL_STATE else v
             aux = {}
@@ -139,17 +149,17 @@ def make_pallas_core(cfg: RaftConfig, lanes: int, tile_g: int, interpret: bool):
                 v = ins[k][...]
                 aux[k] = (v != 0) if k in _BOOL_AUX else v
             el_dirty = tick_mod.phase_body(cfg, s, aux, flags)
-            for k in STATE_FIELDS:
+            for k in sfields:
                 outs[k][...] = s[k].astype(_I32) if k in _BOOL_STATE else s[k]
             outs["el_dirty"][...] = el_dirty.astype(_I32)
 
-        in_specs = [block_spec(field_shapes[k]) for k in STATE_FIELDS]
+        in_specs = [block_spec(field_shapes[k]) for k in sfields]
         in_specs += [block_spec(aux_shapes[k]) for k in aux_names]
         out_shapes = [
             jax.ShapeDtypeStruct(tuple(field_shapes[k][:-1]) + (lanes,), _I32)
-            for k in STATE_FIELDS
+            for k in sfields
         ] + [jax.ShapeDtypeStruct((N, lanes), _I32)]
-        out_specs = [block_spec(field_shapes[k]) for k in STATE_FIELDS]
+        out_specs = [block_spec(field_shapes[k]) for k in sfields]
         out_specs += [block_spec((N, tile_g))]
 
         call = pl.pallas_call(
@@ -158,18 +168,18 @@ def make_pallas_core(cfg: RaftConfig, lanes: int, tile_g: int, interpret: bool):
             in_specs=in_specs,
             out_specs=out_specs,
             out_shape=out_shapes,
-            input_output_aliases={i: i for i in range(len(STATE_FIELDS))},
+            input_output_aliases={i: i for i in range(len(sfields))},
             interpret=interpret,
         )
-        return call, aux_names
+        return call, sfields, aux_names
 
     return build_call
 
 
-def cast_flat_in(flat: dict, aux: dict, aux_names):
+def cast_flat_in(flat: dict, aux: dict, sfields, aux_names):
     """Order + int32-cast the kernel operands from the flat state/aux dicts."""
     ins = []
-    for k in STATE_FIELDS:
+    for k in sfields:
         v = flat[k]
         ins.append(v.astype(_I32) if k in _BOOL_STATE else v)
     for k in aux_names:
@@ -178,10 +188,10 @@ def cast_flat_in(flat: dict, aux: dict, aux_names):
     return ins
 
 
-def cast_flat_out(outs):
+def cast_flat_out(outs, sfields):
     """Inverse of cast_flat_in for the kernel outputs -> (flat state dict, el_dirty)."""
     s = {}
-    for k, v in zip(STATE_FIELDS, outs[: len(STATE_FIELDS)]):
+    for k, v in zip(sfields, outs[: len(sfields)]):
         s[k] = (v != 0) if k in _BOOL_STATE else v
     return s, outs[-1] != 0
 
@@ -214,10 +224,10 @@ def make_pallas_tick(cfg: RaftConfig, tile_g: Optional[int] = None,
         )
         aux, flags = tick_mod.make_aux(
             cfg, base, tkeys, bkeys, state, inject, fault_cmd)
-        call, aux_names = build_call(flags)
+        call, sfields, aux_names = build_call(flags)
         flat = tick_mod.flatten_state(cfg, state)
-        outs = call(*cast_flat_in(flat, aux, aux_names))
-        s, el_dirty = cast_flat_out(outs)
+        outs = call(*cast_flat_in(flat, aux, sfields, aux_names))
+        s, el_dirty = cast_flat_out(outs, sfields)
         return tick_mod.finish_tick(
             cfg, tkeys, tick_mod.unflatten_state(cfg, s), el_dirty, state.tick)
 
@@ -235,6 +245,9 @@ def default_tile(cfg: RaftConfig, lanes: int, interpret: bool) -> int:
                if k not in ("log_term", "log_cmd", "responded",
                             "next_index", "match_index", "link_up"))
     rows = 2 * (n_2d * N + 4 * N * N + 2 * N * C) + (3 * N * N + 5 * N + 1) + N
+    if cfg.uses_mailbox:
+        # §10 mailbox: 13 pair-shaped state fields (in + aliased out) + delay aux.
+        rows += 2 * len(MAILBOX_FIELDS) * N * N + N * N
     t = pick_tile(lanes, rows)
     if t is None:
         if pick_tile(lanes) is None:

@@ -40,6 +40,7 @@ from raft_kotlin_tpu.models.state import (
     FOLLOWER,
     IDLE,
     LEADER,
+    MAILBOX_FIELDS,
     RaftState,
 )
 from raft_kotlin_tpu.utils import rng as rngmod
@@ -47,10 +48,20 @@ from raft_kotlin_tpu.utils.config import RaftConfig
 
 _I32 = jnp.int32
 
-# The phase_body state fields, in canonical order (everything except the tick scalar).
+# The CORE phase_body state fields, in canonical order (everything except the tick
+# scalar and the optional §10 mailbox fields — see state_fields()).
 STATE_FIELDS = tuple(
-    f.name for f in dataclasses.fields(RaftState) if f.name != "tick"
+    f.name for f in dataclasses.fields(RaftState)
+    if f.name != "tick" and f.name not in MAILBOX_FIELDS
 )
+
+
+def state_fields(flags: "BodyFlags") -> tuple:
+    """The state fields phase_body operates on under `flags`: the core set, plus
+    the §10 mailbox slots when the delay path is compiled in."""
+    return STATE_FIELDS + (MAILBOX_FIELDS if flags.delay else ())
+
+
 # Pre-drawn randomness + driver inputs consumed by phase_body.
 AUX_FIELDS = (
     "edge_iid",    # (N*N, G) bool — §4 iid survival, row (s-1)*N + r-1
@@ -62,6 +73,7 @@ AUX_FIELDS = (
     "bdraw",       # (N, G) i32 — backoff draw at pre-tick b_ctr (phase 4)
     "periodic",    # (1, G) i32 — phase-0 workload command value, -1 = none
     "inject",      # (N, G) i32 — driver commands, -1 = none
+    "delay",       # (N*N, G) i32 — §10 per-pair send delays (only when lo < hi)
 )
 
 
@@ -73,7 +85,7 @@ AUX_FIELDS = (
 # static-index updates are one-hot row selects (iota + compare + where — primitives
 # both XLA and Mosaic handle; XLA folds the constant one-hots) and rank never
 # exceeds 2. Flattening (N, N, G) -> (N*N, G) at the wrapper boundary is free.
-_PAIR_FIELDS = ("responded", "next_index", "match_index", "link_up")
+_PAIR_FIELDS = ("responded", "next_index", "match_index", "link_up") + MAILBOX_FIELDS
 _LOG_FIELDS = ("log_term", "log_cmd")
 
 
@@ -107,6 +119,7 @@ class BodyFlags:
     links: bool = False
     periodic: bool = False
     inject: bool = False
+    delay: bool = False  # §10 mailbox exchanges (cfg.uses_mailbox)
 
 
 def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
@@ -138,9 +151,15 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
     def log_gather(name, n, idx):
         # (G,) read of node n's physical slot idx, as a one-hot contraction over the
         # flat (N*C, G) log (no gather op — TPU-friendly); 0 where idx is out of
-        # [0, C) — callers must guard with masks.
-        oh = logrow == ((n - 1) * C + idx)[None, :]
-        return jnp.sum(jnp.where(oh, s[name], 0), axis=0)
+        # [0, C). The bounds terms make that guarantee real: without them an
+        # out-of-range idx in the flat layout would alias an ADJACENT node's row
+        # (idx=-1 -> node n-1 slot C-1; idx=C -> node n+1 slot 0).
+        oh = (logrow == ((n - 1) * C + idx)[None, :]) \
+            & ((idx >= 0) & (idx < C))[None, :]
+        # Widen at read: log storage may be int16 (cfg.log_dtype); the one-hot
+        # sum has at most one nonzero per column, so summing in the narrow dtype
+        # cannot overflow before the cast.
+        return jnp.sum(jnp.where(oh, s[name], 0), axis=0).astype(_I32)
 
     def log_add(n, i, term_v, cmd_v, mask):
         # SEMANTICS.md §3 add(): physical append / reject / overwrite-truncate.
@@ -153,8 +172,9 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
         ovw = mask & (i < li) & (i >= 0)
         slot = (n - 1) * C + jnp.where(app, pl, i)
         oh = (logrow == slot[None, :]) & (app | ovw)[None, :]
-        s["log_term"] = jnp.where(oh, term_v[None, :], s["log_term"])
-        s["log_cmd"] = jnp.where(oh, cmd_v[None, :], s["log_cmd"])
+        ldt = s["log_term"].dtype  # narrow at write (cfg.log_dtype)
+        s["log_term"] = jnp.where(oh, term_v.astype(ldt)[None, :], s["log_term"])
+        s["log_cmd"] = jnp.where(oh, cmd_v.astype(ldt)[None, :], s["log_cmd"])
         setcol("last_index", n, app | ovw, jnp.where(app, li + 1, i + 1))
         setcol("phys_len", n, app, pl + 1)
 
@@ -204,6 +224,12 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
         s["match_index"] = s["match_index"] * keep
         s["hb_armed"] = s["hb_armed"] & ~rst
         s["hb_left"] = jnp.where(rst, zero, s["hb_left"])
+        if flags.delay:
+            # §10: restart clears the slots the node OWNS (its sent requests died
+            # with the process); crash clears nothing (messages stay on the wire).
+            rst_rep = _rep_rows(rst, N)
+            s["vq_due"] = jnp.where(rst_rep, -1, s["vq_due"])
+            s["aq_due"] = jnp.where(rst_rep, -1, s["aq_due"])
         # Immediate reset: el_draw_f is the draw at pre-tick t_ctr, consumed here.
         s["el_left"] = jnp.where(rst, aux["el_draw_f"], s["el_left"])
         s["el_armed"] = s["el_armed"] | rst
@@ -274,46 +300,99 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
 
     # -- phase 3: vote exchanges --------------------------------------------
 
+    def delay_for(a, b):
+        # §10 per-pair send delay this tick (static constant when lo == hi).
+        if cfg.delay_lo == cfg.delay_hi:
+            return jnp.full((G,), cfg.delay_lo, dtype=_I32)
+        return aux["delay"][pair(a, b)]
+
+    def put_pair(name, a, b, mask, vals):
+        row = pair(a, b)
+        s[name] = _set_row(s[name], row, jnp.where(mask, vals, s[name][row]))
+
+    def vote_exchange(c, p, att, req_term, req_lli, req_llt, guard):
+        """§6.1 handler on p + candidate tally, masked by `att`; the request fields
+        are (G,) snapshots (live reads on the synchronous path, §10 slot contents on
+        the mailbox path). `guard` additionally masks the CANDIDATE-side processing
+        (the §10 straggler rule); the handler mutation on p is governed by `att`
+        alone."""
+        p_term = col("term", p)
+        p_vf = col("voted_for", p)
+        p_li = col("last_index", p)
+        p_llt = log_gather("log_term", p, p_li - 1)
+        rej_stale = (p_li >= 1) & (req_llt < p_llt)
+        rej_short = (p_li >= 1) & (req_llt == p_llt) & (req_lli < p_li)
+        grant_gt = (req_term > p_term) & ~rej_stale & ~rej_short
+        # Boolean algebra, not where-of-bools (Mosaic i1-select limits):
+        # term < p.term -> False; == -> votedFor check (quirk g); > -> log check.
+        granted = ((req_term == p_term) & (p_vf == c)) | grant_gt
+        adopt = att & grant_gt
+        setcol("term", p, adopt, req_term)
+        setcol("voted_for", p, adopt, c)
+        setcol("role", p, adopt, FOLLOWER)
+        reset_el_timer_col(p, adopt)
+        resp_term = col("term", p)
+        # Candidate tally (RaftServer.kt:209-211). resp_term is compared against
+        # c's LIVE term (RaftServer.kt:210 reads currentTerm at response
+        # processing); within one tick c's term cannot change during its own peer
+        # loop, so this is bit-identical to comparing against the request term on
+        # the synchronous path.
+        tal = att & guard
+        s["responded"] = _set_row(
+            s["responded"], pair(c, p),
+            jnp.where(tal, 1, s["responded"][pair(c, p)]),
+        )
+        setcol("responses", c, tal, col("responses", c) + 1)
+        setcol("role", c, tal & (resp_term > col("term", c)), FOLLOWER)  # quirk f
+        setcol("votes", c, tal & granted, col("votes", c) + 1)
+
+    def vote_deliver(c, p):
+        # §10 delivery: response leg evaluated at the delivery tick; either-end
+        # failure voids the whole exchange. Candidate processing additionally
+        # guarded by the round stamp (straggler cancellation).
+        row = pair(c, p)
+        due = s["vq_due"][row] == 0
+        att = due & edge_ok(p, c)
+        guard = (col("round_state", c) == ACTIVE) & (
+            s["vq_round"][row] == col("rounds", c)
+        )
+        req_term, req_lli, req_llt = s["vq_term"][row], s["vq_lli"][row], s["vq_llt"][row]
+        put_pair("vq_due", c, p, due, jnp.full((G,), -1, dtype=_I32))
+        vote_exchange(c, p, att, req_term, req_lli, req_llt, guard)
+
     for c in range(1, N + 1):
         c_attempting = (col("round_state", c) == ACTIVE) & (
             col("round_age", c) % cfg.retry_ticks == 0
         )
         for p in range(1, N + 1):
-            att = (
-                c_attempting
-                & (s["responded"][pair(c, p)] == 0)
-                & edge_ok(c, p)
-                & edge_ok(p, c)
-            )
-            # Request built from c's live state (RaftServer.kt:200-207).
-            c_term = col("term", c)
-            c_li = col("last_index", c)
-            c_llt = jnp.where(c_li == 0, 0, log_gather("log_term", c, c_li - 1))
-            # Vote handler on p (SEMANTICS.md §6.1).
-            p_term = col("term", p)
-            p_vf = col("voted_for", p)
-            p_li = col("last_index", p)
-            p_llt = log_gather("log_term", p, p_li - 1)
-            rej_stale = (p_li >= 1) & (c_llt < p_llt)
-            rej_short = (p_li >= 1) & (c_llt == p_llt) & (c_li < p_li)
-            grant_gt = (c_term > p_term) & ~rej_stale & ~rej_short
-            # Boolean algebra, not where-of-bools (Mosaic i1-select limits):
-            # term < p.term -> False; == -> votedFor check (quirk g); > -> log check.
-            granted = ((c_term == p_term) & (p_vf == c)) | grant_gt
-            adopt = att & grant_gt
-            setcol("term", p, adopt, c_term)
-            setcol("voted_for", p, adopt, c)
-            setcol("role", p, adopt, FOLLOWER)
-            reset_el_timer_col(p, adopt)
-            resp_term = col("term", p)
-            # Candidate tally (RaftServer.kt:209-211).
-            s["responded"] = _set_row(
-                s["responded"], pair(c, p),
-                jnp.where(att, 1, s["responded"][pair(c, p)]),
-            )
-            setcol("responses", c, att, col("responses", c) + 1)
-            setcol("role", c, att & (resp_term > c_term), FOLLOWER)  # quirk f
-            setcol("votes", c, att & granted, col("votes", c) + 1)
+            if flags.delay:
+                vote_deliver(c, p)  # in-flight slots from earlier ticks
+                att = (
+                    c_attempting
+                    & (s["responded"][pair(c, p)] == 0)
+                    & edge_ok(c, p)  # request leg at the send tick
+                )
+                c_li = col("last_index", c)
+                put_pair("vq_term", c, p, att, col("term", c))
+                put_pair("vq_lli", c, p, att, c_li)
+                put_pair("vq_llt", c, p, att,
+                         jnp.where(c_li == 0, 0, log_gather("log_term", c, c_li - 1)))
+                put_pair("vq_round", c, p, att, col("rounds", c))
+                put_pair("vq_due", c, p, att, delay_for(c, p))
+                if cfg.delay_lo == 0:
+                    vote_deliver(c, p)  # τ=0: the just-sent slot, same iteration
+            else:
+                att = (
+                    c_attempting
+                    & (s["responded"][pair(c, p)] == 0)
+                    & edge_ok(c, p)
+                    & edge_ok(p, c)
+                )
+                # Request built from c's live state (RaftServer.kt:200-207).
+                c_li = col("last_index", c)
+                c_llt = jnp.where(c_li == 0, 0, log_gather("log_term", c, c_li - 1))
+                true_g = jnp.ones((G,), dtype=bool)
+                vote_exchange(c, p, att, col("term", c), c_li, c_llt, true_g)
 
     # -- phase 4: round conclusions -----------------------------------------
 
@@ -342,6 +421,74 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
 
     # -- phase 5: append / heartbeat ----------------------------------------
 
+    def append_exchange(l, p, act5, req_term, req_commit, pli, plt,
+                        has_entry, ent_t, ent_c):
+        """§6.2 handler on p + leader response processing, masked by `act5`; the
+        request fields are (G,) snapshots (live reads on the synchronous path,
+        §10 slot contents on the mailbox path). Leader-side processing always
+        reads l's LIVE state (RaftServer.kt:146-168 — no latch for appends)."""
+        p_term = col("term", p)
+        if p != l:
+            adopt = act5 & (req_term > p_term)
+            setcol("term", p, adopt, req_term)
+            setcol("voted_for", p, adopt, -1)
+            setcol("role", p, adopt, FOLLOWER)
+            reset_el_timer_col(p, adopt)
+            setcol("role", p, act5, FOLLOWER)  # quirk d: any foreign append
+            reset_el_timer_col(p, act5)
+        p_li = col("last_index", p)
+        p_commit = col("commit", p)
+        cadv = act5 & (req_commit > p_commit)
+        setcol("commit", p, cadv, jnp.minimum(req_commit, p_li))  # quirk e
+        p_plt = log_gather("log_term", p, pli)
+        succ = (pli == -1) | ((p_li > pli) & (pli >= 0) & (p_plt == plt))
+        log_add(p, pli + 1, ent_t, ent_c, act5 & succ & has_entry)
+        resp_term = col("term", p)
+        # --- leader processes the response (RaftServer.kt:146-168) ---
+        if p != l:
+            l_term = col("term", l)
+            demote = act5 & (resp_term > l_term)
+            setcol("term", l, demote, resp_term)
+            setcol("role", l, demote, FOLLOWER)
+            reset_el_timer_col(l, demote)
+        else:
+            demote = jnp.zeros((G,), dtype=_I32) > 0
+        proc = act5 & ~demote & succ
+        with_e = proc & has_entry
+        nfail = act5 & ~demote & ~succ
+        ni = s["next_index"][pair(l, p)]
+        s["next_index"] = _set_row(
+            s["next_index"], pair(l, p),
+            jnp.where(with_e, ni + 1, jnp.where(nfail, ni - 1, ni)),
+        )
+        mi = s["match_index"][pair(l, p)]
+        s["match_index"] = _set_row(
+            s["match_index"], pair(l, p),
+            jnp.where(with_e, mi + 1, jnp.where(proc & ~has_entry, pli + 1, mi)),
+        )
+        # Commit advancement (quirk a), evaluated per response.
+        l_commit = col("commit", l)
+        cnt = jnp.sum(
+            (s["match_index"][(l - 1) * N:l * N] > l_commit[None, :]).astype(_I32),
+            axis=0,
+        )
+        setcol("commit", l, with_e & (cnt >= maj), l_commit + 1)
+
+    def append_deliver(l, p):
+        # §10 delivery: response leg at the delivery tick; either-end failure voids
+        # the exchange. No straggler guard — append responses always process
+        # against live leader state (the reference never cancels them).
+        row = pair(l, p)
+        due = s["aq_due"][row] == 0
+        att = due & edge_ok(p, l)
+        req = {k: s[k][row] for k in
+               ("aq_term", "aq_commit", "aq_pli", "aq_plt",
+                "aq_hase", "aq_ent_t", "aq_ent_c")}
+        put_pair("aq_due", l, p, due, jnp.full((G,), -1, dtype=_I32))
+        append_exchange(l, p, att, req["aq_term"], req["aq_commit"],
+                        req["aq_pli"], req["aq_plt"], req["aq_hase"] != 0,
+                        req["aq_ent_t"], req["aq_ent_c"])
+
     for l in range(1, N + 1):
         raw_armed = col("hb_armed", l)
         armed = raw_armed & col("up", l)
@@ -354,6 +501,11 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
         s["hb_armed"] = _set_row(s["hb_armed"], l - 1, raw_armed & ~(fire & l_is_f))
         setcol("hb_left", l, fire & ~l_is_f, cfg.hb_ticks - 1)
         for p in range(1, N + 1):
+            if flags.delay:
+                append_deliver(l, p)  # in-flight slots from earlier ticks
+
+            # Request construction + §5 skip rules, from l's live state at send
+            # (post-delivery: a delivery just above may have advanced next_index).
             li_l = col("last_index", l)
             i = s["next_index"][pair(l, p)]
             pli = i - 2
@@ -364,57 +516,30 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
             skip = skip | (has_entry & (i <= 0))  # quirk i underflow
             ent_t = log_gather("log_term", l, i - 1)
             ent_c = log_gather("log_cmd", l, i - 1)
-            skip = skip | ~edge_ok(l, p) | ~edge_ok(p, l)
-            act5 = fire & ~skip
-            # --- append handler on p (SEMANTICS.md §6.2) ---
-            req_term = col("term", l)
-            req_commit = col("commit", l)
-            p_term = col("term", p)
-            if p != l:
-                adopt = act5 & (req_term > p_term)
-                setcol("term", p, adopt, req_term)
-                setcol("voted_for", p, adopt, -1)
-                setcol("role", p, adopt, FOLLOWER)
-                reset_el_timer_col(p, adopt)
-                setcol("role", p, act5, FOLLOWER)  # quirk d: any foreign append
-                reset_el_timer_col(p, act5)
-            p_li = col("last_index", p)
-            p_commit = col("commit", p)
-            cadv = act5 & (req_commit > p_commit)
-            setcol("commit", p, cadv, jnp.minimum(req_commit, p_li))  # quirk e
-            p_plt = log_gather("log_term", p, pli)
-            succ = (pli == -1) | ((p_li > pli) & (pli >= 0) & (p_plt == plt))
-            log_add(p, pli + 1, ent_t, ent_c, act5 & succ & has_entry)
-            resp_term = col("term", p)
-            # --- leader processes the response (RaftServer.kt:146-168) ---
-            if p != l:
-                l_term = col("term", l)
-                demote = act5 & (resp_term > l_term)
-                setcol("term", l, demote, resp_term)
-                setcol("role", l, demote, FOLLOWER)
-                reset_el_timer_col(l, demote)
+            if flags.delay:
+                att = fire & ~skip & edge_ok(l, p)  # request leg at send tick
+                put_pair("aq_term", l, p, att, col("term", l))
+                put_pair("aq_commit", l, p, att, col("commit", l))
+                put_pair("aq_pli", l, p, att, pli)
+                put_pair("aq_plt", l, p, att, plt)
+                put_pair("aq_hase", l, p, att, has_entry.astype(_I32))
+                put_pair("aq_ent_t", l, p, att, ent_t)
+                put_pair("aq_ent_c", l, p, att, ent_c)
+                put_pair("aq_due", l, p, att, delay_for(l, p))
+                if cfg.delay_lo == 0:
+                    append_deliver(l, p)  # τ=0: same-iteration delivery
             else:
-                demote = jnp.zeros((G,), dtype=_I32) > 0
-            proc = act5 & ~demote & succ
-            with_e = proc & has_entry
-            nfail = act5 & ~demote & ~succ
-            ni = s["next_index"][pair(l, p)]
-            s["next_index"] = _set_row(
-                s["next_index"], pair(l, p),
-                jnp.where(with_e, ni + 1, jnp.where(nfail, ni - 1, ni)),
-            )
-            mi = s["match_index"][pair(l, p)]
-            s["match_index"] = _set_row(
-                s["match_index"], pair(l, p),
-                jnp.where(with_e, mi + 1, jnp.where(proc & ~has_entry, pli + 1, mi)),
-            )
-            # Commit advancement (quirk a), evaluated per response.
-            l_commit = col("commit", l)
-            cnt = jnp.sum(
-                (s["match_index"][(l - 1) * N:l * N] > l_commit[None, :]).astype(_I32),
-                axis=0,
-            )
-            setcol("commit", l, with_e & (cnt >= maj), l_commit + 1)
+                skip = skip | ~edge_ok(l, p) | ~edge_ok(p, l)
+                act5 = fire & ~skip
+                append_exchange(l, p, act5, col("term", l), col("commit", l),
+                                pli, plt, has_entry, ent_t, ent_c)
+
+    # §10 end-of-tick: in-flight countdowns advance (sent at t with τ ⇒ due == 0
+    # at t+τ's delivery scan).
+    if flags.delay:
+        for name in ("vq_due", "aq_due"):
+            d = s[name]
+            s[name] = d - (d > 0).astype(_I32)
 
     return aux_dirty["m"]
 
@@ -434,7 +559,12 @@ def make_aux(cfg: RaftConfig, base, tkeys, bkeys, state: RaftState,
         links=cfg.p_link_fail > 0 or cfg.p_link_heal > 0,
         periodic=cfg.cmd_period > 0,
         inject=inject is not None,
+        delay=cfg.uses_mailbox,
     )
+    if flags.delay and cfg.delay_lo < cfg.delay_hi:
+        aux["delay"] = rngmod.delay_mask(
+            base, t, (G, N, N), cfg.delay_lo, cfg.delay_hi
+        ).transpose(1, 2, 0).reshape(N * N, G)
     aux["edge_iid"] = rngmod.edge_ok_mask(
         base, t, (G, N, N), cfg.p_drop
     ).transpose(1, 2, 0).reshape(N * N, G).astype(jnp.int32)
@@ -467,10 +597,12 @@ def make_aux(cfg: RaftConfig, base, tkeys, bkeys, state: RaftState,
 
 
 def flatten_state(cfg: RaftConfig, state: RaftState) -> dict:
-    """RaftState -> the rank-2 dict phase_body operates on (free reshapes)."""
+    """RaftState -> the rank-2 dict phase_body operates on (free reshapes).
+    §10 mailbox fields are included iff present on the state (cfg.uses_mailbox)."""
     N, C, G = cfg.n_nodes, cfg.log_capacity, cfg.n_groups
+    fields = STATE_FIELDS + (MAILBOX_FIELDS if cfg.uses_mailbox else ())
     s = {}
-    for k in STATE_FIELDS:
+    for k in fields:
         v = getattr(state, k)
         if k in _PAIR_FIELDS:
             v = v.reshape(N * N, G)
@@ -487,6 +619,8 @@ def unflatten_state(cfg: RaftConfig, s: dict) -> dict:
     N, C, G = cfg.n_nodes, cfg.log_capacity, cfg.n_groups
     out = dict(s)
     for k in _PAIR_FIELDS:
+        if k not in out:
+            continue  # mailbox fields absent when cfg.uses_mailbox is off
         v = out[k].reshape(N, N, G)
         if k in ("responded", "link_up"):
             v = v != 0

@@ -62,21 +62,31 @@ def make_mesh(devices: Optional[Sequence[jax.Device]] = None,
 @functools.lru_cache(maxsize=1)
 def _field_ndims() -> dict:
     """Per-field array rank, derived from init_state itself (eval_shape traces the
-    init without allocating) so new RaftState fields shard correctly on their last
-    axis by construction."""
+    init without allocating, with the §10 mailbox ON so every optional field has a
+    shape) — new RaftState fields shard correctly on their last axis by
+    construction."""
     shapes = jax.eval_shape(
-        lambda: init_state(RaftConfig(n_groups=1, n_nodes=2, log_capacity=2))
+        lambda: init_state(
+            RaftConfig(n_groups=1, n_nodes=2, log_capacity=2, mailbox=True))
     )
     return {f.name: getattr(shapes, f.name).ndim for f in dataclasses.fields(RaftState)}
 
 
-def state_sharding(mesh: Mesh) -> RaftState:
+def state_sharding(mesh: Mesh, cfg: Optional[RaftConfig] = None) -> RaftState:
     """A RaftState-shaped pytree of NamedShardings: every array sharded over the
     flattened ("dcn", "ici") mesh on its LAST (groups) axis — state is groups-minor
-    (models/state.py); rank-0 scalars (the tick counter) replicated."""
+    (models/state.py); rank-0 scalars (the tick counter) replicated. §10 mailbox
+    fields get shardings only when `cfg.uses_mailbox` (None otherwise, matching the
+    state pytree's structure)."""
+    from raft_kotlin_tpu.models.state import MAILBOX_FIELDS
+
+    use_mail = cfg is not None and cfg.uses_mailbox
     ndims = _field_ndims()
     fields = {}
     for f in dataclasses.fields(RaftState):
+        if f.name in MAILBOX_FIELDS and not use_mail:
+            fields[f.name] = None
+            continue
         nd = ndims[f.name]
         spec = P(*([None] * (nd - 1)), ("dcn", "ici")) if nd else P()
         fields[f.name] = NamedSharding(mesh, spec)
@@ -95,7 +105,7 @@ def init_sharded(cfg: RaftConfig, mesh: Mesh) -> RaftState:
     """init_state with every array laid out per `state_sharding` from birth (no
     host-side materialize-then-scatter: jit with out_shardings computes each shard
     on its own device)."""
-    sh = state_sharding(mesh)
+    sh = state_sharding(mesh, cfg)
     fn = jax.jit(lambda: init_state(cfg), out_shardings=sh)
     return fn()
 
@@ -150,9 +160,9 @@ def _make_shardmap_pallas_tick(cfg: RaftConfig, mesh: Mesh,
 
     def tick(state: RaftState) -> RaftState:
         aux, flags = tick_mod.make_aux(cfg, base, tkeys, bkeys, state, None, None)
-        call, aux_names = build_call(flags)
+        call, sfields, aux_names = build_call(flags)
         flat = tick_mod.flatten_state(cfg, state)
-        ins = cast_flat_in(flat, aux, aux_names)
+        ins = cast_flat_in(flat, aux, sfields, aux_names)
         shard_call = jax.shard_map(
             lambda *a: call(*a),
             mesh=mesh,
@@ -162,7 +172,7 @@ def _make_shardmap_pallas_tick(cfg: RaftConfig, mesh: Mesh,
             # embarrassingly parallel over lanes, so the check adds nothing.
             check_vma=False,
         )
-        s, el_dirty = cast_flat_out(shard_call(*ins))
+        s, el_dirty = cast_flat_out(shard_call(*ins), sfields)
         return tick_mod.finish_tick(
             cfg, tkeys, tick_mod.unflatten_state(cfg, s), el_dirty, state.tick)
 
@@ -186,20 +196,22 @@ def make_sharded_run(cfg: RaftConfig, mesh: Mesh, n_ticks: int,
         tick_fn = _make_shardmap_pallas_tick(cfg, mesh)
     else:
         tick_fn = make_tick(cfg)
-    sh = state_sharding(mesh)
+    sh = state_sharding(mesh, cfg)
     rep = NamedSharding(mesh, P())
 
     def body(st, _):
-        prev_role = st.role
+        prev_rounds = st.rounds
         st = tick_fn(st)
         if metrics_every:
             out = {
                 "leaders": jnp.sum(
-                    jnp.any(st.role == LEADER, axis=0).astype(jnp.int32)
+                    jnp.any((st.role == LEADER) & st.up, axis=0).astype(jnp.int32)
                 ),
-                "elections": jnp.sum(
-                    ((prev_role != st.role) & (st.role == 1)).astype(jnp.int32)
-                ),
+                # Elections = vote-round starts (rounds-delta) — the ONE canonical
+                # definition, shared with utils.metrics.tick_metrics and bench.py.
+                # (Role-transition counting would miss consecutive rounds by a node
+                # that stays CANDIDATE through backoff loops — the churn case.)
+                "elections": jnp.sum(st.rounds - prev_rounds),
                 "commit_total": jnp.sum(jnp.max(st.commit, axis=0).astype(jnp.int64)
                                         if jax.config.jax_enable_x64
                                         else jnp.max(st.commit, axis=0)),

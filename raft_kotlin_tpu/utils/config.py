@@ -21,6 +21,19 @@ class RaftConfig:
     n_nodes: int = 3
     log_capacity: int = 64
 
+    # Storage dtype of the log arrays (log_term/log_cmd): "int32" (default) or
+    # "int16" — the deep-log lever (BASELINE config 5: 100k groups x 7 nodes x
+    # 10k-entry logs = 28 GB of int32 terms; int16 halves it, SURVEY.md:350-352).
+    # All arithmetic stays int32: values widen at read, narrow at write —
+    # VALUES ARE NOT RANGE-CHECKED; writes outside int16 silently wrap. int16
+    # is for bounded headless sweeps where both stored quantities fit:
+    # terms < 32768 (terms grow ~1 per election round; at reference-ratio
+    # pacing that is >700k ticks, but a degenerate churn config gets there in
+    # ~65k) and commands < 32768 (the cmd_period workload stores the tick
+    # index, so runs must stay under 32768 ticks). The Simulator API refuses
+    # int16 outright — its interned command ids start at 1<<30 and cannot fit.
+    log_dtype: str = "int32"
+
     # Pacing, in ticks. Inclusive uniform ranges match Kotlin's (a..b).random().
     el_lo: int = 200          # election timeout lower bound
     el_hi: int = 230          # election timeout upper bound (inclusive)
@@ -47,12 +60,64 @@ class RaftConfig:
     p_link_fail: float = 0.0
     p_link_heal: float = 0.0
 
+    # Message latency (SEMANTICS.md §10): per-exchange request delay drawn uniform
+    # [delay_lo, delay_hi] ticks inclusive (per directed pair per send tick). 0/0 =
+    # synchronous-within-tick exchanges (§1 [canon], the default — reference RPCs
+    # are ms-scale against 100 ms ticks). `mailbox=True` forces the mailbox
+    # implementation even at delay 0/0 (bit-identical to the synchronous path —
+    # the τ=0 degeneracy differential tests rely on it).
+    delay_lo: int = 0
+    delay_hi: int = 0
+    mailbox: bool = False
+
     seed: int = 0
+
+    def __post_init__(self):
+        if not (0 <= self.delay_lo <= self.delay_hi):
+            raise ValueError(
+                f"need 0 <= delay_lo <= delay_hi, got {self.delay_lo}/{self.delay_hi}")
+        if self.log_dtype not in ("int32", "int16"):
+            raise ValueError(f"log_dtype must be int32 or int16, got {self.log_dtype}")
+
+    @property
+    def uses_mailbox(self) -> bool:
+        """Whether exchanges route through the deliverable-at-tick mailbox
+        (SEMANTICS.md §10) instead of resolving synchronously within the tick."""
+        return self.mailbox or self.delay_hi > 0
 
     @property
     def majority(self) -> int:
         # RaftServer.kt:44
         return self.n_nodes // 2 + 1
+
+    # -- HBM budget (BASELINE config 5 planning; SURVEY.md:350-352) -----------
+
+    def state_bytes_per_group(self) -> int:
+        """Bytes of RaftState per group under this config (log dtype included).
+        The log dominates for deep-log configs: N * C * 2 arrays."""
+        N, C = self.n_nodes, self.log_capacity
+        itemsize = 2 if self.log_dtype == "int16" else 4
+        log = N * C * 2 * itemsize
+        per_node_i32 = 17 * N * 4     # (N,) int32 grids incl. counters/timers
+        per_node_b = 3 * N * 1        # el_armed/hb_armed/up as packed bool
+        pair = 3 * N * N * 4 + N * N  # responded/next/match (+link_up bool)
+        mail = 13 * N * N * 4 if self.uses_mailbox else 0
+        return log + per_node_i32 + per_node_b + pair + mail
+
+    def hbm_bytes(self, working_factor: float = 2.0) -> int:
+        """Estimated device-memory footprint of a run: state x working_factor
+        (XLA holds input + output copies of the state across a tick; donation
+        reduces but rarely eliminates the second copy) plus per-tick aux masks."""
+        aux = self.n_groups * (self.n_nodes ** 2) * 5  # masks, generously
+        return int(self.n_groups * self.state_bytes_per_group() * working_factor + aux)
+
+    def max_groups_for_hbm(self, hbm_bytes: int = 14 * 10**9,
+                           working_factor: float = 2.0) -> int:
+        """Largest n_groups fitting `hbm_bytes` (default: one 16 GB chip with 2 GB
+        headroom) under this config's per-group cost — the groups-per-chip
+        ceiling for BASELINE config-5 planning."""
+        per = self.state_bytes_per_group() * working_factor + self.n_nodes ** 2 * 5
+        return int(hbm_bytes // per)
 
     def stressed(self, factor: int = 10) -> "RaftConfig":
         """A time-compressed variant: all pacing constants divided by `factor`.

@@ -21,6 +21,7 @@ KIND_CRASH = 3
 KIND_RESTART = 4
 KIND_LINK_FAIL = 5
 KIND_LINK_HEAL = 6
+KIND_DELAY = 7
 
 
 def base_key(seed: int) -> jax.Array:
@@ -100,6 +101,17 @@ def edge_ok_mask(base: jax.Array, tick, shape: tuple, p_drop: float) -> jax.Arra
         return jnp.ones(shape, dtype=bool)
     k = jax.random.fold_in(jax.random.fold_in(base, KIND_FAULT), tick)
     return ~jax.random.bernoulli(k, p_drop, shape)
+
+
+def delay_mask(base: jax.Array, tick, shape: tuple, lo: int, hi: int) -> jax.Array:
+    """(G, N, N) int32 of per-directed-pair message delays for sends at tick `tick`,
+    uniform on [lo, hi] inclusive (SEMANTICS.md §10). Element [g, s-1, r-1] is the
+    delay of the exchange s sends to r this tick. One shaped draw per tick, shared
+    verbatim by oracle, kernel, and native engine — same pattern as edge_ok_mask."""
+    if lo == hi:
+        return jnp.full(shape, lo, dtype=jnp.int32)
+    k = jax.random.fold_in(jax.random.fold_in(base, KIND_DELAY), tick)
+    return jax.random.randint(k, shape, lo, hi + 1, dtype=jnp.int32)
 
 
 def event_mask(base: jax.Array, kind: int, tick, shape: tuple, p: float) -> jax.Array:

@@ -70,15 +70,47 @@ def test_inject_and_fault_cmd_bitmatch():
     assert ntr["up"][30, 0, 0] == 0 and ntr["up"][60, 0, 0] == 1
 
 
-@pytest.mark.slow
-def test_native_soak_deep():
-    # 3M node-ticks of full fault soup: the deepest differential evidence in the
-    # suite (kernel ~66s + native ~42s with a warm compile cache).
+def test_delay_mailbox_bitmatch():
+    # SEMANTICS.md §10 in the NATIVE engine: delayed exchanges (distribution
+    # delay, faults, workload) bit-match the kernel's mailbox path.
     cfg = RaftConfig(
-        n_groups=1024, n_nodes=5, seed=1234, p_drop=0.08, cmd_period=6,
-        p_crash=0.015, p_restart=0.08, p_link_fail=0.01, p_link_heal=0.1,
-        log_capacity=48,
+        n_groups=8, n_nodes=3, seed=13, p_drop=0.1, cmd_period=7,
+        p_crash=0.02, p_restart=0.1, delay_lo=0, delay_hi=3,
     ).stressed(10)
+    assert_native_matches_kernel(cfg, 200)
+
+
+def test_tau0_mailbox_bitmatch_native():
+    # §10 τ=0 degeneracy in the native engine (mailbox forced, zero delay).
+    # n_nodes=3: N=5 mailbox kernels are a separate many-minute XLA compile on a
+    # 1-core box and the N=5 sync path is already covered above; the slow-suite
+    # soak covers larger shapes.
+    cfg = RaftConfig(
+        n_groups=4, n_nodes=3, seed=9, cmd_period=10, p_drop=0.1, mailbox=True,
+    ).stressed(10)
+    assert_native_matches_kernel(cfg, 150)
+
+
+# The deep soak (3M node-ticks of full fault soup — the deepest differential
+# evidence in the suite) is SPLIT into two half-size tests so each completes in
+# minutes cold on a 1-core box (VERDICT r1: budget the slow suite); per-test
+# wall-times land in TEST_TIMES.json via the conftest hook.
+_SOAK = dict(
+    n_groups=512, n_nodes=5, p_drop=0.08, cmd_period=6,
+    p_crash=0.015, p_restart=0.08, p_link_fail=0.01, p_link_heal=0.1,
+    log_capacity=48,
+)
+
+
+@pytest.mark.slow
+def test_native_soak_deep_a():
+    cfg = RaftConfig(seed=1234, **_SOAK).stressed(10)
+    assert_native_matches_kernel(cfg, 600)
+
+
+@pytest.mark.slow
+def test_native_soak_deep_b():
+    cfg = RaftConfig(seed=4321, **_SOAK).stressed(10)
     assert_native_matches_kernel(cfg, 600)
 
 
