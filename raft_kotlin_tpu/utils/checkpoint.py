@@ -32,7 +32,8 @@ from raft_kotlin_tpu.utils.config import RaftConfig
 _HEADER_KEY = "__raft_config_json__"
 _EXTRA_KEY = "__raft_extra_json__"
 _VERSION_KEY = "__raft_ckpt_version__"
-_VERSION = 3  # v2: +up/+link_up fault-model fields; v3: groups-minor array layout
+_VERSION = 4  # v2: +up/+link_up fault-model fields; v3: groups-minor array layout;
+              # v4: optional §10 mailbox arrays (present iff cfg.uses_mailbox)
 
 
 def save(path: str, state: RaftState, cfg: RaftConfig, extra: Optional[dict] = None) -> None:
@@ -45,6 +46,7 @@ def save(path: str, state: RaftState, cfg: RaftConfig, extra: Optional[dict] = N
     arrays = {
         f.name: np.asarray(jax.device_get(getattr(state, f.name)))
         for f in dataclasses.fields(state)
+        if getattr(state, f.name) is not None  # §10 mailbox fields may be absent
     }
     arrays[_HEADER_KEY] = np.frombuffer(
         json.dumps(dataclasses.asdict(cfg)).encode(), dtype=np.uint8
@@ -91,10 +93,165 @@ def load_with_extra(
     return _load_impl(path, expect_cfg, sharding)
 
 
+def save_sharded(dirpath: str, state: RaftState, cfg: RaftConfig,
+                 extra: Optional[dict] = None) -> None:
+    """Checkpoint a SHARDED state without ever materializing a full array on the
+    host: one .npz per device shard (each holding that device's slice of every
+    field) plus a manifest. This is the config-5-scale path — `save()` gathers
+    the whole pytree through one process, which at 100k-group x 10k-log scale is
+    tens of GB; here each shard writes only its own groups-axis slice.
+
+    Layout: dirpath/manifest.json + dirpath/shard_<k>.npz where k indexes the
+    groups-axis slabs in ascending global offset. Restore with `load_sharded`
+    under a mesh of ANY device count whose shard boundaries align (the common
+    case: same total groups, any divisor count), or assemble unsharded.
+    """
+    fields = [
+        f.name for f in dataclasses.fields(state)
+        if getattr(state, f.name) is not None
+    ]
+    # Shard boundaries from one representative groups-axis array (all state
+    # arrays share the groups axis as their last dim; the tick scalar rides in
+    # every shard file). Filenames are keyed by GLOBAL groups offset and the
+    # manifest lists the GLOBAL shard map, so on a multi-host mesh each process
+    # writes only its own shard files (disjoint names) and only process 0
+    # writes the manifest — no clobbering.
+    rep = state.term
+    G = rep.shape[-1]
+
+    def span(index):
+        sl = index[-1]
+        return int(sl.start or 0), int(sl.stop if sl.stop is not None else G)
+
+    global_spans = sorted(
+        {span(idx) for idx in rep.sharding.devices_indices_map(rep.shape).values()}
+    )
+    os.makedirs(dirpath, exist_ok=True)
+    for sh in rep.addressable_shards:
+        lo, hi = span(sh.index)
+        arrays = {}
+        for name in fields:
+            arr = getattr(state, name)
+            if arr.ndim == 0:
+                arrays[name] = np.asarray(arr)
+                continue
+            local = [s for s in arr.addressable_shards
+                     if span(s.index)[0] == lo]
+            assert local, f"field {name} has no shard at groups offset {lo}"
+            arrays[name] = np.asarray(local[0].data)
+        fname = f"shard_g{lo:012d}.npz"
+        tmp = os.path.join(dirpath, "." + fname + ".tmp")
+        with open(tmp, "wb") as f:
+            np.savez_compressed(f, **arrays)
+        os.replace(tmp, os.path.join(dirpath, fname))
+    if jax.process_index() == 0:
+        manifest = {
+            "version": _VERSION,
+            "cfg": dataclasses.asdict(cfg),
+            "extra": extra or {},
+            "n_shards": len(global_spans),
+            "offsets": [[lo, hi] for lo, hi in global_spans],
+            "fields": fields,
+            "shapes": {  # global shapes — restore needs no probe file reads
+                name: list(getattr(state, name).shape) for name in fields
+            },
+        }
+        tmp = os.path.join(dirpath, ".manifest.tmp")
+        with open(tmp, "w") as f:
+            json.dump(manifest, f)
+        os.replace(tmp, os.path.join(dirpath, "manifest.json"))
+
+
+def load_sharded(
+    dirpath: str,
+    mesh=None,
+    expect_cfg: Optional[RaftConfig] = None,
+) -> Tuple[RaftState, RaftConfig]:
+    """Restore a `save_sharded` checkpoint. With `mesh` (a jax.sharding.Mesh),
+    each PROCESS opens only the shard files covering its own addressable
+    devices' slices and device_puts only to those devices — on a multi-host
+    mesh no host ever materializes (or even reads) the full groups axis.
+    Without `mesh`, assembles unsharded arrays on the default device."""
+    with open(os.path.join(dirpath, "manifest.json")) as f:
+        manifest = json.load(f)
+    cfg = RaftConfig(**manifest["cfg"])
+    if expect_cfg is not None and expect_cfg != cfg:
+        raise ValueError(
+            f"checkpoint config mismatch:\n saved   {cfg}\n expected {expect_cfg}")
+    spans = manifest["offsets"]
+
+    loaded: dict = {}
+
+    def shard_file(k):
+        # Lazy per-file cache: only files actually covering a local slice load.
+        if k not in loaded:
+            fname = f"shard_g{spans[k][0]:012d}.npz"
+            with np.load(os.path.join(dirpath, fname)) as z:
+                loaded[k] = {name: z[name] for name in manifest["fields"]}
+        return loaded[k]
+
+    if mesh is None:
+        fields = {}
+        for name in manifest["fields"]:
+            parts = [shard_file(k)[name] for k in range(len(spans))]
+            fields[name] = jax.device_put(
+                parts[0] if parts[0].ndim == 0 else np.concatenate(parts, axis=-1))
+        return RaftState(**fields), cfg
+
+    from raft_kotlin_tpu.parallel.mesh import state_sharding
+
+    sh = state_sharding(mesh, cfg)
+    G = cfg.n_groups
+
+    def device_slice(name, lo, hi):
+        # Gather [lo, hi) of the groups axis from the covering shard files.
+        parts = []
+        for k, (off, end) in enumerate(spans):
+            if end <= lo or off >= hi:
+                continue
+            a = shard_file(k)[name]
+            parts.append(a[..., max(lo - off, 0): hi - off])
+        return parts[0] if len(parts) == 1 else np.concatenate(parts, axis=-1)
+
+    proc = jax.process_index()
+    # Which manifest spans overlap THIS process's device slices (via any
+    # groups-sharded field's device map) — the only shard files we may open.
+    rep_shape = tuple(manifest["shapes"]["term"])
+    local_ranges = [
+        (int(idx[-1].start or 0),
+         int(idx[-1].stop if idx[-1].stop is not None else G))
+        for dev, idx in sh.term.devices_indices_map(rep_shape).items()
+        if dev.process_index == proc
+    ]
+    local_span_ks = [
+        k for k, (off, end) in enumerate(spans)
+        if any(end > lo and off < hi for lo, hi in local_ranges)
+    ]
+    fields = {}
+    for name in manifest["fields"]:
+        target = getattr(sh, name)
+        full_shape = tuple(manifest["shapes"][name])
+        if not full_shape:  # scalar (the tick counter, in every shard file)
+            fields[name] = jax.device_put(
+                shard_file(local_span_ks[0])[name], target)
+            continue
+        singles = []
+        for dev, idx in target.devices_indices_map(full_shape).items():
+            if dev.process_index != proc:
+                continue  # non-addressable: that host supplies its own shards
+            sl = idx[-1]
+            lo = int(sl.start or 0)
+            hi = int(sl.stop if sl.stop is not None else G)
+            singles.append(jax.device_put(device_slice(name, lo, hi), dev))
+        fields[name] = jax.make_array_from_single_device_arrays(
+            full_shape, target, singles)
+    return RaftState(**fields), cfg
+
+
 def _load_impl(path, expect_cfg, sharding):
     with np.load(path) as z:
         version = int(z[_VERSION_KEY])
-        if version not in (1, 2, _VERSION):
+        if version not in (1, 2, 3, _VERSION):
             raise ValueError(
                 f"checkpoint version {version} not supported (can load 1-{_VERSION})")
         cfg_dict = json.loads(bytes(z[_HEADER_KEY].tobytes()).decode())
@@ -122,6 +279,17 @@ def _load_impl(path, expect_cfg, sharding):
         arrays.setdefault("up", np.ones((N, G), dtype=bool))
         arrays.setdefault("link_up", np.ones((N, N, G), dtype=bool))
     cfg = RaftConfig(**cfg_dict)
+    from raft_kotlin_tpu.models.state import MAILBOX_FIELDS
+
+    missing = [
+        f.name for f in dataclasses.fields(RaftState)
+        if f.name not in arrays
+        and (f.name not in MAILBOX_FIELDS or cfg.uses_mailbox)
+    ]
+    if missing:
+        raise ValueError(
+            f"checkpoint {path!r} is corrupt/truncated: missing arrays {missing}"
+        )
     if expect_cfg is not None and expect_cfg != cfg:
         raise ValueError(
             f"checkpoint config mismatch:\n saved   {cfg}\n expected {expect_cfg}"

@@ -68,6 +68,50 @@ def test_load_with_sharding(tmp_path):
     assert_states_equal(st, restored)
 
 
+def test_sharded_save_restore(tmp_path):
+    # Per-shard checkpointing (utils/checkpoint.save_sharded): a sharded 16-group
+    # state round-trips through one .npz PER DEVICE SHARD — no full-size host
+    # gather — and restores (a) sharded under the mesh, bit-exact and correctly
+    # placed, (b) unsharded, and (c) resumes bit-exactly.
+    import os
+
+    import numpy as np
+
+    from raft_kotlin_tpu.parallel.mesh import (
+        init_sharded, make_mesh, make_sharded_run, state_sharding,
+    )
+
+    mesh = make_mesh()
+    n_dev = len(jax.devices())
+    cfg = dataclasses.replace(CFG, n_groups=2 * n_dev)
+    T = 40
+    st, _ = make_sharded_run(cfg, mesh, T)(init_sharded(cfg, mesh))
+
+    d = str(tmp_path / "sharded_ckpt")
+    checkpoint.save_sharded(d, st, cfg)
+    assert os.path.exists(os.path.join(d, "manifest.json"))
+    shard_files = [f for f in os.listdir(d) if f.startswith("shard_")]
+    assert len(shard_files) == n_dev
+    # Each shard file holds only its groups slice (2 groups), not the full axis;
+    # filenames are keyed by global groups offset (multi-host safe).
+    with np.load(os.path.join(d, "shard_g000000000000.npz")) as z:
+        assert z["term"].shape[-1] == cfg.n_groups // n_dev
+
+    restored, cfg2 = checkpoint.load_sharded(d, mesh=mesh, expect_cfg=cfg)
+    assert cfg2 == cfg
+    assert restored.term.sharding.is_equivalent_to(
+        state_sharding(mesh, cfg).term, restored.term.ndim)
+    assert_states_equal(jax.device_get(st), jax.device_get(restored))
+
+    flat, _ = checkpoint.load_sharded(d)  # unsharded assembly
+    assert_states_equal(jax.device_get(st), jax.device_get(flat))
+
+    # Resume path: T more sharded ticks == 2T uninterrupted.
+    straight, _ = make_sharded_run(cfg, mesh, 2 * T)(init_sharded(cfg, mesh))
+    resumed, _ = make_sharded_run(cfg, mesh, T)(restored)
+    assert_states_equal(jax.device_get(straight), jax.device_get(resumed))
+
+
 def test_v1_checkpoint_forward_migration(tmp_path):
     # A v1 checkpoint (pre-fault-model) must load with up/link_up defaulted to
     # all-healthy boot values (utils/checkpoint._load_impl migration).
