@@ -1,0 +1,75 @@
+"""Dev tool: measure elections/sec on the real chip for candidate config-4
+churn settings (reference-ratio pacing, fault knobs swept) — picks the
+bench.py defaults honestly. Not part of the package; run on the TPU box:
+
+    python .tools/tune_churn.py
+"""
+
+import dataclasses
+import itertools
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, ".")
+
+
+def main():
+    from raft_kotlin_tpu.models.state import init_state
+    from raft_kotlin_tpu.ops.pallas_tick import choose_impl, make_pallas_tick
+    from raft_kotlin_tpu.ops.tick import make_tick
+    from raft_kotlin_tpu.utils.config import RaftConfig
+
+    groups, ticks = 102_400, 200
+    sweep = [
+        # (p_drop, p_crash, p_restart, p_link_fail, p_link_heal)
+        (0.25, 0.01, 0.08, 0.02, 0.08),
+        (0.35, 0.02, 0.10, 0.03, 0.08),
+        (0.45, 0.02, 0.10, 0.05, 0.05),
+        (0.30, 0.05, 0.15, 0.02, 0.06),
+    ]
+    for pd, pc, pr, plf, plh in sweep:
+        cfg = RaftConfig(
+            n_groups=groups, n_nodes=5, log_capacity=32, cmd_period=10,
+            p_drop=pd, p_crash=pc, p_restart=pr,
+            p_link_fail=plf, p_link_heal=plh, seed=0,
+        ).stressed(10)
+        impl = choose_impl(cfg)
+        tick = make_pallas_tick(cfg, interpret=False) if impl == "pallas" \
+            else make_tick(cfg)
+
+        @jax.jit
+        def run(st):
+            return jax.lax.scan(lambda s, _: (tick(s), None), st, None,
+                                length=ticks)[0]
+
+        st0 = init_state(cfg)
+        try:
+            end = run(st0)
+            jax.block_until_ready(end.term)
+        except Exception as e:
+            print(json.dumps({"cfg": [pd, pc, pr, plf, plh],
+                              "error": str(e)[:200]}))
+            continue
+        t0 = time.perf_counter()
+        end = run(st0)
+        jax.block_until_ready(end.term)
+        dt = time.perf_counter() - t0
+        elections = int(jnp.sum(end.rounds) - jnp.sum(st0.rounds))
+        leaders = int(jnp.sum(jnp.any((end.role == 2) & end.up, axis=0)))
+        print(json.dumps({
+            "cfg": [pd, pc, pr, plf, plh], "impl": impl,
+            "ticks_per_sec": round(ticks / dt, 1),
+            "elections_per_sec": round(elections / dt, 1),
+            "elections_per_group_per_tick": round(
+                elections / (groups * ticks), 5),
+            "groups_with_leader_frac": round(leaders / groups, 3),
+        }))
+        sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,23 +1,40 @@
 """Headline benchmark: vectorized many-group Raft simulation throughput.
 
-Config matches BASELINE.json config 4 — 100k concurrent 5-node Raft groups with
-randomized partitions (fault-injection masks) and a replication workload — stepped in
-lockstep by the jitted tick kernel (raft_kotlin_tpu/ops/tick.py) on one chip.
+Stages (all on one chip; prints exactly ONE JSON line on stdout):
 
-Headline metric: **Raft group-steps per second per chip** (groups × ticks / elapsed).
-Baseline derivation (the reference publishes no numbers — BASELINE.md): the reference
-advances ONE group in real time at 1 tick = 100 ms of protocol time (heartbeat 2000 ms
-= 20 ticks, reference RaftServer.kt:115), i.e. 10 group-steps/sec. `vs_baseline` is
-the ratio of our throughput to those 10 group-steps/sec.
+1. **Headline** — BASELINE config-4-faithful fault soup: 100k concurrent 5-node
+   groups under randomized partitions (persistent link fail/heal), iid message
+   drops, and leader-killing crash/restart, with a replication workload, at
+   reference-RATIO pacing (`RaftConfig.stressed(10)` divides every constant by
+   10, preserving timeout : heartbeat : round : backoff ratios from
+   reference Commons.kt:23, RaftServer.kt:115,189,221). Metrics: group-steps/s
+   per chip (headline), elections/s (north star — vote-round starts, the
+   rounds-delta definition shared by utils.metrics and parallel.mesh).
+2. **Churn ceiling** — the degenerate 2-3-tick-timeout config: an upper bound on
+   sustained election throughput, reported as a secondary figure only.
+3. **CPU-parity rate** — the native C++ engine (native/raft_oracle.cpp) steps a
+   sampled slice (same seed, same config, RAFT_BENCH_PARITY_GROUPS groups) and
+   the fraction of groups whose full (role, term, commit, last_index, voted_for,
+   rounds, up) traces bit-match the TPU kernel is reported as `parity_rate`
+   (BASELINE.json metric "CPU-parity rate").
+4. **Perf model** — bytes-touched-per-tick from the state/aux footprint (the
+   tick is HBM-bound: every array is read + written once per tick), achieved
+   HBM bandwidth fraction vs the chip's peak, and the XLA-vs-Pallas ratio, so
+   the headline has a roofline anchor.
+5. **Deep log** — BASELINE config-5 shape on one chip: log_capacity=10_000,
+   n_nodes=7, int16 logs (utils/config.log_dtype), n_groups = the HBM-budget
+   ceiling (RaftConfig.max_groups_for_hbm) rounded to lanes. Reports the
+   groups-per-chip ceiling and achieved group-steps/s.
 
-Also reported (extra keys in the same JSON line): elections/sec (round starts, the
-north-star metric), ticks/sec, and config echo.
-
-Prints exactly ONE JSON line on stdout.
+Baseline derivation for `vs_baseline` (the reference publishes no numbers —
+BASELINE.md): the reference advances ONE group in real time at 1 tick = 100 ms
+of protocol time (heartbeat 2000 ms = 20 ticks, reference RaftServer.kt:115),
+i.e. 10 group-steps/sec.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 import sys
@@ -25,51 +42,121 @@ import time
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+
+# Peak HBM bandwidth (bytes/s) per TPU generation, for the roofline anchor.
+_PEAK_HBM = {
+    "v4": 1.228e12,
+    "v5 lite": 8.19e11, "v5e": 8.19e11,
+    "v5p": 2.765e12,
+    "v6": 1.64e12, "v6e": 1.64e12,
+}
+
+
+def _peak_hbm_bytes_per_sec() -> float:
+    kind = getattr(jax.devices()[0], "device_kind", "").lower()
+    for key, bw in _PEAK_HBM.items():
+        if key in kind:
+            return bw
+    return 0.0  # unknown platform: hbm_bw_frac reported as null
+
+
+def measure(cfg, n_ticks, n_reps, impl_candidates):
+    """-> (best_seconds, end_state, start_state, impl); warms up each candidate
+    and falls back if compilation (lazy for Mosaic, at warmup) fails."""
+    from raft_kotlin_tpu.models.state import init_state
+
+    st0 = init_state(cfg)
+    jax.block_until_ready(st0.term)
+    last_err = None
+    for tick_fn, impl in impl_candidates(cfg):
+        @jax.jit
+        def run(st):
+            return jax.lax.scan(
+                lambda s, _: (tick_fn(s), None), st, None, length=n_ticks)[0]
+
+        try:
+            warm = run(st0)
+            jax.block_until_ready(warm.term)
+        except Exception as e:  # Mosaic rejection etc. -> next candidate
+            last_err = e
+            continue
+        best = float("inf")
+        end = warm
+        for _ in range(n_reps):
+            t0 = time.perf_counter()
+            end = run(st0)
+            jax.block_until_ready(end.term)
+            best = min(best, time.perf_counter() - t0)
+        return best, end, st0, impl
+    raise last_err
+
+
+def tick_candidates(cfg):
+    from raft_kotlin_tpu.ops.pallas_tick import choose_impl, make_pallas_tick
+    from raft_kotlin_tpu.ops.tick import make_tick
+
+    if choose_impl(cfg) == "pallas":
+        yield make_pallas_tick(cfg, interpret=False), "pallas"
+    yield make_tick(cfg), "xla"
+
+
+def xla_only(cfg):
+    from raft_kotlin_tpu.ops.tick import make_tick
+
+    yield make_tick(cfg), "xla"
+
+
+def state_aux_bytes_per_tick(cfg) -> int:
+    """HBM bytes the tick must move at minimum: every state array read once and
+    written once (the Pallas megakernel achieves exactly this; XLA re-reads
+    across fusion islands), plus the per-tick aux masks read once."""
+    from raft_kotlin_tpu.models.state import init_state
+
+    shapes = jax.eval_shape(lambda: init_state(cfg))
+    state = sum(
+        int(np.prod(getattr(shapes, f.name).shape)) * getattr(shapes, f.name).dtype.itemsize
+        for f in dataclasses.fields(shapes)
+        if getattr(shapes, f.name) is not None
+    )
+    G, N = cfg.n_groups, cfg.n_nodes
+    aux = G * N * N * 4  # edge_iid as i32 lanes
+    if cfg.p_crash > 0 or cfg.p_restart > 0:
+        aux += G * N * 3 * 4  # crash/restart/el_draw_f
+    if cfg.p_link_fail > 0 or cfg.p_link_heal > 0:
+        aux += G * N * N * 2 * 4
+    aux += G * N * 4  # bdraw
+    return 2 * state + aux
+
+
+def parity_stage(cfg, groups, ticks, impl):
+    """Kernel (this chip, the SAME impl that produced the headline — a
+    Mosaic-only divergence must not hide behind an XLA parity pass) vs the
+    native C++ engine over `groups` groups of the same config/seed: fraction
+    of groups whose full traces bit-match."""
+    from raft_kotlin_tpu.models.state import init_state
+    from raft_kotlin_tpu.native.oracle import TRACE_FIELDS, NativeOracle
+    from raft_kotlin_tpu.ops.tick import make_run
+
+    pcfg = dataclasses.replace(cfg, n_groups=groups)
+    try:
+        run = make_run(pcfg, ticks, trace=True, impl=impl)
+        _, ktr = run(init_state(pcfg))
+    except Exception:
+        # e.g. the parity group count breaks the Mosaic tile model: fall back
+        # (and report the impl actually used).
+        impl = "xla"
+        _, ktr = make_run(pcfg, ticks, trace=True, impl="xla")(init_state(pcfg))
+    ntr = NativeOracle(pcfg).run(ticks)
+    ok = np.ones(groups, dtype=bool)
+    for k in TRACE_FIELDS:
+        kv = np.asarray(ktr[k]).transpose(0, 2, 1).astype(np.int32)  # (T, G, N)
+        ok &= np.all(kv == ntr[k], axis=(0, 2))
+    return float(np.mean(ok)), int(groups), impl
 
 
 def main() -> None:
-    from raft_kotlin_tpu.models.state import init_state
-    from raft_kotlin_tpu.ops.tick import make_tick
     from raft_kotlin_tpu.utils.config import RaftConfig
-
-    # Prefer the Pallas megakernel (ops/pallas_tick.py) on real hardware; fall back
-    # to the XLA tick if the group count is not lane-aligned or Mosaic rejects the
-    # kernel. Mosaic compiles lazily at the first run, so the fallback must wrap the
-    # warmup, not just kernel construction — see measure().
-    def tick_candidates(cfg2):
-        from raft_kotlin_tpu.ops.pallas_tick import choose_impl, make_pallas_tick
-
-        if choose_impl(cfg2) == "pallas":
-            yield make_pallas_tick(cfg2, interpret=False), "pallas"
-        yield make_tick(cfg2), "xla"
-
-    def measure(cfg2, n_ticks, n_reps):
-        """-> (best_seconds, end_state, start_state, impl); warms up each candidate
-        and falls back if compilation (lazy, at warmup) fails."""
-        st0 = init_state(cfg2)
-        jax.block_until_ready(st0.term)
-        last_err = None
-        for tick_fn, impl in tick_candidates(cfg2):
-            @jax.jit
-            def run(st):
-                return jax.lax.scan(
-                    lambda s, _: (tick_fn(s), None), st, None, length=n_ticks)[0]
-
-            try:
-                warm = run(st0)
-                jax.block_until_ready(warm.term)
-            except Exception as e:  # Mosaic rejection etc. -> next candidate
-                last_err = e
-                continue
-            best = float("inf")
-            end = warm
-            for _ in range(n_reps):
-                t0 = time.perf_counter()
-                end = run(st0)
-                jax.block_until_ready(end.term)
-                best = min(best, time.perf_counter() - t0)
-            return best, end, st0, impl
-        raise last_err
 
     platform = jax.devices()[0].platform
     on_accel = platform not in ("cpu",)
@@ -78,40 +165,77 @@ def main() -> None:
     groups = int(os.environ.get("RAFT_BENCH_GROUPS", 102_400 if on_accel else 4_096))
     ticks = int(os.environ.get("RAFT_BENCH_TICKS", 200 if on_accel else 50))
     reps = int(os.environ.get("RAFT_BENCH_REPS", 3))
+    parity_groups = int(os.environ.get(
+        "RAFT_BENCH_PARITY_GROUPS", 2_048 if on_accel else 128))
 
+    # Stage 1 — config-4-faithful churn: reference-ratio pacing (stressed 10),
+    # randomized partitions (persistent link faults), iid drops, crash/restart.
+    # Fault levels keep a sustained fraction of groups leaderless/contending —
+    # a plausible datacenter-incident regime, not a degenerate pacing hack.
     cfg = RaftConfig(
         n_groups=groups,
         n_nodes=5,
         log_capacity=32,
         cmd_period=10,
-        p_drop=0.02,
+        p_drop=float(os.environ.get("RAFT_BENCH_P_DROP", 0.25)),
+        p_crash=float(os.environ.get("RAFT_BENCH_P_CRASH", 0.01)),
+        p_restart=float(os.environ.get("RAFT_BENCH_P_RESTART", 0.08)),
+        p_link_fail=float(os.environ.get("RAFT_BENCH_P_LINK_FAIL", 0.02)),
+        p_link_heal=float(os.environ.get("RAFT_BENCH_P_LINK_HEAL", 0.08)),
         seed=0,
     ).stressed(10)
 
-    best, end_state, st, impl = measure(cfg, ticks, reps)
-
+    best, end_state, st, impl = measure(cfg, ticks, reps, tick_candidates)
     group_steps_per_sec = groups * ticks / best
     elections = int(jnp.sum(end_state.rounds) - jnp.sum(st.rounds))
     elections_per_sec = elections / best
 
-    # Election-churn config (the north-star elections/sec metric, BASELINE.json):
-    # same kernel, pacing compressed to election timeouts of 2-3 ticks so nearly
-    # every node is in a vote round every tick. The lockstep kernel does identical
-    # work per tick regardless of protocol activity, so this measures true
-    # sustained election throughput, not idle ticks.
+    # XLA-vs-Pallas ratio on the same config (perf model; skip if headline
+    # already fell back to XLA).
+    if impl == "pallas":
+        xbest, _, _, _ = measure(cfg, ticks, max(1, reps - 1), xla_only)
+        pallas_vs_xla = xbest / best
+        xla_ticks_per_sec = ticks / xbest
+    else:
+        pallas_vs_xla = 1.0
+        xla_ticks_per_sec = ticks / best
+
+    bytes_per_tick = state_aux_bytes_per_tick(cfg)
+    achieved_bw = bytes_per_tick * (ticks / best)
+    peak = _peak_hbm_bytes_per_sec()
+    hbm_bw_frac = round(achieved_bw / peak, 3) if peak else None
+
+    # Stage 2 — churn ceiling (degenerate pacing; secondary figure).
     churn_cfg = RaftConfig(
         n_groups=groups, n_nodes=cfg.n_nodes, log_capacity=8, seed=1,
         el_lo=2, el_hi=3, hb_ticks=2, round_ticks=3, retry_ticks=2,
         bo_lo=2, bo_hi=3,
     )
-    tbest, out2, st2, churn_impl = measure(churn_cfg, ticks, reps)
-    churn_elections = int(jnp.sum(out2.rounds) - jnp.sum(st2.rounds))
-    churn_elections_per_sec = churn_elections / tbest
+    tbest, out2, st2, churn_impl = measure(churn_cfg, ticks, reps, tick_candidates)
+    churn_elections_per_sec = int(jnp.sum(out2.rounds) - jnp.sum(st2.rounds)) / tbest
 
-    # Reference-equivalent throughput: one group, wall-clock protocol time,
-    # 1 tick = 100 ms -> 10 group-steps/sec (BASELINE.md).
+    # Stage 3 — CPU-parity rate (kernel vs native C++ engine, sampled slice).
+    parity_rate, parity_n, parity_impl = parity_stage(
+        cfg, parity_groups, min(ticks, 200), impl)
+
+    # Stage 5 — deep log (BASELINE config 5 shape on one chip): C=10k, N=7,
+    # int16 logs, G at the HBM ceiling rounded down to lanes.
+    deep_proto = RaftConfig(
+        n_nodes=7, log_capacity=10_000, log_dtype="int16", cmd_period=2,
+        p_drop=0.05, seed=3,
+    ).stressed(10)
+    # Budget leaves headroom for XLA's in+out+transient copies of the state
+    # (~2.5x state bytes live at the scan peak on a 16 GB chip).
+    deep_budget = int(os.environ.get("RAFT_BENCH_DEEPLOG_HBM", 10 * 10**9))
+    deep_g = max(128, (deep_proto.max_groups_for_hbm(deep_budget) // 128) * 128)
+    if not on_accel:
+        deep_g = 256
+    deep_cfg = dataclasses.replace(deep_proto, n_groups=deep_g)
+    deep_ticks = int(os.environ.get("RAFT_BENCH_DEEPLOG_TICKS", 30))
+    dbest, dend, dst, _ = measure(deep_cfg, deep_ticks, 1, xla_only)
+    deep_steps_per_sec = deep_g * deep_ticks / dbest
+
     baseline_group_steps_per_sec = 10.0
-
     print(json.dumps({
         "metric": "raft_group_steps_per_sec_per_chip",
         "value": round(group_steps_per_sec, 1),
@@ -119,6 +243,9 @@ def main() -> None:
         "vs_baseline": round(group_steps_per_sec / baseline_group_steps_per_sec, 1),
         "elections_per_sec": round(elections_per_sec, 1),
         "elections_per_sec_churn": round(churn_elections_per_sec, 1),
+        "parity_rate": parity_rate,
+        "parity_groups": parity_n,
+        "parity_impl": parity_impl,
         "ticks_per_sec": round(ticks / best, 2),
         "impl": impl,
         "impl_churn": churn_impl,
@@ -126,6 +253,19 @@ def main() -> None:
         "n_nodes": cfg.n_nodes,
         "ticks": ticks,
         "platform": platform,
+        # Perf model (roofline anchor).
+        "bytes_per_tick": bytes_per_tick,
+        "achieved_hbm_gbps": round(achieved_bw / 1e9, 1),
+        "hbm_bw_frac": hbm_bw_frac,
+        "pallas_vs_xla": round(pallas_vs_xla, 2),
+        "xla_ticks_per_sec": round(xla_ticks_per_sec, 2),
+        # Deep-log stage (BASELINE config 5 shape).
+        "deeplog_groups_per_chip": deep_g,
+        "deeplog_capacity": deep_cfg.log_capacity,
+        "deeplog_n_nodes": deep_cfg.n_nodes,
+        "deeplog_group_steps_per_sec": round(deep_steps_per_sec, 1),
+        "deeplog_commit_total": int(jnp.sum(jnp.max(dend.commit, axis=0))),
+        "deeplog_hbm_gb": round(deep_cfg.hbm_bytes() / 1e9, 2),
     }))
     sys.stdout.flush()
 

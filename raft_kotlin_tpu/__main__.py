@@ -110,16 +110,27 @@ def main(argv=None) -> int:
         from raft_kotlin_tpu.models.state import init_state
         from raft_kotlin_tpu.ops.tick import make_run
 
+        import jax
+
         cfg = _cfg_from(args)
         impl = args.impl
         if impl == "auto":
             from raft_kotlin_tpu.ops.pallas_tick import choose_impl
 
             impl = choose_impl(cfg)
-        t0 = time.perf_counter()
-        state, _ = make_run(cfg, args.ticks, trace=False, impl=impl)(init_state(cfg))
-        import jax
+        st0 = init_state(cfg)
+        if impl == "pallas" and args.impl == "auto":
+            # Mosaic compiles lazily; probe one tick so a kernel rejection falls
+            # back to the XLA tick instead of crashing mid-run (mirrors
+            # Simulator.__init__ and bench.measure()).
+            from raft_kotlin_tpu.ops.pallas_tick import make_pallas_tick
 
+            try:
+                jax.block_until_ready(jax.jit(make_pallas_tick(cfg))(st0).term)
+            except Exception:
+                impl = "xla"
+        t0 = time.perf_counter()
+        state, _ = make_run(cfg, args.ticks, trace=False, impl=impl)(st0)
         jax.block_until_ready(state.term)
         dt = time.perf_counter() - t0
         roles = np.asarray(state.role)

@@ -8,7 +8,12 @@ simulation; routes are addressed by (group, node):
 
     GET /                                  -> simulation status (tick, groups, leaders)
     GET /{g}/{n}/                          -> "Server n log [...]" (reference GET /)
-    GET /{g}/{n}/cmd/{command}             -> queue command on (g, n) (reference GET /cmd/)
+    GET /{g}/{n}/cmd/{command}             -> append on (g, n), reply with the log dump
+                                              (reference GET /cmd/, RaftServer.kt:87-90:
+                                              synchronous append + dump; the append
+                                              lands in phase 0 of the next tick, which
+                                              this route runs/awaits before dumping);
+                                              ?async=1 -> queue + ack without waiting
     GET /{g}/{n}/status                    -> up/role/term/commit/lastIndex JSON
     GET /{g}/{n}/crash, /{g}/{n}/restart   -> queue a §9 fault event on (g, n)
     GET /step/{k}                          -> advance k ticks (manual-clock mode)
@@ -82,12 +87,41 @@ class RaftHTTPServer:
                         return self._send(200, body, "application/json")
                     m = _ROUTE_CMD.match(self.path)
                     if m:
-                        g, n, cmd = int(m[1]), int(m[2]), unquote(m[3])
+                        g, n = int(m[1]), int(m[2])
+                        cmd = unquote(m[3].split("?")[0])
+                        want_async = self.path.endswith("?async=1")
                         sim.cmd(g, n, cmd)
-                        # Reference replies with the full log dump after appending
-                        # (RaftServer.kt:88-90) — but the append lands next tick
-                        # here, so reply with the queued ack.
-                        return self._send(200, f"Server {n} queued {cmd!r}")
+                        if want_async:
+                            return self._send(200, f"Server {n} queued {cmd!r}")
+                        # Reference-faithful observable: GET /cmd/{c} appends
+                        # synchronously and replies with the full log dump
+                        # (RaftServer.kt:87-90). The append lands in phase 0 of
+                        # the next tick, so block until that tick has run —
+                        # stepping it ourselves on a manual clock, waiting for
+                        # the tick thread otherwise — then dump.
+                        target = sim.tick_count + 1
+                        if outer.tick_hz <= 0:
+                            sim.step(1)
+                        else:
+                            # Generous deadline: the FIRST tick triggers the
+                            # JIT compile, which can take minutes on a slow
+                            # host — and a silent pre-append dump would break
+                            # the reference contract, so time out LOUDLY.
+                            deadline = time.monotonic() + max(
+                                600.0 if sim.tick_count == 0 else 5.0,
+                                3.0 / outer.tick_hz)
+                            while (sim.tick_count < target
+                                   and time.monotonic() < deadline):
+                                time.sleep(min(0.01, 1.0 / outer.tick_hz / 4))
+                            if sim.tick_count < target:
+                                return self._send(
+                                    503,
+                                    f"Server {n} queued {cmd!r} but the "
+                                    f"delivering tick did not run within the "
+                                    f"deadline; retry GET /{g}/{n}/ for the "
+                                    f"log dump")
+                        ents = sim.entries(g, n)
+                        return self._send(200, f"Server {n} log {ents}")
                     m = _ROUTE_LOG.match(self.path)
                     if m:
                         g, n = int(m[1]), int(m[2])

@@ -45,10 +45,17 @@ class Simulator:
         """impl: "xla", "pallas" (ops/pallas_tick.py megakernel), or "auto" —
         pallas when running on an accelerator with a lane-aligned group count,
         else xla. Both backends are bit-identical (shared phase_body)."""
+        if cfg.log_dtype != "int32":
+            raise ValueError(
+                "Simulator requires log_dtype='int32': interned command ids "
+                "start at 1<<30 (INTERN_BASE) and cannot be stored in narrow "
+                "logs. Narrow dtypes are for bounded headless sweeps "
+                "(make_run/bench) only.")
         self.cfg = cfg
         self._lock = threading.RLock()
         self._state = state if state is not None else init_state(cfg)
-        if impl == "auto":
+        auto = impl == "auto"
+        if auto:
             from raft_kotlin_tpu.ops.pallas_tick import choose_impl
 
             impl = choose_impl(cfg)
@@ -58,10 +65,20 @@ class Simulator:
             tick = make_pallas_tick(cfg)
         else:
             tick = make_tick(cfg)
-        self.impl = impl
         # One jitted callable; None-ness of the optional args is static, so each of
         # the four (inject?, fault_cmd?) combinations traces once and is cached.
         self._tick = jax.jit(tick)
+        if auto and impl == "pallas":
+            # choose_impl validates tile construction only; Mosaic compiles lazily
+            # at the first step. Warm up on the boot state (result discarded) so a
+            # config passing the VMEM heuristic but rejected by Mosaic falls back
+            # to the XLA tick here instead of crashing the first real step.
+            try:
+                jax.block_until_ready(self._tick(self._state).term)
+            except Exception:
+                impl = "xla"
+                self._tick = jax.jit(make_tick(cfg))
+        self.impl = impl
         # Pending phase-0 injections for the next tick: {(g, n): cmd_id} — last write
         # wins per (group, node), like back-to-back HTTP posts within one tick window.
         self._pending: Dict[Tuple[int, int], int] = {}
@@ -128,8 +145,10 @@ class Simulator:
             self._pending_faults[(group, node)] = 2
 
     def step(self, n_ticks: int = 1) -> None:
-        with self._lock:
-            for _ in range(n_ticks):
+        # Lock per tick, not per call: step(10_000) from a background clock must not
+        # starve HTTP readers for the whole multi-tick loop.
+        for _ in range(n_ticks):
+            with self._lock:
                 inject = fault_cmd = None
                 if self._pending:
                     arr = np.full(

@@ -40,11 +40,13 @@ def tick_metrics(prev: RaftState, cur: RaftState) -> Dict[str, jax.Array]:
     """Scalar on-device reductions for the transition prev -> cur (one tick apart).
 
     Keys (all () int32 unless noted):
-    - leaders:            groups with >= 1 LEADER node
-    - multi_leader:       groups with >= 2 LEADER nodes (any terms)
-    - split_leaders:      groups with two leaders in the SAME term — classical Raft's
-                          Election Safety violation; reachable in the reference's
-                          semantics (quirks d/f/g), so it is telemetry, not an error
+    - leaders:            groups with >= 1 LIVE LEADER node (a §9-crashed node keeps
+                          role=LEADER inert while up=False; it does not lead)
+    - multi_leader:       groups with >= 2 live LEADER nodes (any terms)
+    - split_leaders:      groups with two live leaders in the SAME term — classical
+                          Raft's Election Safety violation; reachable in the
+                          reference's semantics (quirks d/f/g), so it is telemetry,
+                          not an error
     - elections:          nodes that entered a new vote round this tick
     - rounds_active:      nodes currently in an ACTIVE vote round
     - candidates:         nodes currently CANDIDATE
@@ -55,7 +57,7 @@ def tick_metrics(prev: RaftState, cur: RaftState) -> Dict[str, jax.Array]:
     - log_bytes_used:     total readable log slots (sum of last_index)
     """
     # State is groups-minor: role/term are (N, G); node axis = 0.
-    is_leader = cur.role == LEADER
+    is_leader = (cur.role == LEADER) & cur.up
     n_lead = jnp.sum(is_leader.astype(_I32), axis=0)  # (G,)
 
     # Same-term leader pairs, O(N^2) on the tiny node axis (the is_leader factors

@@ -87,20 +87,28 @@ def test_http_routes_manual_clock():
         root = json.loads(body)
         assert root["tick"] == 0 and root["groups"] == CFG.n_groups
 
+        # Reference-faithful /cmd: append + full log dump in one exchange
+        # (RaftServer.kt:87-90) — on a manual clock the route steps the one tick
+        # that delivers the command.
         code, body = _get(srv.port, "/0/1/cmd/hello%20world")
+        assert code == 200
+        assert body.startswith("Server 1 log ") and "hello world" in body
+
+        # ?async=1 keeps the queue-and-ack form (no tick advanced).
+        code, body = _get(srv.port, "/0/1/cmd/later?async=1")
         assert code == 200 and "queued" in body
 
         code, body = _get(srv.port, "/step/5")
-        assert code == 200 and json.loads(body)["tick"] == 5
+        assert code == 200 and json.loads(body)["tick"] == 6
 
         code, body = _get(srv.port, "/0/1/")
         assert code == 200
         assert body.startswith("Server 1 log ")
-        assert "hello world" in body  # landed in node 1's local log
+        assert "hello world" in body and "later" in body
 
         code, body = _get(srv.port, "/0/1/status")
         st = json.loads(body)
-        assert st["last_index"] >= 1 and st["tick"] == 5
+        assert st["last_index"] >= 2 and st["tick"] == 6
 
         code, _ = _get(srv.port, "/9/1/")
         assert code == 400

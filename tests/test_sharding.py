@@ -89,6 +89,26 @@ def test_config5_scale_shape_sharded():
     assert int(np.asarray(metrics["commit_total"])[-1]) > 0
 
 
+def test_mesh_metrics_match_instrumented_run():
+    # One canonical metrics definition: the sharded run's per-tick reductions must
+    # equal make_instrumented_run's tick_metrics on the same seed — in particular
+    # `elections` (rounds-delta, which counts consecutive rounds a node starts while
+    # staying CANDIDATE through backoff — the churn case role-transition counting
+    # misses) and `leaders` (gated by `up`).
+    from raft_kotlin_tpu.utils.metrics import make_instrumented_run
+
+    mesh = make_mesh()
+    cfg = pad_groups(
+        RaftConfig(n_groups=16, n_nodes=3, log_capacity=8, cmd_period=5,
+                   p_drop=0.15, p_crash=0.01, p_restart=0.1, seed=33).stressed(10),
+        mesh)
+    T = 100
+    _, m_sh = make_sharded_run(cfg, mesh, T, metrics_every=1)(init_sharded(cfg, mesh))
+    _, m_in = make_instrumented_run(cfg, T, impl="xla")(init_state(cfg))
+    for k in ("elections", "leaders", "commit_total"):
+        assert np.array_equal(np.asarray(m_sh[k]), np.asarray(m_in[k])), k
+
+
 def test_sharded_pallas_matches_xla():
     # The megakernel applied per shard via shard_map must equal the XLA sharded
     # run bit-for-bit (they share phase_body; this validates the shard plumbing).
