@@ -81,9 +81,12 @@ def measure(cfg, n_ticks, n_reps, impl_candidates):
         except Exception as e:  # Mosaic rejection etc. -> next candidate
             last_err = e
             continue
-        best = float("inf")
         end = warm
+        warm = None  # free the warm-up output before timing (peak memory: the
+        # deep-log stage runs within ~3x state bytes of the chip's HBM)
+        best = float("inf")
         for _ in range(n_reps):
+            end = None
             t0 = time.perf_counter()
             end = run(st0)
             jax.block_until_ready(end.term)
@@ -219,21 +222,37 @@ def main() -> None:
         cfg, parity_groups, min(ticks, 200), impl)
 
     # Stage 5 — deep log (BASELINE config 5 shape on one chip): C=10k, N=7,
-    # int16 logs, G at the HBM ceiling rounded down to lanes.
+    # int16 logs, G at the HBM ceiling rounded down to lanes. The scan peak
+    # holds ~3x state bytes (st0 + double-buffered carry), hence the working
+    # factor; on ResourceExhausted the stage halves G and retries rather than
+    # killing the whole bench line.
     deep_proto = RaftConfig(
         n_nodes=7, log_capacity=10_000, log_dtype="int16", cmd_period=2,
         p_drop=0.05, seed=3,
     ).stressed(10)
-    # Budget leaves headroom for XLA's in+out+transient copies of the state
-    # (~2.5x state bytes live at the scan peak on a 16 GB chip).
-    deep_budget = int(os.environ.get("RAFT_BENCH_DEEPLOG_HBM", 10 * 10**9))
-    deep_g = max(128, (deep_proto.max_groups_for_hbm(deep_budget) // 128) * 128)
+    deep_budget = int(os.environ.get("RAFT_BENCH_DEEPLOG_HBM", 13 * 10**9))
+    deep_g = max(128, (deep_proto.max_groups_for_hbm(
+        deep_budget, working_factor=3.5) // 128) * 128)
     if not on_accel:
         deep_g = 256
-    deep_cfg = dataclasses.replace(deep_proto, n_groups=deep_g)
     deep_ticks = int(os.environ.get("RAFT_BENCH_DEEPLOG_TICKS", 30))
-    dbest, dend, dst, _ = measure(deep_cfg, deep_ticks, 1, xla_only)
-    deep_steps_per_sec = deep_g * deep_ticks / dbest
+    deep_steps_per_sec = None
+    deep_commit_total = None
+    deep_cfg = dataclasses.replace(deep_proto, n_groups=deep_g)
+    for _attempt in range(3):
+        deep_cfg = dataclasses.replace(deep_proto, n_groups=deep_g)
+        try:
+            dbest, dend, dst, _ = measure(deep_cfg, deep_ticks, 1, xla_only)
+            deep_steps_per_sec = round(deep_g * deep_ticks / dbest, 1)
+            deep_commit_total = int(jnp.sum(jnp.max(dend.commit, axis=0)))
+            break
+        except Exception as e:
+            print(f"deep-log stage failed at G={deep_g}: {str(e)[:300]}",
+                  file=sys.stderr)
+            smaller = max(128, (deep_g // 2 // 128) * 128)
+            if smaller == deep_g:
+                break  # can't shrink further; report nulls
+            deep_g = smaller
 
     baseline_group_steps_per_sec = 10.0
     print(json.dumps({
@@ -260,11 +279,11 @@ def main() -> None:
         "pallas_vs_xla": round(pallas_vs_xla, 2),
         "xla_ticks_per_sec": round(xla_ticks_per_sec, 2),
         # Deep-log stage (BASELINE config 5 shape).
-        "deeplog_groups_per_chip": deep_g,
+        "deeplog_groups_per_chip": deep_g if deep_steps_per_sec else 0,
         "deeplog_capacity": deep_cfg.log_capacity,
         "deeplog_n_nodes": deep_cfg.n_nodes,
-        "deeplog_group_steps_per_sec": round(deep_steps_per_sec, 1),
-        "deeplog_commit_total": int(jnp.sum(jnp.max(dend.commit, axis=0))),
+        "deeplog_group_steps_per_sec": deep_steps_per_sec,
+        "deeplog_commit_total": deep_commit_total,
         "deeplog_hbm_gb": round(deep_cfg.hbm_bytes() / 1e9, 2),
     }))
     sys.stdout.flush()

@@ -119,19 +119,20 @@ def main(argv=None) -> int:
 
             impl = choose_impl(cfg)
         st0 = init_state(cfg)
-        if impl == "pallas" and args.impl == "auto":
-            # Mosaic compiles lazily; probe one tick so a kernel rejection falls
-            # back to the XLA tick instead of crashing mid-run (mirrors
-            # Simulator.__init__ and bench.measure()).
-            from raft_kotlin_tpu.ops.pallas_tick import make_pallas_tick
-
-            try:
-                jax.block_until_ready(jax.jit(make_pallas_tick(cfg))(st0).term)
-            except Exception:
-                impl = "xla"
+        # Mosaic compiles lazily; run the real scan and fall back to the XLA
+        # tick on rejection (bench.measure()'s pattern — no throwaway probe
+        # compile, which would double the minutes-long Mosaic startup).
         t0 = time.perf_counter()
-        state, _ = make_run(cfg, args.ticks, trace=False, impl=impl)(st0)
-        jax.block_until_ready(state.term)
+        try:
+            state, _ = make_run(cfg, args.ticks, trace=False, impl=impl)(st0)
+            jax.block_until_ready(state.term)
+        except Exception:
+            if not (impl == "pallas" and args.impl == "auto"):
+                raise
+            impl = "xla"
+            t0 = time.perf_counter()
+            state, _ = make_run(cfg, args.ticks, trace=False, impl="xla")(st0)
+            jax.block_until_ready(state.term)
         dt = time.perf_counter() - t0
         roles = np.asarray(state.role)
         print(json.dumps({

@@ -31,7 +31,7 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
-from urllib.parse import unquote
+from urllib.parse import parse_qs, unquote
 
 from raft_kotlin_tpu.api.simulator import Simulator
 
@@ -88,8 +88,10 @@ class RaftHTTPServer:
                     m = _ROUTE_CMD.match(self.path)
                     if m:
                         g, n = int(m[1]), int(m[2])
-                        cmd = unquote(m[3].split("?")[0])
-                        want_async = self.path.endswith("?async=1")
+                        raw, _, query = m[3].partition("?")
+                        cmd = unquote(raw)
+                        params = parse_qs(query)
+                        want_async = params.get("async", ["0"])[-1] in ("1", "true")
                         sim.cmd(g, n, cmd)
                         if want_async:
                             return self._send(200, f"Server {n} queued {cmd!r}")

@@ -67,6 +67,11 @@ class Simulator:
             tick = make_tick(cfg)
         # One jitted callable; None-ness of the optional args is static, so each of
         # the four (inject?, fault_cmd?) combinations traces once and is cached.
+        # The rng tuple is passed per call (a jit operand, not a baked constant)
+        # so the compiled tick is seed-independent — see ops.tick.make_rng.
+        from raft_kotlin_tpu.ops.tick import make_rng
+
+        self._rng = make_rng(cfg)
         self._tick = jax.jit(tick)
         if auto and impl == "pallas":
             # choose_impl validates tile construction only; Mosaic compiles lazily
@@ -74,7 +79,8 @@ class Simulator:
             # config passing the VMEM heuristic but rejected by Mosaic falls back
             # to the XLA tick here instead of crashing the first real step.
             try:
-                jax.block_until_ready(self._tick(self._state).term)
+                jax.block_until_ready(
+                    self._tick(self._state, rng=self._rng).term)
             except Exception:
                 impl = "xla"
                 self._tick = jax.jit(make_tick(cfg))
@@ -164,7 +170,8 @@ class Simulator:
                         arr[g, n - 1] = ev
                     self._pending_faults.clear()
                     fault_cmd = jnp.asarray(arr)
-                self._state = self._tick(self._state, inject, fault_cmd)
+                self._state = self._tick(self._state, inject, fault_cmd,
+                                         rng=self._rng)
 
     # -- introspection --------------------------------------------------------
 

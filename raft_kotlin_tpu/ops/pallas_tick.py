@@ -36,7 +36,6 @@ from jax.experimental import pallas as pl
 from raft_kotlin_tpu.models.state import MAILBOX_FIELDS, RaftState
 from raft_kotlin_tpu.ops import tick as tick_mod
 from raft_kotlin_tpu.ops.tick import AUX_FIELDS, STATE_FIELDS, BodyFlags, state_fields
-from raft_kotlin_tpu.utils import rng as rngmod
 from raft_kotlin_tpu.utils.config import RaftConfig
 
 _I32 = jnp.int32
@@ -198,12 +197,11 @@ def cast_flat_out(outs, sfields):
 
 def make_pallas_tick(cfg: RaftConfig, tile_g: Optional[int] = None,
                      interpret: Optional[bool] = None):
-    """Build tick(state, inject=None, fault_cmd=None) -> state — same contract and
-    same bits as ops.tick.make_tick(cfg), different compilation strategy."""
+    """Build tick(state, inject=None, fault_cmd=None[, rng]) -> state — same
+    contract and same bits as ops.tick.make_tick(cfg), different compilation
+    strategy."""
     N, C, G = cfg.n_nodes, cfg.log_capacity, cfg.n_groups
-    base = rngmod.base_key(cfg.seed)
-    tkeys = rngmod.grid_keys(base, rngmod.KIND_TIMEOUT, G, N).T
-    bkeys = rngmod.grid_keys(base, rngmod.KIND_BACKOFF, G, N).T
+    default_rng = tick_mod.make_rng(cfg)
 
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
@@ -218,10 +216,12 @@ def make_pallas_tick(cfg: RaftConfig, tile_g: Optional[int] = None,
         state: RaftState,
         inject: Optional[jax.Array] = None,
         fault_cmd: Optional[jax.Array] = None,
+        rng=None,
     ) -> RaftState:
         assert state.term.shape[-1] == G, (
             f"state has {state.term.shape[-1]} groups, kernel built for {G}"
         )
+        base, tkeys, bkeys = rng if rng is not None else default_rng
         aux, flags = tick_mod.make_aux(
             cfg, base, tkeys, bkeys, state, inject, fault_cmd)
         call, sfields, aux_names = build_call(flags)

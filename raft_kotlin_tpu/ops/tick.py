@@ -637,33 +637,53 @@ def finish_tick(cfg: RaftConfig, tkeys, s: dict, el_dirty, t):
     return RaftState(**s, tick=t + 1)
 
 
+def make_rng(cfg: RaftConfig):
+    """The per-simulation RNG operands: (base key, timeout key grid, backoff key
+    grid). Static key prefixes are computed once per simulation (rng.grid_keys):
+    the per-draw cost inside the tick drops to fold_in(counter) + randint.
+    grid_keys is (G, N) canonical; transposed here so keyed draws line up with
+    (N, G) counter grids (the derivation is per-element, so the draw bits are
+    unchanged).
+
+    This tuple is threaded through jit boundaries as RUNTIME OPERANDS, not
+    closure constants: the seed then never appears in the compiled program, so
+    every same-shape/same-pacing config shares one XLA compilation regardless of
+    seed (multi-minute compiles on small hosts make this the difference between
+    a usable differential suite and an unusable one)."""
+    base = rngmod.base_key(cfg.seed)
+    N = cfg.n_nodes
+    tkeys = rngmod.grid_keys(base, rngmod.KIND_TIMEOUT, cfg.n_groups, N).T
+    bkeys = rngmod.grid_keys(base, rngmod.KIND_BACKOFF, cfg.n_groups, N).T
+    return base, tkeys, bkeys
+
+
 def make_tick(cfg: RaftConfig):
-    """Build tick(state, inject=None, fault_cmd=None) -> state for a fixed config.
+    """Build tick(state, inject=None, fault_cmd=None[, rng]) -> state for a
+    fixed config.
 
     `inject` is an optional (G, N) int32 array of commands (-1 = none) delivered in
     phase 0 in addition to the cfg.cmd_period rule — the driver-level equivalent of the
     reference's GET /cmd/{command} (RaftServer.kt:87-90). `fault_cmd` is an optional
     (G, N) int32 of driver-scheduled §9 events (0 none / 1 crash / 2 restart). Both use
     the driver-canonical (G, N) shape; they are transposed internally.
+
+    `rng` defaults to make_rng(cfg); outer jit wrappers (make_run, Simulator,
+    make_sharded_run) pass it explicitly through their jit boundary so the seed
+    stays out of the compiled program (see make_rng).
     """
-    N = cfg.n_nodes
-    base = rngmod.base_key(cfg.seed)
-    # Static key prefixes, computed once per simulation (rng.grid_keys): the per-draw
-    # cost inside the tick drops to fold_in(counter) + randint. grid_keys is (G, N)
-    # canonical; transposed here so keyed draws line up with (N, G) counter grids
-    # (the derivation is per-element, so the draw bits are unchanged).
-    tkeys = rngmod.grid_keys(base, rngmod.KIND_TIMEOUT, cfg.n_groups, N).T
-    bkeys = rngmod.grid_keys(base, rngmod.KIND_BACKOFF, cfg.n_groups, N).T
+    default_rng = make_rng(cfg)
 
     def tick(
         state: RaftState,
         inject: Optional[jax.Array] = None,
         fault_cmd: Optional[jax.Array] = None,
+        rng=None,
     ) -> RaftState:
         G = state.term.shape[-1]
         assert G == cfg.n_groups, (
             f"state has {G} groups but make_tick was built for {cfg.n_groups}"
         )
+        base, tkeys, bkeys = rng if rng is not None else default_rng
         aux, flags = make_aux(cfg, base, tkeys, bkeys, state, inject, fault_cmd)
         s = flatten_state(cfg, state)
         el_dirty = phase_body(cfg, s, aux, flags)
@@ -686,25 +706,27 @@ def make_run(cfg: RaftConfig, n_ticks: int, trace: bool = True, impl: str = "xla
         tick_fn = make_pallas_tick(cfg)
     else:
         tick_fn = make_tick(cfg)
-
-    def body(st, _):
-        st = tick_fn(st)
-        if trace:
-            out = {
-                "role": st.role,
-                "term": st.term,
-                "commit": st.commit,
-                "last_index": st.last_index,
-                "voted_for": st.voted_for,
-                "rounds": st.rounds,
-                "up": st.up,
-            }
-        else:
-            out = jnp.sum((st.role == LEADER).astype(_I32), axis=0)
-        return st, out
+    rng = make_rng(cfg)
 
     @jax.jit
-    def run(st):
+    def run(st, rng):
+        def body(st, _):
+            st = tick_fn(st, rng=rng)
+            if trace:
+                out = {
+                    "role": st.role,
+                    "term": st.term,
+                    "commit": st.commit,
+                    "last_index": st.last_index,
+                    "voted_for": st.voted_for,
+                    "rounds": st.rounds,
+                    "up": st.up,
+                }
+            else:
+                out = jnp.sum((st.role == LEADER).astype(_I32), axis=0)
+            return st, out
+
         return lax.scan(body, st, None, length=n_ticks)
 
-    return run
+    # rng rides the jit boundary as an operand (seed-independent program).
+    return lambda st: run(st, rng)

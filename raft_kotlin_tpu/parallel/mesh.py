@@ -127,7 +127,6 @@ def _make_shardmap_pallas_tick(cfg: RaftConfig, mesh: Mesh,
         default_tile,
         make_pallas_core,
     )
-    from raft_kotlin_tpu.utils import rng as rngmod
 
     N, G = cfg.n_nodes, cfg.n_groups
     n_dev = math.prod(mesh.devices.shape)
@@ -152,13 +151,10 @@ def _make_shardmap_pallas_tick(cfg: RaftConfig, mesh: Mesh,
                 f"{_TILES} that fits the config, or use impl='xla'"
             ) from e
     build_call = make_pallas_core(cfg, g_local, tile, interpret)
-
-    base = rngmod.base_key(cfg.seed)
-    tkeys = rngmod.grid_keys(base, rngmod.KIND_TIMEOUT, G, N).T
-    bkeys = rngmod.grid_keys(base, rngmod.KIND_BACKOFF, G, N).T
     lanes_spec = P(None, ("dcn", "ici"))
 
-    def tick(state: RaftState) -> RaftState:
+    def tick(state: RaftState, rng) -> RaftState:
+        base, tkeys, bkeys = rng
         aux, flags = tick_mod.make_aux(cfg, base, tkeys, bkeys, state, None, None)
         call, sfields, aux_names = build_call(flags)
         flat = tick_mod.flatten_state(cfg, state)
@@ -192,16 +188,25 @@ def make_sharded_run(cfg: RaftConfig, mesh: Mesh, n_ticks: int,
     impl: "xla" (default — the SPMD partitioner splits the tick shard-locally) or
     "pallas" (the megakernel per shard via shard_map).
     """
+    from raft_kotlin_tpu.ops.tick import make_rng
+
     if impl == "pallas":
-        tick_fn = _make_shardmap_pallas_tick(cfg, mesh)
+        shardmap_tick = _make_shardmap_pallas_tick(cfg, mesh)
+        tick_fn = lambda st, rng: shardmap_tick(st, rng)
     else:
-        tick_fn = make_tick(cfg)
+        xla_tick = make_tick(cfg)
+        tick_fn = lambda st, rng: xla_tick(st, rng=rng)
     sh = state_sharding(mesh, cfg)
     rep = NamedSharding(mesh, P())
+    rng = make_rng(cfg)
+    # rng operand shardings: base key replicated; (N, G) key grids sharded on
+    # the groups axis like every state array.
+    keys_sh = NamedSharding(mesh, P(None, ("dcn", "ici")))
+    rng_sh = (rep, keys_sh, keys_sh)
 
-    def body(st, _):
+    def body(st, rng, _):
         prev_rounds = st.rounds
-        st = tick_fn(st)
+        st = tick_fn(st, rng)
         if metrics_every:
             out = {
                 "leaders": jnp.sum(
@@ -220,8 +225,12 @@ def make_sharded_run(cfg: RaftConfig, mesh: Mesh, n_ticks: int,
             out = None
         return st, out
 
-    def run(st):
-        return jax.lax.scan(body, st, None, length=n_ticks)
+    def run(st, rng):
+        return jax.lax.scan(lambda s, x: body(s, rng, x), st, None,
+                            length=n_ticks)
 
-    return jax.jit(run, in_shardings=(sh,),
-                   out_shardings=(sh, rep if metrics_every else None))
+    jitted = jax.jit(run, in_shardings=(sh, rng_sh),
+                     out_shardings=(sh, rep if metrics_every else None))
+    # rng as a jit operand (seed-independent program); placed per rng_sh.
+    rng_placed = tuple(jax.device_put(a, s) for a, s in zip(rng, rng_sh))
+    return lambda st: jitted(st, rng_placed)

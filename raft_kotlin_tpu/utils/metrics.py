@@ -48,8 +48,8 @@ def tick_metrics(prev: RaftState, cur: RaftState) -> Dict[str, jax.Array]:
                           reference's semantics (quirks d/f/g), so it is telemetry,
                           not an error
     - elections:          nodes that entered a new vote round this tick
-    - rounds_active:      nodes currently in an ACTIVE vote round
-    - candidates:         nodes currently CANDIDATE
+    - rounds_active:      live nodes currently in an ACTIVE vote round
+    - candidates:         live nodes currently CANDIDATE
     - commit_advanced:    sum over (g, n) of commit increase (clipped at 0) — the
                           commit-throughput numerator
     - commit_total:       sum over groups of the max node commit
@@ -75,8 +75,10 @@ def tick_metrics(prev: RaftState, cur: RaftState) -> Dict[str, jax.Array]:
         "multi_leader": jnp.sum((n_lead >= 2).astype(_I32)),
         "split_leaders": jnp.sum(split.astype(_I32)),
         "elections": jnp.sum((cur.rounds - prev.rounds).astype(_I32)),
-        "rounds_active": jnp.sum((cur.round_state == ACTIVE).astype(_I32)),
-        "candidates": jnp.sum((cur.role == CANDIDATE).astype(_I32)),
+        # Like the leader metrics, activity metrics count LIVE nodes only: a §9
+        # crash freezes role/round_state inert while up=False.
+        "rounds_active": jnp.sum(((cur.round_state == ACTIVE) & cur.up).astype(_I32)),
+        "candidates": jnp.sum(((cur.role == CANDIDATE) & cur.up).astype(_I32)),
         "commit_advanced": jnp.sum(d_commit),
         "commit_total": jnp.sum(jnp.max(cur.commit, axis=0)),
         "term_max": jnp.max(cur.term),
@@ -156,19 +158,24 @@ def make_instrumented_run(
         tick_fn = make_pallas_tick(cfg)
     else:
         tick_fn = make_tick(cfg)
+    from raft_kotlin_tpu.ops.tick import make_rng
 
-    def body(st, _):
-        nxt = tick_fn(st)
-        out = tick_metrics(st, nxt)
-        if invariants:
-            out.update({f"inv_{k}": v for k, v in check_invariants(st, nxt, cfg).items()})
-        return nxt, out
+    rng = make_rng(cfg)
 
     @jax.jit
-    def run(st):
+    def run(st, rng):
+        def body(st, _):
+            nxt = tick_fn(st, rng=rng)
+            out = tick_metrics(st, nxt)
+            if invariants:
+                out.update({f"inv_{k}": v
+                            for k, v in check_invariants(st, nxt, cfg).items()})
+            return nxt, out
+
         return jax.lax.scan(body, st, None, length=n_ticks)
 
-    return run
+    # rng as a jit operand: the compiled program is seed-independent.
+    return lambda st: run(st, rng)
 
 
 class MetricsRecorder:

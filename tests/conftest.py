@@ -30,6 +30,14 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 _DURATIONS: dict = {}
 _SLOW_NODES: set = set()
+_COLLECTED: set = set()
+
+
+def pytest_itemcollected(item):
+    # Fires at collection, BEFORE -m deselection: the full universe of tests
+    # this session knows about, used to prune renamed/deleted entries from the
+    # TEST_TIMES.json artifact without dropping deselected (slow) ones.
+    _COLLECTED.add(item.nodeid)
 
 
 def pytest_runtest_logreport(report):
@@ -58,9 +66,19 @@ def pytest_sessionfinish(session, exitstatus):
                 data = json.load(f)
         except Exception:
             data = {"durations": {}}
-    data.setdefault("durations", {}).update(
-        {k: v for k, v in sorted(_DURATIONS.items())})
-    slow = set(data.get("slow_nodes", [])) | _SLOW_NODES
+    durations = data.setdefault("durations", {})
+    durations.update({k: v for k, v in sorted(_DURATIONS.items())})
+    # Prune stale entries (renamed/deleted tests) so slow_total_s stays honest:
+    # any stored nodeid from a module collected THIS session that was not
+    # re-collected no longer exists (deselected tests still collect).
+    collected_files = {n.split("::")[0] for n in _COLLECTED}
+    stale = [
+        k for k in durations
+        if k.split("::")[0] in collected_files and k not in _COLLECTED
+    ]
+    for k in stale:
+        del durations[k]
+    slow = (set(data.get("slow_nodes", [])) | _SLOW_NODES) - set(stale)
     data["slow_nodes"] = sorted(slow)
     data["updated"] = time.strftime("%Y-%m-%dT%H:%M:%S")
     data["slow_total_s"] = round(sum(
