@@ -238,7 +238,6 @@ def main() -> None:
     deep_ticks = int(os.environ.get("RAFT_BENCH_DEEPLOG_TICKS", 30))
     deep_steps_per_sec = None
     deep_commit_total = None
-    deep_cfg = dataclasses.replace(deep_proto, n_groups=deep_g)
     for _attempt in range(3):
         deep_cfg = dataclasses.replace(deep_proto, n_groups=deep_g)
         try:

@@ -124,6 +124,8 @@ def make_pallas_core(cfg: RaftConfig, lanes: int, tile_g: int, interpret: bool):
 
     @functools.lru_cache(maxsize=None)
     def build_call(flags: BodyFlags):
+        # Mosaic has no gather/scatter in the TC path: always the one-hot form.
+        flags = dataclasses.replace(flags, dyn_log=False)
         sfields = state_fields(flags)
         aux_names = tuple(
             k for k in AUX_FIELDS
@@ -201,7 +203,7 @@ def make_pallas_tick(cfg: RaftConfig, tile_g: Optional[int] = None,
     contract and same bits as ops.tick.make_tick(cfg), different compilation
     strategy."""
     N, C, G = cfg.n_nodes, cfg.log_capacity, cfg.n_groups
-    default_rng = tick_mod.make_rng(cfg)
+    default_rng: list = []  # derived lazily; wrappers always pass rng explicitly
 
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
@@ -221,7 +223,11 @@ def make_pallas_tick(cfg: RaftConfig, tile_g: Optional[int] = None,
         assert state.term.shape[-1] == G, (
             f"state has {state.term.shape[-1]} groups, kernel built for {G}"
         )
-        base, tkeys, bkeys = rng if rng is not None else default_rng
+        if rng is None:
+            if not default_rng:
+                default_rng.append(tick_mod.make_rng(cfg))
+            rng = default_rng[0]
+        base, tkeys, bkeys = rng
         aux, flags = tick_mod.make_aux(
             cfg, base, tkeys, bkeys, state, inject, fault_cmd)
         call, sfields, aux_names = build_call(flags)

@@ -120,6 +120,14 @@ class BodyFlags:
     periodic: bool = False
     inject: bool = False
     delay: bool = False  # §10 mailbox exchanges (cfg.uses_mailbox)
+    # Deep-log addressing mode: True = log reads/writes via per-lane dynamic
+    # gather/scatter (take/put_along_axis) instead of (N*C, G) one-hot masks.
+    # The one-hot form is Mosaic's requirement (no scatter/gather in the
+    # Pallas TC path) and is fine for small C, but at config-5 depth
+    # (C=10_000) each one-hot is a ~100M-element intermediate and the tick
+    # does ~6 per (node, peer) pair — gathers make deep logs feasible.
+    # Values are identical either way (same slots, same masks).
+    dyn_log: bool = False
 
 
 def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
@@ -148,34 +156,54 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
         cur = s[name][n - 1]
         s[name] = _set_row(s[name], n - 1, jnp.where(mask, vals, cur))
 
-    def log_gather(name, n, idx):
-        # (G,) read of node n's physical slot idx, as a one-hot contraction over the
-        # flat (N*C, G) log (no gather op — TPU-friendly); 0 where idx is out of
-        # [0, C). The bounds terms make that guarantee real: without them an
-        # out-of-range idx in the flat layout would alias an ADJACENT node's row
-        # (idx=-1 -> node n-1 slot C-1; idx=C -> node n+1 slot 0).
-        oh = (logrow == ((n - 1) * C + idx)[None, :]) \
-            & ((idx >= 0) & (idx < C))[None, :]
-        # Widen at read: log storage may be int16 (cfg.log_dtype); the one-hot
-        # sum has at most one nonzero per column, so summing in the narrow dtype
-        # cannot overflow before the cast.
-        return jnp.sum(jnp.where(oh, s[name], 0), axis=0).astype(_I32)
+    if flags.dyn_log:
+        def log_gather(name, n, idx):
+            # (G,) read of node n's physical slot idx via a per-lane dynamic
+            # gather on the flat (N*C, G) log; 0 where idx is out of [0, C).
+            rows = (n - 1) * C + jnp.clip(idx, 0, C - 1)
+            v = jnp.take_along_axis(s[name], rows[None, :], axis=0)[0]
+            return jnp.where((idx >= 0) & (idx < C), v, 0).astype(_I32)
+    else:
+        def log_gather(name, n, idx):
+            # (G,) read of node n's physical slot idx, as a one-hot contraction
+            # over the flat (N*C, G) log (no gather op — the Mosaic-compatible
+            # form); 0 where idx is out of [0, C). The bounds terms make that
+            # guarantee real: without them an out-of-range idx in the flat
+            # layout would alias an ADJACENT node's row (idx=-1 -> node n-1
+            # slot C-1; idx=C -> node n+1 slot 0).
+            oh = (logrow == ((n - 1) * C + idx)[None, :]) \
+                & ((idx >= 0) & (idx < C))[None, :]
+            # Widen at read: log storage may be int16 (cfg.log_dtype); the
+            # one-hot sum has at most one nonzero per column, so summing in the
+            # narrow dtype cannot overflow before the cast.
+            return jnp.sum(jnp.where(oh, s[name], 0), axis=0).astype(_I32)
 
     def log_add(n, i, term_v, cmd_v, mask):
         # SEMANTICS.md §3 add(): physical append / reject / overwrite-truncate.
-        # One-hot masked write over the flat log instead of a scatter; the write
-        # slot is always in-range where the write mask holds (append needs
-        # phys_len < C; overwrite needs i < last_index <= C).
+        # The write slot is always in-range where the write mask holds (append
+        # needs phys_len < C; overwrite needs i < last_index <= C).
         li = col("last_index", n)
         pl = col("phys_len", n)
         app = mask & (i == li) & (pl < C)
         ovw = mask & (i < li) & (i >= 0)
-        slot = (n - 1) * C + jnp.where(app, pl, i)
-        oh = (logrow == slot[None, :]) & (app | ovw)[None, :]
+        wr = app | ovw
         ldt = s["log_term"].dtype  # narrow at write (cfg.log_dtype)
-        s["log_term"] = jnp.where(oh, term_v.astype(ldt)[None, :], s["log_term"])
-        s["log_cmd"] = jnp.where(oh, cmd_v.astype(ldt)[None, :], s["log_cmd"])
-        setcol("last_index", n, app | ovw, jnp.where(app, li + 1, i + 1))
+        if flags.dyn_log:
+            # Masked read-modify-write of one slot per lane (scatter form).
+            rows = ((n - 1) * C
+                    + jnp.clip(jnp.where(app, pl, i), 0, C - 1))[None, :]
+            for name, v in (("log_term", term_v), ("log_cmd", cmd_v)):
+                cur = jnp.take_along_axis(s[name], rows, axis=0)
+                new = jnp.where(wr[None, :], v.astype(ldt)[None, :], cur)
+                s[name] = jnp.put_along_axis(
+                    s[name], rows, new, axis=0, inplace=False)
+        else:
+            # One-hot masked write over the flat log (Mosaic-compatible form).
+            slot = (n - 1) * C + jnp.where(app, pl, i)
+            oh = (logrow == slot[None, :]) & wr[None, :]
+            s["log_term"] = jnp.where(oh, term_v.astype(ldt)[None, :], s["log_term"])
+            s["log_cmd"] = jnp.where(oh, cmd_v.astype(ldt)[None, :], s["log_cmd"])
+        setcol("last_index", n, wr, jnp.where(app, li + 1, i + 1))
         setcol("phys_len", n, app, pl + 1)
 
     # Election-timer resets (SEMANTICS.md §7): each reset consumes one counted draw
@@ -560,6 +588,10 @@ def make_aux(cfg: RaftConfig, base, tkeys, bkeys, state: RaftState,
         periodic=cfg.cmd_period > 0,
         inject=inject is not None,
         delay=cfg.uses_mailbox,
+        # Deep logs switch to dynamic gather/scatter addressing (the Pallas
+        # builder forces this back off — Mosaic needs the one-hot form, and
+        # deep-log configs never reach Pallas anyway via choose_impl).
+        dyn_log=cfg.log_capacity >= 256,
     )
     if flags.delay and cfg.delay_lo < cfg.delay_hi:
         aux["delay"] = rngmod.delay_mask(
@@ -667,11 +699,12 @@ def make_tick(cfg: RaftConfig):
     (G, N) int32 of driver-scheduled §9 events (0 none / 1 crash / 2 restart). Both use
     the driver-canonical (G, N) shape; they are transposed internally.
 
-    `rng` defaults to make_rng(cfg); outer jit wrappers (make_run, Simulator,
-    make_sharded_run) pass it explicitly through their jit boundary so the seed
-    stays out of the compiled program (see make_rng).
+    `rng` defaults to make_rng(cfg), derived lazily on first use — every outer
+    jit wrapper (make_run, Simulator, make_sharded_run) passes it explicitly
+    through its jit boundary so the seed stays out of the compiled program
+    (see make_rng), and then the default is never materialized.
     """
-    default_rng = make_rng(cfg)
+    default_rng: list = []
 
     def tick(
         state: RaftState,
@@ -683,7 +716,11 @@ def make_tick(cfg: RaftConfig):
         assert G == cfg.n_groups, (
             f"state has {G} groups but make_tick was built for {cfg.n_groups}"
         )
-        base, tkeys, bkeys = rng if rng is not None else default_rng
+        if rng is None:
+            if not default_rng:
+                default_rng.append(make_rng(cfg))
+            rng = default_rng[0]
+        base, tkeys, bkeys = rng
         aux, flags = make_aux(cfg, base, tkeys, bkeys, state, inject, fault_cmd)
         s = flatten_state(cfg, state)
         el_dirty = phase_body(cfg, s, aux, flags)

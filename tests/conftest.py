@@ -70,9 +70,12 @@ def pytest_sessionfinish(session, exitstatus):
     durations.update({k: v for k, v in sorted(_DURATIONS.items())})
     # Prune stale entries (renamed/deleted tests) so slow_total_s stays honest:
     # any stored nodeid from a module collected THIS session that was not
-    # re-collected no longer exists (deselected tests still collect).
+    # re-collected no longer exists (deselected tests still collect). Node-id
+    # invocations (pytest file.py::test_x) RESTRICT collection itself, so
+    # same-file siblings would wrongly look stale — never prune then.
+    restricted = any("::" in a for a in session.config.args)
     collected_files = {n.split("::")[0] for n in _COLLECTED}
-    stale = [
+    stale = [] if restricted else [
         k for k in durations
         if k.split("::")[0] in collected_files and k not in _COLLECTED
     ]

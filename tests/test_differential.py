@@ -76,6 +76,16 @@ def test_fault_injection_bitmatch():
     assert_traces_match(cfg, 420)
 
 
+def test_deep_log_dyn_addressing_bitmatch():
+    # log_capacity >= 256 flips the kernel to dynamic gather/scatter log
+    # addressing (BodyFlags.dyn_log — the config-5 deep-log path); the oracle
+    # must still match bit-for-bit through appends, truncations, and ghost
+    # writes under churn.
+    cfg = RaftConfig(n_groups=2, n_nodes=3, log_capacity=512, seed=29,
+                     p_drop=0.15, cmd_period=3).stressed(10)
+    assert_traces_match(cfg, 150)
+
+
 @pytest.mark.slow
 def test_stressed_churn_bitmatch():
     # Compressed pacing + drops + writes: maximal protocol activity per tick.

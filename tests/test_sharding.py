@@ -69,6 +69,24 @@ def test_state_actually_sharded():
 
 
 @pytest.mark.slow
+def test_deep_log_sharded_matches_unsharded():
+    # The sharded CPU-mesh equivalent of the bench deep-log stage (BASELINE
+    # config-5 shape, scaled for CI): int16 deep logs + dynamic log addressing
+    # sharded over the 8-device mesh must equal the single-device run bit-exactly.
+    mesh = make_mesh()
+    cfg = pad_groups(
+        RaftConfig(n_groups=8, n_nodes=7, log_capacity=1024,
+                   log_dtype="int16", cmd_period=3, p_drop=0.05,
+                   seed=13).stressed(10),
+        mesh)
+    T = 80
+    ref, _ = make_run(cfg, T, trace=False)(init_state(cfg))
+    sh, _ = make_sharded_run(cfg, mesh, T)(init_sharded(cfg, mesh))
+    assert_states_equal(jax.device_get(ref), jax.device_get(sh))
+    assert int(np.max(np.asarray(sh.commit))) > 0  # replication really ran
+
+
+@pytest.mark.slow
 def test_config5_scale_shape_sharded():
     # BASELINE config-5 SHAPE check (scaled down for CI): 7-node groups with a
     # deep log, groups sharded over the full 8-device mesh, replication workload
