@@ -71,7 +71,25 @@ def main(argv=None) -> int:
 
     sub.add_parser("bench", help="run the headline benchmark (bench.py)")
 
+    expl = sub.add_parser(
+        "explain",
+        help="per-event narrative of one group (oracle replay — same seed, "
+             "same bits as the kernel)")
+    _add_cfg_args(expl)
+    expl.add_argument("--group", type=int, default=0)
+    expl.add_argument("--ticks", type=str, default="0..100",
+                      help="inclusive tick window a..b (replays from 0)")
+
     args = ap.parse_args(argv)
+
+    if args.command == "explain":
+        from raft_kotlin_tpu.api.explain import explain
+
+        lo, _, hi = args.ticks.partition("..")
+        lo = int(lo or 0)
+        hi = int(hi) if hi else lo
+        explain(_cfg_from(args), args.group, lo, hi)
+        return 0
 
     if args.command == "bench":
         # bench.py lives at the repo root, not inside the package — load by path so

@@ -1,0 +1,171 @@
+"""Oracle-replay "explain" mode: a per-event narrative for ONE group.
+
+The reference's single observability asset is its per-exchange log trail —
+kLogger.info on every vote/append (reference RaftServer.kt:56,110,134-135,222,
+255,280) plus a raw println of per-peer append state (RaftServer.kt:134). The
+vectorized kernel deliberately has no per-event path (it computes 100k groups
+as array ops), so this module recovers the narrative the cheap way: replay the
+requested group on the scalar Python oracle — same counted-threefry seed ⇒
+same bits as the kernel (the differential suite proves it) — with the oracle's
+event sink on, and render the events as a per-tick, per-phase story: timer
+fires, vote exchanges with grant/reject reasons, append outcomes, commit
+advances.
+
+    python -m raft_kotlin_tpu explain --groups 64 --nodes 5 --p-drop 0.2 \
+        --stress 10 --group 3 --ticks 40..80
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import List, Optional, TextIO
+
+from raft_kotlin_tpu.models.oracle import (
+    OracleGroup,
+    make_edge_ok_fn,
+    make_faults_fn,
+)
+from raft_kotlin_tpu.utils.config import RaftConfig
+
+
+def replay_events(cfg: RaftConfig, group: int, until: int,
+                  schedule=None, fault_schedule=None) -> List[dict]:
+    """Replay `group` for `until` ticks on the oracle with the event sink on;
+    returns the flat event list (each event carries its tick). `schedule` /
+    `fault_schedule` mirror OracleGroup.inject/crash/restart pre-loads:
+    {tick: [(node, cmd)]} / {tick: [(node, "crash"|"restart")]}."""
+    grp = OracleGroup(cfg, group)
+    grp.events = []
+    if schedule:
+        for t, items in schedule.items():
+            for node, cmd in items:
+                grp.inject(t, node, cmd)
+    if fault_schedule:
+        for t, items in fault_schedule.items():
+            for node, kind in items:
+                (grp.crash if kind == "crash" else grp.restart)(t, node)
+    grp.run(until, make_edge_ok_fn(cfg, group), make_faults_fn(cfg, group),
+            trace=False)
+    return grp.events
+
+
+def _vote_reason(e: dict) -> str:
+    """Derive the grant/reject reason from the §6.1 decision table
+    (reference RaftServer.kt:228-251) using the peer's pre-state carried on the
+    event — presentation only; the decision itself was made by vote_handler."""
+    rt, pt = e["req_term"], e["peer_pre_term"]
+    if rt < pt:
+        return f"stale term {rt} < {pt}"
+    if rt == pt:
+        if e["granted"]:
+            return f"equal term, votedFor already {e['cand']} (quirk g)"
+        return f"equal term, votedFor={e['peer_pre_voted_for']} != {e['cand']}"
+    lli, llt = e["peer_pre_lli"], e["peer_pre_llt"]
+    if e["granted"]:
+        return f"higher term, log ok -> peer adopts term {rt}"
+    if lli >= 1 and e["req_llt"] < llt:
+        return f"higher term but log stale (llt {e['req_llt']} < {llt}; no adopt, quirk f)"
+    return (f"higher term but log short (lli {e['req_lli']} < {lli}; "
+            "no adopt, quirk f)")
+
+
+def format_event(e: dict) -> str:
+    t, ph, k = e["tick"], e["phase"], e["kind"]
+    head = f"[t={t:>5} p{ph}] "
+    if k == "crash":
+        return head + f"n{e['node']} CRASH ({e['via']})"
+    if k == "restart":
+        return head + (f"n{e['node']} RESTART ({e['via']}): state wiped "
+                       f"(quirk l), timer re-armed ({e['el_left']} ticks)")
+    if k == "command":
+        got = "accepted" if e["accepted"] else "REJECTED (log full)"
+        return head + (f"n{e['node']} local write cmd={e['cmd']} at index "
+                       f"{e['at']} term {e['term']} ({e['via']}): {got}")
+    if k == "election_timeout":
+        return head + (f"n{e['node']} election timer fired -> CANDIDATE "
+                       f"(term {e['term']})")
+    if k == "backoff_expired":
+        return head + f"n{e['node']} backoff expired, new round next"
+    if k == "round_start":
+        return head + (f"n{e['node']} starts vote round #{e['round']} at term "
+                       f"{e['term']} (votedFor=self)")
+    if k == "demoted_timer_reset":
+        return head + (f"n{e['node']} no longer CANDIDATE; while-loop exits, "
+                       f"timer reset ({e['el_left']} ticks)")
+    if k == "vote_sent":
+        return head + (f"n{e['cand']} -> n{e['peer']} RequestVote(term="
+                       f"{e['req_term']}) in flight, due in {e['due']}")
+    if k == "vote_dropped":
+        return head + (f"n{e['cand']} <- n{e['peer']} vote response LOST "
+                       f"(edge down)")
+    if k == "vote_straggler":
+        return head + (f"n{e['cand']} <- n{e['peer']} vote response arrived "
+                       f"after round closed: peer mutated, tally unchanged")
+    if k == "vote":
+        verdict = "GRANTED" if e["granted"] else "rejected"
+        s = head + (f"n{e['cand']} <-> n{e['peer']} Vote(term={e['req_term']}, "
+                    f"lli={e['req_lli']}, llt={e['req_llt']}): {verdict} "
+                    f"({_vote_reason(e)}); votes={e['cand_votes']}/"
+                    f"{e['cand_responses']} responses")
+        if e["cand_demoted"]:
+            s += f"; candidate demoted by resp term {e['resp_term']} (quirk f)"
+        return s
+    if k == "won_election":
+        return head + (f"n{e['node']} WINS term {e['term']} with {e['votes']}/"
+                       f"{e['responses']} votes -> LEADER; nextIndex[*]="
+                       f"{e['next_index']} (quirk b), heartbeat armed")
+    if k == "lost_round":
+        why = "latch timed out" if e["timed_out"] else "majority responded, too few grants"
+        return head + (f"n{e['node']} loses round at term {e['term']} "
+                       f"({e['votes']}/{e['responses']} votes; {why}); "
+                       f"backoff {e['backoff']} ticks")
+    if k == "concluded_demoted":
+        return head + (f"n{e['node']} round concluded while demoted; timer "
+                       f"reset ({e['el_left']} ticks)")
+    if k == "heartbeat":
+        s = head + f"n{e['leader']} heartbeat fires (term {e['term']})"
+        if e["final"]:
+            s += " — FINAL round (cancelled as FOLLOWER, RaftServer.kt:117)"
+        return s
+    if k == "append_sent":
+        what = f"entry {e['entry']}" if e["entry"] else "empty (pure heartbeat)"
+        return head + (f"n{e['leader']} -> n{e['peer']} Append(pli={e['pli']}, "
+                       f"{what}) in flight, due in {e['due']}")
+    if k == "append_dropped":
+        return head + f"n{e['leader']} x n{e['peer']} append exchange dropped"
+    if k == "skip_peer":
+        return head + (f"n{e['leader']} skips n{e['peer']}: {e['reason']} "
+                       f"(nextIndex={e['next_index']}, quirk i)")
+    if k == "leader_demoted":
+        return head + (f"n{e['leader']} demoted by append response term "
+                       f"{e['resp_term']} from n{e['peer']} -> FOLLOWER")
+    if k == "append":
+        what = f"entry {e['entry']}" if e["entry"] else "heartbeat"
+        s = head + (f"n{e['leader']} -> n{e['peer']} Append(pli={e['pli']}, "
+                    f"plt={e['plt']}, {what}): "
+                    f"{'success' if e['success'] else 'FAIL'}; "
+                    f"nextIndex={e['next_index']}, matchIndex={e['match_index']}")
+        pc0, pc1 = e["peer_commit"]
+        if pc1 != pc0:
+            s += f"; peer commit {pc0}->{pc1} (quirk e)"
+        lc0, lc1 = e["leader_commit"]
+        if lc1 != lc0:
+            s += f"; LEADER COMMIT {lc0}->{lc1} (quirk a)"
+        return s
+    return head + str({k2: v for k2, v in e.items() if k2 not in ("tick", "phase")})
+
+
+def explain(cfg: RaftConfig, group: int, tick_lo: int, tick_hi: int,
+            out: Optional[TextIO] = None, schedule=None,
+            fault_schedule=None) -> List[dict]:
+    """Replay and print the [tick_lo, tick_hi] event narrative of one group.
+    Returns the events in the window (all phases, oracle order — which IS the
+    canonical serialization the kernel implements)."""
+    out = out or sys.stdout
+    events = replay_events(cfg, group, tick_hi + 1, schedule, fault_schedule)
+    window = [e for e in events if tick_lo <= e["tick"] <= tick_hi]
+    print(f"# group {group}, ticks {tick_lo}..{tick_hi}: "
+          f"{len(window)} events (seed {cfg.seed})", file=out)
+    for e in window:
+        print(format_event(e), file=out)
+    return window

@@ -75,10 +75,19 @@ class Simulator:
         self._tick = jax.jit(tick)
         if auto and impl == "pallas":
             # choose_impl validates tile construction only; Mosaic compiles lazily
-            # at the first step. Warm up on the boot state (result discarded) so a
-            # config passing the VMEM heuristic but rejected by Mosaic falls back
-            # to the XLA tick here instead of crashing the first real step.
+            # at the first step. Warm up the WORST-CASE variant — inject AND
+            # fault_cmd present, which compiles the kernel with the most aux
+            # inputs (the largest VMEM stack) — so a config passing the VMEM
+            # heuristic but rejected by Mosaic falls back to the XLA tick here
+            # instead of crashing at the first /cmd or crash()/restart() (the
+            # bare variant is a subset and also warmed; results discarded).
             try:
+                no_cmd = jnp.full((cfg.n_groups, cfg.n_nodes), _NO_CMD,
+                                  dtype=jnp.int32)
+                no_fault = jnp.zeros((cfg.n_groups, cfg.n_nodes), dtype=jnp.int32)
+                jax.block_until_ready(
+                    self._tick(self._state, no_cmd, no_fault,
+                               rng=self._rng).term)
                 jax.block_until_ready(
                     self._tick(self._state, rng=self._rng).term)
             except Exception:

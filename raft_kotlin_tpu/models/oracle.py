@@ -259,6 +259,12 @@ class OracleGroup:
             OracleNode(i + 1, group, cfg, draws[i]) for i in range(cfg.n_nodes)
         ]
         self.tick_count = 0
+        # Optional event sink (api/explain.py): when not None, tick() appends a
+        # dict per protocol event — the rebuild's answer to the reference's
+        # per-exchange log trail (RaftServer.kt:56,110,134-135 kLogger.info on
+        # every vote/append + the println of per-peer append state). Pure
+        # observation; never alters semantics.
+        self.events: Optional[list] = None
         # Persistent directed-link health (SEMANTICS.md §9); [s-1][r-1].
         self.link_up = [[True] * cfg.n_nodes for _ in range(cfg.n_nodes)]
         # External command schedule: {tick: [(node_id, cmd), ...]}
@@ -286,6 +292,17 @@ class OracleGroup:
         t = self.tick_count
         nodes = self.nodes
 
+        # Event-sink guard: call sites are written `ev and emit(...)` so the
+        # kwargs payloads (dict + pre-state tuples per exchange) are never even
+        # CONSTRUCTED on the hot differential path — the suite replays every
+        # group with the sink off, and unconditional payload building costs
+        # ~10x oracle throughput.
+        ev = self.events is not None
+
+        def emit(phase: str, kind: str, **kw) -> bool:
+            self.events.append({"tick": t, "phase": phase, "kind": kind, **kw})
+            return True
+
         def ok(s: int, r: int) -> bool:
             # §9 effective edge health: iid survival ∧ link health ∧ both ends up.
             if not (nodes[s - 1].up and nodes[r - 1].up and self.link_up[s - 1][r - 1]):
@@ -304,8 +321,12 @@ class OracleGroup:
                 cmd = cmds.get(n.id)
                 if was_up[n.id - 1] and (crash_m or cmd == "crash"):
                     n.up = False
+                    ev and emit("F", "crash", node=n.id,
+                         via="driver" if cmd == "crash" else "random")
                 elif not was_up[n.id - 1] and (restart_m or cmd == "restart"):
                     n.restart()
+                    ev and emit("F", "restart", node=n.id, el_left=n.el_left,
+                         via="driver" if cmd == "restart" else "random")
         if faults is not None:
             for si in range(cfg.n_nodes):
                 for ri in range(cfg.n_nodes):
@@ -318,11 +339,17 @@ class OracleGroup:
         if cfg.cmd_period > 0 and t % cfg.cmd_period == 0 and t > 0:
             n = nodes[cfg.cmd_node - 1]
             if n.up:
-                n.log.add(n.log.last_index, n.term, t)
+                at = n.log.last_index
+                added = n.log.add(at, n.term, t)
+                ev and emit("0", "command", node=n.id, cmd=t, term=n.term, at=at,
+                     accepted=added, via="workload")
         for node_id, cmd in self.schedule.get(t, []):
             n = nodes[node_id - 1]
             if n.up:
-                n.log.add(n.log.last_index, n.term, cmd)
+                at = n.log.last_index
+                added = n.log.add(at, n.term, cmd)
+                ev and emit("0", "command", node=n.id, cmd=cmd, term=n.term, at=at,
+                     accepted=added, via="driver")
 
         # Phase 1 — timers. The two countdowns are independent: a demoted backing-off
         # candidate has an armed election timer AND a live delay() (SEMANTICS.md §5).
@@ -336,11 +363,13 @@ class OracleGroup:
                     n.el_armed = False
                     n.role = CANDIDATE  # timer action ignores current role
                     start_round[n.id - 1] = True
+                    ev and emit("1", "election_timeout", node=n.id, term=n.term)
             if n.round_state == BACKOFF:
                 n.bo_left -= 1
                 if n.bo_left <= 0:
                     n.round_state = IDLE
                     start_round[n.id - 1] = True
+                    ev and emit("1", "backoff_expired", node=n.id, term=n.term)
 
         # Phase 2 — round starts.
         for n in nodes:
@@ -356,11 +385,13 @@ class OracleGroup:
                 n.round_age = 0
                 n.round_state = ACTIVE
                 n.rounds += 1
+                ev and emit("2", "round_start", node=n.id, term=n.term, round=n.rounds)
             else:
                 # Demoted while backing off: while(state==CANDIDATE) exits,
                 # channel.send(FOLLOWER) resets the timer (RaftServer.kt:225).
                 n.round_state = IDLE
                 n.reset_election_timer()
+                ev and emit("2", "demoted_timer_reset", node=n.id, el_left=n.el_left)
 
         # Phase 3 — vote exchanges.
         mailbox = cfg.uses_mailbox
@@ -376,10 +407,16 @@ class OracleGroup:
                     return
                 c.vq[p.id - 1] = None
                 if not ok(p.id, c.id):
+                    ev and emit("3", "vote_dropped", cand=c.id, peer=p.id,
+                         req_term=slot["term"])
                     return
                 req = VoteReq(slot["term"], c.id, slot["lli"], slot["llt"])
+                pre = (p.term, p.voted_for, p.log.last_index,
+                       p.last_log_term()) if ev else None
                 resp_term, granted = vote_handler(p, req)
                 if not (c.round_state == ACTIVE and c.rounds == slot["round"]):
+                    ev and emit("3", "vote_straggler", cand=c.id, peer=p.id,
+                         req_term=req.term, granted=granted, resp_term=resp_term)
                     return  # straggler: p mutated, candidate never sees it
                 c.responded[p.id - 1] = True
                 c.responses += 1
@@ -387,6 +424,13 @@ class OracleGroup:
                     c.role = FOLLOWER  # quirk f (live term, RaftServer.kt:210)
                 if granted:
                     c.votes += 1
+                ev and emit("3", "vote", cand=c.id, peer=p.id, req_term=req.term,
+                     req_lli=req.last_log_index, req_llt=req.last_log_term,
+                     granted=granted, resp_term=resp_term,
+                     peer_pre_term=pre[0], peer_pre_voted_for=pre[1],
+                     peer_pre_lli=pre[2], peer_pre_llt=pre[3],
+                     cand_votes=c.votes, cand_responses=c.responses,
+                     cand_demoted=resp_term > c.term)
 
             for c in nodes:
                 attempting = (c.round_state == ACTIVE
@@ -400,6 +444,8 @@ class OracleGroup:
                             "lli": c.log.last_index, "llt": c.last_log_term(),
                             "round": c.rounds,
                         }
+                        ev and emit("3", "vote_sent", cand=c.id, peer=p.id,
+                             req_term=c.term, due=c.vq[p.id - 1]["due"])
                     if cfg.delay_lo == 0:
                         vote_deliver(c, p)  # τ=0: same-iteration delivery
         else:
@@ -414,6 +460,8 @@ class OracleGroup:
                     if not (ok(c.id, p.id) and ok(p.id, c.id)):
                         continue
                     req = VoteReq(c.term, c.id, c.log.last_index, c.last_log_term())
+                    pre = (p.term, p.voted_for, p.log.last_index,
+                           p.last_log_term()) if ev else None
                     resp_term, granted = vote_handler(p, req)
                     c.responded[p.id - 1] = True
                     c.responses += 1
@@ -421,6 +469,13 @@ class OracleGroup:
                         c.role = FOLLOWER  # quirk f: term not adopted (RaftServer.kt:210)
                     if granted:
                         c.votes += 1
+                    ev and emit("3", "vote", cand=c.id, peer=p.id, req_term=req.term,
+                         req_lli=req.last_log_index, req_llt=req.last_log_term,
+                         granted=granted, resp_term=resp_term,
+                         peer_pre_term=pre[0], peer_pre_voted_for=pre[1],
+                         peer_pre_lli=pre[2], peer_pre_llt=pre[3],
+                         cand_votes=c.votes, cand_responses=c.responses,
+                         cand_demoted=resp_term > c.term)
 
         # Phase 4 — round conclusions.
         for n in nodes:
@@ -434,12 +489,21 @@ class OracleGroup:
                     n.hb_armed = True
                     n.hb_left = 0  # fixedRateTimer initial delay 0: fires this tick
                     n.round_state = IDLE
+                    ev and emit("4", "won_election", node=n.id, term=n.term,
+                         votes=n.votes, responses=n.responses,
+                         next_index=n.commit + 1)
                 elif n.role == CANDIDATE:
                     n.round_state = BACKOFF
                     n.bo_left = n._draw_backoff()
+                    ev and emit("4", "lost_round", node=n.id, term=n.term,
+                         votes=n.votes, responses=n.responses,
+                         backoff=n.bo_left,
+                         timed_out=n.responses < cfg.majority)
                 else:
                     n.round_state = IDLE
                     n.reset_election_timer()
+                    ev and emit("4", "concluded_demoted", node=n.id,
+                         el_left=n.el_left)
             else:
                 n.round_left -= 1
                 n.round_age += 1
@@ -454,14 +518,19 @@ class OracleGroup:
                     return
                 l.aq[p.id - 1] = None
                 if not ok(p.id, l.id):
+                    ev and emit("5", "append_dropped", leader=l.id, peer=p.id)
                     return
                 req = AppendReq(slot["term"], l.id, slot["pli"], slot["plt"],
                                 slot["entry"], slot["commit"])
+                p_pre_commit = p.commit
+                l_pre_commit = l.commit
                 resp_term, success = append_handler(p, req)
                 if resp_term > l.term:
                     l.term = resp_term
                     l.role = FOLLOWER
                     l.reset_election_timer()
+                    ev and emit("5", "leader_demoted", leader=l.id, peer=p.id,
+                         resp_term=resp_term)
                     return  # return@launch
                 if success:
                     if slot["entry"] is not None:
@@ -473,6 +542,13 @@ class OracleGroup:
                         l.match_index[p.id - 1] = slot["pli"] + 1  # quirk h
                 else:
                     l.next_index[p.id - 1] -= 1  # quirk i
+                ev and emit("5", "append", leader=l.id, peer=p.id,
+                     pli=req.prev_log_index, plt=req.prev_log_term,
+                     entry=req.entry, success=success,
+                     peer_commit=(p_pre_commit, p.commit),
+                     leader_commit=(l_pre_commit, l.commit),
+                     next_index=l.next_index[p.id - 1],
+                     match_index=l.match_index[p.id - 1])
 
             for l in nodes:
                 fire = False
@@ -481,6 +557,8 @@ class OracleGroup:
                         l.hb_left -= 1
                     else:
                         fire = True
+                        ev and emit("5", "heartbeat", leader=l.id, term=l.term,
+                             final=l.role == FOLLOWER)
                         if l.role == FOLLOWER:
                             l.hb_armed = False  # cancel() stops FUTURE firings only
                         else:
@@ -500,18 +578,25 @@ class OracleGroup:
                                 plt = l.log.get_term(pli)
                             else:
                                 skip = True  # exception -> skip peer
+                                ev and emit("5", "skip_peer", leader=l.id, peer=p.id,
+                                     reason="prev_log_invalid", next_index=i)
                         entry = None
                         if not skip and l.log.last_index >= i:
                             if l.log.valid(i - 1):
                                 entry = (l.log.get_term(i - 1), l.log.get_cmd(i - 1))
                             else:
                                 skip = True  # quirk i underflow
+                                ev and emit("5", "skip_peer", leader=l.id, peer=p.id,
+                                     reason="next_index_underflow", next_index=i)
                         if not skip and ok(l.id, p.id):  # request leg
                             l.aq[p.id - 1] = {
                                 "due": delay_of(l.id, p.id), "term": l.term,
                                 "pli": pli, "plt": plt, "entry": entry,
                                 "commit": l.commit,
                             }
+                            ev and emit("5", "append_sent", leader=l.id, peer=p.id,
+                                 pli=pli, entry=entry,
+                                 due=l.aq[p.id - 1]["due"])
                     if cfg.delay_lo == 0:
                         append_deliver(l, p)  # τ=0: same-iteration delivery
 
@@ -534,11 +619,15 @@ class OracleGroup:
                     l.hb_armed = False
                 else:
                     l.hb_left = cfg.hb_ticks - 1
+                ev and emit("5", "heartbeat", leader=l.id, term=l.term,
+                     final=not l.hb_armed)
                 for p in nodes:
                     i = l.next_index[p.id - 1]
                     prev_log_index = i - 2
                     if prev_log_index >= 0:
                         if not l.log.valid(prev_log_index):
+                            ev and emit("5", "skip_peer", leader=l.id, peer=p.id,
+                                 reason="prev_log_invalid", next_index=i)
                             continue  # exception -> skip peer (RaftServer.kt:170)
                         prev_log_term = l.log.get_term(prev_log_index)
                     else:
@@ -546,16 +635,23 @@ class OracleGroup:
                     entry = None
                     if l.log.last_index >= i:
                         if not l.log.valid(i - 1):
+                            ev and emit("5", "skip_peer", leader=l.id, peer=p.id,
+                                 reason="next_index_underflow", next_index=i)
                             continue  # quirk i: nextIndex underflow -> skip peer
                         entry = (l.log.get_term(i - 1), l.log.get_cmd(i - 1))
                     if not (ok(l.id, p.id) and ok(p.id, l.id)):
+                        ev and emit("5", "append_dropped", leader=l.id, peer=p.id)
                         continue  # dropped exchange, exception swallowed
                     req = AppendReq(l.term, l.id, prev_log_index, prev_log_term, entry, l.commit)
+                    p_pre_commit = p.commit
+                    l_pre_commit = l.commit
                     resp_term, success = append_handler(p, req)
                     if resp_term > l.term:
                         l.term = resp_term
                         l.role = FOLLOWER
                         l.reset_election_timer()  # channel.offer(FOLLOWER) [canon]
+                        ev and emit("5", "leader_demoted", leader=l.id, peer=p.id,
+                             resp_term=resp_term)
                         continue  # return@launch: skip success processing for this peer
                     if success:
                         if entry is not None:
@@ -567,6 +663,13 @@ class OracleGroup:
                             l.match_index[p.id - 1] = prev_log_index + 1  # quirk h
                     else:
                         l.next_index[p.id - 1] -= 1  # quirk i: may underflow
+                    ev and emit("5", "append", leader=l.id, peer=p.id,
+                         pli=req.prev_log_index, plt=req.prev_log_term,
+                         entry=req.entry, success=success,
+                         peer_commit=(p_pre_commit, p.commit),
+                         leader_commit=(l_pre_commit, l.commit),
+                         next_index=l.next_index[p.id - 1],
+                         match_index=l.match_index[p.id - 1])
 
         self.tick_count += 1
 

@@ -143,7 +143,17 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
     """
     N, C, maj = cfg.n_nodes, cfg.log_capacity, cfg.majority
     G = s["term"].shape[-1]
-    logrow = jax.lax.broadcasted_iota(_I32, (N * C, G), 0)
+    logrow_c = jax.lax.broadcasted_iota(_I32, (C, G), 0)
+
+    # Logs live as PER-NODE (C, G) slices for the duration of the phase
+    # lattice (static slices of the flat (N*C, G) layout — free in XLA,
+    # supported value ops in Mosaic). Every one-hot log op then touches C rows
+    # instead of N*C — an Nx cut in the dominant VPU cost of the tick (the
+    # r01/r02 headline was VPU-bound at ~0.1 of HBM peak) — and an
+    # out-of-range index structurally CANNOT alias another node's rows: it
+    # simply matches nothing in [0, C).
+    lt = [s["log_term"][n * C:(n + 1) * C] for n in range(N)]
+    lc = [s["log_cmd"][n * C:(n + 1) * C] for n in range(N)]
 
     def pair(a, b):
         # Flat pair-grid row for (owner a, peer b), both 1-based.
@@ -157,26 +167,43 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
         s[name] = _set_row(s[name], n - 1, jnp.where(mask, vals, cur))
 
     if flags.dyn_log:
+        def _gather1(arr, idx):
+            v = jnp.take_along_axis(
+                arr, jnp.clip(idx, 0, C - 1)[None, :], axis=0)[0]
+            return jnp.where((idx >= 0) & (idx < C), v, 0).astype(_I32)
+
         def log_gather(name, n, idx):
             # (G,) read of node n's physical slot idx via a per-lane dynamic
-            # gather on the flat (N*C, G) log; 0 where idx is out of [0, C).
-            rows = (n - 1) * C + jnp.clip(idx, 0, C - 1)
-            v = jnp.take_along_axis(s[name], rows[None, :], axis=0)[0]
-            return jnp.where((idx >= 0) & (idx < C), v, 0).astype(_I32)
+            # gather on its (C, G) log; 0 where idx is out of [0, C).
+            return _gather1((lt if name == "log_term" else lc)[n - 1], idx)
+
+        def log_gather_tc(n, idx):
+            # (term, cmd) at one slot, sharing the clip/bounds work.
+            rows = jnp.clip(idx, 0, C - 1)[None, :]
+            ok = (idx >= 0) & (idx < C)
+            tv = jnp.take_along_axis(lt[n - 1], rows, axis=0)[0]
+            cv = jnp.take_along_axis(lc[n - 1], rows, axis=0)[0]
+            return (jnp.where(ok, tv, 0).astype(_I32),
+                    jnp.where(ok, cv, 0).astype(_I32))
     else:
-        def log_gather(name, n, idx):
-            # (G,) read of node n's physical slot idx, as a one-hot contraction
-            # over the flat (N*C, G) log (no gather op — the Mosaic-compatible
-            # form); 0 where idx is out of [0, C). The bounds terms make that
-            # guarantee real: without them an out-of-range idx in the flat
-            # layout would alias an ADJACENT node's row (idx=-1 -> node n-1
-            # slot C-1; idx=C -> node n+1 slot 0).
-            oh = (logrow == ((n - 1) * C + idx)[None, :]) \
-                & ((idx >= 0) & (idx < C))[None, :]
+        def _gather1(arr, idx):
+            # One-hot contraction over (C, G) (no gather op — the
+            # Mosaic-compatible form). An out-of-range idx matches no row, so
+            # the 0-outside-[0,C) guarantee needs no explicit bounds term.
+            oh = logrow_c == idx[None, :]
             # Widen at read: log storage may be int16 (cfg.log_dtype); the
             # one-hot sum has at most one nonzero per column, so summing in the
             # narrow dtype cannot overflow before the cast.
-            return jnp.sum(jnp.where(oh, s[name], 0), axis=0).astype(_I32)
+            return jnp.sum(jnp.where(oh, arr, 0), axis=0).astype(_I32)
+
+        def log_gather(name, n, idx):
+            return _gather1((lt if name == "log_term" else lc)[n - 1], idx)
+
+        def log_gather_tc(n, idx):
+            # (term, cmd) at one slot, sharing the one-hot mask.
+            oh = logrow_c == idx[None, :]
+            return (jnp.sum(jnp.where(oh, lt[n - 1], 0), axis=0).astype(_I32),
+                    jnp.sum(jnp.where(oh, lc[n - 1], 0), axis=0).astype(_I32))
 
     def log_add(n, i, term_v, cmd_v, mask):
         # SEMANTICS.md §3 add(): physical append / reject / overwrite-truncate.
@@ -187,22 +214,22 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
         app = mask & (i == li) & (pl < C)
         ovw = mask & (i < li) & (i >= 0)
         wr = app | ovw
-        ldt = s["log_term"].dtype  # narrow at write (cfg.log_dtype)
+        ldt = lt[0].dtype  # narrow at write (cfg.log_dtype)
+        slot = jnp.where(app, pl, i)
         if flags.dyn_log:
             # Masked read-modify-write of one slot per lane (scatter form).
-            rows = ((n - 1) * C
-                    + jnp.clip(jnp.where(app, pl, i), 0, C - 1))[None, :]
-            for name, v in (("log_term", term_v), ("log_cmd", cmd_v)):
-                cur = jnp.take_along_axis(s[name], rows, axis=0)
+            rows = jnp.clip(slot, 0, C - 1)[None, :]
+            for store, v in ((lt, term_v), (lc, cmd_v)):
+                cur = jnp.take_along_axis(store[n - 1], rows, axis=0)
                 new = jnp.where(wr[None, :], v.astype(ldt)[None, :], cur)
-                s[name] = jnp.put_along_axis(
-                    s[name], rows, new, axis=0, inplace=False)
+                store[n - 1] = jnp.put_along_axis(
+                    store[n - 1], rows, new, axis=0, inplace=False)
         else:
-            # One-hot masked write over the flat log (Mosaic-compatible form).
-            slot = (n - 1) * C + jnp.where(app, pl, i)
-            oh = (logrow == slot[None, :]) & wr[None, :]
-            s["log_term"] = jnp.where(oh, term_v.astype(ldt)[None, :], s["log_term"])
-            s["log_cmd"] = jnp.where(oh, cmd_v.astype(ldt)[None, :], s["log_cmd"])
+            # One-hot masked write over the (C, G) log (Mosaic-compatible
+            # form); term and cmd share the mask.
+            oh = (logrow_c == slot[None, :]) & wr[None, :]
+            lt[n - 1] = jnp.where(oh, term_v.astype(ldt)[None, :], lt[n - 1])
+            lc[n - 1] = jnp.where(oh, cmd_v.astype(ldt)[None, :], lc[n - 1])
         setcol("last_index", n, wr, jnp.where(app, li + 1, i + 1))
         setcol("phys_len", n, app, pl + 1)
 
@@ -328,6 +355,16 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
 
     # -- phase 3: vote exchanges --------------------------------------------
 
+    # Hoisted per-node last-log position/term: INVARIANT across phase 3 (no
+    # vote path touches logs or last_index), so the N*N pairs share N gathers
+    # instead of recomputing one per pair. llt_h[n-1] is 0 when the log is
+    # empty (a gather at -1 matches no row), which is exactly the request
+    # convention (lastLogTerm 0 on an empty log) AND the handler's
+    # up-to-dateness input (rej_* are guarded by p_li >= 1).
+    lli_h = [col("last_index", n) for n in range(1, N + 1)]
+    llt_h = [log_gather("log_term", n, lli_h[n - 1] - 1)
+             for n in range(1, N + 1)]
+
     def delay_for(a, b):
         # §10 per-pair send delay this tick (static constant when lo == hi).
         if cfg.delay_lo == cfg.delay_hi:
@@ -346,8 +383,8 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
         alone."""
         p_term = col("term", p)
         p_vf = col("voted_for", p)
-        p_li = col("last_index", p)
-        p_llt = log_gather("log_term", p, p_li - 1)
+        p_li = lli_h[p - 1]
+        p_llt = llt_h[p - 1]
         rej_stale = (p_li >= 1) & (req_llt < p_llt)
         rej_short = (p_li >= 1) & (req_llt == p_llt) & (req_lli < p_li)
         grant_gt = (req_term > p_term) & ~rej_stale & ~rej_short
@@ -400,11 +437,9 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
                     & (s["responded"][pair(c, p)] == 0)
                     & edge_ok(c, p)  # request leg at the send tick
                 )
-                c_li = col("last_index", c)
                 put_pair("vq_term", c, p, att, col("term", c))
-                put_pair("vq_lli", c, p, att, c_li)
-                put_pair("vq_llt", c, p, att,
-                         jnp.where(c_li == 0, 0, log_gather("log_term", c, c_li - 1)))
+                put_pair("vq_lli", c, p, att, lli_h[c - 1])
+                put_pair("vq_llt", c, p, att, llt_h[c - 1])
                 put_pair("vq_round", c, p, att, col("rounds", c))
                 put_pair("vq_due", c, p, att, delay_for(c, p))
                 if cfg.delay_lo == 0:
@@ -416,11 +451,12 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
                     & edge_ok(c, p)
                     & edge_ok(p, c)
                 )
-                # Request built from c's live state (RaftServer.kt:200-207).
-                c_li = col("last_index", c)
-                c_llt = jnp.where(c_li == 0, 0, log_gather("log_term", c, c_li - 1))
+                # Request built from c's live state (RaftServer.kt:200-207);
+                # the log fields come from the hoisted per-node snapshot
+                # (invariant in phase 3).
                 true_g = jnp.ones((G,), dtype=bool)
-                vote_exchange(c, p, att, col("term", c), c_li, c_llt, true_g)
+                vote_exchange(c, p, att, col("term", c),
+                              lli_h[c - 1], llt_h[c - 1], true_g)
 
     # -- phase 4: round conclusions -----------------------------------------
 
@@ -542,8 +578,7 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
             plt = jnp.where(pli >= 0, log_gather("log_term", l, pli), -1)
             has_entry = li_l >= i
             skip = skip | (has_entry & (i <= 0))  # quirk i underflow
-            ent_t = log_gather("log_term", l, i - 1)
-            ent_c = log_gather("log_cmd", l, i - 1)
+            ent_t, ent_c = log_gather_tc(l, i - 1)
             if flags.delay:
                 att = fire & ~skip & edge_ok(l, p)  # request leg at send tick
                 put_pair("aq_term", l, p, att, col("term", l))
@@ -568,6 +603,10 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
         for name in ("vq_due", "aq_due"):
             d = s[name]
             s[name] = d - (d > 0).astype(_I32)
+
+    # Rejoin the per-node log slices into the flat (N*C, G) layout.
+    s["log_term"] = jnp.concatenate(lt, axis=0)
+    s["log_cmd"] = jnp.concatenate(lc, axis=0)
 
     return aux_dirty["m"]
 

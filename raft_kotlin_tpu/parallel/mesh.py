@@ -179,11 +179,16 @@ def make_sharded_run(cfg: RaftConfig, mesh: Mesh, n_ticks: int,
                      metrics_every: int = 0, impl: str = "xla"):
     """Compile run(state [, inject]) -> (state, metrics) sharded over `mesh`.
 
-    metrics: dict of per-tick cross-group reductions, each a (n_ticks,) array —
-    `leaders` (groups with ≥1 leader), `elections` (nodes entering CANDIDATE round),
-    `commit_total` (sum over groups of max node commit). These are the only
-    cross-device ops (XLA inserts the reductions over ICI/DCN); set metrics_every=0
-    to keep even those out and return state only.
+    metrics: dict of cross-group reductions emitted every `metrics_every` ticks
+    — each a (n_ticks // metrics_every,) array with one row per window:
+    `leaders` (groups with ≥1 leader, sampled at the window's last tick),
+    `elections` (vote-round starts summed over the window — the rounds-delta
+    telescopes, so no per-tick accumulator is carried), `commit_total` (sum
+    over groups of max node commit, sampled at the window's last tick). These
+    are the only cross-device ops (XLA inserts the reductions over ICI/DCN).
+    metrics_every=0 keeps even those out and returns (state, None);
+    metrics_every=1 is the dense per-tick trace. Trailing n_ticks %
+    metrics_every ticks still run, after the last emitted row.
 
     impl: "xla" (default — the SPMD partitioner splits the tick shard-locally) or
     "pallas" (the megakernel per shard via shard_map).
@@ -204,30 +209,36 @@ def make_sharded_run(cfg: RaftConfig, mesh: Mesh, n_ticks: int,
     keys_sh = NamedSharding(mesh, P(None, ("dcn", "ici")))
     rng_sh = (rep, keys_sh, keys_sh)
 
-    def body(st, rng, _):
-        prev_rounds = st.rounds
-        st = tick_fn(st, rng)
-        if metrics_every:
-            out = {
-                "leaders": jnp.sum(
-                    jnp.any((st.role == LEADER) & st.up, axis=0).astype(jnp.int32)
-                ),
-                # Elections = vote-round starts (rounds-delta) — the ONE canonical
-                # definition, shared with utils.metrics.tick_metrics and bench.py.
-                # (Role-transition counting would miss consecutive rounds by a node
-                # that stays CANDIDATE through backoff loops — the churn case.)
-                "elections": jnp.sum(st.rounds - prev_rounds),
-                "commit_total": jnp.sum(jnp.max(st.commit, axis=0).astype(jnp.int64)
-                                        if jax.config.jax_enable_x64
-                                        else jnp.max(st.commit, axis=0)),
-            }
-        else:
-            out = None
-        return st, out
+    def window_metrics(st, rounds0):
+        return {
+            "leaders": jnp.sum(
+                jnp.any((st.role == LEADER) & st.up, axis=0).astype(jnp.int32)
+            ),
+            # Elections = vote-round starts (rounds-delta) — the ONE canonical
+            # definition, shared with utils.metrics.tick_metrics and bench.py.
+            # (Role-transition counting would miss consecutive rounds by a node
+            # that stays CANDIDATE through backoff loops — the churn case.)
+            "elections": jnp.sum(st.rounds) - rounds0,
+            "commit_total": jnp.sum(jnp.max(st.commit, axis=0).astype(jnp.int64)
+                                    if jax.config.jax_enable_x64
+                                    else jnp.max(st.commit, axis=0)),
+        }
 
     def run(st, rng):
-        return jax.lax.scan(lambda s, x: body(s, rng, x), st, None,
-                            length=n_ticks)
+        one = lambda s, _: (tick_fn(s, rng), None)
+        if not metrics_every:
+            st, _ = jax.lax.scan(one, st, None, length=n_ticks)
+            return st, None
+
+        def win(st, _):
+            rounds0 = jnp.sum(st.rounds)
+            st, _ = jax.lax.scan(one, st, None, length=metrics_every)
+            return st, window_metrics(st, rounds0)
+
+        st, ms = jax.lax.scan(win, st, None, length=n_ticks // metrics_every)
+        if n_ticks % metrics_every:
+            st, _ = jax.lax.scan(one, st, None, length=n_ticks % metrics_every)
+        return st, ms
 
     jitted = jax.jit(run, in_shardings=(sh, rng_sh),
                      out_shardings=(sh, rep if metrics_every else None))

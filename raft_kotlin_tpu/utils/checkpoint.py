@@ -174,6 +174,13 @@ def load_sharded(
     Without `mesh`, assembles unsharded arrays on the default device."""
     with open(os.path.join(dirpath, "manifest.json")) as f:
         manifest = json.load(f)
+    version = int(manifest.get("version", 0))
+    if version != _VERSION:
+        # The sharded layout has only ever existed at the current version —
+        # fail loudly on future/corrupt manifests, mirroring _load_impl's gate.
+        raise ValueError(
+            f"sharded checkpoint version {version} not supported "
+            f"(this build reads exactly {_VERSION})")
     cfg = RaftConfig(**manifest["cfg"])
     if expect_cfg is not None and expect_cfg != cfg:
         raise ValueError(
@@ -232,8 +239,17 @@ def load_sharded(
         target = getattr(sh, name)
         full_shape = tuple(manifest["shapes"][name])
         if not full_shape:  # scalar (the tick counter, in every shard file)
-            fields[name] = jax.device_put(
-                shard_file(local_span_ks[0])[name], target)
+            # Assembled per ADDRESSABLE device: a device_put straight to the
+            # mesh-wide (replicated) sharding would raise on a multi-process
+            # mesh, where some of its devices belong to other processes.
+            val = np.asarray(shard_file(local_span_ks[0])[name])
+            singles = [
+                jax.device_put(val, dev)
+                for dev, _ in target.devices_indices_map(full_shape).items()
+                if dev.process_index == proc
+            ]
+            fields[name] = jax.make_array_from_single_device_arrays(
+                full_shape, target, singles)
             continue
         singles = []
         for dev, idx in target.devices_indices_map(full_shape).items():

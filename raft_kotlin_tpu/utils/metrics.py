@@ -104,6 +104,14 @@ def check_invariants(prev: RaftState, cur: RaftState, cfg: RaftConfig) -> Dict[s
                          last_index when advanced, and last_index only shrinks via
                          truncation which does not touch commit... truncation CAN
                          strand commit > last_index, so only nonnegativity is owed)
+    - int16_wrap:        (log_dtype="int16" runs only) values at or past the int16
+                         write boundary: source terms >= 32767 (the next log_add of
+                         that term narrows into wrap), stored log values pinned at
+                         32767, and NEGATIVE stored values (log terms/commands are
+                         nonnegative by construction in the int32 semantics, so a
+                         negative stored entry proves a wrap already happened).
+                         utils/config.py:28-34 documents the bound; this makes a
+                         deep-log soak fail loudly instead of corrupting silently.
 
     Note commit monotonicity is deliberately NOT here: quirk e
     (reference RaftServer.kt:270-272) computes min(leaderCommit, last_index), which
@@ -118,7 +126,18 @@ def check_invariants(prev: RaftState, cur: RaftState, cfg: RaftConfig) -> Dict[s
     resp_cnt = jnp.sum(cur.responded.astype(_I32), axis=1)
     in_round = cur.round_state == ACTIVE
     restarted = cur.up & ~prev.up
+    extra = {}
+    if cfg.log_dtype == "int16":
+        lim = jnp.int32(2 ** 15 - 1)
+        extra["int16_wrap"] = (
+            cnt(cur.term >= lim)
+            + cnt(cur.log_term.astype(_I32) < 0)
+            + cnt(cur.log_cmd.astype(_I32) < 0)
+            + cnt(cur.log_term.astype(_I32) == lim)
+            + cnt(cur.log_cmd.astype(_I32) == lim)
+        )
     return {
+        **extra,
         "term_monotone": cnt((cur.term < prev.term) & ~restarted),
         "log_window": cnt(
             (cur.last_index < 0)

@@ -1,0 +1,89 @@
+"""Explain mode (api/explain.py): the oracle-replay event narrative must be
+CONSISTENT with the kernel's own trace — the events are not just prose, they
+reconstruct the simulation. We replay one group, then rebuild its per-tick
+commit trace and election counts purely from the event stream and require them
+to bit-match the TPU kernel trace for the same config/seed."""
+
+import io
+
+import numpy as np
+
+from raft_kotlin_tpu.api.explain import explain, format_event, replay_events
+from raft_kotlin_tpu.constants import LEADER
+from raft_kotlin_tpu.models.state import init_state
+from raft_kotlin_tpu.ops.tick import make_run
+from raft_kotlin_tpu.utils.config import RaftConfig
+
+CFG = RaftConfig(n_groups=4, n_nodes=3, log_capacity=16, cmd_period=7,
+                 p_drop=0.1, p_crash=0.005, p_restart=0.05, seed=5).stressed(10)
+T = 80
+GROUP = 2
+
+
+def kernel_trace():
+    _, tr = make_run(CFG, T, trace=True)(init_state(CFG))
+    return {k: np.asarray(v) for k, v in tr.items()}  # (T, N, G)
+
+
+def test_events_reconstruct_kernel_commit_trace():
+    tr = kernel_trace()
+    events = replay_events(CFG, GROUP, T)
+    N = CFG.n_nodes
+    commit = np.zeros(N, dtype=np.int64)
+    by_tick = {}
+    for e in events:
+        by_tick.setdefault(e["tick"], []).append(e)
+    for t in range(T):
+        for e in by_tick.get(t, []):  # chronological == canonical phase order
+            if e["kind"] == "restart":
+                commit[e["node"] - 1] = 0
+            elif e["kind"] == "append":
+                commit[e["peer"] - 1] = e["peer_commit"][1]
+                commit[e["leader"] - 1] = e["leader_commit"][1]
+        assert np.array_equal(commit, tr["commit"][t, :, GROUP]), (
+            f"commit trace diverges from events at tick {t}")
+
+
+def test_events_match_kernel_elections_and_wins():
+    tr = kernel_trace()
+    events = replay_events(CFG, GROUP, T)
+    rounds = tr["rounds"][:, :, GROUP]  # (T, N)
+    prev = np.vstack([np.zeros((1, CFG.n_nodes), rounds.dtype), rounds[:-1]])
+    delta = (rounds - prev).sum(axis=1)
+    starts = np.zeros(T, dtype=np.int64)
+    role_touch = {}  # (tick, node) -> last role-affecting kind, in order
+    for e in events:
+        if e["kind"] == "round_start":
+            starts[e["tick"]] += 1
+        for node_key, kinds in (
+            ("node", ("election_timeout", "restart", "won_election")),
+            ("peer", ("append",)),      # quirk d: any foreign append -> FOLLOWER
+            ("cand", ("vote",)),        # quirk f demote rides the vote event
+            ("leader", ("leader_demoted",)),
+        ):
+            if e["kind"] in kinds and node_key in e:
+                if e["kind"] == "append" and e["leader"] == e["peer"]:
+                    continue  # self-append: leaderId == id exemption, no demote
+                role_touch[(e["tick"], e[node_key])] = e["kind"]
+    # Election counts: the event stream and the kernel agree per tick, exactly.
+    assert np.array_equal(starts, delta)
+    # A won_election with no later role-affecting event that tick implies the
+    # kernel sees LEADER in the post-tick trace.
+    for e in events:
+        if e["kind"] != "won_election":
+            continue
+        if role_touch[(e["tick"], e["node"])] == "won_election":
+            assert tr["role"][e["tick"], e["node"] - 1, GROUP] == LEADER
+
+
+def test_every_event_formats():
+    events = replay_events(CFG, GROUP, T)
+    assert len(events) > 50  # a fault-soup config generates a real narrative
+    for e in events:
+        line = format_event(e)
+        assert isinstance(line, str) and f"[t={e['tick']:>5}" in line
+    buf = io.StringIO()
+    window = explain(CFG, GROUP, 10, 30, out=buf)
+    text = buf.getvalue()
+    assert all(ev["tick"] >= 10 and ev["tick"] <= 30 for ev in window)
+    assert text.count("\n") == len(window) + 1  # header + one line per event

@@ -73,6 +73,34 @@ def test_invariants_catch_corruption():
     assert all(v == 0 for v in ok.values())
 
 
+def test_int16_wrap_watch():
+    # VERDICT r02 #5: with log_dtype="int16", values at/past the int16 write
+    # boundary must be counted by check_invariants so deep-log soaks fail
+    # loudly instead of silently corrupting (utils/config.py:28-34).
+    from raft_kotlin_tpu.ops.tick import make_tick
+
+    cfg = dataclasses.replace(CFG, log_dtype="int16", cmd_period=0)
+    st = init_state(cfg)
+    # Drive a REAL wrapped write through the kernel: inject a command whose
+    # value exceeds int16 range — phase 0's log_add narrows it to a negative
+    # stored value, which the watch counts as proof of wrap.
+    inject = np.full((cfg.n_groups, cfg.n_nodes), -1, dtype=np.int32)
+    inject[0, 0] = 2 ** 15 + 5
+    st2 = make_tick(cfg)(st, inject=np.asarray(inject))
+    viol = {k: int(np.asarray(v)) for k, v in check_invariants(st, st2, cfg).items()}
+    assert viol["int16_wrap"] > 0
+    # Terms at the boundary are flagged even before any log write.
+    hot = dataclasses.replace(st2, term=st2.term.at[0, 0].set(2 ** 15 - 1))
+    viol = {k: int(np.asarray(v)) for k, v in check_invariants(st2, hot, cfg).items()}
+    assert viol["int16_wrap"] > 0
+    # And an int32 run has no such key at all.
+    assert "int16_wrap" not in check_invariants(st, st2, CFG)
+    # A clean int16 run reports zero.
+    clean = make_tick(cfg)(st)
+    viol = {k: int(np.asarray(v)) for k, v in check_invariants(st, clean, cfg).items()}
+    assert viol["int16_wrap"] == 0
+
+
 def test_recorder_roundtrip(tmp_path):
     path = str(tmp_path / "metrics.jsonl")
     rec = MetricsRecorder(path)
