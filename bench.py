@@ -122,11 +122,13 @@ def measure(cfg, n_ticks, n_reps, impl_candidates, summarize=None):
 
 
 def median(xs):
-    """Lower-middle median: always an ELEMENT of xs (callers look up the rep's
-    stats via .index()), and for even rep counts picks the faster of the two
-    middle reps — never publishing the slower one as 'the' measurement."""
-    s = sorted(xs)
-    return s[(len(s) - 1) // 2]
+    """Lower-middle median (stdlib median_low): always an ELEMENT of xs
+    (callers look up the rep's stats via .index()), and for even rep counts
+    the faster of the two middle reps — never publishing the slower one as
+    'the' measurement."""
+    import statistics
+
+    return statistics.median_low(xs)
 
 
 def tick_candidates(cfg):

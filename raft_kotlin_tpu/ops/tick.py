@@ -143,17 +143,49 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
     """
     N, C, maj = cfg.n_nodes, cfg.log_capacity, cfg.majority
     G = s["term"].shape[-1]
-    logrow_c = jax.lax.broadcasted_iota(_I32, (C, G), 0)
 
     # Logs live as PER-NODE (C, G) slices for the duration of the phase
     # lattice (static slices of the flat (N*C, G) layout — free in XLA,
-    # supported value ops in Mosaic). Every one-hot log op then touches C rows
-    # instead of N*C — an Nx cut in the dominant VPU cost of the tick (the
-    # r01/r02 headline was VPU-bound at ~0.1 of HBM peak) — and an
-    # out-of-range index structurally CANNOT alias another node's rows: it
-    # simply matches nothing in [0, C).
+    # supported value ops in Mosaic). Every log op then touches a C-row
+    # operand instead of N*C — an Nx cut in the dominant cost of the tick —
+    # and an out-of-range index structurally CANNOT alias another node's
+    # rows: it simply matches nothing in [0, C).
     lt = [s["log_term"][n * C:(n + 1) * C] for n in range(N)]
     lc = [s["log_cmd"][n * C:(n + 1) * C] for n in range(N)]
+
+    # Deep-log batched engine (XLA-only; Mosaic never sees dyn_log). Measured
+    # cost model on TPU (v5e, C=10k, G=13k): a take/put costs the SAME for 1
+    # or 64 index rows — per-OP x operand-size, not per-row. The per-pair
+    # engine issues ~7 single-row ops per (l, p) pair = ~350 log-sized ops
+    # per tick; this mode instead (a) batches ALL phase-5 reads into 2 takes
+    # per node up front (row indices are known post-phase-4: in the
+    # non-mailbox path next_index[pair(l, p)] is only mutated by its own
+    # exchange), (b) DEFERS every phase-5 log write into a per-node pending
+    # list, applying it at end of phase as one duplicate-resolved scatter
+    # per node per array, and (c) overlays pending writes onto batched reads
+    # at consume time (patch), preserving the canonical pair-order semantics
+    # bit-for-bit. The mailbox path interleaves deliveries with sends (reads
+    # depend on in-tick slot state), so it keeps the per-pair engine.
+    batched_logs = flags.dyn_log and not flags.delay
+    logrow_c = None if flags.dyn_log else jax.lax.broadcasted_iota(_I32, (C, G), 0)
+
+    if batched_logs:
+        # node -> chronological [(local_rows (G,), term_v, cmd_v, wr)] of
+        # deferred phase-5 writes; values kept int32, narrowed at patch/apply.
+        pending = {n: [] for n in range(1, N + 1)}
+        defer = {"on": False}
+        ldt_b = lt[0].dtype
+
+        def patch(name, node, row, v):
+            """Overlay node's pending (deferred) writes onto a raw gather of
+            local row `row` — the value a read AFTER those writes must see.
+            Values roundtrip the storage dtype so an int16 wrap patches
+            identically to a real store."""
+            for prow, pt, pc, pwr in pending[node]:
+                pv = pt if name == "log_term" else pc
+                pv = pv.astype(ldt_b).astype(_I32)
+                v = jnp.where(pwr & (prow == row), pv, v)
+            return v
 
     def pair(a, b):
         # Flat pair-grid row for (owner a, peer b), both 1-based.
@@ -214,8 +246,15 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
         app = mask & (i == li) & (pl < C)
         ovw = mask & (i < li) & (i >= 0)
         wr = app | ovw
-        ldt = lt[0].dtype  # narrow at write (cfg.log_dtype)
         slot = jnp.where(app, pl, i)
+        if batched_logs and defer["on"]:
+            # Phase 5: record only; applied at end of phase as one resolved
+            # scatter per node (reads in between go through patch()).
+            pending[n].append((jnp.clip(slot, 0, C - 1), term_v, cmd_v, wr))
+            setcol("last_index", n, wr, jnp.where(app, li + 1, i + 1))
+            setcol("phys_len", n, app, pl + 1)
+            return
+        ldt = lt[0].dtype  # narrow at write (cfg.log_dtype)
         if flags.dyn_log:
             # Masked read-modify-write of one slot per lane (scatter form).
             rows = jnp.clip(slot, 0, C - 1)[None, :]
@@ -486,11 +525,13 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
     # -- phase 5: append / heartbeat ----------------------------------------
 
     def append_exchange(l, p, act5, req_term, req_commit, pli, plt,
-                        has_entry, ent_t, ent_c):
+                        has_entry, ent_t, ent_c, p_plt=None):
         """§6.2 handler on p + leader response processing, masked by `act5`; the
         request fields are (G,) snapshots (live reads on the synchronous path,
         §10 slot contents on the mailbox path). Leader-side processing always
-        reads l's LIVE state (RaftServer.kt:146-168 — no latch for appends)."""
+        reads l's LIVE state (RaftServer.kt:146-168 — no latch for appends).
+        `p_plt` (p's log term at pli) may be supplied pre-gathered (the
+        batched deep-log engine); None = gather here."""
         p_term = col("term", p)
         if p != l:
             adopt = act5 & (req_term > p_term)
@@ -504,7 +545,8 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
         p_commit = col("commit", p)
         cadv = act5 & (req_commit > p_commit)
         setcol("commit", p, cadv, jnp.minimum(req_commit, p_li))  # quirk e
-        p_plt = log_gather("log_term", p, pli)
+        if p_plt is None:
+            p_plt = log_gather("log_term", p, pli)
         succ = (pli == -1) | ((p_li > pli) & (pli >= 0) & (p_plt == plt))
         log_add(p, pli + 1, ent_t, ent_c, act5 & succ & has_entry)
         resp_term = col("term", p)
@@ -553,6 +595,35 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
                         req["aq_pli"], req["aq_plt"], req["aq_hase"] != 0,
                         req["aq_ent_t"], req["aq_ent_c"])
 
+    if batched_logs:
+        defer["on"] = True  # phase-5 log writes are deferred from here on
+
+        def bounded(idx, v):
+            # log_gather's out-of-[0, C) => 0 convention for a raw take.
+            return jnp.where((idx >= 0) & (idx < C), v, 0)
+
+        # ALL of phase 5's log reads in 2 takes per node, up front. Row
+        # indices are known post-phase-4 (see the engine note above); writes
+        # that land between here and a pair's consume point are overlaid by
+        # patch(). Node n's batch rows: [0, N) = prevLog reads of n-as-leader
+        # (pli(n, q)); [N, 2N) = entry reads of n-as-leader (i(n, q) - 1);
+        # [2N, 3N) = n-as-peer prevLog checks (pli(l, n) for each leader l).
+        i_all = {(a, b): s["next_index"][pair(a, b)]
+                 for a in range(1, N + 1) for b in range(1, N + 1)}
+        brows_t, bvals_t, brows_c, bvals_c = {}, {}, {}, {}
+        for n in range(1, N + 1):
+            rows = (
+                [jnp.clip(i_all[(n, q)] - 2, 0, C - 1) for q in range(1, N + 1)]
+                + [jnp.clip(i_all[(n, q)] - 1, 0, C - 1) for q in range(1, N + 1)]
+                + [jnp.clip(i_all[(l, n)] - 2, 0, C - 1) for l in range(1, N + 1)]
+            )
+            brows_t[n] = rows
+            bvals_t[n] = jnp.take_along_axis(
+                lt[n - 1], jnp.stack(rows), axis=0).astype(_I32)
+            brows_c[n] = rows[N:2 * N]
+            bvals_c[n] = jnp.take_along_axis(
+                lc[n - 1], jnp.stack(rows[N:2 * N]), axis=0).astype(_I32)
+
     for l in range(1, N + 1):
         raw_armed = col("hb_armed", l)
         armed = raw_armed & col("up", l)
@@ -575,10 +646,24 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
             pli = i - 2
             # prevLogTerm: invalid get -> exception -> skip peer (§6 skip rule).
             skip = (pli >= 0) & ~(pli < li_l)
-            plt = jnp.where(pli >= 0, log_gather("log_term", l, pli), -1)
+            if batched_logs:
+                raw_plt = bounded(pli, patch(
+                    "log_term", l, brows_t[l][p - 1], bvals_t[l][p - 1]))
+                plt = jnp.where(pli >= 0, raw_plt, -1)
+            else:
+                plt = jnp.where(pli >= 0, log_gather("log_term", l, pli), -1)
             has_entry = li_l >= i
             skip = skip | (has_entry & (i <= 0))  # quirk i underflow
-            ent_t, ent_c = log_gather_tc(l, i - 1)
+            if batched_logs:
+                ent_t = bounded(i - 1, patch(
+                    "log_term", l, brows_t[l][N + p - 1], bvals_t[l][N + p - 1]))
+                ent_c = bounded(i - 1, patch(
+                    "log_cmd", l, brows_c[l][p - 1], bvals_c[l][p - 1]))
+                p_plt_b = bounded(pli, patch(
+                    "log_term", p, brows_t[p][2 * N + l - 1],
+                    bvals_t[p][2 * N + l - 1]))
+            else:
+                ent_t, ent_c = log_gather_tc(l, i - 1)
             if flags.delay:
                 att = fire & ~skip & edge_ok(l, p)  # request leg at send tick
                 put_pair("aq_term", l, p, att, col("term", l))
@@ -595,7 +680,8 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
                 skip = skip | ~edge_ok(l, p) | ~edge_ok(p, l)
                 act5 = fire & ~skip
                 append_exchange(l, p, act5, col("term", l), col("commit", l),
-                                pli, plt, has_entry, ent_t, ent_c)
+                                pli, plt, has_entry, ent_t, ent_c,
+                                p_plt=p_plt_b if batched_logs else None)
 
     # §10 end-of-tick: in-flight countdowns advance (sent at t with τ ⇒ due == 0
     # at t+τ's delivery scan).
@@ -603,6 +689,36 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
         for name in ("vq_due", "aq_due"):
             d = s[name]
             s[name] = d - (d > 0).astype(_I32)
+
+    if batched_logs:
+        # Apply each node's deferred phase-5 writes as one scatter per log
+        # array. Duplicate rows within a lane are possible (two leaders
+        # appending to the same slot of one node; a masked no-op colliding
+        # with a real write) and XLA scatter order over duplicates is
+        # unspecified — so every entry is first resolved to the LAST real
+        # write at its row (ascending scan over this node's entries,
+        # starting from the current stored value): duplicates then carry
+        # identical values and the scatter is deterministic.
+        for n in range(1, N + 1):
+            writes = pending[n]
+            if not writes:
+                continue
+            rows = jnp.stack([w[0] for w in writes])  # (K, G) local rows
+            cur_t = jnp.take_along_axis(lt[n - 1], rows, axis=0)
+            cur_c = jnp.take_along_axis(lc[n - 1], rows, axis=0)
+            eff_t, eff_c = [], []
+            for k, (rk, _tk, _ck, _wk) in enumerate(writes):
+                et, ec = cur_t[k], cur_c[k]
+                for rj, tj, cj, wj in writes:
+                    hit = wj & (rj == rk)
+                    et = jnp.where(hit, tj.astype(ldt_b), et)
+                    ec = jnp.where(hit, cj.astype(ldt_b), ec)
+                eff_t.append(et)
+                eff_c.append(ec)
+            lt[n - 1] = jnp.put_along_axis(
+                lt[n - 1], rows, jnp.stack(eff_t), axis=0, inplace=False)
+            lc[n - 1] = jnp.put_along_axis(
+                lc[n - 1], rows, jnp.stack(eff_c), axis=0, inplace=False)
 
     # Rejoin the per-node log slices into the flat (N*C, G) layout.
     s["log_term"] = jnp.concatenate(lt, axis=0)

@@ -203,11 +203,17 @@ def make_sharded_run(cfg: RaftConfig, mesh: Mesh, n_ticks: int,
         tick_fn = lambda st, rng: xla_tick(st, rng=rng)
     sh = state_sharding(mesh, cfg)
     rep = NamedSharding(mesh, P())
-    rng = make_rng(cfg)
     # rng operand shardings: base key replicated; (N, G) key grids sharded on
     # the groups axis like every state array.
     keys_sh = NamedSharding(mesh, P(None, ("dcn", "ici")))
     rng_sh = (rep, keys_sh, keys_sh)
+    # rng computed straight into its mesh placement (init_sharded's pattern):
+    # a host-side make_rng + device_put to these shardings would raise on a
+    # multi-process mesh, where the shardings span non-addressable devices
+    # (tests/test_multiprocess.py exercises exactly this). The tiny producer
+    # program bakes the seed, but the SCAN below still takes rng as an
+    # operand, so the expensive compilation stays seed-independent.
+    rng_placed = jax.jit(lambda: make_rng(cfg), out_shardings=rng_sh)()
 
     def window_metrics(st, rounds0):
         return {
@@ -242,6 +248,4 @@ def make_sharded_run(cfg: RaftConfig, mesh: Mesh, n_ticks: int,
 
     jitted = jax.jit(run, in_shardings=(sh, rng_sh),
                      out_shardings=(sh, rep if metrics_every else None))
-    # rng as a jit operand (seed-independent program); placed per rng_sh.
-    rng_placed = tuple(jax.device_put(a, s) for a, s in zip(rng, rng_sh))
     return lambda st: jitted(st, rng_placed)

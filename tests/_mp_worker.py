@@ -1,0 +1,76 @@
+"""Multi-process worker for tests/test_multiprocess.py (NOT a test module).
+
+Each invocation is ONE jax.distributed process of a 2-process CPU cluster
+(SURVEY.md §4 item 4: multi-host tests via jax.distributed simulation on CPU).
+Phases (argv[1]):
+  phase_a: init sharded state over the 2-process mesh, step T1 ticks,
+           save_sharded -> CKPT_A. The process then EXITS — the restart
+           boundary is a real process boundary.
+  phase_b: (fresh processes) load_sharded CKPT_A under a new mesh, step T2
+           more ticks, save_sharded -> CKPT_B.
+
+Config/paths ride environment variables (MP_*) set by the parent test.
+"""
+
+import os
+import sys
+
+
+def main() -> int:
+    phase = sys.argv[1]
+    proc_id = int(os.environ["MP_PROC"])
+    n_procs = int(os.environ["MP_NPROCS"])
+    port = os.environ["MP_PORT"]
+
+    import jax
+
+    # The axon TPU plugin ignores JAX_PLATFORMS (memory: env var baked over);
+    # only the config knob reliably forces CPU here.
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=n_procs,
+        process_id=proc_id,
+    )
+    assert jax.process_count() == n_procs, jax.process_count()
+
+    from raft_kotlin_tpu.parallel.mesh import (
+        init_sharded, make_mesh, make_sharded_run)
+    from raft_kotlin_tpu.utils import checkpoint
+    from raft_kotlin_tpu.utils.config import RaftConfig
+
+    cfg = RaftConfig(
+        n_groups=int(os.environ["MP_GROUPS"]), n_nodes=3, log_capacity=8,
+        cmd_period=5, p_drop=0.1, seed=int(os.environ["MP_SEED"]),
+    ).stressed(10)
+    t1 = int(os.environ["MP_T1"])
+    t2 = int(os.environ["MP_T2"])
+    ckpt_a = os.environ["MP_CKPT_A"]
+    ckpt_b = os.environ["MP_CKPT_B"]
+
+    mesh = make_mesh(dcn=n_procs)
+    assert mesh.devices.shape[0] == n_procs
+
+    if phase == "phase_a":
+        st = init_sharded(cfg, mesh)
+        st, _ = make_sharded_run(cfg, mesh, t1)(st)
+        checkpoint.save_sharded(ckpt_a, st, cfg)
+    elif phase == "phase_b":
+        st, loaded_cfg = checkpoint.load_sharded(ckpt_a, mesh=mesh,
+                                                 expect_cfg=cfg)
+        assert loaded_cfg == cfg
+        # Every process must hold ONLY its own addressable shards.
+        local = {sh.index for sh in st.term.addressable_shards}
+        total = len(st.term.sharding.devices_indices_map(st.term.shape))
+        assert 0 < len(local) < total, (len(local), total)
+        st, _ = make_sharded_run(cfg, mesh, t2)(st)
+        checkpoint.save_sharded(ckpt_b, st, cfg)
+    else:
+        raise SystemExit(f"unknown phase {phase}")
+
+    jax.distributed.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

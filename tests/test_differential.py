@@ -87,6 +87,33 @@ def test_deep_log_dyn_addressing_bitmatch():
 
 
 @pytest.mark.slow
+def test_deep_log_fault_soup_bitmatch():
+    # The batched deep-log engine (ops/tick.py batched_logs: per-leader
+    # batched reads + deferred duplicate-resolved write scatter) under the
+    # nastiest write pattern: partitions + crash/restart drive split-brain
+    # groups where MULTIPLE leaders append to one node in one tick, and
+    # restarts force overwrite-truncations — the consume-time patch overlay
+    # and last-write-wins resolution must stay bit-identical to the scalar
+    # oracle's sequential order.
+    cfg = RaftConfig(n_groups=4, n_nodes=5, log_capacity=300, seed=61,
+                     p_drop=0.2, p_crash=0.01, p_restart=0.1,
+                     p_link_fail=0.03, p_link_heal=0.1,
+                     cmd_period=2).stressed(10)
+    assert_traces_match(cfg, 250)
+
+
+@pytest.mark.slow
+def test_deep_log_with_delay_bitmatch():
+    # Deep logs + §10 message delays: the dyn-addressing PER-PAIR engine (the
+    # batched engine disables itself under the mailbox, whose deliveries make
+    # read rows depend on in-tick slot state) must bit-match the oracle.
+    cfg = RaftConfig(n_groups=2, n_nodes=3, log_capacity=300, seed=67,
+                     p_drop=0.1, cmd_period=3, delay_lo=0,
+                     delay_hi=2).stressed(10)
+    assert_traces_match(cfg, 150)
+
+
+@pytest.mark.slow
 def test_stressed_churn_bitmatch():
     # Compressed pacing + drops + writes: maximal protocol activity per tick.
     cfg = RaftConfig(

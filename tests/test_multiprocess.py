@@ -1,0 +1,95 @@
+"""REAL multi-process sharding evidence (VERDICT r02 #4): two
+jax.distributed.initialize CPU processes on one host, each owning half of a
+("dcn", "ici") mesh, step a sharded run, save_sharded across the fleet,
+RESTART (actual process exit + fresh processes), load_sharded, continue, and
+bit-compare the result against an unsharded single-process run.
+
+This exercises the process-local paths in utils/checkpoint.py save_sharded /
+load_sharded (per-process shard-file selection, make_array_from_single_device_arrays
+assembly, the replicated-scalar per-addressable-device path) across an actual
+process boundary — the in-process 8-virtual-device tests cannot reach them.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from conftest import assert_states_equal
+
+from raft_kotlin_tpu.models.state import init_state
+from raft_kotlin_tpu.ops.tick import make_run
+from raft_kotlin_tpu.utils import checkpoint
+from raft_kotlin_tpu.utils.config import RaftConfig
+
+GROUPS, SEED, T1, T2 = 16, 41, 40, 35
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _run_fleet(phase: str, env: dict) -> None:
+    worker = os.path.join(os.path.dirname(__file__), "_mp_worker.py")
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(worker)))
+    procs = []
+    for pid in range(2):
+        e = dict(os.environ)
+        e.update(env)
+        e["MP_PROC"] = str(pid)
+        # 4 virtual CPU devices per process -> an 8-device global mesh. The
+        # distributed runtime must not inherit pytest's single-process flags.
+        e["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        # The worker runs as a script (sys.path[0] = tests/): put the repo
+        # root first WITHOUT clobbering the existing path (the TPU tunnel
+        # plugin registers via PYTHONPATH — extend, never replace).
+        e["PYTHONPATH"] = repo_root + os.pathsep + e.get("PYTHONPATH", "")
+        procs.append(subprocess.Popen(
+            [sys.executable, worker, phase],
+            env=e, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(worker)))))
+    try:
+        outs = [p.communicate(timeout=1200)[0] for p in procs]
+    finally:
+        for p in procs:  # a hung coordinator must not leak workers
+            if p.poll() is None:
+                p.kill()
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, (
+            f"{phase} proc {pid} failed:\n{out.decode(errors='replace')[-4000:]}")
+
+
+@pytest.mark.slow
+def test_two_process_sharded_save_restart_resume(tmp_path):
+    ckpt_a = str(tmp_path / "ckpt_a")
+    ckpt_b = str(tmp_path / "ckpt_b")
+    env = {
+        "MP_NPROCS": "2", "MP_PORT": str(_free_port()),
+        "MP_GROUPS": str(GROUPS), "MP_SEED": str(SEED),
+        "MP_T1": str(T1), "MP_T2": str(T2),
+        "MP_CKPT_A": ckpt_a, "MP_CKPT_B": ckpt_b,
+    }
+    _run_fleet("phase_a", env)
+    # Both processes wrote their own (disjoint) shard files; process 0 the
+    # manifest. 8 devices -> 8 shard files of 2 groups each.
+    shard_files = [f for f in os.listdir(ckpt_a) if f.startswith("shard_")]
+    assert len(shard_files) == 8
+    _run_fleet("phase_b", env)
+
+    # Ground truth: the same T1 + T2 ticks unsharded in THIS process.
+    cfg = RaftConfig(n_groups=GROUPS, n_nodes=3, log_capacity=8,
+                     cmd_period=5, p_drop=0.1, seed=SEED).stressed(10)
+    ref, _ = make_run(cfg, T1 + T2, trace=False)(init_state(cfg))
+
+    got, got_cfg = checkpoint.load_sharded(ckpt_b)  # meshless full assembly
+    assert got_cfg == cfg
+    assert_states_equal(jax.device_get(ref), jax.device_get(got))
+    assert int(np.max(np.asarray(got.commit))) > 0  # the run really replicated
