@@ -181,7 +181,7 @@ def parity_stage(cfg, groups, ticks, impl):
     native C++ engine over `groups` groups of the same config/seed: fraction
     of groups whose full traces bit-match."""
     from raft_kotlin_tpu.models.state import init_state
-    from raft_kotlin_tpu.native.oracle import TRACE_FIELDS, NativeOracle
+    from raft_kotlin_tpu.native.oracle import NativeOracle, trace_parity
     from raft_kotlin_tpu.ops.tick import make_run
 
     pcfg = dataclasses.replace(cfg, n_groups=groups)
@@ -194,10 +194,9 @@ def parity_stage(cfg, groups, ticks, impl):
         impl = "xla"
         _, ktr = make_run(pcfg, ticks, trace=True, impl="xla")(init_state(pcfg))
     ntr = NativeOracle(pcfg).run(ticks)
-    ok = np.ones(groups, dtype=bool)
-    for k in TRACE_FIELDS:
-        kv = np.asarray(ktr[k]).transpose(0, 2, 1).astype(np.int32)  # (T, G, N)
-        ok &= np.all(kv == ntr[k], axis=(0, 2))
+    ok, first = trace_parity(ktr, ntr)
+    if first:
+        print(f"parity: {first}", file=sys.stderr)
     return float(np.mean(ok)), int(groups), impl
 
 

@@ -92,6 +92,28 @@ class _Trace(ct.Structure):
 TRACE_FIELDS = tuple(k for k, _ in _Trace._fields_)
 
 
+def trace_parity(ktr, ntr):
+    """Compare a kernel trace (dict of (T, N, G) groups-minor arrays) against
+    a NativeOracle trace (dict of (T, G, N) int32): returns
+    (ok: (G,) bool — per-group bit-match over ALL TRACE_FIELDS,
+     first_mismatch: str | None — field/tick/group/node of the first
+     divergence, for diagnostics). The single canonical compare shared by
+    bench.py's parity stage and the parity tests."""
+    ok = None
+    first = None
+    for k in TRACE_FIELDS:
+        kv = np.asarray(ktr[k]).transpose(0, 2, 1).astype(np.int32)  # (T,G,N)
+        eq = kv == ntr[k]
+        if ok is None:
+            ok = np.ones(eq.shape[1], dtype=bool)
+        ok &= np.all(eq, axis=(0, 2))
+        if first is None and not eq.all():
+            ti, g, n = np.argwhere(~eq)[0]
+            first = (f"field {k} diverges first at tick={ti} group={g} "
+                     f"node={n + 1}: kernel={kv[ti, g]} native={ntr[k][ti, g]}")
+    return ok, first
+
+
 def build_lib(force: bool = False) -> str:
     """Compile the shared library if missing or stale; returns its path."""
     with _BUILD_LOCK:

@@ -72,8 +72,8 @@ def choose_impl(cfg: RaftConfig) -> str:
     (see bench.py measure())."""
     if jax.default_backend() == "cpu":
         return "xla"
-    if cfg.log_dtype != "int32":
-        return "xla"  # narrow-log configs are deep-log configs: XLA path
+    if cfg.log_capacity >= 256:
+        return "xla"  # dyn-log band: the batched XLA engine (ops/tick.py)
     try:
         default_tile(cfg, cfg.n_groups, interpret=False)
     except ValueError:
@@ -96,10 +96,10 @@ def make_pallas_core(cfg: RaftConfig, lanes: int, tile_g: int, interpret: bool):
     (callable(*flat_int32_arrays) -> flat outputs + el_dirty, aux_names)."""
     N, C = cfg.n_nodes, cfg.log_capacity
     assert lanes % tile_g == 0, (lanes, tile_g)
-    if cfg.log_dtype != "int32":
-        raise ValueError(
-            "the Pallas megakernel moves all state as int32; narrow-log "
-            "(deep-log) configs use the XLA tick — see choose_impl")
+    # Log blocks travel in the STORAGE dtype (cfg.log_dtype): int16 halves
+    # the VMEM footprint and the VPU data movement of the dominant one-hot
+    # log ops (Mosaic packs 16-bit lanes 2x). Everything else is int32.
+    log_dt = jnp.int16 if cfg.log_dtype == "int16" else _I32
 
     # Per-tile block shapes. Everything is RANK-2 (rows, tile_g): phase_body's flat
     # layout (ops/tick.py) — pair grids (N*N, ·), logs (N*C, ·) — which is also what
@@ -154,10 +154,14 @@ def make_pallas_core(cfg: RaftConfig, lanes: int, tile_g: int, interpret: bool):
                 outs[k][...] = s[k].astype(_I32) if k in _BOOL_STATE else s[k]
             outs["el_dirty"][...] = el_dirty.astype(_I32)
 
+        def field_dtype(k):
+            return log_dt if k in ("log_term", "log_cmd") else _I32
+
         in_specs = [block_spec(field_shapes[k]) for k in sfields]
         in_specs += [block_spec(aux_shapes[k]) for k in aux_names]
         out_shapes = [
-            jax.ShapeDtypeStruct(tuple(field_shapes[k][:-1]) + (lanes,), _I32)
+            jax.ShapeDtypeStruct(
+                tuple(field_shapes[k][:-1]) + (lanes,), field_dtype(k))
             for k in sfields
         ] + [jax.ShapeDtypeStruct((N, lanes), _I32)]
         out_specs = [block_spec(field_shapes[k]) for k in sfields]
@@ -225,7 +229,10 @@ def make_pallas_tick(cfg: RaftConfig, tile_g: Optional[int] = None,
         )
         if rng is None:
             if not default_rng:
-                default_rng.append(tick_mod.make_rng(cfg))
+                # Eager even under a jit trace (see ops.tick: a staged tracer
+                # cached here would leak into later trace signatures).
+                with jax.ensure_compile_time_eval():
+                    default_rng.append(tick_mod.make_rng(cfg))
             rng = default_rng[0]
         base, tkeys, bkeys = rng
         aux, flags = tick_mod.make_aux(
@@ -250,7 +257,10 @@ def default_tile(cfg: RaftConfig, lanes: int, interpret: bool) -> int:
     n_2d = sum(1 for k in STATE_FIELDS
                if k not in ("log_term", "log_cmd", "responded",
                             "next_index", "match_index", "link_up"))
-    rows = 2 * (n_2d * N + 4 * N * N + 2 * N * C) + (3 * N * N + 5 * N + 1) + N
+    log_rows = 2 * 2 * N * C  # 2 log arrays, in + aliased out
+    if cfg.log_dtype == "int16":
+        log_rows //= 2  # i16 rows cost half the VMEM of the i32 model rows
+    rows = 2 * (n_2d * N + 4 * N * N) + log_rows + (3 * N * N + 5 * N + 1) + N
     if cfg.uses_mailbox:
         # §10 mailbox: 13 pair-shaped state fields (in + aliased out) + delay aux.
         rows += 2 * len(MAILBOX_FIELDS) * N * N + N * N

@@ -191,12 +191,64 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
         # Flat pair-grid row for (owner a, peer b), both 1-based.
         return (a - 1) * N + (b - 1)
 
+    # Columnar view for phases 3/5 (the per-pair exchange loops): node fields
+    # live as N separate (G,) rows and pair fields as N*N rows, so a per-pair
+    # update is ONE (G,) select instead of a full-grid rebuild (the iota-
+    # compare _set_row pattern measured ~31% of the megakernel's runtime).
+    # Grid phases (F, 0-2, 4) run on the stacked (N, G)/(N*N, G) arrays as
+    # before; enter_cols()/exit_cols() convert at the phase boundaries (a
+    # handful of stacks — far cheaper than per-update rebuilds). Bool fields
+    # in the view (el_armed/hb_armed/up) are only ever combined with boolean
+    # algebra, never select-of-i1-values (Mosaic limits).
+    _COLF = ("term", "voted_for", "role", "commit", "last_index", "phys_len",
+             "el_armed", "round_state", "round_age", "votes", "responses",
+             "hb_armed", "hb_left", "up", "t_ctr", "rounds")
+    _PAIRV = ("responded", "next_index", "match_index") + \
+        (MAILBOX_FIELDS if flags.delay else ())
+    view: dict = {}
+
+    def enter_cols():
+        for k in _COLF:
+            view[k] = [s[k][i] for i in range(N)]
+        for k in _PAIRV:
+            view[k] = [s[k][i] for i in range(N * N)]
+        view["__dirty"] = [aux_dirty["m"][i] for i in range(N)]
+
+    def _stack_rows(rows):
+        # Bool rows restack through int32: Mosaic lowers i1 concat via an i8
+        # widening it cannot truncate back (same limitation as _rep_rows).
+        if rows[0].dtype == jnp.bool_:
+            return jnp.stack([r.astype(_I32) for r in rows]) != 0
+        return jnp.stack(rows)
+
+    def exit_cols():
+        for k in _COLF + _PAIRV:
+            s[k] = _stack_rows(view[k])
+        aux_dirty["m"] = _stack_rows(view["__dirty"])
+        view.clear()
+
     def col(name, n):
+        if name in view:
+            return view[name][n - 1]
         return s[name][n - 1]
 
     def setcol(name, n, mask, vals):
+        if name in view:
+            view[name][n - 1] = jnp.where(mask, vals, view[name][n - 1])
+            return
         cur = s[name][n - 1]
         s[name] = _set_row(s[name], n - 1, jnp.where(mask, vals, cur))
+
+    def prow(name, a, b):
+        if name in view:
+            return view[name][pair(a, b)]
+        return s[name][pair(a, b)]
+
+    def set_prow(name, a, b, vals):
+        if name in view:
+            view[name][pair(a, b)] = vals
+            return
+        s[name] = _set_row(s[name], pair(a, b), vals)
 
     if flags.dyn_log:
         def _gather1(arr, idx):
@@ -284,6 +336,11 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
 
     def reset_el_timer_col(n, mask):
         ctr = col("t_ctr", n)
+        if view:
+            view["el_armed"][n - 1] = view["el_armed"][n - 1] | mask
+            view["t_ctr"][n - 1] = ctr + mask.astype(_I32)
+            view["__dirty"][n - 1] = view["__dirty"][n - 1] | mask
+            return
         s["el_armed"] = _set_row(s["el_armed"], n - 1, col("el_armed", n) | mask)
         setcol("t_ctr", n, mask, ctr + 1)
         aux_dirty["m"] = _set_row(aux_dirty["m"], n - 1, aux_dirty["m"][n - 1] | mask)
@@ -400,6 +457,7 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
     # empty (a gather at -1 matches no row), which is exactly the request
     # convention (lastLogTerm 0 on an empty log) AND the handler's
     # up-to-dateness input (rej_* are guarded by p_li >= 1).
+    enter_cols()  # phases 3 runs on the columnar view
     lli_h = [col("last_index", n) for n in range(1, N + 1)]
     llt_h = [log_gather("log_term", n, lli_h[n - 1] - 1)
              for n in range(1, N + 1)]
@@ -411,8 +469,7 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
         return aux["delay"][pair(a, b)]
 
     def put_pair(name, a, b, mask, vals):
-        row = pair(a, b)
-        s[name] = _set_row(s[name], row, jnp.where(mask, vals, s[name][row]))
+        set_prow(name, a, b, jnp.where(mask, vals, prow(name, a, b)))
 
     def vote_exchange(c, p, att, req_term, req_lli, req_llt, guard):
         """§6.1 handler on p + candidate tally, masked by `att`; the request fields
@@ -442,10 +499,7 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
         # loop, so this is bit-identical to comparing against the request term on
         # the synchronous path.
         tal = att & guard
-        s["responded"] = _set_row(
-            s["responded"], pair(c, p),
-            jnp.where(tal, 1, s["responded"][pair(c, p)]),
-        )
+        put_pair("responded", c, p, tal, 1)
         setcol("responses", c, tal, col("responses", c) + 1)
         setcol("role", c, tal & (resp_term > col("term", c)), FOLLOWER)  # quirk f
         setcol("votes", c, tal & granted, col("votes", c) + 1)
@@ -454,13 +508,13 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
         # §10 delivery: response leg evaluated at the delivery tick; either-end
         # failure voids the whole exchange. Candidate processing additionally
         # guarded by the round stamp (straggler cancellation).
-        row = pair(c, p)
-        due = s["vq_due"][row] == 0
+        due = prow("vq_due", c, p) == 0
         att = due & edge_ok(p, c)
         guard = (col("round_state", c) == ACTIVE) & (
-            s["vq_round"][row] == col("rounds", c)
+            prow("vq_round", c, p) == col("rounds", c)
         )
-        req_term, req_lli, req_llt = s["vq_term"][row], s["vq_lli"][row], s["vq_llt"][row]
+        req_term = prow("vq_term", c, p)
+        req_lli, req_llt = prow("vq_lli", c, p), prow("vq_llt", c, p)
         put_pair("vq_due", c, p, due, jnp.full((G,), -1, dtype=_I32))
         vote_exchange(c, p, att, req_term, req_lli, req_llt, guard)
 
@@ -473,7 +527,7 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
                 vote_deliver(c, p)  # in-flight slots from earlier ticks
                 att = (
                     c_attempting
-                    & (s["responded"][pair(c, p)] == 0)
+                    & (prow("responded", c, p) == 0)
                     & edge_ok(c, p)  # request leg at the send tick
                 )
                 put_pair("vq_term", c, p, att, col("term", c))
@@ -486,7 +540,7 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
             else:
                 att = (
                     c_attempting
-                    & (s["responded"][pair(c, p)] == 0)
+                    & (prow("responded", c, p) == 0)
                     & edge_ok(c, p)
                     & edge_ok(p, c)
                 )
@@ -499,6 +553,7 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
 
     # -- phase 4: round conclusions -----------------------------------------
 
+    exit_cols()  # phase 4 is grid-wide
     act = (s["round_state"] == ACTIVE) & up
     concl = act & ((s["responses"] >= maj) | (s["round_left"] <= 0))
     is_cand = s["role"] == CANDIDATE
@@ -562,38 +617,34 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
         proc = act5 & ~demote & succ
         with_e = proc & has_entry
         nfail = act5 & ~demote & ~succ
-        ni = s["next_index"][pair(l, p)]
-        s["next_index"] = _set_row(
-            s["next_index"], pair(l, p),
-            jnp.where(with_e, ni + 1, jnp.where(nfail, ni - 1, ni)),
-        )
-        mi = s["match_index"][pair(l, p)]
-        s["match_index"] = _set_row(
-            s["match_index"], pair(l, p),
-            jnp.where(with_e, mi + 1, jnp.where(proc & ~has_entry, pli + 1, mi)),
-        )
+        ni = prow("next_index", l, p)
+        set_prow("next_index", l, p,
+                 jnp.where(with_e, ni + 1, jnp.where(nfail, ni - 1, ni)))
+        mi = prow("match_index", l, p)
+        set_prow("match_index", l, p,
+                 jnp.where(with_e, mi + 1,
+                           jnp.where(proc & ~has_entry, pli + 1, mi)))
         # Commit advancement (quirk a), evaluated per response.
         l_commit = col("commit", l)
-        cnt = jnp.sum(
-            (s["match_index"][(l - 1) * N:l * N] > l_commit[None, :]).astype(_I32),
-            axis=0,
-        )
+        cnt = sum((prow("match_index", l, q) > l_commit).astype(_I32)
+                  for q in range(1, N + 1))
         setcol("commit", l, with_e & (cnt >= maj), l_commit + 1)
 
     def append_deliver(l, p):
         # §10 delivery: response leg at the delivery tick; either-end failure voids
         # the exchange. No straggler guard — append responses always process
         # against live leader state (the reference never cancels them).
-        row = pair(l, p)
-        due = s["aq_due"][row] == 0
+        due = prow("aq_due", l, p) == 0
         att = due & edge_ok(p, l)
-        req = {k: s[k][row] for k in
+        req = {k: prow(k, l, p) for k in
                ("aq_term", "aq_commit", "aq_pli", "aq_plt",
                 "aq_hase", "aq_ent_t", "aq_ent_c")}
         put_pair("aq_due", l, p, due, jnp.full((G,), -1, dtype=_I32))
         append_exchange(l, p, att, req["aq_term"], req["aq_commit"],
                         req["aq_pli"], req["aq_plt"], req["aq_hase"] != 0,
                         req["aq_ent_t"], req["aq_ent_c"])
+
+    enter_cols()  # phase 5 runs on the columnar view
 
     if batched_logs:
         defer["on"] = True  # phase-5 log writes are deferred from here on
@@ -608,7 +659,7 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
         # patch(). Node n's batch rows: [0, N) = prevLog reads of n-as-leader
         # (pli(n, q)); [N, 2N) = entry reads of n-as-leader (i(n, q) - 1);
         # [2N, 3N) = n-as-peer prevLog checks (pli(l, n) for each leader l).
-        i_all = {(a, b): s["next_index"][pair(a, b)]
+        i_all = {(a, b): prow("next_index", a, b)
                  for a in range(1, N + 1) for b in range(1, N + 1)}
         brows_t, bvals_t, brows_c, bvals_c = {}, {}, {}, {}
         for n in range(1, N + 1):
@@ -633,7 +684,7 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
         l_is_f = col("role", l) == FOLLOWER
         # FOLLOWER cancels future firings but this round still goes out
         # (TimerTask.cancel semantics, RaftServer.kt:117).
-        s["hb_armed"] = _set_row(s["hb_armed"], l - 1, raw_armed & ~(fire & l_is_f))
+        view["hb_armed"][l - 1] = raw_armed & ~(fire & l_is_f)
         setcol("hb_left", l, fire & ~l_is_f, cfg.hb_ticks - 1)
         for p in range(1, N + 1):
             if flags.delay:
@@ -642,7 +693,7 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
             # Request construction + §5 skip rules, from l's live state at send
             # (post-delivery: a delivery just above may have advanced next_index).
             li_l = col("last_index", l)
-            i = s["next_index"][pair(l, p)]
+            i = prow("next_index", l, p)
             pli = i - 2
             # prevLogTerm: invalid get -> exception -> skip peer (§6 skip rule).
             skip = (pli >= 0) & ~(pli < li_l)
@@ -682,6 +733,8 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
                 append_exchange(l, p, act5, col("term", l), col("commit", l),
                                 pli, plt, has_entry, ent_t, ent_c,
                                 p_plt=p_plt_b if batched_logs else None)
+
+    exit_cols()
 
     # §10 end-of-tick: in-flight countdowns advance (sent at t with τ ⇒ due == 0
     # at t+τ's delivery scan).
@@ -873,7 +926,12 @@ def make_tick(cfg: RaftConfig):
         )
         if rng is None:
             if not default_rng:
-                default_rng.append(make_rng(cfg))
+                # Eager even when first called under a jit trace: omnistaging
+                # would otherwise stage these into the CURRENT trace and the
+                # cached tracer would leak into the next (inject/fault)
+                # signature's trace (UnexpectedTracerError).
+                with jax.ensure_compile_time_eval():
+                    default_rng.append(make_rng(cfg))
             rng = default_rng[0]
         base, tkeys, bkeys = rng
         aux, flags = make_aux(cfg, base, tkeys, bkeys, state, inject, fault_cmd)

@@ -47,7 +47,11 @@ def _run_fleet(phase: str, env: dict) -> None:
         e["MP_PROC"] = str(pid)
         # 4 virtual CPU devices per process -> an 8-device global mesh. The
         # distributed runtime must not inherit pytest's single-process flags.
-        e["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        # Extend, never replace (the rule the PYTHONPATH note below states):
+        # a later duplicate of the same XLA flag wins, so appending both
+        # overrides any inherited device count and keeps other flags.
+        e["XLA_FLAGS"] = (e.get("XLA_FLAGS", "") +
+                          " --xla_force_host_platform_device_count=4").strip()
         # The worker runs as a script (sys.path[0] = tests/): put the repo
         # root first WITHOUT clobbering the existing path (the TPU tunnel
         # plugin registers via PYTHONPATH — extend, never replace).

@@ -7,7 +7,7 @@ import numpy as np
 import pytest
 
 from raft_kotlin_tpu.models.state import init_state
-from raft_kotlin_tpu.native.oracle import TRACE_FIELDS, NativeOracle
+from raft_kotlin_tpu.native.oracle import TRACE_FIELDS, NativeOracle, trace_parity
 from raft_kotlin_tpu.ops.tick import make_run
 from raft_kotlin_tpu.utils.config import RaftConfig
 
@@ -16,15 +16,9 @@ def assert_native_matches_kernel(cfg: RaftConfig, n_ticks: int):
     run = make_run(cfg, n_ticks, trace=True)
     _, ktr = run(init_state(cfg))
     ntr = NativeOracle(cfg).run(n_ticks)
-    for k in TRACE_FIELDS:
-        kv = np.asarray(ktr[k]).transpose(0, 2, 1).astype(np.int32)
-        if not np.array_equal(kv, ntr[k]):
-            bad = np.argwhere(kv != ntr[k])
-            ti, g, n = bad[0]
-            raise AssertionError(
-                f"field {k} diverges first at tick={ti} group={g} node={n + 1}: "
-                f"kernel={kv[ti, g]} native={ntr[k][ti, g]}"
-            )
+    ok, first = trace_parity(ktr, ntr)
+    if not ok.all():
+        raise AssertionError(first)
 
 
 def test_election_replication_bitmatch():

@@ -111,6 +111,20 @@ def test_sharded_pallas_step_on_tpu():
     _RESULTS["sharded_pallas_step"] = f"ok on {len(jax.devices())} device(s)"
 
 
+def test_mosaic_int16_logs_match_xla():
+    # The int16 log-block megakernel (narrow VMEM logs) on real Mosaic: must
+    # equal the XLA tick bit-for-bit, including narrowing writes.
+    cfg = _cfg(log_capacity=64, log_dtype="int16")
+    tx = jax.jit(make_tick(cfg))
+    tp = jax.jit(make_pallas_tick(cfg, interpret=False))
+    sx = sp = init_state(cfg)
+    for _ in range(40):
+        sx = tx(sx)
+        sp = tp(sp)
+    _assert_equal(sx, sp, "int16 logs")
+    _RESULTS["variant_int16_logs"] = "bit-equal"
+
+
 def test_deeplog_batched_engine_vs_native_on_tpu():
     # The deep-log batched engine (ops/tick.py batched_logs — per-node
     # batched takes + deferred duplicate-resolved write scatters) on REAL
@@ -119,7 +133,7 @@ def test_deeplog_batched_engine_vs_native_on_tpu():
     # megakernel needs the whole (N*C, tile) log block in VMEM, and C=10k at
     # the minimum 128-lane tile is ~36 MB against a ~16 MB scoped budget —
     # see ops/pallas_tick.py. The XLA engine above is the deep-log fast path.)
-    from raft_kotlin_tpu.native.oracle import TRACE_FIELDS, NativeOracle
+    from raft_kotlin_tpu.native.oracle import NativeOracle, trace_parity
     from raft_kotlin_tpu.ops.tick import make_run
 
     cfg = RaftConfig(n_groups=128, n_nodes=7, log_capacity=1024,
@@ -128,12 +142,9 @@ def test_deeplog_batched_engine_vs_native_on_tpu():
     T = 60
     _, ktr = make_run(cfg, T, trace=True, impl="xla")(init_state(cfg))
     ntr = NativeOracle(cfg).run(T)
-    ok = np.ones(cfg.n_groups, dtype=bool)
-    for k in TRACE_FIELDS:
-        kv = np.asarray(ktr[k]).transpose(0, 2, 1).astype(np.int32)
-        ok &= np.all(kv == ntr[k], axis=(0, 2))
+    ok, first = trace_parity(ktr, ntr)
     rate = float(np.mean(ok))
-    assert rate == 1.0, f"deep-log parity rate {rate}"
+    assert rate == 1.0, f"deep-log parity rate {rate}: {first}"
     _RESULTS["deeplog_batched_vs_native"] = (
         f"parity 1.0 over {cfg.n_groups} groups x {T} ticks "
         f"(C={cfg.log_capacity}, int16)")
@@ -155,6 +166,7 @@ def test_tile_model_sweep_on_tpu():
         "n5_c64_mailbox": _cfg(log_capacity=64, delay_lo=0, delay_hi=2),
         "n7_c32_mailbox": _cfg(n_nodes=7, log_capacity=32,
                                delay_lo=0, delay_hi=2),
+        "n5_c128_int16": _cfg(log_capacity=128, log_dtype="int16"),
     }
     sweep = {}
     for name, cfg in probes.items():
