@@ -72,7 +72,7 @@ def choose_impl(cfg: RaftConfig) -> str:
     (see bench.py measure())."""
     if jax.default_backend() == "cpu":
         return "xla"
-    if cfg.log_capacity >= 256:
+    if cfg.uses_dyn_log:
         return "xla"  # dyn-log band: the batched XLA engine (ops/tick.py)
     try:
         default_tile(cfg, cfg.n_groups, interpret=False)
@@ -125,7 +125,7 @@ def make_pallas_core(cfg: RaftConfig, lanes: int, tile_g: int, interpret: bool):
     @functools.lru_cache(maxsize=None)
     def build_call(flags: BodyFlags):
         # Mosaic has no gather/scatter in the TC path: always the one-hot form.
-        flags = dataclasses.replace(flags, dyn_log=False)
+        flags = dataclasses.replace(flags, dyn_log=False, batched=False)
         sfields = state_fields(flags)
         aux_names = tuple(
             k for k in AUX_FIELDS

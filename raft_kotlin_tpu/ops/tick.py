@@ -128,6 +128,13 @@ class BodyFlags:
     # does ~6 per (node, peer) pair — gathers make deep logs feasible.
     # Values are identical either way (same slots, same masks).
     dyn_log: bool = False
+    # Deep-log BATCHED engine (phase-5 reads in 2 takes per node + deferred
+    # duplicate-resolved write scatters): the single-device deep-log fast
+    # path. Off under the mailbox (deliveries make read rows depend on
+    # in-tick slot state) and off for SHARDED runs (the SPMD partitioner
+    # aborts on the batched gather/scatter program; per-shard widths are
+    # tiny anyway, so the per-pair engine costs little there).
+    batched: bool = False
 
 
 def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
@@ -150,8 +157,20 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
     # operand instead of N*C — an Nx cut in the dominant cost of the tick —
     # and an out-of-range index structurally CANNOT alias another node's
     # rows: it simply matches nothing in [0, C).
-    lt = [s["log_term"][n * C:(n + 1) * C] for n in range(N)]
-    lc = [s["log_cmd"][n * C:(n + 1) * C] for n in range(N)]
+    #
+    # EXCEPT the per-pair dyn engine (sharded deep logs / mailbox deep logs):
+    # there the logs stay FLAT with global rows — the slice + per-slice
+    # scatter + concat pattern makes XLA's SPMD partitioner blow up
+    # (observed: SIGABRT / unbounded HLO-pass memory on the CPU backend),
+    # and the flat per-pair form is the round-2-proven sharded program.
+    # Known tradeoff: a SINGLE-DEVICE mailbox+deep config (delay > 0,
+    # C >= 256) also takes the flat path and pays ~Nx more per log op than
+    # slices would; that corner class is unbenchmarked — revisit if it ever
+    # matters (a flags bit distinguishing "actually sharded" would do it).
+    use_slices = (not flags.dyn_log) or flags.batched
+    if use_slices:
+        lt = [s["log_term"][n * C:(n + 1) * C] for n in range(N)]
+        lc = [s["log_cmd"][n * C:(n + 1) * C] for n in range(N)]
 
     # Deep-log batched engine (XLA-only; Mosaic never sees dyn_log). Measured
     # cost model on TPU (v5e, C=10k, G=13k): a take/put costs the SAME for 1
@@ -166,8 +185,14 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
     # at consume time (patch), preserving the canonical pair-order semantics
     # bit-for-bit. The mailbox path interleaves deliveries with sends (reads
     # depend on in-tick slot state), so it keeps the per-pair engine.
-    batched_logs = flags.dyn_log and not flags.delay
+    batched_logs = flags.batched
     logrow_c = None if flags.dyn_log else jax.lax.broadcasted_iota(_I32, (C, G), 0)
+    # The columnar view pays off inside the Mosaic megakernel (grid rebuilds
+    # measured ~31% of it); deep-log (dyn) configs are XLA-only, where the
+    # fusion compiler already folds the rebuilds — and the columnar
+    # stack/split pattern combined with dyn gather/scatter trips an XLA:CPU
+    # SPMD-partitioner abort on sharded runs. Grid mode for dyn configs.
+    use_columnar = not flags.dyn_log
 
     if batched_logs:
         # node -> chronological [(local_rows (G,), term_v, cmd_v, wr)] of
@@ -250,7 +275,7 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
             return
         s[name] = _set_row(s[name], pair(a, b), vals)
 
-    if flags.dyn_log:
+    if flags.dyn_log and use_slices:
         def _gather1(arr, idx):
             v = jnp.take_along_axis(
                 arr, jnp.clip(idx, 0, C - 1)[None, :], axis=0)[0]
@@ -267,6 +292,22 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
             ok = (idx >= 0) & (idx < C)
             tv = jnp.take_along_axis(lt[n - 1], rows, axis=0)[0]
             cv = jnp.take_along_axis(lc[n - 1], rows, axis=0)[0]
+            return (jnp.where(ok, tv, 0).astype(_I32),
+                    jnp.where(ok, cv, 0).astype(_I32))
+    elif flags.dyn_log:
+        # Per-pair dyn engine, FLAT addressing (global row (n-1)*C + slot).
+        # The bounds terms are load-bearing here: an out-of-range idx in the
+        # flat layout would otherwise alias an ADJACENT node's row.
+        def log_gather(name, n, idx):
+            rows = (n - 1) * C + jnp.clip(idx, 0, C - 1)
+            v = jnp.take_along_axis(s[name], rows[None, :], axis=0)[0]
+            return jnp.where((idx >= 0) & (idx < C), v, 0).astype(_I32)
+
+        def log_gather_tc(n, idx):
+            rows = ((n - 1) * C + jnp.clip(idx, 0, C - 1))[None, :]
+            ok = (idx >= 0) & (idx < C)
+            tv = jnp.take_along_axis(s["log_term"], rows, axis=0)[0]
+            cv = jnp.take_along_axis(s["log_cmd"], rows, axis=0)[0]
             return (jnp.where(ok, tv, 0).astype(_I32),
                     jnp.where(ok, cv, 0).astype(_I32))
     else:
@@ -306,8 +347,16 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
             setcol("last_index", n, wr, jnp.where(app, li + 1, i + 1))
             setcol("phys_len", n, app, pl + 1)
             return
-        ldt = lt[0].dtype  # narrow at write (cfg.log_dtype)
-        if flags.dyn_log:
+        ldt = s["log_term"].dtype  # narrow at write (cfg.log_dtype)
+        if flags.dyn_log and not use_slices:
+            # Flat masked read-modify-write of one global row per lane.
+            rows = ((n - 1) * C + jnp.clip(slot, 0, C - 1))[None, :]
+            for name, v in (("log_term", term_v), ("log_cmd", cmd_v)):
+                cur = jnp.take_along_axis(s[name], rows, axis=0)
+                new = jnp.where(wr[None, :], v.astype(ldt)[None, :], cur)
+                s[name] = jnp.put_along_axis(
+                    s[name], rows, new, axis=0, inplace=False)
+        elif flags.dyn_log:
             # Masked read-modify-write of one slot per lane (scatter form).
             rows = jnp.clip(slot, 0, C - 1)[None, :]
             for store, v in ((lt, term_v), (lc, cmd_v)):
@@ -457,7 +506,8 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
     # empty (a gather at -1 matches no row), which is exactly the request
     # convention (lastLogTerm 0 on an empty log) AND the handler's
     # up-to-dateness input (rej_* are guarded by p_li >= 1).
-    enter_cols()  # phases 3 runs on the columnar view
+    if use_columnar:
+        enter_cols()  # phase 3 runs on the columnar view
     lli_h = [col("last_index", n) for n in range(1, N + 1)]
     llt_h = [log_gather("log_term", n, lli_h[n - 1] - 1)
              for n in range(1, N + 1)]
@@ -553,7 +603,8 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
 
     # -- phase 4: round conclusions -----------------------------------------
 
-    exit_cols()  # phase 4 is grid-wide
+    if use_columnar:
+        exit_cols()  # phase 4 is grid-wide
     act = (s["round_state"] == ACTIVE) & up
     concl = act & ((s["responses"] >= maj) | (s["round_left"] <= 0))
     is_cand = s["role"] == CANDIDATE
@@ -644,7 +695,8 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
                         req["aq_pli"], req["aq_plt"], req["aq_hase"] != 0,
                         req["aq_ent_t"], req["aq_ent_c"])
 
-    enter_cols()  # phase 5 runs on the columnar view
+    if use_columnar:
+        enter_cols()  # phase 5 runs on the columnar view
 
     if batched_logs:
         defer["on"] = True  # phase-5 log writes are deferred from here on
@@ -684,7 +736,11 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
         l_is_f = col("role", l) == FOLLOWER
         # FOLLOWER cancels future firings but this round still goes out
         # (TimerTask.cancel semantics, RaftServer.kt:117).
-        view["hb_armed"][l - 1] = raw_armed & ~(fire & l_is_f)
+        if view:
+            view["hb_armed"][l - 1] = raw_armed & ~(fire & l_is_f)
+        else:
+            s["hb_armed"] = _set_row(s["hb_armed"], l - 1,
+                                     raw_armed & ~(fire & l_is_f))
         setcol("hb_left", l, fire & ~l_is_f, cfg.hb_ticks - 1)
         for p in range(1, N + 1):
             if flags.delay:
@@ -734,7 +790,8 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
                                 pli, plt, has_entry, ent_t, ent_c,
                                 p_plt=p_plt_b if batched_logs else None)
 
-    exit_cols()
+    if use_columnar:
+        exit_cols()
 
     # §10 end-of-tick: in-flight countdowns advance (sent at t with τ ⇒ due == 0
     # at t+τ's delivery scan).
@@ -773,23 +830,27 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
             lc[n - 1] = jnp.put_along_axis(
                 lc[n - 1], rows, jnp.stack(eff_c), axis=0, inplace=False)
 
-    # Rejoin the per-node log slices into the flat (N*C, G) layout.
-    s["log_term"] = jnp.concatenate(lt, axis=0)
-    s["log_cmd"] = jnp.concatenate(lc, axis=0)
+    if use_slices:
+        # Rejoin the per-node log slices into the flat (N*C, G) layout.
+        s["log_term"] = jnp.concatenate(lt, axis=0)
+        s["log_cmd"] = jnp.concatenate(lc, axis=0)
 
     return aux_dirty["m"]
 
 
 def make_aux(cfg: RaftConfig, base, tkeys, bkeys, state: RaftState,
-             inject, fault_cmd):
+             inject, fault_cmd, batched: Optional[bool] = None):
     """Draw/assemble the phase_body aux inputs from pre-tick state (XLA ops).
 
     Randomness is drawn in the canonical (G, ...) §4 shapes and transposed, so no
     drawn bit depends on the groups-minor layout. Returns (aux dict, flags).
+    `batched=False` forces the per-pair deep-log engine (sharded runs — see
+    BodyFlags.batched); None = automatic (batched whenever dyn and no mailbox).
     """
     G, N = cfg.n_groups, cfg.n_nodes
     t = state.tick
     aux = {}
+    dyn = cfg.uses_dyn_log
     flags = BodyFlags(
         faults=cfg.p_crash > 0 or cfg.p_restart > 0 or fault_cmd is not None,
         links=cfg.p_link_fail > 0 or cfg.p_link_heal > 0,
@@ -799,7 +860,8 @@ def make_aux(cfg: RaftConfig, base, tkeys, bkeys, state: RaftState,
         # Deep logs switch to dynamic gather/scatter addressing (the Pallas
         # builder forces this back off — Mosaic needs the one-hot form, and
         # deep-log configs never reach Pallas anyway via choose_impl).
-        dyn_log=cfg.log_capacity >= 256,
+        dyn_log=dyn,
+        batched=dyn and not cfg.uses_mailbox and batched is not False,
     )
     if flags.delay and cfg.delay_lo < cfg.delay_hi:
         aux["delay"] = rngmod.delay_mask(
@@ -897,9 +959,10 @@ def make_rng(cfg: RaftConfig):
     return base, tkeys, bkeys
 
 
-def make_tick(cfg: RaftConfig):
+def make_tick(cfg: RaftConfig, batched: Optional[bool] = None):
     """Build tick(state, inject=None, fault_cmd=None[, rng]) -> state for a
-    fixed config.
+    fixed config. `batched=False` forces the per-pair deep-log engine
+    (BodyFlags.batched; used by sharded runs).
 
     `inject` is an optional (G, N) int32 array of commands (-1 = none) delivered in
     phase 0 in addition to the cfg.cmd_period rule — the driver-level equivalent of the
@@ -934,7 +997,8 @@ def make_tick(cfg: RaftConfig):
                     default_rng.append(make_rng(cfg))
             rng = default_rng[0]
         base, tkeys, bkeys = rng
-        aux, flags = make_aux(cfg, base, tkeys, bkeys, state, inject, fault_cmd)
+        aux, flags = make_aux(cfg, base, tkeys, bkeys, state, inject, fault_cmd,
+                              batched=batched)
         s = flatten_state(cfg, state)
         el_dirty = phase_body(cfg, s, aux, flags)
         return finish_tick(cfg, tkeys, unflatten_state(cfg, s), el_dirty, state.tick)
@@ -942,20 +1006,24 @@ def make_tick(cfg: RaftConfig):
     return tick
 
 
-def make_run(cfg: RaftConfig, n_ticks: int, trace: bool = True, impl: str = "xla"):
+def make_run(cfg: RaftConfig, n_ticks: int, trace: bool = True, impl: str = "xla",
+             batched: Optional[bool] = None):
     """jitted runner: state -> (state, trace) stepping n_ticks via lax.scan.
 
     trace is a dict of (T, N, G) arrays (role/term/commit/last_index/voted_for/rounds/
     up per tick, post-tick) — the differential-test observable. With trace=False
     returns per-tick (G,) leader counts only (cheap bench/metrics mode).
     impl: "xla" (default) or "pallas" (the ops/pallas_tick.py megakernel).
+    batched=False forces the per-pair deep-log engine (BodyFlags.batched) —
+    XLA:CPU compiles of the batched engine blow up on int16 deep configs, so
+    CPU-bound tests of such configs pass this.
     """
     if impl == "pallas":
         from raft_kotlin_tpu.ops.pallas_tick import make_pallas_tick
 
         tick_fn = make_pallas_tick(cfg)
     else:
-        tick_fn = make_tick(cfg)
+        tick_fn = make_tick(cfg, batched=batched)
     rng = make_rng(cfg)
 
     @jax.jit

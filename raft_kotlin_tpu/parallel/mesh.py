@@ -175,6 +175,57 @@ def _make_shardmap_pallas_tick(cfg: RaftConfig, mesh: Mesh,
     return tick
 
 
+def _make_shardmap_xla_tick(cfg: RaftConfig, mesh: Mesh):
+    """The XLA tick with phase_body applied per device shard via jax.shard_map
+    (same division of labor as _make_shardmap_pallas_tick: RNG/aux pre-pass
+    and deferred-draw post-pass stay globally-sharded XLA; the phase lattice
+    runs shard-locally — it is embarrassingly parallel over groups, and
+    phase_body reads its group count from the arrays, not the config).
+
+    Used for deep-log (dyn) configs: XLA's SPMD partitioner mishandles the
+    per-lane log gather/scatter program (observed on the CPU backend:
+    pathological HLO-pass memory, then SIGABRT at execution — consistent
+    with the gathers being rewritten into materialized dense forms).
+    shard_map keeps the compiled per-shard program identical to the
+    single-device one. Bit-identical either way."""
+    from raft_kotlin_tpu.ops import tick as tick_mod
+
+    n_dev = math.prod(mesh.devices.shape)
+    assert cfg.n_groups % n_dev == 0, "pad_groups first"
+    lanes_spec = P(None, ("dcn", "ici"))
+
+    def tick(state: RaftState, rng) -> RaftState:
+        base, tkeys, bkeys = rng
+        # batched=False: the per-pair engine per shard. Per-shard widths are
+        # small (op cost immaterial) and XLA:CPU compiles of the batched
+        # program blow up on int16 deep configs; the batched engine remains
+        # the single-device deep-log fast path (bench's config-5 stage).
+        aux, flags = tick_mod.make_aux(cfg, base, tkeys, bkeys, state,
+                                       None, None, batched=False)
+        sfields = tick_mod.state_fields(flags)
+        aux_names = tuple(k for k in tick_mod.AUX_FIELDS if k in aux)
+        flat = tick_mod.flatten_state(cfg, state)
+
+        def body(*arrs):
+            s = dict(zip(sfields, arrs[: len(sfields)]))
+            a = dict(zip(aux_names, arrs[len(sfields):]))
+            el_dirty = tick_mod.phase_body(cfg, s, a, flags)
+            return tuple(s[k] for k in sfields) + (el_dirty,)
+
+        ins = [flat[k] for k in sfields] + [aux[k] for k in aux_names]
+        outs = jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(lanes_spec,) * len(ins),
+            out_specs=(lanes_spec,) * (len(sfields) + 1),
+            check_vma=False,
+        )(*ins)
+        s = dict(zip(sfields, outs[:-1]))
+        return tick_mod.finish_tick(
+            cfg, tkeys, tick_mod.unflatten_state(cfg, s), outs[-1], state.tick)
+
+    return tick
+
+
 def make_sharded_run(cfg: RaftConfig, mesh: Mesh, n_ticks: int,
                      metrics_every: int = 0, impl: str = "xla"):
     """Compile run(state [, inject]) -> (state, metrics) sharded over `mesh`.
@@ -197,6 +248,13 @@ def make_sharded_run(cfg: RaftConfig, mesh: Mesh, n_ticks: int,
 
     if impl == "pallas":
         shardmap_tick = _make_shardmap_pallas_tick(cfg, mesh)
+        tick_fn = lambda st, rng: shardmap_tick(st, rng)
+    elif cfg.uses_dyn_log:
+        # Deep-log (dyn) configs: phase_body per shard — the SPMD
+        # partitioner mishandles the per-lane gather/scatter program (see
+        # _make_shardmap_xla_tick, which also forces the PER-PAIR engine:
+        # sharded deep runs do NOT use the batched engine).
+        shardmap_tick = _make_shardmap_xla_tick(cfg, mesh)
         tick_fn = lambda st, rng: shardmap_tick(st, rng)
     else:
         xla_tick = make_tick(cfg)

@@ -86,6 +86,14 @@ class RaftConfig:
         return self.mailbox or self.delay_hi > 0
 
     @property
+    def uses_dyn_log(self) -> bool:
+        """Whether the kernel uses dynamic (gather/scatter) log addressing —
+        the deep-log band. THE one threshold shared by engine selection
+        (ops/tick.make_aux), backend choice (ops/pallas_tick.choose_impl),
+        and sharded-run routing (parallel/mesh.make_sharded_run)."""
+        return self.log_capacity >= 256
+
+    @property
     def majority(self) -> int:
         # RaftServer.kt:44
         return self.n_nodes // 2 + 1

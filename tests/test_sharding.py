@@ -72,7 +72,12 @@ def test_state_actually_sharded():
 def test_deep_log_sharded_matches_unsharded():
     # The sharded CPU-mesh equivalent of the bench deep-log stage (BASELINE
     # config-5 shape, scaled for CI): int16 deep logs + dynamic log addressing
-    # sharded over the 8-device mesh must equal the single-device run bit-exactly.
+    # sharded over the 8-device mesh must equal the single-device run
+    # bit-exactly. Both sides run the PER-PAIR dyn engine (batched=False; the
+    # sharded path forces it internally): XLA:CPU compiles of the BATCHED
+    # engine blow up on int16 deep configs (>30 min, >30 GB), while the
+    # batched engine's correctness is covered by the int32 differentials on
+    # CPU and by the int16 parity run on real TPU (test_tpu_pallas).
     mesh = make_mesh()
     cfg = pad_groups(
         RaftConfig(n_groups=8, n_nodes=7, log_capacity=1024,
@@ -80,7 +85,7 @@ def test_deep_log_sharded_matches_unsharded():
                    seed=13).stressed(10),
         mesh)
     T = 80
-    ref, _ = make_run(cfg, T, trace=False)(init_state(cfg))
+    ref, _ = make_run(cfg, T, trace=False, batched=False)(init_state(cfg))
     sh, _ = make_sharded_run(cfg, mesh, T)(init_sharded(cfg, mesh))
     assert_states_equal(jax.device_get(ref), jax.device_get(sh))
     assert int(np.max(np.asarray(sh.commit))) > 0  # replication really ran

@@ -190,10 +190,20 @@ def test_tile_model_sweep_on_tpu():
 
 def test_zzz_write_artifact():
     # Last alphabetically within the module run order: record the evidence.
+    # MERGED into the existing artifact, so a partial (-k filtered) run
+    # refreshes its own entries without dropping the rest of the suite's.
     if _RESULTS:
         path = os.path.join(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))), "TPU_PALLAS.json")
+        results = {}
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    results = json.load(f).get("results", {})
+            except (json.JSONDecodeError, OSError):
+                pass
+        results.update(_RESULTS)
         with open(path, "w") as f:
             json.dump({"platform": jax.default_backend(),
                        "device": str(jax.devices()[0]),
-                       "results": _RESULTS}, f, indent=1)
+                       "results": results}, f, indent=1)
