@@ -126,3 +126,32 @@ def test_append_two_timer_resets_on_foreign_higher_term(node):
     append_handler(node, AppendReq(2, 2, -1, -1, None, 0))
     assert node.t_ctr == t0 + 2
     assert node.el_armed
+
+
+def test_dyn_log_threshold_is_shared():
+    # RaftConfig.uses_dyn_log is THE dyn-log band predicate: engine selection
+    # (make_aux's dyn_log/batched flags), backend choice (choose_impl), and
+    # sharded-run routing all read it. This exercises the predicate itself
+    # and the make_aux flag derivation across the boundary; choose_impl's
+    # CPU behavior is asserted below (its accelerator branch and the mesh
+    # routing read the same property by reference, confirmed by review).
+    from raft_kotlin_tpu.models.state import init_state
+    from raft_kotlin_tpu.ops.pallas_tick import choose_impl
+    from raft_kotlin_tpu.ops.tick import make_aux, make_rng
+
+    for cap, expect in ((255, False), (256, True), (10_000, True)):
+        cfg = RaftConfig(n_groups=2, n_nodes=3, log_capacity=cap)
+        assert cfg.uses_dyn_log is expect, cap
+        assert choose_impl(cfg) == "xla"  # always xla on CPU; dyn band never pallas
+        base, tkeys, bkeys = make_rng(cfg)
+        _, flags = make_aux(cfg, base, tkeys, bkeys, init_state(cfg), None, None)
+        assert flags.dyn_log is expect
+        assert flags.batched is expect  # no mailbox -> batched rides dyn
+        _, flags_pp = make_aux(cfg, base, tkeys, bkeys, init_state(cfg),
+                               None, None, batched=False)
+        assert flags_pp.batched is False  # the sharded/per-pair override
+        mcfg = RaftConfig(n_groups=2, n_nodes=3, log_capacity=cap,
+                          delay_lo=0, delay_hi=1)
+        base2, tk2, bk2 = make_rng(mcfg)
+        _, mflags = make_aux(mcfg, base2, tk2, bk2, init_state(mcfg), None, None)
+        assert mflags.batched is False  # mailbox always per-pair
