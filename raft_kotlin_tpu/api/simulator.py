@@ -75,21 +75,21 @@ class Simulator:
         self._tick = jax.jit(tick)
         if auto and impl == "pallas":
             # choose_impl validates tile construction only; Mosaic compiles lazily
-            # at the first step. Warm up the WORST-CASE variant — inject AND
-            # fault_cmd present, which compiles the kernel with the most aux
-            # inputs (the largest VMEM stack) — so a config passing the VMEM
-            # heuristic but rejected by Mosaic falls back to the XLA tick here
-            # instead of crashing at the first /cmd or crash()/restart() (the
-            # bare variant is a subset and also warmed; results discarded).
+            # at the first step. step() can present any of the FOUR (inject?,
+            # fault_cmd?) presence combinations (e.g. a first /cmd with no
+            # pending fault is inject-only), and each is a distinct BodyFlags
+            # variant — a distinct Mosaic kernel. Warm ALL four so a config
+            # passing the VMEM heuristic but rejected by Mosaic for any variant
+            # falls back to the XLA tick here instead of crashing at the first
+            # /cmd or crash()/restart() (results discarded).
             try:
                 no_cmd = jnp.full((cfg.n_groups, cfg.n_nodes), _NO_CMD,
                                   dtype=jnp.int32)
                 no_fault = jnp.zeros((cfg.n_groups, cfg.n_nodes), dtype=jnp.int32)
-                jax.block_until_ready(
-                    self._tick(self._state, no_cmd, no_fault,
-                               rng=self._rng).term)
-                jax.block_until_ready(
-                    self._tick(self._state, rng=self._rng).term)
+                for args in ((no_cmd, no_fault), (no_cmd, None),
+                             (None, no_fault), (None, None)):
+                    jax.block_until_ready(
+                        self._tick(self._state, *args, rng=self._rng).term)
             except Exception:
                 impl = "xla"
                 self._tick = jax.jit(make_tick(cfg))

@@ -273,6 +273,13 @@ def make_sharded_run(cfg: RaftConfig, mesh: Mesh, n_ticks: int,
     # operand, so the expensive compilation stays seed-independent.
     rng_placed = jax.jit(lambda: make_rng(cfg), out_shardings=rng_sh)()
 
+    def _rounds_sum(st):
+        # Absolute int32 round counters summed over all N*G lanes can exceed
+        # int32 on long production-scale soaks (unlike the old per-tick delta
+        # sum) — widen like commit_total when x64 is available.
+        r = st.rounds
+        return jnp.sum(r.astype(jnp.int64) if jax.config.jax_enable_x64 else r)
+
     def window_metrics(st, rounds0):
         return {
             "leaders": jnp.sum(
@@ -282,7 +289,7 @@ def make_sharded_run(cfg: RaftConfig, mesh: Mesh, n_ticks: int,
             # definition, shared with utils.metrics.tick_metrics and bench.py.
             # (Role-transition counting would miss consecutive rounds by a node
             # that stays CANDIDATE through backoff loops — the churn case.)
-            "elections": jnp.sum(st.rounds) - rounds0,
+            "elections": _rounds_sum(st) - rounds0,
             "commit_total": jnp.sum(jnp.max(st.commit, axis=0).astype(jnp.int64)
                                     if jax.config.jax_enable_x64
                                     else jnp.max(st.commit, axis=0)),
@@ -295,7 +302,7 @@ def make_sharded_run(cfg: RaftConfig, mesh: Mesh, n_ticks: int,
             return st, None
 
         def win(st, _):
-            rounds0 = jnp.sum(st.rounds)
+            rounds0 = _rounds_sum(st)
             st, _ = jax.lax.scan(one, st, None, length=metrics_every)
             return st, window_metrics(st, rounds0)
 
