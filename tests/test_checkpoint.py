@@ -112,6 +112,51 @@ def test_sharded_save_restore(tmp_path):
     assert_states_equal(jax.device_get(straight), jax.device_get(resumed))
 
 
+def test_resharding_restore(tmp_path):
+    # load_sharded's docstring promise (utils/checkpoint.py): restore under a
+    # mesh of ANY device count whose shard boundaries align. Save under the
+    # full 8-device mesh, restore under 4- and 2-device meshes (each device
+    # slice assembles from MULTIPLE shard files — checkpoint.device_slice),
+    # then save under 2 and restore under 8 (each device slice is a SUB-slice
+    # of one file). Resume from a resharded restore must stay bit-exact.
+    from raft_kotlin_tpu.parallel.mesh import (
+        init_sharded, make_mesh, make_sharded_run, state_sharding,
+    )
+
+    devs = jax.devices()
+    assert len(devs) >= 8, "conftest forces an 8-device CPU pool"
+    mesh8 = make_mesh(devs)
+    cfg = dataclasses.replace(CFG, n_groups=16)
+    T = 40
+    st, _ = make_sharded_run(cfg, mesh8, T)(init_sharded(cfg, mesh8))
+    d8 = str(tmp_path / "ck8")
+    checkpoint.save_sharded(d8, st, cfg)
+
+    for n in (4, 2):
+        m = make_mesh(devs[:n])
+        restored, _ = checkpoint.load_sharded(d8, mesh=m, expect_cfg=cfg)
+        assert restored.term.sharding.is_equivalent_to(
+            state_sharding(m, cfg).term, restored.term.ndim)
+        assert_states_equal(jax.device_get(st), jax.device_get(restored))
+
+    # Resume under the 4-device mesh: T more ticks == 2T uninterrupted on 8.
+    m4 = make_mesh(devs[:4])
+    restored4, _ = checkpoint.load_sharded(d8, mesh=m4, expect_cfg=cfg)
+    resumed, _ = make_sharded_run(cfg, m4, T)(restored4)
+    straight, _ = make_sharded_run(cfg, mesh8, 2 * T)(init_sharded(cfg, mesh8))
+    assert_states_equal(jax.device_get(straight), jax.device_get(resumed))
+
+    # Up-sharding: a 2-shard save restores under the 8-device mesh.
+    m2 = make_mesh(devs[:2])
+    st2, _ = checkpoint.load_sharded(d8, mesh=m2, expect_cfg=cfg)
+    d2 = str(tmp_path / "ck2")
+    checkpoint.save_sharded(d2, st2, cfg)
+    r8, _ = checkpoint.load_sharded(d2, mesh=mesh8, expect_cfg=cfg)
+    assert r8.term.sharding.is_equivalent_to(
+        state_sharding(mesh8, cfg).term, r8.term.ndim)
+    assert_states_equal(jax.device_get(st), jax.device_get(r8))
+
+
 def test_v1_checkpoint_forward_migration(tmp_path):
     # A v1 checkpoint (pre-fault-model) must load with up/link_up defaulted to
     # all-healthy boot values (utils/checkpoint._load_impl migration).
