@@ -21,10 +21,18 @@ Stages (all on one chip; prints exactly ONE JSON line on stdout):
    tick is HBM-bound: every array is read + written once per tick), achieved
    HBM bandwidth fraction vs the chip's peak, and the XLA-vs-Pallas ratio, so
    the headline has a roofline anchor.
-5. **Deep log** — BASELINE config-5 shape on one chip: log_capacity=10_000,
+5. **Mailbox** — the headline config with 1-3-tick §10 message delays (the
+   reference's true async regime: every exchange rides a capacity-1 in-flight
+   slot with straggler cancellation).
+6. **Deep log** — BASELINE config-5 shape on one chip: log_capacity=10_000,
    n_nodes=7, int16 logs (utils/config.log_dtype), n_groups = the HBM-budget
    ceiling (RaftConfig.max_groups_for_hbm) rounded to lanes. Reports the
-   groups-per-chip ceiling and achieved group-steps/s.
+   groups-per-chip ceiling and achieved group-steps/s, under the same
+   integrity envelope as stage 1 (median-of-3+, suspect gates, a
+   minimum-traffic roofline anchor).
+7. **Engine corners** — C=1024 deep-band probes: the sharded shard_map+flat
+   per-pair program (1-device mesh), the single-device sliced comparator, and
+   the mailbox+deep corner sliced-vs-flat pair (the BodyFlags.sharded payoff).
 
 Baseline derivation for `vs_baseline` (the reference publishes no numbers —
 BASELINE.md): the reference advances ONE group in real time at 1 tick = 100 ms
@@ -203,6 +211,14 @@ def parity_stage(cfg, groups, ticks, impl):
 def main() -> None:
     from raft_kotlin_tpu.utils.config import RaftConfig
 
+    # Persistent compile cache (same location as tests/conftest.py): the bench
+    # compiles ~10 distinct tick programs; cache hits make repeat runs minutes
+    # faster on small hosts.
+    jax.config.update("jax_compilation_cache_dir", os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
     platform = jax.devices()[0].platform
     on_accel = platform not in ("cpu",)
 
@@ -285,6 +301,18 @@ def main() -> None:
     parity_rate, parity_n, parity_impl = parity_stage(
         cfg, parity_groups, min(ticks, 200), impl)
 
+    # Stage 4b — §10 mailbox at headline scale (VERDICT r03 missing #2): the
+    # reference's true async regime (RaftServer.kt:214-215 straggler
+    # cancellation) — same fault-soup config, every exchange now carries a
+    # 1-3-tick delivery delay through the capacity-1 mailbox slots. Same
+    # measurement discipline as stage 1 (median of `reps` with distinct rng
+    # operands + in-region materialization).
+    mail_cfg = dataclasses.replace(cfg, delay_lo=1, delay_hi=3, seed=5)
+    mail_times, mstats, mail_impl = measure(mail_cfg, ticks, reps, tick_candidates)
+    mbest = median(mail_times)
+    mail_steps_per_sec = groups * ticks / mbest
+    mail_elections_per_sec = mstats[mail_times.index(mbest)]["rounds"] / mbest
+
     # Stage 5 — deep log (BASELINE config 5 shape on one chip): C=10k, N=7,
     # int16 logs, G at the HBM ceiling rounded down to lanes. The scan peak
     # holds ~3x state bytes (st0 + double-buffered carry), hence the working
@@ -300,18 +328,46 @@ def main() -> None:
     if not on_accel:
         deep_g = 256
     deep_ticks = int(os.environ.get("RAFT_BENCH_DEEPLOG_TICKS", 30))
+    deep_reps = int(os.environ.get("RAFT_BENCH_DEEPLOG_REPS", 3))
     deep_steps_per_sec = None
     deep_commit_total = None
     deep_times = []
     deep_impl = "xla"
+    deep_suspect_reasons = ["stage did not run"]
+    deep_min_bytes = None
+    deep_hbm_frac = None
     for _attempt in range(3):
         deep_cfg = dataclasses.replace(deep_proto, n_groups=deep_g)
         try:
-            deep_times, dstats, deep_impl = measure(
-                deep_cfg, deep_ticks, 2, deep_candidates,
-                summarize=lambda end: {
-                    "commit": int(jnp.sum(jnp.max(end.commit, axis=0)))})
-            dbest = median(deep_times)
+            # Same integrity envelope as stage 1 (VERDICT r03 weak #2): >=3
+            # reps, a bytes/tick anchor, and the suspect gates. The anchor is
+            # the MINIMUM traffic — every state array read + written once per
+            # tick (state_aux_bytes_per_tick); if even that implies more than
+            # the chip's physical HBM peak, the measurement is bogus. The
+            # fraction is the roofline-style "how close to one ideal pass
+            # over state" figure for the deep engine.
+            deep_min_bytes = state_aux_bytes_per_tick(deep_cfg)
+            for deep_attempt in range(2):
+                deep_times, dstats, deep_impl = measure(
+                    deep_cfg, deep_ticks, deep_reps, deep_candidates,
+                    summarize=lambda end: {
+                        "commit": int(jnp.sum(jnp.max(end.commit, axis=0)))})
+                dbest = median(deep_times)
+                d_bw = deep_min_bytes * (deep_ticks / dbest)
+                deep_hbm_frac = round(d_bw / peak, 3) if peak else None
+                d_spread = max(deep_times) / min(deep_times)
+                bad = []
+                if deep_hbm_frac is not None and deep_hbm_frac > 1.0:
+                    bad.append(f"deep hbm_bw_frac {deep_hbm_frac} > 1.0 "
+                               "(physically impossible)")
+                if d_spread > 10:
+                    bad.append(f"deep rep spread {d_spread:.1f}x > 10x")
+                deep_suspect_reasons = bad
+                if not bad:
+                    break
+                print(f"deep measurement attempt {deep_attempt} suspect: "
+                      f"{'; '.join(bad)}; rep times {deep_times}",
+                      file=sys.stderr)
             deep_steps_per_sec = round(deep_g * deep_ticks / dbest, 1)
             deep_commit_total = dstats[deep_times.index(dbest)]["commit"]
             break
@@ -322,6 +378,60 @@ def main() -> None:
             if smaller == deep_g:
                 break  # can't shrink further; report nulls
             deep_g = smaller
+
+    # Stage 6 — the two formerly-unbenchmarked engine corners (VERDICT r03
+    # missing #2 / weak #3), at a reduced-but-deep shape (C=1024 keeps the
+    # per-pair engines' op costs measurable; both are still the dyn band):
+    # (a) the SHARDED deep-log per-pair FLAT engine — the exact per-shard
+    #     program parallel/mesh compiles (shard_map over a 1-device mesh on
+    #     this chip; multi-chip only changes the lane width per shard);
+    # (b) the single-device mailbox+deep corner, sliced (the BodyFlags.sharded
+    #     routing) vs flat (what it paid before the flags bit).
+    corner_g = int(os.environ.get("RAFT_BENCH_CORNER_GROUPS", 2048))
+    corner_ticks = int(os.environ.get("RAFT_BENCH_CORNER_TICKS", 10))
+    corner_proto = dataclasses.replace(
+        deep_proto, log_capacity=1024, n_groups=corner_g, seed=7)
+    if not on_accel:
+        corner_g = 64
+        corner_proto = dataclasses.replace(corner_proto, n_groups=corner_g)
+    corner = {}
+
+    def corner_measure(key, cfg_c, candidates):
+        try:
+            ts, _, _ = measure(cfg_c, corner_ticks, 2, candidates)
+            corner[key] = round(cfg_c.n_groups * corner_ticks / median(ts), 1)
+            corner[key + "_rep_times_s"] = [round(t, 4) for t in ts]
+        except Exception as e:
+            print(f"corner stage {key} failed: {str(e)[:200]}", file=sys.stderr)
+            corner[key] = None
+
+    def shardmap_candidates(cfg_c):
+        # The exact per-shard program parallel/mesh compiles for deep configs:
+        # shard_map + per-pair FLAT engine, here over a 1-device mesh (the one
+        # real chip; multi-chip only widens the lane count per shard).
+        from raft_kotlin_tpu.parallel.mesh import (
+            _make_shardmap_xla_tick, make_mesh)
+
+        mesh = make_mesh(jax.devices()[:1])
+        smt = _make_shardmap_xla_tick(cfg_c, mesh)
+        yield (lambda st, rng=None: smt(st, rng)), "shardmap-flat"
+
+    def make_pair_candidates(sharded):
+        def gen(cfg_c):
+            from raft_kotlin_tpu.ops.tick import make_tick
+
+            yield make_tick(cfg_c, batched=False, sharded=sharded), (
+                "per-pair-flat" if sharded else "per-pair-sliced")
+        return gen
+
+    corner_measure("shardeddeep_gsps", corner_proto, shardmap_candidates)
+    corner_measure("cornerdeep_pp_sliced_gsps", corner_proto,
+                   make_pair_candidates(False))
+    mbdeep_cfg = dataclasses.replace(corner_proto, delay_lo=1, delay_hi=3)
+    corner_measure("mbdeep_sliced_gsps", mbdeep_cfg,
+                   make_pair_candidates(False))
+    corner_measure("mbdeep_flat_gsps", mbdeep_cfg,
+                   make_pair_candidates(True))
 
     baseline_group_steps_per_sec = 10.0
     print(json.dumps({
@@ -354,7 +464,15 @@ def main() -> None:
         "hbm_bw_frac": hbm_bw_frac,
         "pallas_vs_xla": round(pallas_vs_xla, 2),
         "xla_ticks_per_sec": round(xla_ticks_per_sec, 2),
-        # Deep-log stage (BASELINE config 5 shape).
+        # §10 mailbox stage (headline fault-soup config + 1-3-tick delays).
+        "mailbox_group_steps_per_sec": round(mail_steps_per_sec, 1),
+        "mailbox_elections_per_sec": round(mail_elections_per_sec, 1),
+        "mailbox_impl": mail_impl,
+        "mailbox_delay_ticks": [mail_cfg.delay_lo, mail_cfg.delay_hi],
+        "mailbox_rep_times_s": [round(t, 4) for t in mail_times],
+        # Deep-log stage (BASELINE config 5 shape), same integrity envelope
+        # as the headline: median of >=3 reps, suspect gates, and a
+        # minimum-traffic roofline anchor (state read+written once per tick).
         "deeplog_groups_per_chip": deep_g if deep_steps_per_sec else 0,
         "deeplog_capacity": deep_cfg.log_capacity,
         "deeplog_n_nodes": deep_cfg.n_nodes,
@@ -363,6 +481,17 @@ def main() -> None:
         "deeplog_impl": deep_impl,
         "deeplog_rep_times_s": [round(t, 4) for t in deep_times],
         "deeplog_hbm_gb": round(deep_cfg.hbm_bytes() / 1e9, 2),
+        "deeplog_suspect": bool(deep_suspect_reasons),
+        "deeplog_suspect_reason": "; ".join(deep_suspect_reasons) or None,
+        "deeplog_min_bytes_per_tick": deep_min_bytes,
+        "deeplog_hbm_bw_frac": deep_hbm_frac,
+        # Engine-corner probes (C=1024 deep band, G=corner_g, group-steps/s):
+        # the sharded shard_map+flat program on a 1-device mesh, the
+        # single-device per-pair sliced comparator, and the mailbox+deep
+        # corner sliced (BodyFlags.sharded routing) vs flat (pre-flags cost).
+        "corner_groups": corner_g,
+        "corner_capacity": corner_proto.log_capacity,
+        **corner,
     }))
     sys.stdout.flush()
 

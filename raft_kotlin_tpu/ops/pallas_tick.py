@@ -125,7 +125,8 @@ def make_pallas_core(cfg: RaftConfig, lanes: int, tile_g: int, interpret: bool):
     @functools.lru_cache(maxsize=None)
     def build_call(flags: BodyFlags):
         # Mosaic has no gather/scatter in the TC path: always the one-hot form.
-        flags = dataclasses.replace(flags, dyn_log=False, batched=False)
+        flags = dataclasses.replace(flags, dyn_log=False, batched=False,
+                                    sharded=False)
         sfields = state_fields(flags)
         aux_names = tuple(
             k for k in AUX_FIELDS

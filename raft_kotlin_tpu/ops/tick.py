@@ -135,6 +135,12 @@ class BodyFlags:
     # aborts on the batched gather/scatter program; per-shard widths are
     # tiny anyway, so the per-pair engine costs little there).
     batched: bool = False
+    # True only for runs that are ACTUALLY sharded (parallel/mesh routes the
+    # dyn tick through shard_map and sets this): the per-pair dyn engine then
+    # keeps the logs FLAT — the round-2-proven sharded program. Single-device
+    # per-pair dyn runs (the mailbox+deep corner) leave it False and get
+    # per-node (C, G) slice operands, an ~Nx cut per log op.
+    sharded: bool = False
 
 
 def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
@@ -158,16 +164,15 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
     # and an out-of-range index structurally CANNOT alias another node's
     # rows: it simply matches nothing in [0, C).
     #
-    # EXCEPT the per-pair dyn engine (sharded deep logs / mailbox deep logs):
-    # there the logs stay FLAT with global rows — the slice + per-slice
-    # scatter + concat pattern makes XLA's SPMD partitioner blow up
-    # (observed: SIGABRT / unbounded HLO-pass memory on the CPU backend),
-    # and the flat per-pair form is the round-2-proven sharded program.
-    # Known tradeoff: a SINGLE-DEVICE mailbox+deep config (delay > 0,
-    # C >= 256) also takes the flat path and pays ~Nx more per log op than
-    # slices would; that corner class is unbenchmarked — revisit if it ever
-    # matters (a flags bit distinguishing "actually sharded" would do it).
-    use_slices = (not flags.dyn_log) or flags.batched
+    # EXCEPT the per-pair dyn engine on ACTUALLY SHARDED runs (flags.sharded,
+    # set by parallel/mesh): there the logs stay FLAT with global rows — the
+    # slice + per-slice scatter + concat pattern makes XLA's SPMD partitioner
+    # blow up (observed: SIGABRT / unbounded HLO-pass memory on the CPU
+    # backend), and the flat per-pair form is the round-2-proven sharded
+    # program. A SINGLE-DEVICE per-pair dyn run (the mailbox+deep corner)
+    # keeps slices: same values (differentially tested), ~Nx less log-op cost
+    # (bench.py's mailbox-deep probe carries the number).
+    use_slices = (not flags.dyn_log) or flags.batched or not flags.sharded
     if use_slices:
         lt = [s["log_term"][n * C:(n + 1) * C] for n in range(N)]
         lc = [s["log_cmd"][n * C:(n + 1) * C] for n in range(N)]
@@ -839,13 +844,16 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
 
 
 def make_aux(cfg: RaftConfig, base, tkeys, bkeys, state: RaftState,
-             inject, fault_cmd, batched: Optional[bool] = None):
+             inject, fault_cmd, batched: Optional[bool] = None,
+             sharded: bool = False):
     """Draw/assemble the phase_body aux inputs from pre-tick state (XLA ops).
 
     Randomness is drawn in the canonical (G, ...) §4 shapes and transposed, so no
     drawn bit depends on the groups-minor layout. Returns (aux dict, flags).
     `batched=False` forces the per-pair deep-log engine (sharded runs — see
     BodyFlags.batched); None = automatic (batched whenever dyn and no mailbox).
+    `sharded=True` marks an actually-sharded run (parallel/mesh): the per-pair
+    dyn engine then uses the flat log layout (BodyFlags.sharded).
     """
     G, N = cfg.n_groups, cfg.n_nodes
     t = state.tick
@@ -862,6 +870,7 @@ def make_aux(cfg: RaftConfig, base, tkeys, bkeys, state: RaftState,
         # deep-log configs never reach Pallas anyway via choose_impl).
         dyn_log=dyn,
         batched=dyn and not cfg.uses_mailbox and batched is not False,
+        sharded=dyn and sharded,
     )
     if flags.delay and cfg.delay_lo < cfg.delay_hi:
         aux["delay"] = rngmod.delay_mask(
@@ -959,10 +968,13 @@ def make_rng(cfg: RaftConfig):
     return base, tkeys, bkeys
 
 
-def make_tick(cfg: RaftConfig, batched: Optional[bool] = None):
+def make_tick(cfg: RaftConfig, batched: Optional[bool] = None,
+              sharded: bool = False):
     """Build tick(state, inject=None, fault_cmd=None[, rng]) -> state for a
     fixed config. `batched=False` forces the per-pair deep-log engine
-    (BodyFlags.batched; used by sharded runs).
+    (BodyFlags.batched; used by sharded runs); `sharded=True` additionally
+    selects the flat log layout inside it (BodyFlags.sharded — what
+    parallel/mesh compiles per shard; exposed here for differential tests).
 
     `inject` is an optional (G, N) int32 array of commands (-1 = none) delivered in
     phase 0 in addition to the cfg.cmd_period rule — the driver-level equivalent of the
@@ -998,7 +1010,7 @@ def make_tick(cfg: RaftConfig, batched: Optional[bool] = None):
             rng = default_rng[0]
         base, tkeys, bkeys = rng
         aux, flags = make_aux(cfg, base, tkeys, bkeys, state, inject, fault_cmd,
-                              batched=batched)
+                              batched=batched, sharded=sharded)
         s = flatten_state(cfg, state)
         el_dirty = phase_body(cfg, s, aux, flags)
         return finish_tick(cfg, tkeys, unflatten_state(cfg, s), el_dirty, state.tick)

@@ -200,8 +200,10 @@ def _make_shardmap_xla_tick(cfg: RaftConfig, mesh: Mesh):
         # small (op cost immaterial) and XLA:CPU compiles of the batched
         # program blow up on int16 deep configs; the batched engine remains
         # the single-device deep-log fast path (bench's config-5 stage).
+        # sharded=True: flat log layout (the round-2-proven sharded program —
+        # see BodyFlags.sharded).
         aux, flags = tick_mod.make_aux(cfg, base, tkeys, bkeys, state,
-                                       None, None, batched=False)
+                                       None, None, batched=False, sharded=True)
         sfields = tick_mod.state_fields(flags)
         aux_names = tuple(k for k in tick_mod.AUX_FIELDS if k in aux)
         flat = tick_mod.flatten_state(cfg, state)

@@ -1,0 +1,138 @@
+"""TPU probe: deep-log op cost curves + tick attribution (round-4 design input).
+
+Measures, on the real chip:
+1. take_along_axis / put_along_axis cost on a (C, G) int16 operand as a
+   function of C (the operand-size-proportionality the round-3 cost model
+   claims: per-OP x operand-size, per memory of TPU measurements) and of the
+   number of index rows;
+2. the deep tick's wall time and its ablated variants (reads zeroed / final
+   write scatters dropped) to attribute the 155 ms/tick.
+
+Writes one JSON line per measurement to stdout; run with
+  python scripts/probe_deep_costs.py [G]
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_compilation_cache_dir", os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
+def timeit(fn, *args, reps=3):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+def op_curves(G: int):
+    key = jax.random.PRNGKey(0)
+    for C in (128, 256, 512, 1024, 2048, 10_000):
+        arr = jax.random.randint(key, (C, G), 0, 100, dtype=jnp.int32).astype(jnp.int16)
+        for R in (1, 8, 32):
+            rows = jax.random.randint(key, (R, G), 0, C, dtype=jnp.int32)
+
+            @jax.jit
+            def take(a, r):
+                return jnp.take_along_axis(a, r, axis=0)
+
+            @jax.jit
+            def put(a, r):
+                vals = (r % 7).astype(jnp.int16)
+                return jnp.put_along_axis(a, r, vals, axis=0, inplace=False)
+
+            # N scan iterations so per-dispatch overhead amortizes out.
+            @jax.jit
+            def take_scan(a, r):
+                def body(c, _):
+                    return c + 1, jnp.sum(take(a, r + c % 3))
+                return jax.lax.scan(body, 0, None, length=20)[1].sum()
+
+            @jax.jit
+            def put_scan(a, r):
+                def body(c, _):
+                    a2 = put(a, r + c % 3)
+                    return c + 1, jnp.sum(a2[0])
+                return jax.lax.scan(body, 0, None, length=20)[1].sum()
+
+            t_take = timeit(take_scan, arr, rows) / 20
+            t_put = timeit(put_scan, arr, rows) / 20
+            print(json.dumps({
+                "probe": "op", "C": C, "G": G, "rows": R,
+                "operand_mb": round(C * G * 2 / 1e6, 1),
+                "take_ms": round(t_take * 1e3, 3),
+                "put_ms": round(t_put * 1e3, 3),
+            }), flush=True)
+
+
+def tick_attribution(G: int):
+    from raft_kotlin_tpu.models.state import init_state
+    from raft_kotlin_tpu.ops import tick as tick_mod
+    from raft_kotlin_tpu.utils.config import RaftConfig
+
+    cfg = dataclasses.replace(RaftConfig(
+        n_nodes=7, log_capacity=10_000, log_dtype="int16", cmd_period=2,
+        p_drop=0.05, seed=3,
+    ).stressed(10), n_groups=G)
+    T = 10
+
+    def run_variant(label, patch=None):
+        orig_take = jnp.take_along_axis
+        orig_put = jnp.put_along_axis
+        try:
+            if patch == "no_reads":
+                def fake_take(a, r, axis=0):
+                    return jnp.zeros(
+                        r.shape if a.ndim == r.ndim else r.shape, a.dtype)
+                jnp.take_along_axis = fake_take
+            elif patch == "no_writes":
+                def fake_put(a, r, v, axis=0, inplace=False):
+                    return a
+                jnp.put_along_axis = fake_put
+            tick = tick_mod.make_tick(cfg)
+            rng = tick_mod.make_rng(cfg)
+
+            @jax.jit
+            def run(st, rng):
+                return jax.lax.scan(
+                    lambda s, _: (tick(s, rng=rng), None), st, None, length=T)[0]
+
+            st0 = init_state(cfg)
+            t = timeit(lambda: run(st0, rng), reps=2)
+            print(json.dumps({
+                "probe": "tick", "variant": label, "G": G,
+                "ms_per_tick": round(t / T * 1e3, 2),
+            }), flush=True)
+        finally:
+            jnp.take_along_axis = orig_take
+            jnp.put_along_axis = orig_put
+
+    run_variant("full")
+    run_variant("no_reads", "no_reads")
+    run_variant("no_writes", "no_writes")
+
+
+if __name__ == "__main__":
+    G = int(sys.argv[1]) if len(sys.argv) > 1 else 13_184
+    print(json.dumps({"devices": str(jax.devices())}), flush=True)
+    op_curves(G)
+    tick_attribution(G)

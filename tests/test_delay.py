@@ -153,6 +153,26 @@ def test_straggler_vote_mutates_peer_but_not_candidate():
     assert c.responses == 0 and c.votes == 0
 
 
+def test_mailbox_deep_sliced_engine_matches_flat():
+    # The "actually sharded" flags bit (BodyFlags.sharded): a SINGLE-DEVICE
+    # mailbox+deep config (delay > 0, C >= 256) runs the per-pair dyn engine
+    # on per-node (C, G) slice operands — ~Nx less log-op cost than the flat
+    # layout. Forcing the flat form (what parallel/mesh compiles per shard via
+    # make_tick(sharded=True)) must produce identical bits tick for tick.
+    import jax
+
+    from raft_kotlin_tpu.ops.tick import make_tick
+
+    cfg = dataclasses.replace(SYNC, log_capacity=256, delay_lo=0, delay_hi=3)
+    t_sliced = jax.jit(make_tick(cfg))
+    t_flat = jax.jit(make_tick(cfg, sharded=True))
+    a = b = init_state(cfg)
+    for _ in range(100):
+        a, b = t_sliced(a), t_flat(b)
+    assert_states_equal(jax.device_get(a), jax.device_get(b))
+    assert int(np.max(np.asarray(a.commit))) > 0  # replication really ran
+
+
 def test_restart_clears_owned_slots():
     # §10: a restarted node's in-flight sent requests die with the process.
     cfg = RaftConfig(n_groups=1, n_nodes=3, log_capacity=8, seed=4,

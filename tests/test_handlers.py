@@ -150,8 +150,13 @@ def test_dyn_log_threshold_is_shared():
         _, flags_pp = make_aux(cfg, base, tkeys, bkeys, init_state(cfg),
                                None, None, batched=False)
         assert flags_pp.batched is False  # the sharded/per-pair override
+        assert flags_pp.sharded is False  # per-pair alone != actually sharded
+        _, flags_sh = make_aux(cfg, base, tkeys, bkeys, init_state(cfg),
+                               None, None, batched=False, sharded=True)
+        assert flags_sh.sharded is expect  # flat layout only in the dyn band
         mcfg = RaftConfig(n_groups=2, n_nodes=3, log_capacity=cap,
                           delay_lo=0, delay_hi=1)
         base2, tk2, bk2 = make_rng(mcfg)
         _, mflags = make_aux(mcfg, base2, tk2, bk2, init_state(mcfg), None, None)
         assert mflags.batched is False  # mailbox always per-pair
+        assert mflags.sharded is False  # single-device mailbox+deep: slices
