@@ -1,0 +1,88 @@
+"""TPU probe: headline-config megakernel tile ladder + glue attribution.
+
+For the stage-1 fault-soup config (N=5, C=32, G=102400), times the Pallas
+tick at each candidate tile_g (whether or not the 30 B/element VMEM model
+would pick it) and records Mosaic accept/reject — the rejection-boundary data
+VERDICT r03 item 8 asks for — plus the XLA-glue share (aux draws + casts +
+finish_tick) measured by timing the kernel-only portion separately.
+
+  python scripts/probe_stage1_tiles.py
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_compilation_cache_dir", os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
+def timed_run(tick, cfg, T=50, reps=3):
+    from raft_kotlin_tpu.models.state import init_state
+    from raft_kotlin_tpu.ops.tick import make_rng
+
+    rngs = [make_rng(dataclasses.replace(cfg, seed=cfg.seed + 1000 * r))
+            for r in range(reps + 1)]
+
+    @jax.jit
+    def run(st, rng):
+        return jax.lax.scan(
+            lambda s, _: (tick(s, rng=rng), None), st, None, length=T)[0]
+
+    st0 = init_state(cfg)
+    int(jnp.sum(run(st0, rngs[reps]).rounds))  # warm
+    ts = []
+    for r in range(reps):
+        t0 = time.perf_counter()
+        int(jnp.sum(run(st0, rngs[r]).rounds))
+        ts.append(time.perf_counter() - t0)
+    return min(ts) / T
+
+
+def main():
+    from raft_kotlin_tpu.ops.pallas_tick import default_tile, make_pallas_tick
+    from raft_kotlin_tpu.ops.tick import make_tick
+    from raft_kotlin_tpu.utils.config import RaftConfig
+
+    cfg = RaftConfig(
+        n_groups=102_400, n_nodes=5, log_capacity=32, cmd_period=10,
+        p_drop=0.25, p_crash=0.01, p_restart=0.08,
+        p_link_fail=0.02, p_link_heal=0.08, seed=0,
+    ).stressed(10)
+    model_tile = default_tile(cfg, cfg.n_groups, False)
+    print(json.dumps({"model_tile": model_tile}), flush=True)
+
+    for tile in (2048, 1024, 512, 256, 128):
+        if cfg.n_groups % tile:
+            continue
+        try:
+            tick = make_pallas_tick(cfg, tile_g=tile, interpret=False)
+            ms = timed_run(tick, cfg) * 1e3
+            print(json.dumps({
+                "probe": "tile", "tile": tile, "ms_per_tick": round(ms, 3),
+                "model_would_pick": tile == model_tile, "mosaic": "ok",
+            }), flush=True)
+        except Exception as e:
+            print(json.dumps({
+                "probe": "tile", "tile": tile, "mosaic": "reject",
+                "err": str(e)[:200],
+            }), flush=True)
+
+    ms_xla = timed_run(make_tick(cfg), cfg) * 1e3
+    print(json.dumps({"probe": "xla", "ms_per_tick": round(ms_xla, 3)}),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()
