@@ -44,6 +44,17 @@ class RaftState:
     phys_len: jax.Array    # (N, G) i32
     log_term: jax.Array    # (N, C, G) i32
     log_cmd: jax.Array     # (N, C, G) i32
+    # Derived cache: log_term at physical slot last_index - 1 (0 when the log
+    # is logically empty) — the lastLogTerm every vote request/handler reads
+    # (reference RaftServer.kt:200-207). Maintained by the tick (zeroed on
+    # restart, patched after phase-0 appends, recomputed from the final log at
+    # tick end) so phase 3 never needs a per-node log gather; on deep-log
+    # configs those gathers are ~ms-scale ops (round-4 cost probe). Note the
+    # ghost-append quirk (§3) makes this NOT "the last appended term": after a
+    # logical truncation an append writes physical slot phys_len while
+    # last_index points elsewhere, so the cache must be recomputed, not
+    # accumulated.
+    last_term: jax.Array   # (N, G) i32
 
     # Election timer (one-shot; armed at boot).
     el_armed: jax.Array    # (N, G) bool
@@ -121,6 +132,7 @@ def init_state(cfg: RaftConfig) -> RaftState:
         phys_len=zi(N, G),
         log_term=jnp.zeros((N, C, G), dtype=ldt),
         log_cmd=jnp.zeros((N, C, G), dtype=ldt),
+        last_term=zi(N, G),
         el_armed=jnp.ones((N, G), dtype=bool),
         el_left=el_left,
         round_state=zi(N, G),

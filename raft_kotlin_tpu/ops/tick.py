@@ -231,8 +231,8 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
     # in the view (el_armed/hb_armed/up) are only ever combined with boolean
     # algebra, never select-of-i1-values (Mosaic limits).
     _COLF = ("term", "voted_for", "role", "commit", "last_index", "phys_len",
-             "el_armed", "round_state", "round_age", "votes", "responses",
-             "hb_armed", "hb_left", "up", "t_ctr", "rounds")
+             "last_term", "el_armed", "round_state", "round_age", "votes",
+             "responses", "hb_armed", "hb_left", "up", "t_ctr", "rounds")
     _PAIRV = ("responded", "next_index", "match_index") + \
         (MAILBOX_FIELDS if flags.delay else ())
     view: dict = {}
@@ -419,7 +419,8 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
         s["last_index"] = jnp.where(rst, zero, s["last_index"])
         s["phys_len"] = jnp.where(rst, zero, s["phys_len"])
         s["round_state"] = jnp.where(rst, IDLE, s["round_state"])
-        for f in ("votes", "responses", "round_left", "round_age", "bo_left"):
+        for f in ("votes", "responses", "round_left", "round_age", "bo_left",
+                  "last_term"):
             s[f] = jnp.where(rst, zero, s[f])
         # Pair grids are owned by their FIRST node index (candidate/leader).
         # Arithmetic selects: pair-shaped tensors never hold i1 (Mosaic limits).
@@ -467,6 +468,22 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
             cmd = aux["inject"][n - 1]
             log_add(n, col("last_index", n), col("term", n), cmd,
                     (cmd >= 0) & col("up", n))
+    if flags.periodic or flags.inject:
+        # Refresh the lastLogTerm cache for nodes phase 0 may have appended
+        # to: phase 3 reads state.last_term this same tick, and a ghost
+        # append (§3) makes the post-append value a LOG read (slot li-1),
+        # not the written term. In batched mode the add was deferred, so the
+        # raw gather is patched with this node's pending writes.
+        p0_nodes = set([cfg.cmd_node] if flags.periodic else [])
+        if flags.inject:
+            p0_nodes.update(range(1, N + 1))
+        for n in sorted(p0_nodes):
+            li_n = col("last_index", n)
+            raw = log_gather("log_term", n, li_n - 1)
+            if batched_logs:
+                raw = patch("log_term", n, jnp.clip(li_n - 1, 0, C - 1), raw)
+            s["last_term"] = _set_row(
+                s["last_term"], n - 1, jnp.where(li_n >= 1, raw, 0))
 
     # -- phase 1: timers (independent countdowns) ---------------------------
 
@@ -506,16 +523,18 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
     # -- phase 3: vote exchanges --------------------------------------------
 
     # Hoisted per-node last-log position/term: INVARIANT across phase 3 (no
-    # vote path touches logs or last_index), so the N*N pairs share N gathers
-    # instead of recomputing one per pair. llt_h[n-1] is 0 when the log is
-    # empty (a gather at -1 matches no row), which is exactly the request
-    # convention (lastLogTerm 0 on an empty log) AND the handler's
-    # up-to-dateness input (rej_* are guarded by p_li >= 1).
+    # vote path touches logs or last_index), so the N*N pairs share N reads
+    # instead of recomputing one per pair. llt_h comes from the state-carried
+    # lastLogTerm cache (state.last_term — zeroed by restart in phase F,
+    # refreshed after phase-0 appends above, recomputed from the final log at
+    # tick end), so phase 3 issues NO log gathers at all; llt_h[n-1] is 0
+    # when the log is empty, which is exactly the request convention
+    # (lastLogTerm 0 on an empty log) AND the handler's up-to-dateness input
+    # (rej_* are guarded by p_li >= 1).
     if use_columnar:
         enter_cols()  # phase 3 runs on the columnar view
     lli_h = [col("last_index", n) for n in range(1, N + 1)]
-    llt_h = [log_gather("log_term", n, lli_h[n - 1] - 1)
-             for n in range(1, N + 1)]
+    llt_h = [col("last_term", n) for n in range(1, N + 1)]
 
     def delay_for(a, b):
         # §10 per-pair send delay this tick (static constant when lo == hi).
@@ -834,6 +853,18 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
                 lt[n - 1], rows, jnp.stack(eff_t), axis=0, inplace=False)
             lc[n - 1] = jnp.put_along_axis(
                 lc[n - 1], rows, jnp.stack(eff_c), axis=0, inplace=False)
+
+    # lastLogTerm cache refresh (state.last_term): recomputed from the FINAL
+    # log (batched scatters are applied above), so the ghost-append quirk (§3)
+    # is honored exactly — the cache is log_term[last_index - 1], which after
+    # a post-truncation append is NOT the term just written. Net-neutral op
+    # count for the one-hot and per-pair engines (it replaces the N gathers
+    # phase 3 used to issue); the batched engine's Pallas read kernel folds
+    # these rows into its superset.
+    for n in range(1, N + 1):
+        s["last_term"] = _set_row(
+            s["last_term"], n - 1,
+            log_gather("log_term", n, s["last_index"][n - 1] - 1))
 
     if use_slices:
         # Rejoin the per-node log slices into the flat (N*C, G) layout.

@@ -32,8 +32,19 @@ from raft_kotlin_tpu.utils.config import RaftConfig
 _HEADER_KEY = "__raft_config_json__"
 _EXTRA_KEY = "__raft_extra_json__"
 _VERSION_KEY = "__raft_ckpt_version__"
-_VERSION = 4  # v2: +up/+link_up fault-model fields; v3: groups-minor array layout;
-              # v4: optional §10 mailbox arrays (present iff cfg.uses_mailbox)
+_VERSION = 5  # v2: +up/+link_up fault-model fields; v3: groups-minor array layout;
+              # v4: optional §10 mailbox arrays (present iff cfg.uses_mailbox);
+              # v5: +last_term lastLogTerm cache (derived from the log on load
+              # of older checkpoints)
+
+
+def _derive_last_term(log_term, last_index):
+    """last_term for v<5 checkpoints: log_term at physical slot last_index-1
+    (0 when logically empty) — the §3 read phase 3 used to issue per tick."""
+    li = last_index.astype(np.int64)
+    idx = np.clip(li - 1, 0, log_term.shape[1] - 1)
+    vals = np.take_along_axis(log_term, idx[:, None, :], axis=1)[:, 0, :]
+    return np.where(li >= 1, vals, 0).astype(np.int32)
 
 
 def save(path: str, state: RaftState, cfg: RaftConfig, extra: Optional[dict] = None) -> None:
@@ -175,17 +186,22 @@ def load_sharded(
     with open(os.path.join(dirpath, "manifest.json")) as f:
         manifest = json.load(f)
     version = int(manifest.get("version", 0))
-    if version != _VERSION:
-        # The sharded layout has only ever existed at the current version —
-        # fail loudly on future/corrupt manifests, mirroring _load_impl's gate.
+    if version not in (4, _VERSION):
+        # The sharded layout first existed at v4 — fail loudly on
+        # future/corrupt manifests, mirroring _load_impl's gate.
         raise ValueError(
             f"sharded checkpoint version {version} not supported "
-            f"(this build reads exactly {_VERSION})")
+            f"(this build reads 4-{_VERSION})")
     cfg = RaftConfig(**manifest["cfg"])
     if expect_cfg is not None and expect_cfg != cfg:
         raise ValueError(
             f"checkpoint config mismatch:\n saved   {cfg}\n expected {expect_cfg}")
     spans = manifest["offsets"]
+    if version < 5 and "last_term" not in manifest["fields"]:
+        # v4 predates the lastLogTerm cache: derive per shard on read (each
+        # shard file carries its own full (N, C, g_slice) log).
+        manifest["fields"] = list(manifest["fields"]) + ["last_term"]
+        manifest["shapes"]["last_term"] = manifest["shapes"]["term"]
 
     loaded: dict = {}
 
@@ -194,7 +210,11 @@ def load_sharded(
         if k not in loaded:
             fname = f"shard_g{spans[k][0]:012d}.npz"
             with np.load(os.path.join(dirpath, fname)) as z:
-                loaded[k] = {name: z[name] for name in manifest["fields"]}
+                d = {name: z[name] for name in manifest["fields"] if name in z}
+            if "last_term" not in d:
+                d["last_term"] = _derive_last_term(
+                    d["log_term"], d["last_index"])
+            loaded[k] = d
         return loaded[k]
 
     if mesh is None:
@@ -267,7 +287,7 @@ def load_sharded(
 def _load_impl(path, expect_cfg, sharding):
     with np.load(path) as z:
         version = int(z[_VERSION_KEY])
-        if version not in (1, 2, 3, _VERSION):
+        if version not in (1, 2, 3, 4, _VERSION):
             raise ValueError(
                 f"checkpoint version {version} not supported (can load 1-{_VERSION})")
         cfg_dict = json.loads(bytes(z[_HEADER_KEY].tobytes()).decode())
@@ -294,6 +314,9 @@ def _load_impl(path, expect_cfg, sharding):
         N, G = arrays["term"].shape
         arrays.setdefault("up", np.ones((N, G), dtype=bool))
         arrays.setdefault("link_up", np.ones((N, N, G), dtype=bool))
+    if version < 5 and "last_term" not in arrays:
+        arrays["last_term"] = _derive_last_term(
+            arrays["log_term"], arrays["last_index"])
     cfg = RaftConfig(**cfg_dict)
     from raft_kotlin_tpu.models.state import MAILBOX_FIELDS
 

@@ -32,14 +32,16 @@ jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 
-def timeit(fn, *args, reps=3):
-    out = fn(*args)
-    jax.block_until_ready(out)
+def timeit(fn, reps=3):
+    """fn(rep) -> scalar array; the AXON TUNNEL TIMING TRAP means
+    block_until_ready is not a sync — only host materialization (float())
+    provably ends the device work, and inputs must VARY per call (identical
+    back-to-back dispatches have reported absurd times)."""
+    float(fn(-1))  # warm (distinct operand from every timed rep)
     ts = []
-    for _ in range(reps):
+    for r in range(reps):
         t0 = time.perf_counter()
-        out = fn(*args)
-        jax.block_until_ready(out)
+        float(fn(r))
         ts.append(time.perf_counter() - t0)
     return min(ts)
 
@@ -51,31 +53,28 @@ def op_curves(G: int):
         for R in (1, 8, 32):
             rows = jax.random.randint(key, (R, G), 0, C, dtype=jnp.int32)
 
+            # 20 scan iterations so per-dispatch overhead amortizes out; the
+            # row indices depend on the carry so no iteration is foldable.
             @jax.jit
-            def take(a, r):
-                return jnp.take_along_axis(a, r, axis=0)
-
-            @jax.jit
-            def put(a, r):
-                vals = (r % 7).astype(jnp.int16)
-                return jnp.put_along_axis(a, r, vals, axis=0, inplace=False)
-
-            # N scan iterations so per-dispatch overhead amortizes out.
-            @jax.jit
-            def take_scan(a, r):
+            def take_scan(a, r, off):
                 def body(c, _):
-                    return c + 1, jnp.sum(take(a, r + c % 3))
+                    rr = jnp.clip(r + (c + off) % 3, 0, C - 1)
+                    return c + 1, jnp.sum(
+                        jnp.take_along_axis(a, rr, axis=0).astype(jnp.int32))
                 return jax.lax.scan(body, 0, None, length=20)[1].sum()
 
             @jax.jit
-            def put_scan(a, r):
-                def body(c, _):
-                    a2 = put(a, r + c % 3)
-                    return c + 1, jnp.sum(a2[0])
-                return jax.lax.scan(body, 0, None, length=20)[1].sum()
+            def put_scan(a, r, off):
+                def body(a2, c):
+                    rr = jnp.clip(r + (c + off) % 3, 0, C - 1)
+                    vals = (rr % 7).astype(jnp.int16)
+                    return jnp.put_along_axis(
+                        a2, rr, vals, axis=0, inplace=False), None
+                a3, _ = jax.lax.scan(body, a, jnp.arange(20))
+                return jnp.sum(a3[0].astype(jnp.int32))
 
-            t_take = timeit(take_scan, arr, rows) / 20
-            t_put = timeit(put_scan, arr, rows) / 20
+            t_take = timeit(lambda rep: take_scan(arr, rows, rep)) / 20
+            t_put = timeit(lambda rep: put_scan(arr, rows, rep)) / 20
             print(json.dumps({
                 "probe": "op", "C": C, "G": G, "rows": R,
                 "operand_mb": round(C * G * 2 / 1e6, 1),
@@ -109,15 +108,17 @@ def tick_attribution(G: int):
                     return a
                 jnp.put_along_axis = fake_put
             tick = tick_mod.make_tick(cfg)
-            rng = tick_mod.make_rng(cfg)
+            rngs = [tick_mod.make_rng(dataclasses.replace(
+                cfg, seed=cfg.seed + 1000 * (r + 2))) for r in range(4)]
 
             @jax.jit
             def run(st, rng):
-                return jax.lax.scan(
+                st = jax.lax.scan(
                     lambda s, _: (tick(s, rng=rng), None), st, None, length=T)[0]
+                return jnp.sum(st.rounds) + jnp.sum(st.last_index)
 
             st0 = init_state(cfg)
-            t = timeit(lambda: run(st0, rng), reps=2)
+            t = timeit(lambda rep: run(st0, rngs[rep + 1]), reps=2)
             print(json.dumps({
                 "probe": "tick", "variant": label, "G": G,
                 "ms_per_tick": round(t / T * 1e3, 2),

@@ -1,0 +1,145 @@
+"""TPU probe: alternative formulations of the per-lane log gather/scatter.
+
+The round-4 attribution shows the deep tick is ~90% take_along_axis cost and
+that a take on (C, G) axis 0 has a ~4 ms per-op floor at G=13184 REGARDLESS
+of C — i.e. the XLA:TPU lowering is per-lane serial, not operand-traffic.
+This probe times the SAME semantic op (read row idx[g] of lane g) in other
+layouts/formulations to find a fast form:
+
+  a0   : take_along_axis axis=0 on (C, G)    [the current engine's form]
+  a1   : take_along_axis axis=1 on (G, C)    [lane-major log layout]
+  lin  : jnp.take on the flat (C*G,) array with linear indices idx*G + iota
+  oh   : one-hot contraction over (C, G)     [the Mosaic/shallow form]
+
+and the matching scatters (put axis=0, put axis=1, flat-linear put). Run:
+  python scripts/probe_gather_forms.py [G]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_compilation_cache_dir", os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
+def timeit(fn, reps=3):
+    float(fn(-1))
+    ts = []
+    for r in range(reps):
+        t0 = time.perf_counter()
+        float(fn(r))
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+def main(G: int):
+    key = jax.random.PRNGKey(0)
+    # SCAN must drown the ~100 ms tunnel round-trip per host call — at 20 the
+    # RTT/20 = ~5 ms floor swamps every op (the first run's lesson).
+    SCAN = 200
+    for C in (1024, 10_000):
+        for dt in (jnp.int16,):
+            a_cg = jax.random.randint(key, (C, G), 0, 100, jnp.int32).astype(dt)
+            a_gc = jnp.asarray(a_cg.T)  # materialized lane-major copy
+            a_flat = a_cg.reshape(-1)
+            for R in (1, 21):
+                rows = jax.random.randint(key, (R, G), 0, C - 4, jnp.int32)
+
+                def bench_one(name, fn):
+                    t = timeit(fn) / SCAN
+                    print(json.dumps({
+                        "probe": name, "C": C, "G": G, "rows": R,
+                        "dtype": str(dt.__name__), "ms": round(t * 1e3, 3),
+                    }), flush=True)
+
+                @jax.jit
+                def f_a0(off):
+                    def body(c, _):
+                        rr = rows + (c + off) % 3
+                        return c + 1, jnp.sum(jnp.take_along_axis(
+                            a_cg, rr, axis=0).astype(jnp.int32))
+                    return jax.lax.scan(body, 0, None, length=SCAN)[1].sum()
+
+                @jax.jit
+                def f_a1(off):
+                    def body(c, _):
+                        rr = (rows + (c + off) % 3).T  # (G, R)
+                        return c + 1, jnp.sum(jnp.take_along_axis(
+                            a_gc, rr, axis=1).astype(jnp.int32))
+                    return jax.lax.scan(body, 0, None, length=SCAN)[1].sum()
+
+                @jax.jit
+                def f_lin(off):
+                    lane = jnp.arange(G, dtype=jnp.int32)[None, :]
+                    def body(c, _):
+                        rr = rows + (c + off) % 3
+                        lin = rr * G + lane
+                        return c + 1, jnp.sum(
+                            jnp.take(a_flat, lin).astype(jnp.int32))
+                    return jax.lax.scan(body, 0, None, length=SCAN)[1].sum()
+
+                bench_one("a0", f_a0)
+                bench_one("a1", f_a1)
+                bench_one("lin", f_lin)
+                if R == 1:
+                    @jax.jit
+                    def f_oh(off):
+                        iota = jax.lax.broadcasted_iota(jnp.int32, (C, G), 0)
+                        def body(c, _):
+                            rr = rows[0] + (c + off) % 3
+                            oh = iota == rr[None, :]
+                            return c + 1, jnp.sum(
+                                jnp.where(oh, a_cg, 0).astype(jnp.int32))
+                        return jax.lax.scan(body, 0, None, length=SCAN)[1].sum()
+                    bench_one("oh", f_oh)
+
+                # Scatters (R rows), same three layouts.
+                @jax.jit
+                def g_s0(off):
+                    def body(a2, c):
+                        rr = rows + (c + off) % 3
+                        return jnp.put_along_axis(
+                            a2, rr, (rr % 7).astype(dt), axis=0,
+                            inplace=False), None
+                    a3, _ = jax.lax.scan(body, a_cg, jnp.arange(SCAN))
+                    return jnp.sum(a3[0].astype(jnp.int32))
+
+                @jax.jit
+                def g_s1(off):
+                    def body(a2, c):
+                        rr = (rows + (c + off) % 3).T
+                        return jnp.put_along_axis(
+                            a2, rr, (rr % 7).astype(dt), axis=1,
+                            inplace=False), None
+                    a3, _ = jax.lax.scan(body, a_gc, jnp.arange(SCAN))
+                    return jnp.sum(a3[:, 0].astype(jnp.int32))
+
+                @jax.jit
+                def g_slin(off):
+                    lane = jnp.arange(G, dtype=jnp.int32)[None, :]
+                    def body(a2, c):
+                        rr = rows + (c + off) % 3
+                        lin = (rr * G + lane).reshape(-1)
+                        vals = (rr % 7).astype(dt).reshape(-1)
+                        return a2.at[lin].set(vals), None
+                    a3, _ = jax.lax.scan(body, a_flat, jnp.arange(SCAN))
+                    return jnp.sum(a3[:G].astype(jnp.int32))
+
+                bench_one("s0", g_s0)
+                bench_one("s1", g_s1)
+                bench_one("slin", g_slin)
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 13_184)

@@ -63,3 +63,45 @@ def test_capacity_clip():
     # Overwrite of an existing physical slot is still allowed at capacity.
     assert log.add(0, 2, 9)
     assert log.last_index == 1
+
+
+def test_last_term_cache_matches_log_gather():
+    # state.last_term (the lastLogTerm cache phase 3 reads instead of
+    # gathering) must equal log_term[last_index - 1] (0 when empty) at EVERY
+    # tick of a churny faulty run — including after ghost appends (§3), where
+    # the value is an old physical slot's term, not the term just written.
+    # Run under both the XLA tick and the interpret-mode megakernel.
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from raft_kotlin_tpu.models.state import init_state
+    from raft_kotlin_tpu.ops.pallas_tick import make_pallas_tick
+    from raft_kotlin_tpu.ops.tick import make_tick
+    from raft_kotlin_tpu.utils.config import RaftConfig
+
+    cfg = RaftConfig(
+        n_groups=8, n_nodes=3, log_capacity=16, cmd_period=3,
+        p_drop=0.25, p_crash=0.02, p_restart=0.15, seed=23,
+    ).stressed(10)
+
+    def check(st, t):
+        li = np.asarray(st.last_index)
+        lt = np.asarray(st.log_term).astype(np.int64)
+        cache = np.asarray(st.last_term)
+        idx = np.clip(li - 1, 0, cfg.log_capacity - 1)
+        vals = np.take_along_axis(lt, idx[:, None, :], axis=1)[:, 0, :]
+        expect = np.where(li >= 1, vals, 0)
+        assert np.array_equal(cache, expect), f"tick {t}"
+
+    for mk in (make_tick(cfg), make_pallas_tick(cfg, interpret=True)):
+        tick = jax.jit(mk)
+        st = init_state(cfg)
+        saw_ghost = False
+        for t in range(120):
+            st = tick(st)
+            check(st, t)
+            saw_ghost = saw_ghost or bool(
+                np.any(np.asarray(st.phys_len) > np.asarray(st.last_index)))
+        assert saw_ghost, "run never exercised the ghost-append regime"
