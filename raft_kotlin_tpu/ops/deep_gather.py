@@ -1,0 +1,127 @@
+"""Pallas batched log-row gather — the deep-log read engine.
+
+Round-4 on-chip cost model (scripts/probe_deep_costs.py, BENCH attribution):
+an XLA:TPU `take_along_axis` on a (C, G) operand costs ~0.5 ms per OP plus
+~0.17 ms per index ROW at G=13k, essentially INDEPENDENT of C — the lowering
+is per-lane serial, so the batched deep engine's ~35 takes were ~90% of the
+155 ms config-5 tick. This kernel replaces all of them with ONE pallas_call:
+
+- grid (node, C-chunk, G-tile); each step DMAs a (Cb, tile) slab of that
+  node's log_term/log_cmd (the whole log crosses HBM exactly once per tick,
+  ~4.5 ms at config-5 scale vs ~90 ms of gathers);
+- row extraction happens in VMEM via full-shape `jnp.take_along_axis`
+  (Mosaic's tpu.dynamic_gather: indices must have the operand's shape, so
+  the (R, tile) row matrix is padded with zeros to (Cb, tile) and the first
+  R rows of the result are kept);
+- out-of-chunk rows are merged across chunk steps by revisiting the output
+  block (accumulation pattern: the (R, tile) output block's index_map
+  ignores the chunk axis).
+
+Contract: rows are PHYSICAL slot indices already clipped to [0, C);
+returned values are the raw storage dtype (callers widen and apply their
+own out-of-range masking, exactly as they did after an XLA take).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_I32 = jnp.int32
+_G_TILES = (512, 256, 128)
+
+# Escape hatch: force the XLA take_along_axis fallback (differential tests
+# pin kernel-vs-takes equality through this; also a field kill switch).
+DISABLE = bool(os.environ.get("RAFT_DISABLE_GATHER_KERNEL"))
+
+
+def _chunk(C: int) -> int:
+    """Largest divisor of C that keeps a (Cb, tile) slab comfortably in VMEM
+    (~2 MB at int16/tile 512). Non-power-of-two capacities (e.g. the
+    config-5 C=10_000) get their largest divisor <= 2500."""
+    for d in range(min(C, 2500), 0, -1):
+        if C % d == 0:
+            return d
+    return C
+
+
+def _tile(G: int, interpret: bool):
+    if interpret:
+        return G
+    for t in _G_TILES:
+        if G % t == 0:
+            return t
+    return None
+
+
+@functools.lru_cache(maxsize=None)
+def build_gather(N: int, C: int, Rt: int, Rc: int, ldt_name: str, G: int,
+                 interpret: bool):
+    """-> callable(log_term (N*C, G) ldt, log_cmd (N*C, G) ldt,
+                   rows_t (N*Rt, G) i32, rows_c (N*Rc, G) i32)
+       -> (vals_t (N*Rt, G) ldt, vals_c (N*Rc, G) ldt)
+    with vals_x[n*R + r, g] = log_x[n*C + rows_x[n*R + r, g], g].
+    Returns None when no supported G-tile divides G (caller falls back to
+    XLA takes)."""
+    ldt = jnp.dtype(ldt_name)
+    tile = _tile(G, interpret)
+    if tile is None:
+        return None
+    Cb = _chunk(C)
+    n_chunks = C // Cb
+    assert Cb > max(Rt, Rc), (Cb, Rt, Rc)
+
+    def kernel(lt_ref, lc_ref, rt_ref, rc_ref, ot_ref, oc_ref):
+        # The chunk axis is the INNERMOST grid dim: output blocks are only
+        # accumulated across CONSECUTIVE grid steps mapping to the same
+        # block, so all chunks of one (node, g-tile) must run back to back.
+        c = pl.program_id(2)
+
+        @pl.when(c == 0)
+        def _init():
+            ot_ref[...] = jnp.zeros_like(ot_ref)
+            oc_ref[...] = jnp.zeros_like(oc_ref)
+
+        j0 = c * Cb
+        for blk_ref, rows_ref, out_ref, R in (
+            (lt_ref, rt_ref, ot_ref, Rt),
+            (lc_ref, rc_ref, oc_ref, Rc),
+        ):
+            rows = rows_ref[...]
+            rel = rows - j0
+            hit = (rel >= 0) & (rel < Cb)
+            relc = jnp.clip(rel, 0, Cb - 1)
+            idx_full = jnp.concatenate(
+                [relc, jnp.zeros((Cb - R, tile), _I32)], axis=0)
+            # Widen to i32 for the dynamic_gather, narrow back after: Mosaic's
+            # gather support is solid on 32-bit lanes; the cast is VMEM-local.
+            vals = jnp.take_along_axis(
+                blk_ref[...].astype(_I32), idx_full, axis=0)[:R]
+            out_ref[...] = jnp.where(hit, vals.astype(out_ref.dtype),
+                                     out_ref[...])
+
+    grid = (N, G // tile, n_chunks)
+    call = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((Cb, tile), lambda n, i, c: (n * n_chunks + c, i)),
+            pl.BlockSpec((Cb, tile), lambda n, i, c: (n * n_chunks + c, i)),
+            pl.BlockSpec((Rt, tile), lambda n, i, c: (n, i)),
+            pl.BlockSpec((Rc, tile), lambda n, i, c: (n, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((Rt, tile), lambda n, i, c: (n, i)),
+            pl.BlockSpec((Rc, tile), lambda n, i, c: (n, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((N * Rt, G), ldt),
+            jax.ShapeDtypeStruct((N * Rc, G), ldt),
+        ],
+        interpret=interpret,
+    )
+    return call

@@ -201,9 +201,15 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
 
     if batched_logs:
         # node -> chronological [(local_rows (G,), term_v, cmd_v, wr)] of
-        # deferred phase-5 writes; values kept int32, narrowed at patch/apply.
+        # deferred phase-0/5 writes; values kept int32, narrowed at
+        # patch/apply. Rows are the SAFE-REDIRECTED form: where the write
+        # mask is off, the row points at the append-range base (a row whose
+        # stored value the read kernel prefetches), so the final scatter can
+        # write back a known current value on masked lanes without a
+        # dedicated cur-gather.
         pending = {n: [] for n in range(1, N + 1)}
         defer = {"on": False}
+        plen_base: dict = {}  # filled post-phase-F (append-slot range base)
         ldt_b = lt[0].dtype
 
         def patch(name, node, row, v):
@@ -346,9 +352,13 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
         wr = app | ovw
         slot = jnp.where(app, pl, i)
         if batched_logs and defer["on"]:
-            # Phase 5: record only; applied at end of phase as one resolved
-            # scatter per node (reads in between go through patch()).
-            pending[n].append((jnp.clip(slot, 0, C - 1), term_v, cmd_v, wr))
+            # Phases 0/5: record only; applied at end of tick as one
+            # resolved scatter per node (reads in between go through
+            # patch()). Masked lanes redirect to the append-range base so
+            # cur resolution never needs a row outside the kernel superset.
+            safe = jnp.clip(plen_base[n], 0, C - 1)
+            row_eff = jnp.where(wr, jnp.clip(slot, 0, C - 1), safe)
+            pending[n].append((row_eff, term_v, cmd_v, wr))
             setcol("last_index", n, wr, jnp.where(app, li + 1, i + 1))
             setcol("phys_len", n, app, pl + 1)
             return
@@ -455,6 +465,18 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
             & up[a - 1]
             & up[b - 1]
         )
+
+    if batched_logs:
+        # Append-slot range base: phys_len after phase F (restart wipes it),
+        # before any deferred append bumps it. Every deferred append this
+        # tick lands in [plen_base, plen_base + N + 2) — the cur-superset
+        # rows the read kernel prefetches. Deferral starts HERE: phase-0
+        # adds join the same pending list (chronological), so consume-time
+        # patch() and the final resolved scatter replay phase 0 + phase 5
+        # in canonical order from the pre-tick stored log.
+        for n in range(1, N + 1):
+            plen_base[n] = s["phys_len"][n - 1]
+        defer["on"] = True
 
     # -- phase 0: command injection (quirk k) -------------------------------
 
@@ -723,33 +745,70 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
         enter_cols()  # phase 5 runs on the columnar view
 
     if batched_logs:
-        defer["on"] = True  # phase-5 log writes are deferred from here on
-
         def bounded(idx, v):
             # log_gather's out-of-[0, C) => 0 convention for a raw take.
             return jnp.where((idx >= 0) & (idx < C), v, 0)
 
-        # ALL of phase 5's log reads in 2 takes per node, up front. Row
+        # ALL of the tick's remaining log reads batched up front. Row
         # indices are known post-phase-4 (see the engine note above); writes
         # that land between here and a pair's consume point are overlaid by
-        # patch(). Node n's batch rows: [0, N) = prevLog reads of n-as-leader
-        # (pli(n, q)); [N, 2N) = entry reads of n-as-leader (i(n, q) - 1);
-        # [2N, 3N) = n-as-peer prevLog checks (pli(l, n) for each leader l).
+        # patch(). Node n's batch rows (log_term):
+        #   [0, N)        prevLog reads of n-as-leader (pli(n, q))
+        #   [N, 2N)       entry reads of n-as-leader (i(n, q) - 1)
+        #   [2N, 3N)      n-as-peer prevLog checks (pli(l, n))
+        #   3N            last_index - 1 (the tick-end last_term base)
+        #   [3N+1, 4N+1)  overwrite cur superset (i(l, n) - 1)
+        #   [4N+1, 5N+3)  append-range cur superset (plen_base + j)
+        # log_cmd rows: [0, N) entry reads; [N, 2N) overwrite cur;
+        # [2N, 3N+2) append-range cur. The cur-superset rows exist so the
+        # duplicate-resolved scatter and the last_term refresh never issue
+        # another gather: every pending write row structurally matches one.
         i_all = {(a, b): prow("next_index", a, b)
                  for a in range(1, N + 1) for b in range(1, N + 1)}
+        T_LLT, T_CURO, T_CURA = 3 * N, 3 * N + 1, 4 * N + 1
+        C_CURO, C_CURA = N, 2 * N
         brows_t, bvals_t, brows_c, bvals_c = {}, {}, {}, {}
         for n in range(1, N + 1):
-            rows = (
+            cur_sup = (
+                [jnp.clip(i_all[(l, n)] - 1, 0, C - 1) for l in range(1, N + 1)]
+                + [jnp.clip(plen_base[n] + j, 0, C - 1) for j in range(N + 2)]
+            )
+            brows_t[n] = (
                 [jnp.clip(i_all[(n, q)] - 2, 0, C - 1) for q in range(1, N + 1)]
                 + [jnp.clip(i_all[(n, q)] - 1, 0, C - 1) for q in range(1, N + 1)]
                 + [jnp.clip(i_all[(l, n)] - 2, 0, C - 1) for l in range(1, N + 1)]
+                + [jnp.clip(col("last_index", n) - 1, 0, C - 1)]
+                + cur_sup
             )
-            brows_t[n] = rows
-            bvals_t[n] = jnp.take_along_axis(
-                lt[n - 1], jnp.stack(rows), axis=0).astype(_I32)
-            brows_c[n] = rows[N:2 * N]
-            bvals_c[n] = jnp.take_along_axis(
-                lc[n - 1], jnp.stack(rows[N:2 * N]), axis=0).astype(_I32)
+            brows_c[n] = brows_t[n][N:2 * N] + cur_sup
+        Rt, Rc = 5 * N + 3, 3 * N + 2
+        from raft_kotlin_tpu.ops import deep_gather
+
+        gather = None
+        if not deep_gather.DISABLE:
+            gather = deep_gather.build_gather(
+                N, C, Rt, Rc, str(ldt_b), G,
+                jax.default_backend() == "cpu")
+        if gather is not None:
+            # One pallas_call: the whole log crosses HBM exactly once; all
+            # row extraction happens in VMEM (see ops/deep_gather.py for the
+            # measured XLA-gather cost model this replaces).
+            vt, vc = gather(
+                s["log_term"], s["log_cmd"],
+                jnp.concatenate([jnp.stack(brows_t[n])
+                                 for n in range(1, N + 1)]),
+                jnp.concatenate([jnp.stack(brows_c[n])
+                                 for n in range(1, N + 1)]),
+            )
+            for n in range(1, N + 1):
+                bvals_t[n] = vt[(n - 1) * Rt: n * Rt].astype(_I32)
+                bvals_c[n] = vc[(n - 1) * Rc: n * Rc].astype(_I32)
+        else:
+            for n in range(1, N + 1):
+                bvals_t[n] = jnp.take_along_axis(
+                    lt[n - 1], jnp.stack(brows_t[n]), axis=0).astype(_I32)
+                bvals_c[n] = jnp.take_along_axis(
+                    lc[n - 1], jnp.stack(brows_c[n]), axis=0).astype(_I32)
 
     for l in range(1, N + 1):
         raw_armed = col("hb_armed", l)
@@ -825,24 +884,39 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
             s[name] = d - (d > 0).astype(_I32)
 
     if batched_logs:
-        # Apply each node's deferred phase-5 writes as one scatter per log
+        # Apply each node's deferred phase-0/5 writes as one scatter per log
         # array. Duplicate rows within a lane are possible (two leaders
         # appending to the same slot of one node; a masked no-op colliding
         # with a real write) and XLA scatter order over duplicates is
         # unspecified — so every entry is first resolved to the LAST real
         # write at its row (ascending scan over this node's entries,
         # starting from the current stored value): duplicates then carry
-        # identical values and the scatter is deterministic.
+        # identical values and the scatter is deterministic. The "current
+        # stored value" comes from the prefetched cur-superset rows — every
+        # pending row (including the masked-lane safe redirect) structurally
+        # matches one — so no additional gather is ever issued.
+        sup_t = list(range(T_CURO, T_CURO + N)) + \
+            list(range(T_CURA, T_CURA + N + 2))
+        sup_c = list(range(C_CURO, C_CURO + N)) + \
+            list(range(C_CURA, C_CURA + N + 2))
         for n in range(1, N + 1):
             writes = pending[n]
             if not writes:
                 continue
             rows = jnp.stack([w[0] for w in writes])  # (K, G) local rows
-            cur_t = jnp.take_along_axis(lt[n - 1], rows, axis=0)
-            cur_c = jnp.take_along_axis(lc[n - 1], rows, axis=0)
+
+            def cur_at(rk, n=n):
+                ct = jnp.zeros((G,), _I32)
+                cc = jnp.zeros((G,), _I32)
+                for it, ic in zip(sup_t, sup_c):
+                    m = brows_t[n][it] == rk
+                    ct = jnp.where(m, bvals_t[n][it], ct)
+                    cc = jnp.where(m, bvals_c[n][ic], cc)
+                return ct.astype(ldt_b), cc.astype(ldt_b)
+
             eff_t, eff_c = [], []
-            for k, (rk, _tk, _ck, _wk) in enumerate(writes):
-                et, ec = cur_t[k], cur_c[k]
+            for rk, _tk, _ck, _wk in writes:
+                et, ec = cur_at(rk)
                 for rj, tj, cj, wj in writes:
                     hit = wj & (rj == rk)
                     et = jnp.where(hit, tj.astype(ldt_b), et)
@@ -855,16 +929,22 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
                 lc[n - 1], rows, jnp.stack(eff_c), axis=0, inplace=False)
 
     # lastLogTerm cache refresh (state.last_term): recomputed from the FINAL
-    # log (batched scatters are applied above), so the ghost-append quirk (§3)
-    # is honored exactly — the cache is log_term[last_index - 1], which after
-    # a post-truncation append is NOT the term just written. Net-neutral op
-    # count for the one-hot and per-pair engines (it replaces the N gathers
-    # phase 3 used to issue); the batched engine's Pallas read kernel folds
-    # these rows into its superset.
+    # log, so the ghost-append quirk (§3) is honored exactly — the cache is
+    # log_term[last_index - 1], which after a post-truncation append is NOT
+    # the term just written. Net-neutral op count for the one-hot and
+    # per-pair engines (it replaces the N gathers phase 3 used to issue);
+    # the batched engine reads its prefetched last_index-1 base row and
+    # overlays this tick's pending writes (a lane whose last_index moved got
+    # its new top slot written this tick, so patch() supplies it).
     for n in range(1, N + 1):
-        s["last_term"] = _set_row(
-            s["last_term"], n - 1,
-            log_gather("log_term", n, s["last_index"][n - 1] - 1))
+        li_f = s["last_index"][n - 1]
+        if batched_logs:
+            raw = patch("log_term", n, jnp.clip(li_f - 1, 0, C - 1),
+                        bvals_t[n][T_LLT])
+            v = jnp.where(li_f >= 1, raw, 0)
+        else:
+            v = log_gather("log_term", n, li_f - 1)
+        s["last_term"] = _set_row(s["last_term"], n - 1, v)
 
     if use_slices:
         # Rejoin the per-node log slices into the flat (N*C, G) layout.
