@@ -97,13 +97,8 @@ def measure(cfg, n_ticks, n_reps, impl_candidates, summarize=None):
     rngs = [make_rng(dataclasses.replace(cfg, seed=cfg.seed + 1000 * (r + 1)))
             for r in range(n_reps + 1)]
     last_err = None
-    for tick_fn, impl in impl_candidates(cfg):
-        @jax.jit
-        def run(st, rng):
-            return jax.lax.scan(
-                lambda s, _: (tick_fn(s, rng=rng), None), st, None,
-                length=n_ticks)[0]
-
+    for builder, impl in impl_candidates(cfg):
+        run = builder(n_ticks)
         try:
             warm = run(st0, rngs[n_reps])
             # Materialize the same reduction the timed region uses, so rep 0
@@ -139,19 +134,33 @@ def median(xs):
     return statistics.median_low(xs)
 
 
+def scan_runner(tick_fn):
+    """builder(n_ticks) -> jitted run(st, rng) for a per-tick function."""
+    def build(n_ticks):
+        @jax.jit
+        def run(st, rng):
+            return jax.lax.scan(
+                lambda s, _: (tick_fn(s, rng=rng), None), st, None,
+                length=n_ticks)[0]
+        return run
+    return build
+
+
 def tick_candidates(cfg):
-    from raft_kotlin_tpu.ops.pallas_tick import choose_impl, make_pallas_tick
+    from raft_kotlin_tpu.ops.pallas_tick import choose_impl, make_pallas_scan
     from raft_kotlin_tpu.ops.tick import make_tick
 
     if choose_impl(cfg) == "pallas":
-        yield make_pallas_tick(cfg, interpret=False), "pallas"
-    yield make_tick(cfg), "xla"
+        # Flat-carry multi-tick runner: state<->kernel-form conversions once
+        # per call, not once per tick (~0.3 ms/tick on the headline config).
+        yield (lambda n: make_pallas_scan(cfg, n, interpret=False)), "pallas"
+    yield scan_runner(make_tick(cfg)), "xla"
 
 
 def xla_only(cfg):
     from raft_kotlin_tpu.ops.tick import make_tick
 
-    yield make_tick(cfg), "xla"
+    yield scan_runner(make_tick(cfg)), "xla"
 
 
 def deep_candidates(cfg):
@@ -414,13 +423,13 @@ def main() -> None:
 
         mesh = make_mesh(jax.devices()[:1])
         smt = _make_shardmap_xla_tick(cfg_c, mesh)
-        yield (lambda st, rng=None: smt(st, rng)), "shardmap-flat"
+        yield scan_runner(lambda st, rng=None: smt(st, rng)), "shardmap-flat"
 
     def make_pair_candidates(sharded):
         def gen(cfg_c):
             from raft_kotlin_tpu.ops.tick import make_tick
 
-            yield make_tick(cfg_c, batched=False, sharded=sharded), (
+            yield scan_runner(make_tick(cfg_c, batched=False, sharded=sharded)), (
                 "per-pair-flat" if sharded else "per-pair-sliced")
         return gen
 

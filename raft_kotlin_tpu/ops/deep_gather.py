@@ -1,10 +1,12 @@
-"""Pallas batched log-row gather — the deep-log read engine.
+"""Pallas batched log-row gather — interpret-mode reference of the deep-log
+read batch (NOT the TPU path; see build_gather for the Mosaic limitation).
 
 Round-4 on-chip cost model (scripts/probe_deep_costs.py, BENCH attribution):
 an XLA:TPU `take_along_axis` on a (C, G) operand costs ~0.5 ms per OP plus
-~0.17 ms per index ROW at G=13k, essentially INDEPENDENT of C — the lowering
-is per-lane serial, so the batched deep engine's ~35 takes were ~90% of the
-155 ms config-5 tick. This kernel replaces all of them with ONE pallas_call:
+~0.16 ms per index ROW at G=13k, essentially INDEPENDENT of C and of layout
+(axis-0, lane-major axis-1, and flat-linear forms all cost the same —
+scripts/probe_gather_forms.py) — the lowering is per-lane serial. This
+kernel was designed to replace all of them with ONE pallas_call:
 
 - grid (node, C-chunk, G-tile); each step DMAs a (Cb, tile) slab of that
   node's log_term/log_cmd (the whole log crosses HBM exactly once per tick,
@@ -39,14 +41,16 @@ _G_TILES = (512, 256, 128)
 DISABLE = bool(os.environ.get("RAFT_DISABLE_GATHER_KERNEL"))
 
 
-def _chunk(C: int) -> int:
+def _chunk(C: int):
     """Largest divisor of C that keeps a (Cb, tile) slab comfortably in VMEM
-    (~2 MB at int16/tile 512). Non-power-of-two capacities (e.g. the
-    config-5 C=10_000) get their largest divisor <= 2500."""
-    for d in range(min(C, 2500), 0, -1):
-        if C % d == 0:
+    (~2 MB at int16/tile 512). Mosaic requires the sublane block dim be a
+    multiple of 8 (the block never equals the full (N*C) first dim), so only
+    multiples of 8 qualify; None = no valid chunking (caller falls back to
+    XLA takes). The config-5 C=10_000 gets 2000."""
+    for d in range(min(C, 2500), 7, -1):
+        if C % d == 0 and d % 8 == 0:
             return d
-    return C
+    return None
 
 
 def _tile(G: int, interpret: bool):
@@ -68,12 +72,33 @@ def build_gather(N: int, C: int, Rt: int, Rc: int, ldt_name: str, G: int,
     Returns None when no supported G-tile divides G (caller falls back to
     XLA takes)."""
     ldt = jnp.dtype(ldt_name)
+    if not interpret:
+        # Round-4 TPU probe result: Mosaic's tpu.dynamic_gather only supports
+        # sublane gathers WITHIN one vreg (8 rows) — take_along_axis on a
+        # (Cb, tile) block with Cb in {16..2048} is an internal compiler error
+        # on real hardware (scripts/probe_gather_forms.py sweep; the 8-row
+        # case is the only one that compiles). A hierarchical 8-row
+        # decomposition degenerates to a full one-hot stream over C, which is
+        # VPU-compute-bound ~20x above the DMA cost it was meant to save. The
+        # kernel therefore runs only in interpreter mode (differential tests
+        # pin its semantics); on TPU the engine uses the XLA takes whose
+        # measured cost model lives in the module docstring.
+        return None
     tile = _tile(G, interpret)
     if tile is None:
         return None
     Cb = _chunk(C)
+    if Cb is None:
+        return None
     n_chunks = C // Cb
-    assert Cb > max(Rt, Rc), (Cb, Rt, Rc)
+    # Row-block heights must also be sublane-aligned (multiple of 8): pad the
+    # row matrices with zero rows (a clipped slot-0 gather, sliced off below).
+    Rtp, Rcp = -(-Rt // 8) * 8, -(-Rc // 8) * 8
+    if Cb <= max(Rtp, Rcp):
+        # Pathological capacity (e.g. C=2504 -> largest 8-multiple divisor
+        # 8): the in-chunk concat below needs Cb >= padded row count. Same
+        # graceful fallback as every other unsupported shape.
+        return None
 
     def kernel(lt_ref, lc_ref, rt_ref, rc_ref, ot_ref, oc_ref):
         # The chunk axis is the INNERMOST grid dim: output blocks are only
@@ -88,8 +113,8 @@ def build_gather(N: int, C: int, Rt: int, Rc: int, ldt_name: str, G: int,
 
         j0 = c * Cb
         for blk_ref, rows_ref, out_ref, R in (
-            (lt_ref, rt_ref, ot_ref, Rt),
-            (lc_ref, rc_ref, oc_ref, Rc),
+            (lt_ref, rt_ref, ot_ref, Rtp),
+            (lc_ref, rc_ref, oc_ref, Rcp),
         ):
             rows = rows_ref[...]
             rel = rows - j0
@@ -111,17 +136,30 @@ def build_gather(N: int, C: int, Rt: int, Rc: int, ldt_name: str, G: int,
         in_specs=[
             pl.BlockSpec((Cb, tile), lambda n, i, c: (n * n_chunks + c, i)),
             pl.BlockSpec((Cb, tile), lambda n, i, c: (n * n_chunks + c, i)),
-            pl.BlockSpec((Rt, tile), lambda n, i, c: (n, i)),
-            pl.BlockSpec((Rc, tile), lambda n, i, c: (n, i)),
+            pl.BlockSpec((Rtp, tile), lambda n, i, c: (n, i)),
+            pl.BlockSpec((Rcp, tile), lambda n, i, c: (n, i)),
         ],
         out_specs=[
-            pl.BlockSpec((Rt, tile), lambda n, i, c: (n, i)),
-            pl.BlockSpec((Rc, tile), lambda n, i, c: (n, i)),
+            pl.BlockSpec((Rtp, tile), lambda n, i, c: (n, i)),
+            pl.BlockSpec((Rcp, tile), lambda n, i, c: (n, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((N * Rt, G), ldt),
-            jax.ShapeDtypeStruct((N * Rc, G), ldt),
+            jax.ShapeDtypeStruct((N * Rtp, G), ldt),
+            jax.ShapeDtypeStruct((N * Rcp, G), ldt),
         ],
         interpret=interpret,
     )
-    return call
+    if Rtp == Rt and Rcp == Rc:
+        return call
+
+    def padded_call(lt, lc, rows_t, rows_c):
+        def pad(r, R, Rp):
+            r = r.reshape(N, R, G)
+            z = jnp.zeros((N, Rp - R, G), _I32)
+            return jnp.concatenate([r, z], axis=1).reshape(N * Rp, G)
+
+        vt, vc = call(lt, lc, pad(rows_t, Rt, Rtp), pad(rows_c, Rc, Rcp))
+        return (vt.reshape(N, Rtp, G)[:, :Rt].reshape(N * Rt, G),
+                vc.reshape(N, Rcp, G)[:, :Rc].reshape(N * Rc, G))
+
+    return padded_call

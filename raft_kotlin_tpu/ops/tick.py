@@ -27,6 +27,7 @@ backends are bit-identical by construction.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Optional
 
 import jax
@@ -156,6 +157,11 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
     """
     N, C, maj = cfg.n_nodes, cfg.log_capacity, cfg.majority
     G = s["term"].shape[-1]
+    # Probe-only phase ablation (scripts/probe_phase_cuts.py): compile the
+    # lattice cut after phase k — output bits are then MEANINGLESS; used
+    # exclusively for per-phase timing attribution on hardware. Read at trace
+    # time so probes can sweep without reloading the module.
+    cut = int(os.environ.get("RAFT_PHASE_CUT", "99"))
 
     # Logs live as PER-NODE (C, G) slices for the duration of the phase
     # lattice (static slices of the flat (N*C, G) layout — free in XLA,
@@ -202,14 +208,11 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
     if batched_logs:
         # node -> chronological [(local_rows (G,), term_v, cmd_v, wr)] of
         # deferred phase-0/5 writes; values kept int32, narrowed at
-        # patch/apply. Rows are the SAFE-REDIRECTED form: where the write
-        # mask is off, the row points at the append-range base (a row whose
-        # stored value the read kernel prefetches), so the final scatter can
-        # write back a known current value on masked lanes without a
-        # dedicated cur-gather.
+        # patch/apply. Where the write mask is off, the row is C — OUT OF
+        # RANGE — and the final scatter drops it (mode="drop"), so masked
+        # lanes need no current-value resolution at all.
         pending = {n: [] for n in range(1, N + 1)}
         defer = {"on": False}
-        plen_base: dict = {}  # filled post-phase-F (append-slot range base)
         ldt_b = lt[0].dtype
 
         def patch(name, node, row, v):
@@ -353,11 +356,10 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
         slot = jnp.where(app, pl, i)
         if batched_logs and defer["on"]:
             # Phases 0/5: record only; applied at end of tick as one
-            # resolved scatter per node (reads in between go through
-            # patch()). Masked lanes redirect to the append-range base so
-            # cur resolution never needs a row outside the kernel superset.
-            safe = jnp.clip(plen_base[n], 0, C - 1)
-            row_eff = jnp.where(wr, jnp.clip(slot, 0, C - 1), safe)
+            # duplicate-resolved scatter per node (reads in between go
+            # through patch()). Masked lanes get row C — dropped by the
+            # scatter, never matched by patch (read rows are < C).
+            row_eff = jnp.where(wr, jnp.clip(slot, 0, C - 1), C)
             pending[n].append((row_eff, term_v, cmd_v, wr))
             setcol("last_index", n, wr, jnp.where(app, li + 1, i + 1))
             setcol("phys_len", n, app, pl + 1)
@@ -467,15 +469,10 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
         )
 
     if batched_logs:
-        # Append-slot range base: phys_len after phase F (restart wipes it),
-        # before any deferred append bumps it. Every deferred append this
-        # tick lands in [plen_base, plen_base + N + 2) — the cur-superset
-        # rows the read kernel prefetches. Deferral starts HERE: phase-0
-        # adds join the same pending list (chronological), so consume-time
-        # patch() and the final resolved scatter replay phase 0 + phase 5
-        # in canonical order from the pre-tick stored log.
-        for n in range(1, N + 1):
-            plen_base[n] = s["phys_len"][n - 1]
+        # Deferral starts HERE (post-phase-F, so restart wipes are already
+        # applied): phase-0 adds join the same pending list (chronological),
+        # so consume-time patch() and the final resolved scatter replay
+        # phase 0 + phase 5 in canonical order from the pre-tick stored log.
         defer["on"] = True
 
     # -- phase 0: command injection (quirk k) -------------------------------
@@ -507,6 +504,8 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
             s["last_term"] = _set_row(
                 s["last_term"], n - 1, jnp.where(li_n >= 1, raw, 0))
 
+    if cut < 1:
+        return aux_dirty["m"]
     # -- phase 1: timers (independent countdowns) ---------------------------
 
     armed = s["el_armed"] & up
@@ -524,6 +523,8 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
     s["round_state"] = jnp.where(bfire, IDLE, s["round_state"])
     start_round = start_round | bfire
 
+    if cut < 2:
+        return aux_dirty["m"]
     # -- phase 2: round starts ---------------------------------------------
 
     is_cand = s["role"] == CANDIDATE
@@ -542,6 +543,8 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
     s["round_state"] = jnp.where(demoted_bo, IDLE, s["round_state"])
     reset_el_timer_grid(demoted_bo)
 
+    if cut < 3:
+        return aux_dirty["m"]
     # -- phase 3: vote exchanges --------------------------------------------
 
     # Hoisted per-node last-log position/term: INVARIANT across phase 3 (no
@@ -651,6 +654,8 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
 
     if use_columnar:
         exit_cols()  # phase 4 is grid-wide
+    if cut < 4:
+        return aux_dirty["m"]
     act = (s["round_state"] == ACTIVE) & up
     concl = act & ((s["responses"] >= maj) | (s["round_left"] <= 0))
     is_cand = s["role"] == CANDIDATE
@@ -674,6 +679,8 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
     s["round_left"] = s["round_left"] - ongoing.astype(_I32)
     s["round_age"] = s["round_age"] + ongoing.astype(_I32)
 
+    if cut < 5:
+        return aux_dirty["m"]
     # -- phase 5: append / heartbeat ----------------------------------------
 
     def append_exchange(l, p, act5, req_term, req_commit, pli, plt,
@@ -753,35 +760,34 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
         # indices are known post-phase-4 (see the engine note above); writes
         # that land between here and a pair's consume point are overlaid by
         # patch(). Node n's batch rows (log_term):
-        #   [0, N)        prevLog reads of n-as-leader (pli(n, q))
-        #   [N, 2N)       entry reads of n-as-leader (i(n, q) - 1)
-        #   [2N, 3N)      n-as-peer prevLog checks (pli(l, n))
-        #   3N            last_index - 1 (the tick-end last_term base)
-        #   [3N+1, 4N+1)  overwrite cur superset (i(l, n) - 1)
-        #   [4N+1, 5N+3)  append-range cur superset (plen_base + j)
-        # log_cmd rows: [0, N) entry reads; [N, 2N) overwrite cur;
-        # [2N, 3N+2) append-range cur. The cur-superset rows exist so the
-        # duplicate-resolved scatter and the last_term refresh never issue
-        # another gather: every pending write row structurally matches one.
+        #   [0, N)    prevLog reads of n-as-leader (pli(n, q))
+        #   [N, 2N)   entry reads of n-as-leader (i(n, q) - 1)
+        #   [2N, 3N)  n-as-peer prevLog checks (pli(l, n))
+        #   3N        last_index - 1 (the tick-end last_term base)
+        #   [3N+1, 4N+1) n-as-peer GHOST rows (i(l, n) - 1): a §3 ghost
+        #     append (post-truncation, phys_len > last_index) writes slot
+        #     phys_len while moving last_index to i(l, n) + 1, so the
+        #     tick-end cache must read the STALE stored value at i(l, n) —
+        #     a row no write covers (the round-4 review's tick-129
+        #     last_term divergence; tests/test_deep_gather.py pins it).
+        # log_cmd rows: [0, N) entry reads. The final scatter needs no
+        # current-value rows: masked writes carry out-of-range rows and are
+        # DROPPED (mode="drop"), and duplicate real rows are pre-resolved to
+        # the last write's value.
         i_all = {(a, b): prow("next_index", a, b)
                  for a in range(1, N + 1) for b in range(1, N + 1)}
-        T_LLT, T_CURO, T_CURA = 3 * N, 3 * N + 1, 4 * N + 1
-        C_CURO, C_CURA = N, 2 * N
+        T_LLT, T_GHOST = 3 * N, 3 * N + 1
         brows_t, bvals_t, brows_c, bvals_c = {}, {}, {}, {}
         for n in range(1, N + 1):
-            cur_sup = (
-                [jnp.clip(i_all[(l, n)] - 1, 0, C - 1) for l in range(1, N + 1)]
-                + [jnp.clip(plen_base[n] + j, 0, C - 1) for j in range(N + 2)]
-            )
             brows_t[n] = (
                 [jnp.clip(i_all[(n, q)] - 2, 0, C - 1) for q in range(1, N + 1)]
                 + [jnp.clip(i_all[(n, q)] - 1, 0, C - 1) for q in range(1, N + 1)]
                 + [jnp.clip(i_all[(l, n)] - 2, 0, C - 1) for l in range(1, N + 1)]
                 + [jnp.clip(col("last_index", n) - 1, 0, C - 1)]
-                + cur_sup
+                + [jnp.clip(i_all[(l, n)] - 1, 0, C - 1) for l in range(1, N + 1)]
             )
-            brows_c[n] = brows_t[n][N:2 * N] + cur_sup
-        Rt, Rc = 5 * N + 3, 3 * N + 2
+            brows_c[n] = brows_t[n][N:2 * N]
+        Rt, Rc = 4 * N + 1, N
         from raft_kotlin_tpu.ops import deep_gather
 
         gather = None
@@ -885,38 +891,21 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
 
     if batched_logs:
         # Apply each node's deferred phase-0/5 writes as one scatter per log
-        # array. Duplicate rows within a lane are possible (two leaders
-        # appending to the same slot of one node; a masked no-op colliding
-        # with a real write) and XLA scatter order over duplicates is
-        # unspecified — so every entry is first resolved to the LAST real
-        # write at its row (ascending scan over this node's entries,
-        # starting from the current stored value): duplicates then carry
-        # identical values and the scatter is deterministic. The "current
-        # stored value" comes from the prefetched cur-superset rows — every
-        # pending row (including the masked-lane safe redirect) structurally
-        # matches one — so no additional gather is ever issued.
-        sup_t = list(range(T_CURO, T_CURO + N)) + \
-            list(range(T_CURA, T_CURA + N + 2))
-        sup_c = list(range(C_CURO, C_CURO + N)) + \
-            list(range(C_CURA, C_CURA + N + 2))
+        # array. Masked entries carry row C and are DROPPED by the scatter.
+        # Duplicate REAL rows within a lane are possible (two leaders
+        # appending to the same slot of one node) and XLA scatter order over
+        # duplicates is unspecified — so every entry is first resolved to
+        # the LAST real write at its row (chronological pass over this
+        # node's entries): duplicates then carry identical values and the
+        # scatter is deterministic.
         for n in range(1, N + 1):
             writes = pending[n]
             if not writes:
                 continue
             rows = jnp.stack([w[0] for w in writes])  # (K, G) local rows
-
-            def cur_at(rk, n=n):
-                ct = jnp.zeros((G,), _I32)
-                cc = jnp.zeros((G,), _I32)
-                for it, ic in zip(sup_t, sup_c):
-                    m = brows_t[n][it] == rk
-                    ct = jnp.where(m, bvals_t[n][it], ct)
-                    cc = jnp.where(m, bvals_c[n][ic], cc)
-                return ct.astype(ldt_b), cc.astype(ldt_b)
-
             eff_t, eff_c = [], []
-            for rk, _tk, _ck, _wk in writes:
-                et, ec = cur_at(rk)
+            for rk, tk, ck, _wk in writes:
+                et, ec = tk.astype(ldt_b), ck.astype(ldt_b)
                 for rj, tj, cj, wj in writes:
                     hit = wj & (rj == rk)
                     et = jnp.where(hit, tj.astype(ldt_b), et)
@@ -924,9 +913,11 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
                 eff_t.append(et)
                 eff_c.append(ec)
             lt[n - 1] = jnp.put_along_axis(
-                lt[n - 1], rows, jnp.stack(eff_t), axis=0, inplace=False)
+                lt[n - 1], rows, jnp.stack(eff_t), axis=0, inplace=False,
+                mode="drop")
             lc[n - 1] = jnp.put_along_axis(
-                lc[n - 1], rows, jnp.stack(eff_c), axis=0, inplace=False)
+                lc[n - 1], rows, jnp.stack(eff_c), axis=0, inplace=False,
+                mode="drop")
 
     # lastLogTerm cache refresh (state.last_term): recomputed from the FINAL
     # log, so the ghost-append quirk (§3) is honored exactly — the cache is
@@ -939,8 +930,16 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
     for n in range(1, N + 1):
         li_f = s["last_index"][n - 1]
         if batched_logs:
-            raw = patch("log_term", n, jnp.clip(li_f - 1, 0, C - 1),
-                        bvals_t[n][T_LLT])
+            # Stored-value candidates for the final last_index - 1 row: the
+            # prefetch-time base (li unchanged) plus the ghost rows (li moved
+            # by an append; see the batch-row comment). This tick's writes
+            # overlay LAST via patch() — a ghost row that was also written
+            # this tick must report the written value.
+            row = jnp.clip(li_f - 1, 0, C - 1)
+            raw = bvals_t[n][T_LLT]
+            for j in range(T_GHOST, T_GHOST + N):
+                raw = jnp.where(brows_t[n][j] == row, bvals_t[n][j], raw)
+            raw = patch("log_term", n, row, raw)
             v = jnp.where(li_f >= 1, raw, 0)
         else:
             v = log_gather("log_term", n, li_f - 1)
@@ -952,6 +951,27 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
         s["log_cmd"] = jnp.concatenate(lc, axis=0)
 
     return aux_dirty["m"]
+
+
+def make_flags(cfg: RaftConfig, inject_present: bool = False,
+               fault_present: bool = False, batched: Optional[bool] = None,
+               sharded: bool = False) -> BodyFlags:
+    """The BodyFlags a tick over `cfg` compiles with (shared by make_aux and
+    the multi-tick flat-carry runner, which needs the field set up front)."""
+    dyn = cfg.uses_dyn_log
+    return BodyFlags(
+        faults=cfg.p_crash > 0 or cfg.p_restart > 0 or fault_present,
+        links=cfg.p_link_fail > 0 or cfg.p_link_heal > 0,
+        periodic=cfg.cmd_period > 0,
+        inject=inject_present,
+        delay=cfg.uses_mailbox,
+        # Deep logs switch to dynamic gather/scatter addressing (the Pallas
+        # builder forces this back off — Mosaic needs the one-hot form, and
+        # deep-log configs never reach Pallas anyway via choose_impl).
+        dyn_log=dyn,
+        batched=dyn and not cfg.uses_mailbox and batched is not False,
+        sharded=dyn and sharded,
+    )
 
 
 def make_aux(cfg: RaftConfig, base, tkeys, bkeys, state: RaftState,
@@ -969,20 +989,9 @@ def make_aux(cfg: RaftConfig, base, tkeys, bkeys, state: RaftState,
     G, N = cfg.n_groups, cfg.n_nodes
     t = state.tick
     aux = {}
-    dyn = cfg.uses_dyn_log
-    flags = BodyFlags(
-        faults=cfg.p_crash > 0 or cfg.p_restart > 0 or fault_cmd is not None,
-        links=cfg.p_link_fail > 0 or cfg.p_link_heal > 0,
-        periodic=cfg.cmd_period > 0,
-        inject=inject is not None,
-        delay=cfg.uses_mailbox,
-        # Deep logs switch to dynamic gather/scatter addressing (the Pallas
-        # builder forces this back off — Mosaic needs the one-hot form, and
-        # deep-log configs never reach Pallas anyway via choose_impl).
-        dyn_log=dyn,
-        batched=dyn and not cfg.uses_mailbox and batched is not False,
-        sharded=dyn and sharded,
-    )
+    flags = make_flags(cfg, inject_present=inject is not None,
+                       fault_present=fault_cmd is not None,
+                       batched=batched, sharded=sharded)
     if flags.delay and cfg.delay_lo < cfg.delay_hi:
         aux["delay"] = rngmod.delay_mask(
             base, t, (G, N, N), cfg.delay_lo, cfg.delay_hi
@@ -1052,10 +1061,18 @@ def unflatten_state(cfg: RaftConfig, s: dict) -> dict:
     return out
 
 
+def materialize_el(cfg: RaftConfig, tkeys, s: dict, el_dirty):
+    """The SEMANTICS.md §7 deferred election draw: el_left for dirty nodes is
+    the counted draw at t_ctr - 1 (the last counter the tick consumed).
+    Shared by finish_tick and the flat-carry Pallas runner so the deferral
+    formula lives in exactly one place."""
+    d = rngmod.draw_uniform_keyed(tkeys, s["t_ctr"] - 1, cfg.el_lo, cfg.el_hi)
+    return jnp.where(el_dirty, d, s["el_left"])
+
+
 def finish_tick(cfg: RaftConfig, tkeys, s: dict, el_dirty, t):
     """Materialize the deferred election draws and bump the tick counter."""
-    d = rngmod.draw_uniform_keyed(tkeys, s["t_ctr"] - 1, cfg.el_lo, cfg.el_hi)
-    s["el_left"] = jnp.where(el_dirty, d, s["el_left"])
+    s["el_left"] = materialize_el(cfg, tkeys, s, el_dirty)
     return RaftState(**s, tick=t + 1)
 
 

@@ -104,7 +104,7 @@ def tick_attribution(G: int):
                         r.shape if a.ndim == r.ndim else r.shape, a.dtype)
                 jnp.take_along_axis = fake_take
             elif patch == "no_writes":
-                def fake_put(a, r, v, axis=0, inplace=False):
+                def fake_put(a, r, v, axis=0, inplace=False, mode=None):
                     return a
                 jnp.put_along_axis = fake_put
             tick = tick_mod.make_tick(cfg)

@@ -1,0 +1,67 @@
+"""TPU probe: per-phase attribution of the headline Pallas megakernel.
+
+Sweeps RAFT_PHASE_CUT (ops/tick.phase_body's probe-only ablation knob):
+cut=k compiles the lattice truncated after phase k, so successive deltas
+attribute kernel time to phases F+0, 1, 2, 3(+columnar exit), 4, 5, and the
+tick tail (mailbox countdown + last_term refresh + log rejoin). Output bits
+of cut kernels are meaningless; only wall time is read.
+
+  python scripts/probe_phase_cuts.py
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_compilation_cache_dir", os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
+def main():
+    from raft_kotlin_tpu.models.state import init_state
+    from raft_kotlin_tpu.ops import tick as tick_mod
+    from raft_kotlin_tpu.utils.config import RaftConfig
+
+    cfg = RaftConfig(
+        n_groups=102_400, n_nodes=5, log_capacity=32, cmd_period=10,
+        p_drop=0.25, p_crash=0.01, p_restart=0.08,
+        p_link_fail=0.02, p_link_heal=0.08, seed=0,
+    ).stressed(10)
+    T = 50
+    st0 = init_state(cfg)
+    prev = 0.0
+    for cut in (0, 1, 2, 3, 4, 99):
+        os.environ["RAFT_PHASE_CUT"] = str(cut)
+        from raft_kotlin_tpu.ops.pallas_tick import make_pallas_scan
+        rngs = [tick_mod.make_rng(dataclasses.replace(
+            cfg, seed=cfg.seed + 1000 * (r + 1))) for r in range(3)]
+        run = make_pallas_scan(cfg, T, interpret=False)
+        try:
+            int(jnp.sum(run(st0, rngs[2]).rounds))
+            ts = []
+            for r in range(2):
+                t0 = time.perf_counter()
+                int(jnp.sum(run(st0, rngs[r]).rounds))
+                ts.append(time.perf_counter() - t0)
+            ms = min(ts) / T * 1e3
+            print(json.dumps({"cut": cut, "ms_per_tick": round(ms, 3),
+                              "delta_ms": round(ms - prev, 3)}), flush=True)
+            prev = ms
+        except Exception as e:
+            print(json.dumps({"cut": cut, "err": str(e)[:200]}), flush=True)
+    os.environ.pop("RAFT_PHASE_CUT", None)
+
+
+if __name__ == "__main__":
+    main()

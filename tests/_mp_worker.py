@@ -40,7 +40,9 @@ def main() -> int:
     from raft_kotlin_tpu.utils.config import RaftConfig
 
     cfg = RaftConfig(
-        n_groups=int(os.environ["MP_GROUPS"]), n_nodes=3, log_capacity=8,
+        n_groups=int(os.environ["MP_GROUPS"]), n_nodes=3,
+        log_capacity=int(os.environ.get("MP_CAPACITY", "8")),
+        log_dtype=os.environ.get("MP_LOG_DTYPE", "int32"),
         cmd_period=5, p_drop=0.1, seed=int(os.environ["MP_SEED"]),
     ).stressed(10)
     t1 = int(os.environ["MP_T1"])

@@ -78,3 +78,22 @@ def test_batched_int16_tick_kernel_matches_fallback(monkeypatch):
     for _ in range(99):
         a, b = t_kernel(a), t_takes(b)
     assert_states_equal(jax.device_get(a), jax.device_get(b))
+
+
+def test_batched_ghost_append_last_term(monkeypatch):
+    """Round-4 review regression: a §3 GHOST append (post-truncation,
+    phys_len > last_index) moves last_index to i while writing slot
+    phys_len, so the tick-end last_term cache must read the STALE stored
+    row i — which the batched engine's prefetch did not carry (it diverged
+    from the per-pair engine at tick 129 of exactly this soup)."""
+    cfg = RaftConfig(
+        n_groups=8, n_nodes=3, log_capacity=256, cmd_period=3, p_drop=0.2,
+        p_crash=0.02, p_restart=0.15, seed=41,
+    ).stressed(10)
+    st0 = init_state(cfg)
+    t_b = jax.jit(make_tick(cfg))             # batched engine
+    t_p = jax.jit(make_tick(cfg, batched=False))  # per-pair ground truth
+    a = b = st0
+    for _ in range(150):
+        a, b = t_b(a), t_p(b)
+    assert_states_equal(jax.device_get(a), jax.device_get(b))

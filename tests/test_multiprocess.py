@@ -97,3 +97,38 @@ def test_two_process_sharded_save_restart_resume(tmp_path):
     assert got_cfg == cfg
     assert_states_equal(jax.device_get(ref), jax.device_get(got))
     assert int(np.max(np.asarray(got.commit))) > 0  # the run really replicated
+
+
+@pytest.mark.slow
+def test_two_process_sharded_deep_log(tmp_path):
+    """Deep-log (dyn band, int16) evidence across a REAL process boundary
+    (VERDICT r03 next #4): the shard_map per-pair FLAT engine
+    (parallel/mesh._make_shardmap_xla_tick) plus save_sharded/load_sharded of
+    C=256 logs cross a jax.distributed restart, bit-equal to the unsharded
+    single-process run. The toy-C tests above cannot reach the deep engine —
+    C=256 crosses the uses_dyn_log threshold (utils/config.py)."""
+    ckpt_a = str(tmp_path / "dckpt_a")
+    ckpt_b = str(tmp_path / "dckpt_b")
+    t1, t2 = 30, 25
+    env = {
+        "MP_NPROCS": "2", "MP_PORT": str(_free_port()),
+        "MP_GROUPS": str(GROUPS), "MP_SEED": str(SEED + 1),
+        "MP_T1": str(t1), "MP_T2": str(t2),
+        "MP_CAPACITY": "256", "MP_LOG_DTYPE": "int16",
+        "MP_CKPT_A": ckpt_a, "MP_CKPT_B": ckpt_b,
+    }
+    _run_fleet("phase_a", env)
+    _run_fleet("phase_b", env)
+
+    cfg = RaftConfig(n_groups=GROUPS, n_nodes=3, log_capacity=256,
+                     log_dtype="int16", cmd_period=5, p_drop=0.1,
+                     seed=SEED + 1).stressed(10)
+    assert cfg.uses_dyn_log  # the deep engine really is the path under test
+    # batched=False: XLA:CPU compiles of the batched deep engine blow up on
+    # int16 configs (ops/tick.make_run docstring); values are identical.
+    ref, _ = make_run(cfg, t1 + t2, trace=False, batched=False)(init_state(cfg))
+
+    got, got_cfg = checkpoint.load_sharded(ckpt_b)
+    assert got_cfg == cfg
+    assert_states_equal(jax.device_get(ref), jax.device_get(got))
+    assert int(np.max(np.asarray(got.last_index))) > 0  # logs really grew

@@ -82,3 +82,39 @@ def test_pick_tile_vmem_model():
     assert pick_tile(102_400, total_rows=1146) == 256  # measured N=5 C=32 config
     assert pick_tile(1024, total_rows=300) == 1024
     assert pick_tile(100_000, total_rows=300) is None  # not lane-aligned
+
+
+def test_flat_carry_scan_matches_tick():
+    """make_pallas_scan (flat int32 scan carry, conversions once per call)
+    must be bit-identical to scanning make_pallas_tick — same kernel, same
+    draws, different carry plumbing. Fault soup + mailbox-free headline-like
+    shape."""
+    from raft_kotlin_tpu.ops.pallas_tick import make_pallas_scan
+
+    cfg = RaftConfig(n_groups=8, n_nodes=5, log_capacity=8, cmd_period=5,
+                     p_drop=0.1, p_crash=0.02, p_restart=0.1,
+                     p_link_fail=0.02, p_link_heal=0.1, seed=11).stressed(10)
+    T = 50
+    tp = jax.jit(make_pallas_tick(cfg, interpret=True))
+    sp = init_state(cfg)
+    for _ in range(T):
+        sp = tp(sp)
+    run = make_pallas_scan(cfg, T, interpret=True)
+    from raft_kotlin_tpu.ops.tick import make_rng
+    sf = run(init_state(cfg), make_rng(cfg))
+    assert_states_equal(jax.device_get(sp), jax.device_get(sf))
+
+
+def test_flat_carry_scan_matches_tick_mailbox():
+    from raft_kotlin_tpu.ops.pallas_tick import make_pallas_scan
+    from raft_kotlin_tpu.ops.tick import make_rng
+
+    cfg = RaftConfig(n_groups=8, n_nodes=3, log_capacity=8, cmd_period=5,
+                     p_drop=0.1, delay_lo=1, delay_hi=3, seed=13).stressed(10)
+    T = 40
+    tp = jax.jit(make_pallas_tick(cfg, interpret=True))
+    sp = init_state(cfg)
+    for _ in range(T):
+        sp = tp(sp)
+    sf = make_pallas_scan(cfg, T, interpret=True)(init_state(cfg), make_rng(cfg))
+    assert_states_equal(jax.device_get(sp), jax.device_get(sf))
