@@ -183,12 +183,12 @@ def state_aux_bytes_per_tick(cfg) -> int:
         if getattr(shapes, f.name) is not None
     )
     G, N = cfg.n_groups, cfg.n_nodes
-    aux = G * N * N * 4  # edge_iid as i32 lanes
+    aux = G * N * N * 2  # edge_iid as i16 lanes (make_aux narrowing)
     if cfg.p_crash > 0 or cfg.p_restart > 0:
-        aux += G * N * 3 * 4  # crash/restart/el_draw_f
+        aux += G * N * (1 + 1 + 2)  # crash/restart bool + el_draw_f i16
     if cfg.p_link_fail > 0 or cfg.p_link_heal > 0:
-        aux += G * N * N * 2 * 4
-    aux += G * N * 4  # bdraw
+        aux += G * N * N * 2 * 2
+    aux += G * N * 2  # bdraw i16
     return 2 * state + aux
 
 
@@ -360,7 +360,7 @@ def main() -> None:
                 deep_times, dstats, deep_impl = measure(
                     deep_cfg, deep_ticks, deep_reps, deep_candidates,
                     summarize=lambda end: {
-                        "commit": int(jnp.sum(jnp.max(end.commit, axis=0)))})
+                        "commit": int(jnp.sum(jnp.max(end.commit, axis=0).astype(jnp.int32)))})
                 dbest = median(deep_times)
                 d_bw = deep_min_bytes * (deep_ticks / dbest)
                 deep_hbm_frac = round(d_bw / peak, 3) if peak else None

@@ -33,19 +33,25 @@ from raft_kotlin_tpu.constants import (  # noqa: F401  (re-exported)
 
 @struct.dataclass
 class RaftState:
-    # Core Raft variables (RaftServer.kt:35-48).
-    term: jax.Array        # (N, G) i32
-    voted_for: jax.Array   # (N, G) i32, -1 = none
-    role: jax.Array        # (N, G) i32 ∈ {FOLLOWER, CANDIDATE, LEADER}
-    commit: jax.Array      # (N, G) i32
+    # Core Raft variables (RaftServer.kt:35-48). Round-4 narrowing: every
+    # field whose value range is STRUCTURALLY bounded (roles, vote tallies,
+    # timer countdowns, log positions <= C < 2^15) is stored int16 — state
+    # DMA is a first-order cost of the megakernel tick, and narrow lanes
+    # halve it. Unbounded monotone quantities (term, rounds, draw counters)
+    # stay int32; NARROW_FIELDS below is the canonical list.
+    term: jax.Array        # (N, G) i32 (unbounded: grows per election)
+    voted_for: jax.Array   # (N, G) i16, -1 = none (node ids <= N <= 9)
+    role: jax.Array        # (N, G) i16 ∈ {FOLLOWER, CANDIDATE, LEADER}
+    commit: jax.Array      # (N, G) i16 (<= last_index <= C)
 
     # Log (SEMANTICS.md §3): physical slots + logical last_index ≤ phys_len.
-    last_index: jax.Array  # (N, G) i32
-    phys_len: jax.Array    # (N, G) i32
-    log_term: jax.Array    # (N, C, G) i32
+    last_index: jax.Array  # (N, G) i16 (<= C)
+    phys_len: jax.Array    # (N, G) i16 (<= C)
+    log_term: jax.Array    # (N, C, G) i32 (or i16 via cfg.log_dtype)
     log_cmd: jax.Array     # (N, C, G) i32
     # Derived cache: log_term at physical slot last_index - 1 (0 when the log
-    # is logically empty) — the lastLogTerm every vote request/handler reads
+    # is logically empty; i32 — term-valued) — the lastLogTerm every vote
+    # request/handler reads
     # (reference RaftServer.kt:200-207). Maintained by the tick (zeroed on
     # restart, patched after phase-0 appends, recomputed from the final log at
     # tick end) so phase 3 never needs a per-node log gather; on deep-log
@@ -109,9 +115,46 @@ class RaftState:
     aq_commit: Optional[jax.Array] = None  # leaderCommit
 
 
+# Structurally bounded fields stored int16 (round-4 narrowing): node ids,
+# vote tallies, role/round enums, timer countdowns (<= el_hi/bo_hi/round_ticks
+# etc.), and log positions (<= log_capacity; RaftConfig asserts C < 2^15).
+# next_index's lower bound is 1: a failed exchange at i=1 is impossible
+# (prevLogIndex -1 always succeeds), so the decrement walk never leaves int16.
+NARROW16 = (
+    "voted_for", "role", "commit", "last_index", "phys_len", "el_left",
+    "round_state", "round_left", "round_age", "votes", "responses",
+    "bo_left", "next_index", "match_index", "hb_left",
+    # §10 mailbox: index-/countdown-/flag-valued slots. Term-valued slots
+    # (vq_term/vq_llt/aq_term/aq_plt/aq_ent_t), the cmd payload (aq_ent_c,
+    # tick-valued) and the rounds stamp (vq_round) stay int32 like their
+    # sources.
+    "vq_due", "vq_lli", "aq_due", "aq_pli", "aq_hase", "aq_commit",
+)
+
+
+def field_dtype(name: str, cfg: RaftConfig):
+    """Canonical STORAGE dtype of a RaftState field under `cfg`."""
+    if name in ("log_term", "log_cmd"):
+        return jnp.int16 if cfg.log_dtype == "int16" else jnp.int32
+    if name in ("el_armed", "hb_armed", "up", "responded", "link_up"):
+        return jnp.bool_
+    return jnp.int16 if name in NARROW16 else jnp.int32
+
+
+def assert_narrow_bounds(cfg: RaftConfig) -> None:
+    """Value-range guards for the int16 NARROW16 storage: log positions need
+    log_capacity < 2^15 and the timer/delay draws feed int16 countdowns."""
+    assert cfg.log_capacity < 2 ** 15, (
+        "int16 log positions (NARROW16) need log_capacity < 32768")
+    assert max(cfg.el_hi, cfg.bo_hi, cfg.delay_hi) < 2 ** 15, (
+        "int16 countdown fields (NARROW16) need el_hi/bo_hi/delay_hi < 32768")
+
+
 def init_state(cfg: RaftConfig) -> RaftState:
     G, N, C = cfg.n_groups, cfg.n_nodes, cfg.log_capacity
+    assert_narrow_bounds(cfg)
     zi = lambda *s: jnp.zeros(s, dtype=jnp.int32)
+    z16 = lambda *s: jnp.zeros(s, dtype=jnp.int16)
     zb = lambda *s: jnp.zeros(s, dtype=bool)
     # Log storage dtype (cfg.log_dtype): int16 halves the dominant deep-log HBM
     # cost (BASELINE config 5); all handler arithmetic widens to int32 at read
@@ -122,30 +165,30 @@ def init_state(cfg: RaftConfig) -> RaftState:
     # Drawn in the canonical (G, N) shape (SEMANTICS.md §4), then transposed.
     el_left = rngmod.draw_uniform_grid(
         base, rngmod.KIND_TIMEOUT, zi(G, N), cfg.el_lo, cfg.el_hi
-    ).T
+    ).T.astype(jnp.int16)
     return RaftState(
         term=zi(N, G),
-        voted_for=jnp.full((N, G), -1, dtype=jnp.int32),
-        role=zi(N, G),
-        commit=zi(N, G),
-        last_index=zi(N, G),
-        phys_len=zi(N, G),
+        voted_for=jnp.full((N, G), -1, dtype=jnp.int16),
+        role=z16(N, G),
+        commit=z16(N, G),
+        last_index=z16(N, G),
+        phys_len=z16(N, G),
         log_term=jnp.zeros((N, C, G), dtype=ldt),
         log_cmd=jnp.zeros((N, C, G), dtype=ldt),
         last_term=zi(N, G),
         el_armed=jnp.ones((N, G), dtype=bool),
         el_left=el_left,
-        round_state=zi(N, G),
-        round_left=zi(N, G),
-        round_age=zi(N, G),
-        votes=zi(N, G),
-        responses=zi(N, G),
+        round_state=z16(N, G),
+        round_left=z16(N, G),
+        round_age=z16(N, G),
+        votes=z16(N, G),
+        responses=z16(N, G),
         responded=zb(N, N, G),
-        bo_left=zi(N, G),
-        next_index=zi(N, N, G),
-        match_index=zi(N, N, G),
+        bo_left=z16(N, G),
+        next_index=z16(N, N, G),
+        match_index=z16(N, N, G),
         hb_armed=zb(N, G),
-        hb_left=zi(N, G),
+        hb_left=z16(N, G),
         up=jnp.ones((N, G), dtype=bool),
         link_up=jnp.ones((N, N, G), dtype=bool),
         t_ctr=jnp.ones((N, G), dtype=jnp.int32),
@@ -154,9 +197,10 @@ def init_state(cfg: RaftConfig) -> RaftState:
         tick=jnp.zeros((), dtype=jnp.int32),
         **(
             {
-                "vq_due": jnp.full((N, N, G), -1, dtype=jnp.int32),
-                "aq_due": jnp.full((N, N, G), -1, dtype=jnp.int32),
-                **{k: zi(N, N, G) for k in (
+                "vq_due": jnp.full((N, N, G), -1, dtype=jnp.int16),
+                "aq_due": jnp.full((N, N, G), -1, dtype=jnp.int16),
+                **{k: (z16(N, N, G) if k in NARROW16 else zi(N, N, G))
+                   for k in (
                     "vq_term", "vq_lli", "vq_llt", "vq_round",
                     "aq_term", "aq_pli", "aq_plt", "aq_hase",
                     "aq_ent_t", "aq_ent_c", "aq_commit",

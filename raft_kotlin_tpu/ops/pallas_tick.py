@@ -33,14 +33,15 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from raft_kotlin_tpu.models.state import MAILBOX_FIELDS, RaftState
+from raft_kotlin_tpu.models.state import MAILBOX_FIELDS, NARROW16, RaftState
 from raft_kotlin_tpu.ops import tick as tick_mod
 from raft_kotlin_tpu.ops.tick import AUX_FIELDS, STATE_FIELDS, BodyFlags, state_fields
 from raft_kotlin_tpu.utils.config import RaftConfig
 
 _I32 = jnp.int32
-# Bool<->int32 conversion happens only for (N, G) grids; pair-shaped fields
-# (responded/link_up) and pair aux masks travel as int32 end to end — phase_body's
+_I16 = jnp.int16
+# Bool<->int16 conversion happens only for (N, G) grids; pair-shaped fields
+# (responded/link_up) and pair aux masks travel as int16 end to end — phase_body's
 # contract (no i1 tensors at pair shape).
 _BOOL_STATE = ("el_armed", "hb_armed", "up")
 _BOOL_AUX = ("crash_m", "restart_m")
@@ -51,13 +52,17 @@ def pick_tile(G: int, total_rows: int = 0) -> Optional[int]:
     """Largest supported tile dividing G that fits the Mosaic scoped-VMEM budget.
 
     Empirical cost model: the kernel's VMEM stack (inputs + outputs + live
-    temporaries across the unrolled phase lattice) measures ~30 bytes per
-    (row, lane) element — the N=5, C=32 config hits 34 MB at ~1120 rows x 1024
-    lanes against the 16 MB scoped limit. Budget 12 MB for headroom.
+    temporaries across the unrolled phase lattice) costs B bytes per
+    (row, lane) element. The round-4 tile ladder on the headline config
+    (N=5, C=32, 1156 model rows — scripts/probe_stage1_tiles.py) brackets B:
+    Mosaic ACCEPTS tile 512 (=> B <= 27) and REJECTS tile 1024 (=> B > 13.5)
+    against its ~16 MB scoped limit. B=20 with a 12 MB budget reproduces
+    that boundary exactly (512 in, 1024 out) and is re-validated both ways
+    by tests/test_tpu_pallas.py::test_tile_rejection_boundary.
     """
     budget = 12e6
     for t in _TILES:
-        if G % t == 0 and (not total_rows or total_rows * t * 30 <= budget):
+        if G % t == 0 and (not total_rows or total_rows * t * 20 <= budget):
             return t
     return None
 
@@ -86,6 +91,22 @@ def pad_groups_for_pallas(cfg: RaftConfig, tile: int = 256) -> RaftConfig:
     simulations, just surplus — same convention as parallel.mesh.pad_groups)."""
     g = ((cfg.n_groups + tile - 1) // tile) * tile
     return dataclasses.replace(cfg, n_groups=g)
+
+
+def kernel_field_dtype(cfg: RaftConfig, k: str):
+    """Dtype of a state field in the flat KERNEL form: the log storage dtype
+    for logs, int32 for EVERYTHING else — including the int16-stored NARROW16
+    fields and bool fields (i32 stand-ins). Narrow state blocks in the
+    megakernel trip a Mosaic layout bug (layout.h \"arr.size() >=
+    layout_rank\" SIGABRT once phase 3's columnar view is included; minimal
+    i16-block/bool-cast/1-D-i16 repros all pass, so it is an interaction bug
+    — round-4 bisection via RAFT_PHASE_CUT). State therefore crosses the
+    kernel boundary widened; the storage narrowing still pays on the XLA
+    paths (deep engine, sharded shard_map) and on checkpoints. Aux blocks
+    are inputs only (no aliasing constraint) and DO ride int16."""
+    if k in ("log_term", "log_cmd"):
+        return _I16 if cfg.log_dtype == "int16" else _I32
+    return _I32
 
 
 def make_pallas_core(cfg: RaftConfig, lanes: int, tile_g: int, interpret: bool):
@@ -142,21 +163,34 @@ def make_pallas_core(cfg: RaftConfig, lanes: int, tile_g: int, interpret: bool):
             n_in = len(sfields) + len(aux_names)
             ins = dict(zip(sfields + aux_names, refs[:n_in]))
             outs = dict(zip(sfields + ("el_dirty",), refs[n_in:]))
+            # Blocks cross HBM in the narrow storage dtypes (the round-4 DMA
+            # win); the kernel INTERIOR widens to int32 — Mosaic's int16
+            # layout handling crashes on the columnar (G,) rows (layout.h
+            # "arr.size() >= layout_rank" check), and int16 compute measured
+            # no faster anyway (probe_headline_dtypes). Logs keep their
+            # storage dtype: their (C, tile) one-hot ops are rank-2 and the
+            # int16 log kernel is TPU-proven (TPU_PALLAS variant_int16_logs).
             s = {}
             for k in sfields:
                 v = ins[k][...]
-                s[k] = (v != 0) if k in _BOOL_STATE else v
+                if k in _BOOL_STATE:
+                    s[k] = v != 0
+                elif k in ("log_term", "log_cmd"):
+                    s[k] = v
+                else:
+                    s[k] = v.astype(_I32)
             aux = {}
             for k in aux_names:
                 v = ins[k][...]
-                aux[k] = (v != 0) if k in _BOOL_AUX else v
+                aux[k] = (v != 0) if k in _BOOL_AUX else v.astype(_I32)
             el_dirty = tick_mod.phase_body(cfg, s, aux, flags)
             for k in sfields:
-                outs[k][...] = s[k].astype(_I32) if k in _BOOL_STATE else s[k]
+                outs[k][...] = (s[k] if k in ("log_term", "log_cmd")
+                                else s[k].astype(kernel_field_dtype(cfg, k)))
             outs["el_dirty"][...] = el_dirty.astype(_I32)
 
         def field_dtype(k):
-            return log_dt if k in ("log_term", "log_cmd") else _I32
+            return kernel_field_dtype(cfg, k)
 
         in_specs = [block_spec(field_shapes[k]) for k in sfields]
         in_specs += [block_spec(aux_shapes[k]) for k in aux_names]
@@ -166,7 +200,7 @@ def make_pallas_core(cfg: RaftConfig, lanes: int, tile_g: int, interpret: bool):
             for k in sfields
         ] + [jax.ShapeDtypeStruct((N, lanes), _I32)]
         out_specs = [block_spec(field_shapes[k]) for k in sfields]
-        out_specs += [block_spec((N, tile_g))]
+        out_specs += [block_spec((N, tile_g))]  # el_dirty (i16)
 
         call = pl.pallas_call(
             kernel,
@@ -183,10 +217,11 @@ def make_pallas_core(cfg: RaftConfig, lanes: int, tile_g: int, interpret: bool):
 
 
 def cast_aux_in(aux: dict, aux_names):
-    """Order + int32-cast the aux kernel operands (the aux half of
-    cast_flat_in; the flat-carry runner uses it alone — its state already
-    rides in kernel form)."""
-    return [aux[k].astype(_I32) if k in _BOOL_AUX else aux[k]
+    """Order-and-cast the aux kernel operands (the aux half of cast_flat_in;
+    the flat-carry runner uses it alone — its state already rides in kernel
+    form). Aux blocks are kernel INPUTS only, so they keep their narrow
+    (int16) dtypes; bool aux rides as int16 stand-ins."""
+    return [aux[k].astype(_I16) if k in _BOOL_AUX else aux[k]
             for k in aux_names]
 
 
@@ -195,16 +230,26 @@ def cast_flat_in(flat: dict, aux: dict, sfields, aux_names):
     ins = []
     for k in sfields:
         v = flat[k]
-        ins.append(v.astype(_I32) if k in _BOOL_STATE else v)
+        ins.append(v if k in ("log_term", "log_cmd") else v.astype(_I32))
     return ins + cast_aux_in(aux, aux_names)
 
 
-def cast_flat_out(outs, sfields):
-    """Inverse of cast_flat_in for the kernel outputs -> (flat state dict, el_dirty)."""
+def cast_flat_out(cfg, outs, sfields, with_dirty: bool = True):
+    """Inverse of cast_flat_in for the kernel outputs -> (flat state dict,
+    el_dirty): bools from their i32 stand-ins, narrowed ints back to their
+    storage dtypes (the kernel computes in i32 — see kernel_field_dtype).
+    with_dirty=False: `outs` carries exactly the state fields (the flat-carry
+    exit path, where el_left was already materialized) -> (dict, None)."""
+    from raft_kotlin_tpu.models.state import field_dtype
+
     s = {}
     for k, v in zip(sfields, outs[: len(sfields)]):
-        s[k] = (v != 0) if k in _BOOL_STATE else v
-    return s, outs[-1] != 0
+        want = field_dtype(k, cfg)
+        if want == jnp.bool_:
+            s[k] = v != 0  # incl. pair bools: unflatten_state re-derives them
+        else:
+            s[k] = v.astype(want) if v.dtype != want else v
+    return s, (outs[-1] != 0) if with_dirty else None
 
 
 def make_pallas_tick(cfg: RaftConfig, tile_g: Optional[int] = None,
@@ -246,16 +291,183 @@ def make_pallas_tick(cfg: RaftConfig, tile_g: Optional[int] = None,
         call, sfields, aux_names = build_call(flags)
         flat = tick_mod.flatten_state(cfg, state)
         outs = call(*cast_flat_in(flat, aux, sfields, aux_names))
-        s, el_dirty = cast_flat_out(outs, sfields)
+        s, el_dirty = cast_flat_out(cfg, outs, sfields)
         return tick_mod.finish_tick(
             cfg, tkeys, tick_mod.unflatten_state(cfg, s), el_dirty, state.tick)
 
     return tick
 
 
+def resets_per_tick_bound(N: int) -> int:
+    """Structural upper bound on election-timer resets per (node, tick) —
+    the t_ctr advance the K-tick kernel's draw table must cover. Phase F
+    restart (1) + phase-2 demotion (1) + phase-3 adopts (<= N candidates) +
+    phase-4 demotion (1) + phase-5 adopt and quirk-d resets (2 per foreign
+    leader, <= 2(N-1)): 3N + 1 total. This is a worst case over the phase
+    lattice's reset SITES, not a typical-path estimate — the draw-table
+    select masks unused entries, so only the bound's validity matters."""
+    return 3 * N + 1
+
+
+def make_pallas_core_k(cfg: RaftConfig, lanes: int, tile_g: int,
+                       interpret: bool, K: int):
+    """K-ticks-per-launch megakernel builder.
+
+    The phase-cut probe (scripts/probe_phase_cuts.py, round 4) shows the
+    1-tick kernel is DMA/overhead-bound: a kernel truncated to phases F+0
+    costs ~3.3 ms/tick vs ~4.0 full — the state round-trip through HBM plus
+    launch overhead dominates, and only phase 5's log one-hots register as
+    compute. Running K ticks inside one pallas_call keeps ALL state VMEM-
+    resident across the K phase lattices, cutting the dominant state DMA and
+    launch overhead by K.
+
+    Randomness stays outside (bit-compat invariant): per-tick aux masks
+    arrive as K-stacked row slabs, and the counter-keyed draws (el timeout,
+    backoff) arrive as PRE-DRAWN TABLES over the counter windows the launch
+    can reach — el: W = resets_per_tick_bound(N) * K entries from t_ctr0,
+    backoff: K entries from b_ctr0 (phase 4 consumes at most one backoff
+    draw per tick). The kernel selects table entries by one-hot over the
+    window, so every draw equals the per-tick path's draw at the same
+    counter bit-for-bit; deferred el_left materialization happens in-kernel
+    at each tick boundary (same §7 formula as tick.materialize_el).
+
+    Returns build_call(flags) -> (call, sfields, aux_names) where call takes
+    [state fields..., aux K-slabs..., el_table (N*W, lanes), b_table
+    (N*K, lanes)] and returns the post-K-tick state fields (aliased)."""
+    N, C = cfg.n_nodes, cfg.log_capacity
+    assert lanes % tile_g == 0, (lanes, tile_g)
+    log_dt = jnp.int16 if cfg.log_dtype == "int16" else _I32
+    W = resets_per_tick_bound(N) * K
+
+    field_shapes = {
+        **{k: (N, tile_g) for k in STATE_FIELDS},
+        "log_term": (N * C, tile_g), "log_cmd": (N * C, tile_g),
+        "responded": (N * N, tile_g), "next_index": (N * N, tile_g),
+        "match_index": (N * N, tile_g), "link_up": (N * N, tile_g),
+        **{k: (N * N, tile_g) for k in MAILBOX_FIELDS},
+    }
+    aux_rows = {
+        "edge_iid": N * N, "crash_m": N, "restart_m": N, "link_fail": N * N,
+        "link_heal": N * N, "periodic": 1, "inject": N, "delay": N * N,
+    }
+
+    def block_spec(shape):
+        return pl.BlockSpec(shape, lambda i: (0, i))
+
+    @functools.lru_cache(maxsize=None)
+    def build_call(flags: BodyFlags):
+        flags = dataclasses.replace(flags, dyn_log=False, batched=False,
+                                    sharded=False, inject=False)
+        sfields = state_fields(flags)
+        aux_names = tuple(
+            k for k in AUX_FIELDS
+            if (k == "edge_iid")
+            or (k in ("crash_m", "restart_m") and flags.faults)
+            or (k in ("link_fail", "link_heal") and flags.links)
+            or (k == "periodic" and flags.periodic)
+            or (k == "delay" and flags.delay and cfg.delay_lo < cfg.delay_hi)
+        )
+
+        def sel(table, Wn, delta):
+            # (N, tile) values: per node, table rows [n*Wn, (n+1)*Wn) at
+            # per-lane offset delta[n] (one (Wn, tile) one-hot contraction
+            # per node — compute is nearly free in this DMA-bound kernel).
+            rows_iota = jax.lax.broadcasted_iota(_I32, (Wn, tile_g), 0)
+            vals = []
+            for n in range(N):
+                oh = rows_iota == delta[n][None]
+                vals.append(jnp.sum(
+                    jnp.where(oh, table[n * Wn:(n + 1) * Wn], 0), axis=0))
+            return jnp.stack(vals)
+
+        def kernel(*refs):
+            n_in = len(sfields) + len(aux_names)
+            ins = dict(zip(sfields, refs[:len(sfields)]))
+            slabs = {k: r[...] for k, r in
+                     zip(aux_names, refs[len(sfields):n_in])}
+            el_tab = refs[n_in][...].astype(_I32)
+            b_tab = refs[n_in + 1][...].astype(_I32)
+            outs = dict(zip(sfields, refs[n_in + 2:]))
+            # Same widen-at-entry boundary as the 1-tick kernel (Mosaic int16
+            # layout crash on columnar rows): narrow in HBM, int32 inside.
+            s = {}
+            for k in sfields:
+                v = ins[k][...]
+                if k in _BOOL_STATE:
+                    s[k] = v != 0
+                elif k in ("log_term", "log_cmd"):
+                    s[k] = v
+                else:
+                    s[k] = v.astype(_I32)
+            t0, b0 = s["t_ctr"], s["b_ctr"]
+            for k in range(K):
+                aux = {}
+                for name in aux_names:
+                    r = aux_rows[name]
+                    v = slabs[name][k * r:(k + 1) * r]
+                    aux[name] = (v != 0) if name in _BOOL_AUX \
+                        else v.astype(_I32)
+                if flags.faults:
+                    aux["el_draw_f"] = sel(el_tab, W, s["t_ctr"] - t0)
+                aux["bdraw"] = sel(b_tab, K, s["b_ctr"] - b0)
+                el_dirty = tick_mod.phase_body(cfg, s, aux, flags)
+                d = sel(el_tab, W, s["t_ctr"] - 1 - t0)
+                s["el_left"] = jnp.where(el_dirty, d, s["el_left"])
+            for k in sfields:
+                outs[k][...] = (s[k] if k in ("log_term", "log_cmd")
+                                else s[k].astype(kernel_field_dtype(cfg, k)))
+
+        def field_dtype(k):
+            return kernel_field_dtype(cfg, k)
+
+        in_specs = [block_spec(field_shapes[k]) for k in sfields]
+        in_specs += [block_spec((K * aux_rows[k], tile_g)) for k in aux_names]
+        in_specs += [block_spec((N * W, tile_g)), block_spec((N * K, tile_g))]
+        out_shapes = [
+            jax.ShapeDtypeStruct(
+                tuple(field_shapes[k][:-1]) + (lanes,), field_dtype(k))
+            for k in sfields
+        ]
+        out_specs = [block_spec(field_shapes[k]) for k in sfields]
+        call = pl.pallas_call(
+            kernel,
+            grid=(lanes // tile_g,),
+            in_specs=in_specs,
+            out_specs=out_specs,
+            out_shape=out_shapes,
+            input_output_aliases={i: i for i in range(len(sfields))},
+            interpret=interpret,
+        )
+        return call, sfields, aux_names
+
+    return build_call
+
+
+def draw_tables(cfg: RaftConfig, tkeys, bkeys, t_ctr, b_ctr, K: int):
+    """The K-launch counter-keyed draw tables (XLA, outside the kernel):
+    el_table (N*W, G) rows n*W + j = draw_uniform_keyed(tkeys, t_ctr0 + j)
+    for node n; b_table (N*K, G) likewise over bkeys/b_ctr0. Same counted
+    threefry as the per-tick path — table entry == that path's draw at the
+    same counter, bit for bit."""
+    from raft_kotlin_tpu.utils import rng as rngmod
+
+    N = cfg.n_nodes
+    W = resets_per_tick_bound(N) * K
+
+    def tab(keys, ctr0, Wn, lo, hi):
+        draws = jnp.stack([rngmod.draw_uniform_keyed(keys, ctr0 + j, lo, hi)
+                           for j in range(Wn)])  # (Wn, N, G)
+        # Row n*Wn + j = node n's draw at counter ctr0 + j.
+        return draws.transpose(1, 0, 2).reshape(N * Wn, -1)
+
+    return (tab(tkeys, t_ctr, W, cfg.el_lo, cfg.el_hi),
+            tab(bkeys, b_ctr, K, cfg.bo_lo, cfg.bo_hi))
+
+
 def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
                      tile_g: Optional[int] = None,
-                     interpret: Optional[bool] = None):
+                     interpret: Optional[bool] = None,
+                     k_per_launch: int = 1):
     """Multi-tick Pallas runner with a FLAT int32 scan carry.
 
     Scanning make_pallas_tick converts RaftState <-> the kernel's flat int32
@@ -266,27 +478,38 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
     unflatten after. Bits are identical by construction (same phase_body
     kernel, same aux draws, same deferred-draw materialization).
 
+    With k_per_launch = K > 1, full launches run through the K-tick kernel
+    (make_pallas_core_k: state crosses HBM once per K ticks) and the
+    n_ticks % K remainder through the 1-tick kernel — still bit-identical
+    (same phase_body, same counted draws via the launch tables).
+
     Returns run(state, rng) -> state (jitted; rng rides as an operand so the
     compilation is seed-independent, as everywhere else)."""
     import types
 
     N, G = cfg.n_nodes, cfg.n_groups
+    K = max(1, k_per_launch)
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
     if tile_g is None:
-        tile_g = default_tile(cfg, G, interpret)
+        tile_g = default_tile(cfg, G, interpret, k_per_launch=K)
     if interpret and G % tile_g:
         tile_g = G
     build_call = make_pallas_core(cfg, G, tile_g, interpret)
+    build_call_k = (make_pallas_core_k(cfg, G, tile_g, interpret, K)
+                    if K > 1 else None)
     sfields = state_fields(tick_mod.make_flags(cfg))
+    n_launch, rem = divmod(n_ticks, K) if K > 1 else (0, n_ticks)
 
     @jax.jit
     def run(state: RaftState, rng):
         base, tkeys, bkeys = rng
         flat = tick_mod.flatten_state(cfg, state)
-        # One-time entry casts (the per-tick cost this runner removes).
-        for k in _BOOL_STATE:
-            flat[k] = flat[k].astype(_I32)
+        # One-time entry casts (the per-tick cost this runner removes): the
+        # scan carries the i32 kernel form; storage dtypes return at exit.
+        for k in sfields:
+            if k not in ("log_term", "log_cmd"):
+                flat[k] = flat[k].astype(_I32)
 
         def body(carry, _):
             s, t = carry
@@ -301,18 +524,45 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
                 cfg, tkeys, s2, outs[-1] != 0)
             return (s2, t + 1), None
 
-        (flat, t), _ = jax.lax.scan(body, (flat, state.tick), None,
-                                    length=n_ticks)
-        s = {k: ((flat[k] != 0) if k in _BOOL_STATE else flat[k])
-             for k in sfields}
+        def body_k(carry, _):
+            s, t = carry
+            per, flags = [], None
+            for k in range(K):
+                shim = types.SimpleNamespace(
+                    tick=t + k, t_ctr=s["t_ctr"], b_ctr=s["b_ctr"])
+                aux_k, flags = tick_mod.make_aux(
+                    cfg, base, tkeys, bkeys, shim, None, None)
+                per.append(aux_k)
+            call, sfields_k, aux_names = build_call_k(flags)
+            slabs = [jnp.concatenate(
+                [p[nm].astype(_I16) if nm in _BOOL_AUX else p[nm]
+                 for p in per], axis=0) for nm in aux_names]
+            el_tab, b_tab = draw_tables(
+                cfg, tkeys, bkeys, s["t_ctr"], s["b_ctr"], K)
+            outs = call(*([s[k] for k in sfields_k] + slabs
+                          + [el_tab, b_tab]))
+            return (dict(zip(sfields_k, outs)), t + K), None
+
+        flat_t = (flat, state.tick)
+        if n_launch:
+            flat_t, _ = jax.lax.scan(body_k, flat_t, None, length=n_launch)
+        if rem:
+            flat_t, _ = jax.lax.scan(body, flat_t, None, length=rem)
+        flat, t = flat_t
+        s, _ = cast_flat_out(cfg, [flat[k] for k in sfields], sfields,
+                             with_dirty=False)
         return RaftState(**tick_mod.unflatten_state(cfg, s), tick=t)
 
     return run
 
 
-def default_tile(cfg: RaftConfig, lanes: int, interpret: bool) -> int:
-    """VMEM-model tile choice for `lanes` lane columns (raises if none fits)."""
+def default_tile(cfg: RaftConfig, lanes: int, interpret: bool,
+                 k_per_launch: int = 1) -> int:
+    """VMEM-model tile choice for `lanes` lane columns (raises if none fits).
+    k_per_launch > 1 models the K-tick kernel: K aux slabs plus the el/backoff
+    draw tables replace the single-tick aux set."""
     N, C = cfg.n_nodes, cfg.log_capacity
+    K = max(1, k_per_launch)
     if interpret:
         return min(lanes, 256)
     # Rows across all in/out blocks: 2x state (in + aliased out) + worst-case aux
@@ -323,7 +573,11 @@ def default_tile(cfg: RaftConfig, lanes: int, interpret: bool) -> int:
     log_rows = 2 * 2 * N * C  # 2 log arrays, in + aliased out
     if cfg.log_dtype == "int16":
         log_rows //= 2  # i16 rows cost half the VMEM of the i32 model rows
-    rows = 2 * (n_2d * N + 4 * N * N) + log_rows + (3 * N * N + 5 * N + 1) + N
+    aux_rows = K * (3 * N * N + 5 * N + 1) + N
+    if K > 1:
+        # el table N*(3N+1)K + backoff table N*K rows.
+        aux_rows += K * N * (3 * N + 2)
+    rows = 2 * (n_2d * N + 4 * N * N) + log_rows + aux_rows
     if cfg.uses_mailbox:
         # §10 mailbox: 13 pair-shaped state fields (in + aliased out) + delay aux.
         rows += 2 * len(MAILBOX_FIELDS) * N * N + N * N

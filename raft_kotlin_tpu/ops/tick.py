@@ -423,25 +423,24 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
         restart_ev = ~s["up"] & aux["restart_m"]
         s["up"] = (s["up"] & ~crash_ev) | restart_ev
         rst = restart_ev
-        zero = jnp.zeros((), _I32)
-        s["term"] = jnp.where(rst, zero, s["term"])
+        s["term"] = jnp.where(rst, 0, s["term"])
         s["voted_for"] = jnp.where(rst, -1, s["voted_for"])
         s["role"] = jnp.where(rst, FOLLOWER, s["role"])
-        s["commit"] = jnp.where(rst, zero, s["commit"])
-        s["last_index"] = jnp.where(rst, zero, s["last_index"])
-        s["phys_len"] = jnp.where(rst, zero, s["phys_len"])
+        s["commit"] = jnp.where(rst, 0, s["commit"])
+        s["last_index"] = jnp.where(rst, 0, s["last_index"])
+        s["phys_len"] = jnp.where(rst, 0, s["phys_len"])
         s["round_state"] = jnp.where(rst, IDLE, s["round_state"])
         for f in ("votes", "responses", "round_left", "round_age", "bo_left",
                   "last_term"):
-            s[f] = jnp.where(rst, zero, s[f])
+            s[f] = jnp.where(rst, 0, s[f])
         # Pair grids are owned by their FIRST node index (candidate/leader).
         # Arithmetic selects: pair-shaped tensors never hold i1 (Mosaic limits).
-        keep = 1 - _rep_rows(rst.astype(_I32), N)
+        keep = 1 - _rep_rows(rst.astype(s["responded"].dtype), N)
         s["responded"] = s["responded"] * keep
         s["next_index"] = s["next_index"] * keep
         s["match_index"] = s["match_index"] * keep
         s["hb_armed"] = s["hb_armed"] & ~rst
-        s["hb_left"] = jnp.where(rst, zero, s["hb_left"])
+        s["hb_left"] = jnp.where(rst, 0, s["hb_left"])
         if flags.delay:
             # §10: restart clears the slots the node OWNS (its sent requests died
             # with the process); crash clears nothing (messages stay on the wire).
@@ -509,7 +508,7 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
     # -- phase 1: timers (independent countdowns) ---------------------------
 
     armed = s["el_armed"] & up
-    left = s["el_left"] - armed.astype(_I32)
+    left = s["el_left"] - armed.astype(s["el_left"].dtype)
     fire = armed & (left <= 0)
     s["el_left"] = left
     s["el_armed"] = s["el_armed"] & ~fire
@@ -517,7 +516,7 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
     start_round = fire
 
     in_bo = (s["round_state"] == BACKOFF) & up
-    bleft = s["bo_left"] - in_bo.astype(_I32)
+    bleft = s["bo_left"] - in_bo.astype(s["bo_left"].dtype)
     bfire = in_bo & (bleft <= 0)
     s["bo_left"] = bleft
     s["round_state"] = jnp.where(bfire, IDLE, s["round_state"])
@@ -529,12 +528,12 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
 
     is_cand = s["role"] == CANDIDATE
     init = start_round & is_cand
-    node_ids = jax.lax.broadcasted_iota(_I32, (N, G), 0) + 1
+    node_ids = jax.lax.broadcasted_iota(s["voted_for"].dtype, (N, G), 0) + 1
     s["term"] = s["term"] + init.astype(_I32)
     s["voted_for"] = jnp.where(init, node_ids, s["voted_for"])
     s["votes"] = jnp.where(init, 0, s["votes"])
     s["responses"] = jnp.where(init, 0, s["responses"])
-    s["responded"] = s["responded"] * (1 - _rep_rows(init.astype(_I32), N))
+    s["responded"] = s["responded"] * (1 - _rep_rows(init.astype(s["responded"].dtype), N))
     s["round_left"] = jnp.where(init, cfg.round_ticks, s["round_left"])
     s["round_age"] = jnp.where(init, 0, s["round_age"])
     s["round_state"] = jnp.where(init, ACTIVE, s["round_state"])
@@ -564,7 +563,7 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
     def delay_for(a, b):
         # §10 per-pair send delay this tick (static constant when lo == hi).
         if cfg.delay_lo == cfg.delay_hi:
-            return jnp.full((G,), cfg.delay_lo, dtype=_I32)
+            return jnp.full((G,), cfg.delay_lo, dtype=prow("vq_due", a, b).dtype)
         return aux["delay"][pair(a, b)]
 
     def put_pair(name, a, b, mask, vals):
@@ -614,7 +613,7 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
         )
         req_term = prow("vq_term", c, p)
         req_lli, req_llt = prow("vq_lli", c, p), prow("vq_llt", c, p)
-        put_pair("vq_due", c, p, due, jnp.full((G,), -1, dtype=_I32))
+        put_pair("vq_due", c, p, due, jnp.full((G,), -1, dtype=s["vq_due"].dtype))
         vote_exchange(c, p, att, req_term, req_lli, req_llt, guard)
 
     for c in range(1, N + 1):
@@ -663,7 +662,7 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
     lose = concl & is_cand & ~win
     dem = concl & ~is_cand
     s["role"] = jnp.where(win, LEADER, s["role"])
-    win_rep = _rep_rows(win.astype(_I32), N)
+    win_rep = _rep_rows(win.astype(s["next_index"].dtype), N)
     s["next_index"] = (
         win_rep * _rep_rows(s["commit"] + 1, N) + (1 - win_rep) * s["next_index"]
     )  # quirk b
@@ -676,8 +675,8 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
     s["b_ctr"] = s["b_ctr"] + lose.astype(_I32)
     reset_el_timer_grid(dem)
     ongoing = act & ~concl
-    s["round_left"] = s["round_left"] - ongoing.astype(_I32)
-    s["round_age"] = s["round_age"] + ongoing.astype(_I32)
+    s["round_left"] = s["round_left"] - ongoing.astype(s["round_left"].dtype)
+    s["round_age"] = s["round_age"] + ongoing.astype(s["round_age"].dtype)
 
     if cut < 5:
         return aux_dirty["m"]
@@ -743,7 +742,7 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
         req = {k: prow(k, l, p) for k in
                ("aq_term", "aq_commit", "aq_pli", "aq_plt",
                 "aq_hase", "aq_ent_t", "aq_ent_c")}
-        put_pair("aq_due", l, p, due, jnp.full((G,), -1, dtype=_I32))
+        put_pair("aq_due", l, p, due, jnp.full((G,), -1, dtype=s["aq_due"].dtype))
         append_exchange(l, p, att, req["aq_term"], req["aq_commit"],
                         req["aq_pli"], req["aq_plt"], req["aq_hase"] != 0,
                         req["aq_ent_t"], req["aq_ent_c"])
@@ -866,7 +865,8 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
                 put_pair("aq_commit", l, p, att, col("commit", l))
                 put_pair("aq_pli", l, p, att, pli)
                 put_pair("aq_plt", l, p, att, plt)
-                put_pair("aq_hase", l, p, att, has_entry.astype(_I32))
+                put_pair("aq_hase", l, p, att,
+                         has_entry.astype(prow("aq_hase", l, p).dtype))
                 put_pair("aq_ent_t", l, p, att, ent_t)
                 put_pair("aq_ent_c", l, p, att, ent_c)
                 put_pair("aq_due", l, p, att, delay_for(l, p))
@@ -887,7 +887,7 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
     if flags.delay:
         for name in ("vq_due", "aq_due"):
             d = s[name]
-            s[name] = d - (d > 0).astype(_I32)
+            s[name] = d - (d > 0).astype(d.dtype)
 
     if batched_logs:
         # Apply each node's deferred phase-0/5 writes as one scatter per log
@@ -995,10 +995,10 @@ def make_aux(cfg: RaftConfig, base, tkeys, bkeys, state: RaftState,
     if flags.delay and cfg.delay_lo < cfg.delay_hi:
         aux["delay"] = rngmod.delay_mask(
             base, t, (G, N, N), cfg.delay_lo, cfg.delay_hi
-        ).transpose(1, 2, 0).reshape(N * N, G)
+        ).transpose(1, 2, 0).reshape(N * N, G).astype(jnp.int16)
     aux["edge_iid"] = rngmod.edge_ok_mask(
         base, t, (G, N, N), cfg.p_drop
-    ).transpose(1, 2, 0).reshape(N * N, G).astype(jnp.int32)
+    ).transpose(1, 2, 0).reshape(N * N, G).astype(jnp.int16)
     if flags.faults:
         crash_m = rngmod.event_mask(
             base, rngmod.KIND_CRASH, t, (G, N), cfg.p_crash).T
@@ -1009,15 +1009,16 @@ def make_aux(cfg: RaftConfig, base, tkeys, bkeys, state: RaftState,
             restart_m = restart_m | (fault_cmd.T == 2)
         aux["crash_m"], aux["restart_m"] = crash_m, restart_m
         aux["el_draw_f"] = rngmod.draw_uniform_keyed(
-            tkeys, state.t_ctr, cfg.el_lo, cfg.el_hi)
+            tkeys, state.t_ctr, cfg.el_lo, cfg.el_hi).astype(jnp.int16)
     if flags.links:
         aux["link_fail"] = rngmod.event_mask(
             base, rngmod.KIND_LINK_FAIL, t, (G, N, N), cfg.p_link_fail
-        ).transpose(1, 2, 0).reshape(N * N, G).astype(jnp.int32)
+        ).transpose(1, 2, 0).reshape(N * N, G).astype(jnp.int16)
         aux["link_heal"] = rngmod.event_mask(
             base, rngmod.KIND_LINK_HEAL, t, (G, N, N), cfg.p_link_heal
-        ).transpose(1, 2, 0).reshape(N * N, G).astype(jnp.int32)
-    aux["bdraw"] = rngmod.draw_uniform_keyed(bkeys, state.b_ctr, cfg.bo_lo, cfg.bo_hi)
+        ).transpose(1, 2, 0).reshape(N * N, G).astype(jnp.int16)
+    aux["bdraw"] = rngmod.draw_uniform_keyed(
+        bkeys, state.b_ctr, cfg.bo_lo, cfg.bo_hi).astype(jnp.int16)
     if flags.periodic:
         due = (t % cfg.cmd_period == 0) & (t > 0)
         aux["periodic"] = jnp.where(
@@ -1038,7 +1039,7 @@ def flatten_state(cfg: RaftConfig, state: RaftState) -> dict:
         if k in _PAIR_FIELDS:
             v = v.reshape(N * N, G)
             if v.dtype == jnp.bool_:
-                v = v.astype(_I32)  # no i1 tensors at pair shape (Mosaic limits)
+                v = v.astype(jnp.int16)  # no i1 tensors at pair shape (Mosaic limits)
         elif k in _LOG_FIELDS:
             v = v.reshape(N * C, G)
         s[k] = v
@@ -1067,7 +1068,7 @@ def materialize_el(cfg: RaftConfig, tkeys, s: dict, el_dirty):
     Shared by finish_tick and the flat-carry Pallas runner so the deferral
     formula lives in exactly one place."""
     d = rngmod.draw_uniform_keyed(tkeys, s["t_ctr"] - 1, cfg.el_lo, cfg.el_hi)
-    return jnp.where(el_dirty, d, s["el_left"])
+    return jnp.where(el_dirty, d.astype(s["el_left"].dtype), s["el_left"])
 
 
 def finish_tick(cfg: RaftConfig, tkeys, s: dict, el_dirty, t):

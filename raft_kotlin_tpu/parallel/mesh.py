@@ -168,7 +168,7 @@ def _make_shardmap_pallas_tick(cfg: RaftConfig, mesh: Mesh,
             # embarrassingly parallel over lanes, so the check adds nothing.
             check_vma=False,
         )
-        s, el_dirty = cast_flat_out(shard_call(*ins), sfields)
+        s, el_dirty = cast_flat_out(cfg, shard_call(*ins), sfields)
         return tick_mod.finish_tick(
             cfg, tkeys, tick_mod.unflatten_state(cfg, s), el_dirty, state.tick)
 
@@ -292,9 +292,8 @@ def make_sharded_run(cfg: RaftConfig, mesh: Mesh, n_ticks: int,
             # (Role-transition counting would miss consecutive rounds by a node
             # that stays CANDIDATE through backoff loops — the churn case.)
             "elections": _rounds_sum(st) - rounds0,
-            "commit_total": jnp.sum(jnp.max(st.commit, axis=0).astype(jnp.int64)
-                                    if jax.config.jax_enable_x64
-                                    else jnp.max(st.commit, axis=0)),
+            "commit_total": jnp.sum(jnp.max(st.commit, axis=0).astype(
+                jnp.int64 if jax.config.jax_enable_x64 else jnp.int32)),
         }
 
     def run(st, rng):

@@ -32,10 +32,26 @@ from raft_kotlin_tpu.utils.config import RaftConfig
 _HEADER_KEY = "__raft_config_json__"
 _EXTRA_KEY = "__raft_extra_json__"
 _VERSION_KEY = "__raft_ckpt_version__"
-_VERSION = 5  # v2: +up/+link_up fault-model fields; v3: groups-minor array layout;
+_VERSION = 6  # v2: +up/+link_up fault-model fields; v3: groups-minor array layout;
               # v4: optional §10 mailbox arrays (present iff cfg.uses_mailbox);
               # v5: +last_term lastLogTerm cache (derived from the log on load
-              # of older checkpoints)
+              # of older checkpoints); v6: narrowed int16 storage for
+              # structurally bounded fields (models/state.NARROW16) — loads of
+              # ANY version cast to the canonical field dtypes (_canon_dtypes)
+
+
+def _canon_dtypes(arrays: dict, cfg: RaftConfig) -> dict:
+    """Cast loaded arrays to the canonical storage dtypes (v6 narrowing —
+    models/state.field_dtype): every narrowed field's value range is
+    structurally bounded, so the cast is lossless for any valid checkpoint."""
+    from raft_kotlin_tpu.models.state import assert_narrow_bounds, field_dtype
+
+    assert_narrow_bounds(cfg)  # an out-of-range cfg must fail loudly, not wrap
+    out = {}
+    for name, a in arrays.items():
+        want = np.dtype(field_dtype(name, cfg)) if name != "tick" else a.dtype
+        out[name] = a.astype(want) if a.dtype != want else a
+    return out
 
 
 def _derive_last_term(log_term, last_index):
@@ -186,7 +202,7 @@ def load_sharded(
     with open(os.path.join(dirpath, "manifest.json")) as f:
         manifest = json.load(f)
     version = int(manifest.get("version", 0))
-    if version not in (4, _VERSION):
+    if version not in (4, 5, _VERSION):
         # The sharded layout first existed at v4 — fail loudly on
         # future/corrupt manifests, mirroring _load_impl's gate.
         raise ValueError(
@@ -214,7 +230,7 @@ def load_sharded(
             if "last_term" not in d:
                 d["last_term"] = _derive_last_term(
                     d["log_term"], d["last_index"])
-            loaded[k] = d
+            loaded[k] = _canon_dtypes(d, cfg)
         return loaded[k]
 
     if mesh is None:
@@ -287,7 +303,7 @@ def load_sharded(
 def _load_impl(path, expect_cfg, sharding):
     with np.load(path) as z:
         version = int(z[_VERSION_KEY])
-        if version not in (1, 2, 3, 4, _VERSION):
+        if version not in (1, 2, 3, 4, 5, _VERSION):
             raise ValueError(
                 f"checkpoint version {version} not supported (can load 1-{_VERSION})")
         cfg_dict = json.loads(bytes(z[_HEADER_KEY].tobytes()).decode())
@@ -318,6 +334,7 @@ def _load_impl(path, expect_cfg, sharding):
         arrays["last_term"] = _derive_last_term(
             arrays["log_term"], arrays["last_index"])
     cfg = RaftConfig(**cfg_dict)
+    arrays = _canon_dtypes(arrays, cfg)
     from raft_kotlin_tpu.models.state import MAILBOX_FIELDS
 
     missing = [

@@ -79,10 +79,10 @@ def tick_metrics(prev: RaftState, cur: RaftState) -> Dict[str, jax.Array]:
         # crash freezes role/round_state inert while up=False.
         "rounds_active": jnp.sum(((cur.round_state == ACTIVE) & cur.up).astype(_I32)),
         "candidates": jnp.sum(((cur.role == CANDIDATE) & cur.up).astype(_I32)),
-        "commit_advanced": jnp.sum(d_commit),
-        "commit_total": jnp.sum(jnp.max(cur.commit, axis=0)),
+        "commit_advanced": jnp.sum(d_commit.astype(_I32)),
+        "commit_total": jnp.sum(jnp.max(cur.commit, axis=0).astype(_I32)),
         "term_max": jnp.max(cur.term),
-        "log_bytes_used": jnp.sum(cur.last_index),
+        "log_bytes_used": jnp.sum(cur.last_index.astype(_I32)),
     }
 
 

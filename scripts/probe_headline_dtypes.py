@@ -1,0 +1,63 @@
+"""TPU probe: headline tick vs log storage dtype (int32 vs int16).
+
+The phase-cut attribution (probe_phase_cuts.py) shows phase 5's (C, tile)
+log one-hots are the only real compute in the megakernel (~1.0 ms of the
+~2.5 ms tick); int16 log blocks halve their vreg count. Times the flat-carry
+runner (make_pallas_scan, K=1) on the stage-1 fault-soup config for both
+storage dtypes.
+
+  python scripts/probe_headline_dtypes.py
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_compilation_cache_dir", os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
+def main():
+    from raft_kotlin_tpu.models.state import init_state
+    from raft_kotlin_tpu.ops.pallas_tick import default_tile, make_pallas_scan
+    from raft_kotlin_tpu.ops.tick import make_rng
+    from raft_kotlin_tpu.utils.config import RaftConfig
+
+    T = 200
+    for ldt in ("int32", "int16"):
+        cfg = RaftConfig(
+            n_groups=102_400, n_nodes=5, log_capacity=32, cmd_period=10,
+            p_drop=0.25, p_crash=0.01, p_restart=0.08, p_link_fail=0.02,
+            p_link_heal=0.08, seed=0, log_dtype=ldt).stressed(10)
+        st0 = init_state(cfg)
+        rngs = [make_rng(dataclasses.replace(cfg, seed=cfg.seed + 1000 * (r + 1)))
+                for r in range(4)]
+        run = make_pallas_scan(cfg, T, interpret=False)
+        int(jnp.sum(run(st0, rngs[3]).rounds))
+        ts = []
+        for r in range(3):
+            t0 = time.perf_counter()
+            int(jnp.sum(run(st0, rngs[r]).rounds))
+            ts.append(time.perf_counter() - t0)
+        ms = min(ts) / T * 1e3
+        print(json.dumps({
+            "log_dtype": ldt,
+            "tile": default_tile(cfg, cfg.n_groups, False),
+            "ms_per_tick": round(ms, 3),
+            "ticks_per_sec": round(1e3 / ms, 1),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -118,3 +118,47 @@ def test_flat_carry_scan_matches_tick_mailbox():
         sp = tp(sp)
     sf = make_pallas_scan(cfg, T, interpret=True)(init_state(cfg), make_rng(cfg))
     assert_states_equal(jax.device_get(sp), jax.device_get(sf))
+
+
+def test_k_tick_kernel_matches_per_tick():
+    """make_pallas_scan(k_per_launch=3): the K-tick kernel (state VMEM-
+    resident across K phase lattices, counter-keyed draws via launch tables)
+    must be bit-identical to the per-tick kernel. T=50 = 16 K-launches + 2
+    remainder ticks, so both in-scan paths run. Fault soup exercises the
+    phase-F immediate draws (el_draw_f from the table) and backoff draws."""
+    from raft_kotlin_tpu.ops.pallas_tick import make_pallas_scan
+    from raft_kotlin_tpu.ops.tick import make_rng
+
+    cfg = RaftConfig(n_groups=8, n_nodes=5, log_capacity=8, cmd_period=5,
+                     p_drop=0.1, p_crash=0.02, p_restart=0.1,
+                     p_link_fail=0.02, p_link_heal=0.1, seed=11).stressed(10)
+    T = 50
+    rng = make_rng(cfg)
+    tp = jax.jit(make_pallas_tick(cfg, interpret=True))
+    sp = init_state(cfg)
+    for _ in range(T):
+        sp = tp(sp, rng=rng)
+    sk = make_pallas_scan(cfg, T, interpret=True, k_per_launch=3)(
+        init_state(cfg), rng)
+    assert_states_equal(jax.device_get(sp), jax.device_get(sk))
+
+
+@pytest.mark.slow
+def test_k_tick_kernel_churn_backoff_table():
+    # Churn pacing (2-3-tick timeouts): maximal election/backoff pressure on
+    # the K-launch draw tables (b_ctr advances nearly every conclusion).
+    from raft_kotlin_tpu.ops.pallas_tick import make_pallas_scan
+    from raft_kotlin_tpu.ops.tick import make_rng
+
+    cfg = RaftConfig(n_groups=16, n_nodes=3, log_capacity=8, seed=1,
+                     el_lo=2, el_hi=3, hb_ticks=2, round_ticks=3,
+                     retry_ticks=2, bo_lo=2, bo_hi=3)
+    T = 61  # 15 K=4 launches + 1 remainder
+    rng = make_rng(cfg)
+    tp = jax.jit(make_pallas_tick(cfg, interpret=True))
+    sp = init_state(cfg)
+    for _ in range(T):
+        sp = tp(sp, rng=rng)
+    sk = make_pallas_scan(cfg, T, interpret=True, k_per_launch=4)(
+        init_state(cfg), rng)
+    assert_states_equal(jax.device_get(sp), jax.device_get(sk))

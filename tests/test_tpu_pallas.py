@@ -188,6 +188,34 @@ def test_tile_model_sweep_on_tpu():
     _RESULTS["tile_model_sweep"] = sweep
 
 
+def test_tile_rejection_boundary():
+    # VERDICT r03 #8: probe the tile model's REJECTION boundary both ways on
+    # the headline shape (N=5, C=32). The tightened model (20 B/element,
+    # 12 MB budget — pallas_tick.pick_tile) must accept tile 512 (Mosaic
+    # compiles it) and reject tile 1024 (Mosaic's scoped-VMEM limit rejects
+    # it too): one model-rejected config in the sweep, and no model-accepted
+    # tile Mosaic rejects.
+    from raft_kotlin_tpu.ops.pallas_tick import default_tile
+
+    cfg = _cfg(n_groups=1024)
+    model_tile = default_tile(cfg, cfg.n_groups, False)
+    assert model_tile == 512, model_tile
+
+    tick = jax.jit(make_pallas_tick(cfg, tile_g=512, interpret=False))
+    st = tick(init_state(cfg))
+    jax.block_until_ready(st.term)
+
+    rejected = False
+    try:
+        tick_big = jax.jit(make_pallas_tick(cfg, tile_g=1024, interpret=False))
+        jax.block_until_ready(tick_big(init_state(cfg)).term)
+    except Exception:
+        rejected = True
+    assert rejected, "Mosaic accepted tile 1024 — the model under-accepts"
+    _RESULTS["tile_boundary_n5_c32"] = (
+        "model 512=accept/1024=reject == mosaic 512=compiles/1024=rejects")
+
+
 def test_zzz_write_artifact():
     # Last alphabetically within the module run order: record the evidence.
     # MERGED into the existing artifact, so a partial (-k filtered) run
