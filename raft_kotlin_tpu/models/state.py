@@ -48,7 +48,7 @@ class RaftState:
     last_index: jax.Array  # (N, G) i16 (<= C)
     phys_len: jax.Array    # (N, G) i16 (<= C)
     log_term: jax.Array    # (N, C, G) i32 (or i16 via cfg.log_dtype)
-    log_cmd: jax.Array     # (N, C, G) i32
+    log_cmd: jax.Array     # (N, C, G) i32 (or i16 via cfg.log_dtype)
     # Derived cache: log_term at physical slot last_index - 1 (0 when the log
     # is logically empty; i32 — term-valued) — the lastLogTerm every vote
     # request/handler reads
@@ -117,9 +117,10 @@ class RaftState:
 
 # Structurally bounded fields stored int16 (round-4 narrowing): node ids,
 # vote tallies, role/round enums, timer countdowns (<= el_hi/bo_hi/round_ticks
-# etc.), and log positions (<= log_capacity; RaftConfig asserts C < 2^15).
-# next_index's lower bound is 1: a failed exchange at i=1 is impossible
-# (prevLogIndex -1 always succeeds), so the decrement walk never leaves int16.
+# etc.), and log positions (<= log_capacity; assert_narrow_bounds guards the
+# config ranges at init and checkpoint load). next_index's lower bound is 1:
+# a failed exchange at i=1 is impossible (prevLogIndex -1 always succeeds),
+# so the decrement walk never leaves int16.
 NARROW16 = (
     "voted_for", "role", "commit", "last_index", "phys_len", "el_left",
     "round_state", "round_left", "round_age", "votes", "responses",
