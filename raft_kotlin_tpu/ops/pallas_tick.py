@@ -467,7 +467,8 @@ def draw_tables(cfg: RaftConfig, tkeys, bkeys, t_ctr, b_ctr, K: int):
 def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
                      tile_g: Optional[int] = None,
                      interpret: Optional[bool] = None,
-                     k_per_launch: int = 1):
+                     k_per_launch: int = 1,
+                     jitted: bool = True):
     """Multi-tick Pallas runner with a FLAT int32 scan carry.
 
     Scanning make_pallas_tick converts RaftState <-> the kernel's flat int32
@@ -501,7 +502,6 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
     sfields = state_fields(tick_mod.make_flags(cfg))
     n_launch, rem = divmod(n_ticks, K) if K > 1 else (0, n_ticks)
 
-    @jax.jit
     def run(state: RaftState, rng):
         base, tkeys, bkeys = rng
         flat = tick_mod.flatten_state(cfg, state)
@@ -553,7 +553,11 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
                              with_dirty=False)
         return RaftState(**tick_mod.unflatten_state(cfg, s), tick=t)
 
-    return run
+    # jitted=False hands the traceable fn to callers that embed it in a
+    # larger jit (bench.measure reduces the end state to scalars INSIDE one
+    # jit — a nested pjit would materialize the multi-GB state at the inner
+    # call boundary, the exact harness tax the reduction exists to avoid).
+    return jax.jit(run) if jitted else run
 
 
 def default_tile(cfg: RaftConfig, lanes: int, interpret: bool,
