@@ -144,11 +144,18 @@ def field_dtype(name: str, cfg: RaftConfig):
 
 def assert_narrow_bounds(cfg: RaftConfig) -> None:
     """Value-range guards for the int16 NARROW16 storage: log positions need
-    log_capacity < 2^15 and the timer/delay draws feed int16 countdowns."""
-    assert cfg.log_capacity < 2 ** 15, (
-        "int16 log positions (NARROW16) need log_capacity < 32768")
-    assert max(cfg.el_hi, cfg.bo_hi, cfg.delay_hi) < 2 ** 15, (
-        "int16 countdown fields (NARROW16) need el_hi/bo_hi/delay_hi < 32768")
+    log_capacity < 2^15 - 1 (next_index ranges over [0, C + 1]: set to
+    commit + 1 <= C + 1 on an election win, ops/tick.py phase 4, and
+    incremented to last_index + 1 <= C + 1 on append success) and every
+    config value that seeds an int16 countdown (el/bo/delay draws, the
+    round window, the heartbeat period) must itself fit int16."""
+    assert cfg.log_capacity < 2 ** 15 - 1, (
+        "int16 log positions (NARROW16) need log_capacity < 32767 "
+        "(next_index reaches log_capacity + 1)")
+    assert max(cfg.el_hi, cfg.bo_hi, cfg.delay_hi,
+               cfg.round_ticks, cfg.hb_ticks) < 2 ** 15, (
+        "int16 countdown fields (NARROW16) need el_hi/bo_hi/delay_hi/"
+        "round_ticks/hb_ticks < 32768")
 
 
 def init_state(cfg: RaftConfig) -> RaftState:

@@ -1,0 +1,244 @@
+"""Frontier-value cache for the batched deep-log engine — the TEMPORAL lever
+(VERDICT r04 missing #1 / next-round item 1).
+
+The batched engine's per-tick read batch (ops/tick.py phase 5) takes ~250
+log rows per tick; the round-5 on-chip cost model (ROUND5.md) prices an
+XLA:TPU take at ~5 ms per OP + ~0.17 ms per ROW, so those rows are most of
+the deep tick. But the protocol only ever reads rows at the per-pair
+frontier `next_index(l, p)`, and the frontier moves by at most 1 per
+exchange (reference RaftServer.kt:156-167) with two discontinuities: the
+quirk-b jump to commit+1 on an election win (RaftServer.kt:112) and the
+restart wipe. This module caches the VALUES at the frontier as extra scan
+state, maintained incrementally:
+
+- per pair (l, p), 4 values, each with a validity bit:
+    f_pli    = l.log_term[ni-2]   (prevLogTerm of the next request)
+    f_ent_t  = l.log_term[ni-1]   (the entry's term)
+    f_ent_c  = l.log_cmd [ni-1]   (the entry's command)
+    f_ppli   = p.log_term[ni-2]   (the peer-side prevLog check row)
+- per node, f_topw = log_term[last_index + j] for j in [0, W_TOP) — the
+  physical rows an append's §3 GHOST case exposes to the lastLogTerm
+  cache: an append at logical index li writes slot phys_len and moves
+  last_index to li+1, so the new last_term row is li — f_topw's base row.
+  It is a WINDOW (not one value) because a ghost-catching node consumes
+  one row per append while the per-tick refill can only top it up once:
+  phase-0 appends consume BEFORE the refill runs, so the slack must
+  survive a tick of drift.
+
+Maintenance is pure (G,)-wide algebra (ops/tick.py `fcache` hooks):
+- frontier +1 (append success): f_pli' = f_ent_t; f_ppli' comes from the
+  write the exchange just performed (ghost case propagates invalidity
+  lazily); the new entry row is unknown UNTIL the leader's next phase-0
+  append writes it — which, at reference pacing, happens before the next
+  heartbeat reads it, so steady state needs (almost) NO log reads at all;
+- frontier -1 (append failure): shifts run the other way and expose one
+  unknown row per stream;
+- every deferred log write PATCHES every cache whose (log, row) it hits
+  (value + validity), and updates state.last_term live (the §3 rule);
+- election win invalidates the winner's streams; restart zeroes them
+  (out-of-range rows read as 0 by the engine's convention).
+
+Unknown-but-needed rows are served by ONE small per-tick refill take per
+log array with a fixed row budget: per lane, needed-and-invalid cache
+entries are ranked (exclusive prefix count over a static enumeration) and
+assigned take rows; hard demand beyond the budget — or a consumed-invalid
+value — raises the OV flag, and the runner (make_deep_scan) falls back to
+re-running the whole call on the plain batched engine. Correctness
+therefore never depends on the budget or on any validity reasoning here:
+overflow costs time, not bits — and the differential suite pins the two
+engines against each other tick-for-tick.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_I32 = jnp.int32
+
+# Pair-shaped value fields and the node-shaped top window, canonical order.
+PAIR_VALS = ("f_pli", "f_ent_t", "f_ent_c", "f_ppli")
+NODE_VALS = ("f_topw",)
+ALL_VALS = PAIR_VALS + NODE_VALS
+
+# Rows of the above-last_index window (f_topw[(n-1)*W_TOP + j] =
+# log_term[last_index + j]).
+W_TOP = 4
+
+
+def ok_name(k: str) -> str:
+    return "ok_" + k[2:]
+
+
+FIELDS = ALL_VALS + tuple(ok_name(k) for k in ALL_VALS)
+
+# Per-tick refill row budgets (term take, cmd take). Sized so that even a
+# whole-group election win (3 hard entries x N pairs for the winner) plus
+# the soft top-window top-ups fit; exceeding them is not an error, just an
+# OV fallback to the plain engine.
+TERM_BUDGET = 40
+CMD_BUDGET = 12
+
+
+def init_fields(N: int, G: int) -> dict:
+    """All-invalid cache (cold start; runners call refill_all instead)."""
+    fc = {}
+    for k in PAIR_VALS:
+        fc[k] = jnp.zeros((N * N, G), _I32)
+        fc[ok_name(k)] = jnp.zeros((N * N, G), dtype=bool)
+    fc["f_topw"] = jnp.zeros((N * W_TOP, G), _I32)
+    fc["ok_topw"] = jnp.zeros((N * W_TOP, G), dtype=bool)
+    return fc
+
+
+def refill_all(cfg, state) -> dict:
+    """Populate EVERY cache entry from the current state with one flat take
+    per log array (the plain engine's full row set, paid once per call
+    start instead of every tick)."""
+    N, C = cfg.n_nodes, cfg.log_capacity
+    G = state.term.shape[-1]
+    ni = state.next_index.reshape(N * N, G).astype(_I32)
+    li = state.last_index.astype(_I32)
+    lt = state.log_term.reshape(N * C, G)
+    lc = state.log_cmd.reshape(N * C, G)
+
+    def pair_rows(delta, owner_side):
+        # Global rows for pair (a, b) entries at ni + delta; the owner side
+        # reads a's log, the peer side b's log.
+        rows = []
+        for a in range(1, N + 1):
+            for b in range(1, N + 1):
+                node = a if owner_side else b
+                rows.append((node - 1) * C
+                            + jnp.clip(ni[(a - 1) * N + (b - 1)] + delta,
+                                       0, C - 1))
+        return rows
+
+    top_rows = [li[n - 1] + j for n in range(1, N + 1) for j in range(W_TOP)]
+    rows_t = (pair_rows(-2, True) + pair_rows(-1, True)
+              + pair_rows(-2, False)
+              + [(n - 1) * C + jnp.clip(top_rows[k], 0, C - 1)
+                 for n in range(1, N + 1)
+                 for k in range((n - 1) * W_TOP, n * W_TOP)])
+    rows_c = pair_rows(-1, True)
+    vt = jnp.take_along_axis(lt, jnp.stack(rows_t), axis=0).astype(_I32)
+    vc = jnp.take_along_axis(lc, jnp.stack(rows_c), axis=0).astype(_I32)
+
+    def bound(vals, rows):
+        # 0 outside [0, C) — the engine's log_gather convention.
+        return jnp.where((rows >= 0) & (rows < C), vals, 0)
+
+    P = N * N
+    fc = {}
+    fc["f_pli"] = bound(vt[:P], ni - 2)
+    fc["f_ent_t"] = bound(vt[P:2 * P], ni - 1)
+    fc["f_ppli"] = bound(vt[2 * P:3 * P], ni - 2)
+    fc["f_topw"] = bound(vt[3 * P:], jnp.stack(top_rows))
+    fc["f_ent_c"] = bound(vc, ni - 1)
+    for k in PAIR_VALS:
+        fc[ok_name(k)] = jnp.ones((P, G), dtype=bool)
+    fc["ok_topw"] = jnp.ones((N * W_TOP, G), dtype=bool)
+    return fc
+
+
+def make_deep_scan(cfg, n_ticks: int, return_state: bool = False):
+    """Multi-tick runner for the frontier-cached deep engine.
+
+    run(state, rng[, summarize]) executes n_ticks through the fcache tick
+    in ONE jit (log_cmd live-pinned through the scan carry, scalar
+    reductions as outputs — bench.measure's elision discipline), checks the
+    OV flag on the host, and on overflow RERUNS the whole call on the plain
+    batched engine (bit-identical semantics, no cache) — so callers always
+    get plain-engine bits, just faster when the cache held. Returns a dict
+    of host-materializable scalars: rounds, livepin, ov (0/1), plus
+    whatever `summarize(end_state)` adds. The callable is marked
+    `self_timed` for bench.measure (it manages its own jit; measure times
+    it through the same host-materialization discipline)."""
+    from raft_kotlin_tpu.models.state import RaftState
+    from raft_kotlin_tpu.ops import tick as tick_mod
+
+    tick_plain = tick_mod.make_tick(cfg)
+    N, G = cfg.n_nodes, cfg.n_groups
+
+    def fc_tick(state, fc, rng):
+        base, tkeys, bkeys = rng
+        aux, flags = tick_mod.make_aux(cfg, base, tkeys, bkeys, state,
+                                       None, None)
+        assert flags.batched, "make_deep_scan needs a batched-engine config"
+        s = tick_mod.flatten_state(cfg, state)
+        fc = dict(fc)
+        el_dirty = tick_mod.phase_body(cfg, s, aux, flags, fcache=fc)
+        ov = fc.pop("ov")
+        st = tick_mod.finish_tick(cfg, tkeys, tick_mod.unflatten_state(cfg, s),
+                                  el_dirty, state.tick)
+        return st, fc, ov
+
+    def scan_of(tick_fn, with_fc):
+        def run(st, fc, rng):
+            def body(carry, _):
+                if with_fc:
+                    s, f, acc, ova = carry
+                    s2, f2, ov = tick_fn(s, f, rng)
+                    ova = ova | jnp.any(ov)
+                else:
+                    s, f, acc, ova = carry
+                    s2, f2 = tick_fn(s, rng=rng), f
+                acc = acc + jnp.sum(s2.log_cmd[:, 0, :].astype(_I32))
+                return (s2, f2, acc, ova), None
+
+            carry0 = (st, fc, jnp.zeros((), _I32), jnp.zeros((), bool))
+            (end, _, acc, ova), _ = jax.lax.scan(
+                body, carry0, None, length=n_ticks)
+            return end, acc, ova
+        return run
+
+    fc_scan = scan_of(fc_tick, True)
+    plain_scan = scan_of(lambda s, rng: tick_plain(s, rng=rng), False)
+
+    def reductions(end, acc, ova, summarize):
+        out = {"rounds": jnp.sum(end.rounds), "livepin": acc,
+               "ov": ova.astype(_I32)}
+        if summarize is not None:
+            out.update(summarize(end))
+        return out
+
+    refill_jit = jax.jit(lambda s: refill_all(cfg, s))
+
+    if return_state:
+        # Test mode: (full end state, ov: bool) — differential suites
+        # compare pytrees and assert on whether the cache actually held.
+        jfc_s = jax.jit(lambda s, r, f: fc_scan(s, f, r))
+        jplain_s = jax.jit(lambda s, r: plain_scan(s, None, r))
+
+        def run_state(st, rng):
+            end, _, ova = jfc_s(st, rng, refill_jit(st))
+            ov = bool(jax.device_get(ova))
+            if ov:
+                end, _, _ = jplain_s(st, rng)
+            return end, ov
+
+        return run_state
+
+    jitted = {}
+
+    def run(st, rng, summarize=None):
+        key = id(summarize)
+        if key not in jitted:
+            jitted[key] = (
+                jax.jit(lambda s, r, f: reductions(
+                    *fc_scan(s, f, r), summarize)),
+                jax.jit(lambda s, r: reductions(
+                    *plain_scan(s, None, r), summarize)),
+            )
+        jfc, jplain = jitted[key]
+        fc = refill_jit(st)
+        vals = {k: v for k, v in jfc(st, rng, fc).items()}
+        if int(jax.device_get(vals["ov"])):
+            vals = {k: v for k, v in jplain(st, rng).items()}
+            vals["ov"] = jnp.ones((), _I32)
+        return vals
+
+    run.self_timed = True
+    return run

@@ -1,0 +1,131 @@
+"""Pallas one-hot log-write kernel — the deep engine's scatter alternative.
+
+The batched deep engine ends each tick with 2 XLA scatters (term + cmd)
+applying ~K resolved rows per node (ops/tick.py deferred writes). The
+round-5 probe model: an XLA scatter's cost scales with OPERAND SIZE (it
+materializes a copy unless the while-body donates in place), and even the
+donated in-context form pays tens of ms at config-5 scale. This kernel
+applies BOTH arrays' writes in ONE pass over the logs:
+
+- grid (node, C-chunk, G-tile); each step DMAs one (Cb, tile) slab of
+  log_term AND log_cmd (the whole log crosses HBM exactly once, read +
+  write, ~9 ms at config-5 scale);
+- the write is applied as a K-deep one-hot select chain over the slab:
+  `iota + chunk_offset == row` — compare shared by term and cmd (the two
+  arrays write the same rows by construction). K is SMALL (~N+1 per node),
+  so the VPU cost (K * C * G compares/selects) stays a few ms — the
+  regime where one-hot beats gather/scatter lowering. (READS are the
+  opposite: R~36 rows/node makes a one-hot read stream VPU-bound, which is
+  why the read side uses XLA takes — ops/deep_gather.py docstring.)
+- rows are LOCAL slot indices in [0, C); row == C means "dropped" (masked
+  write) and matches no slab row.
+
+Unlike ops/deep_gather.py (Mosaic's tpu.dynamic_gather 8-row limit), this
+kernel uses only compare/select primitives, so it compiles on real TPU.
+Caller contract: duplicate rows within a lane must already be resolved to
+identical values (the engine's chronological resolution pass), making the
+application order irrelevant.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+_I32 = jnp.int32
+_G_TILES = (512, 256, 128)
+
+# Escape hatch: force the XLA put_along_axis fallback (differential tests
+# pin kernel-vs-puts equality through this; also a field kill switch).
+DISABLE = bool(os.environ.get("RAFT_DISABLE_SCATTER_KERNEL"))
+
+
+def _chunk(C: int):
+    """Largest divisor of C that keeps (Cb, tile) slabs of BOTH arrays in
+    VMEM; sublane blocks must be multiples of 8 (ops/deep_gather._chunk)."""
+    for d in range(min(C, 2000), 7, -1):
+        if C % d == 0 and d % 8 == 0:
+            return d
+    return None
+
+
+def _tile(G: int, interpret: bool):
+    if interpret:
+        return G
+    for t in _G_TILES:
+        if G % t == 0:
+            return t
+    return None
+
+
+@functools.lru_cache(maxsize=None)
+def build_scatter(N: int, C: int, K: int, ldt_name: str, G: int,
+                  interpret: bool):
+    """-> callable(log_term (N*C, G) ldt, log_cmd (N*C, G) ldt,
+                   rows (N*K, G) i32 LOCAL slots ([0, C); C = dropped),
+                   vals_t (N*K, G) ldt, vals_c (N*K, G) ldt)
+       -> (log_term', log_cmd') with per-lane writes applied.
+    Returns None when no supported tiling exists (caller falls back to XLA
+    scatters)."""
+    ldt = jnp.dtype(ldt_name)
+    tile = _tile(G, interpret)
+    if tile is None:
+        return None
+    Cb = _chunk(C)
+    if Cb is None:
+        return None
+    n_chunks = C // Cb
+    Kp = -(-K // 8) * 8  # sublane-aligned row-block height
+
+    def kernel(rows_ref, vt_ref, vc_ref, lt_ref, lc_ref, ot_ref, oc_ref):
+        c = pl.program_id(2)
+        j0 = c * Cb
+        rows = rows_ref[...]
+        blk_t, blk_c = lt_ref[...], lc_ref[...]
+        iot = lax.broadcasted_iota(_I32, (Cb, tile), 0) + j0
+        for k in range(K):
+            hit = iot == rows[k][None, :]  # row C never matches (iot < C)
+            blk_t = jnp.where(hit, vt_ref[k][None, :], blk_t)
+            blk_c = jnp.where(hit, vc_ref[k][None, :], blk_c)
+        ot_ref[...] = blk_t
+        oc_ref[...] = blk_c
+
+    call = pl.pallas_call(
+        kernel,
+        grid=(N, G // tile, n_chunks),
+        in_specs=[
+            pl.BlockSpec((Kp, tile), lambda n, i, c: (n, i)),
+            pl.BlockSpec((Kp, tile), lambda n, i, c: (n, i)),
+            pl.BlockSpec((Kp, tile), lambda n, i, c: (n, i)),
+            pl.BlockSpec((Cb, tile), lambda n, i, c: (n * n_chunks + c, i)),
+            pl.BlockSpec((Cb, tile), lambda n, i, c: (n * n_chunks + c, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((Cb, tile), lambda n, i, c: (n * n_chunks + c, i)),
+            pl.BlockSpec((Cb, tile), lambda n, i, c: (n * n_chunks + c, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((N * C, G), ldt),
+            jax.ShapeDtypeStruct((N * C, G), ldt),
+        ],
+        input_output_aliases={3: 0, 4: 1},
+        interpret=interpret,
+    )
+
+    def padded_call(lt, lc, rows, vals_t, vals_c):
+        def pad(r, fill):
+            r3 = r.reshape(N, K, G)
+            z = jnp.full((N, Kp - K, G), fill, r.dtype)
+            return jnp.concatenate([r3, z], axis=1).reshape(N * Kp, G)
+
+        # Pad rows with C ("dropped") so the extra sublanes write nothing.
+        return call(pad(rows, C), pad(vals_t, 0), pad(vals_c, 0), lt, lc)
+
+    if Kp == K:
+        return lambda lt, lc, rows, vt, vc: call(rows, vt, vc, lt, lc)
+    return padded_call

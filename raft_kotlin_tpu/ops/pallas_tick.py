@@ -298,20 +298,47 @@ def make_pallas_tick(cfg: RaftConfig, tile_g: Optional[int] = None,
     return tick
 
 
-def resets_per_tick_bound(N: int) -> int:
+def resets_per_tick_bound(N: int, delay_zero: bool = False) -> int:
     """Structural upper bound on election-timer resets per (node, tick) —
-    the t_ctr advance the K-tick kernel's draw table must cover. Phase F
-    restart (1) + phase-2 demotion (1) + phase-3 adopts (<= N candidates) +
-    phase-4 demotion (1) + phase-5 adopt and quirk-d resets (2 per foreign
-    leader, <= 2(N-1)): 3N + 1 total. This is a worst case over the phase
-    lattice's reset SITES, not a typical-path estimate — the draw-table
-    select masks unused entries, so only the bound's validity matters."""
-    return 3 * N + 1
+    the t_ctr advance the K-tick kernel's draw table must cover. Recounted
+    over ALL reset sites (r4 ADVICE: the original 3N+1 omitted the phase-5
+    leader-side response-demote resets and the delay_lo==0 double-delivery):
+
+    - phase F restart: 1
+    - phase 2 demotion (round start while not CANDIDATE): 1
+    - phase 3 vote-handler adopts: 1 per processed (c, node) exchange, <= N
+    - phase 4 demotion (round conclusion while not CANDIDATE): 1
+    - phase 5, node as peer: adopt + quirk-d resets, 2 per foreign-leader
+      exchange, <= 2(N-1)
+    - phase 5, node as leader: response demote (ops/tick.py
+      append_exchange's leader leg), 1 per peer exchange, <= N-1
+
+    Sync (and mailbox with delay_lo > 0, where each pair delivers at most
+    one in-flight slot per tick): 1+1+N+1+2(N-1)+(N-1) = 4N.
+    Mailbox with delay_lo == 0: vote_deliver/append_deliver run TWICE per
+    pair per tick (the pre-send in-flight delivery plus the same-iteration
+    tau=0 delivery), doubling the phase-3/5 site counts: 8N-3.
+
+    This is a worst case over reset SITES, not a typical-path estimate —
+    the draw-table select masks unused entries, so only the bound's
+    validity matters; make_pallas_core_k additionally clamps the table
+    offset and reports an overflow flag that make_pallas_scan raises on,
+    so even a bound violation fails loudly instead of diverging silently."""
+    return 8 * N - 3 if delay_zero else 4 * N
 
 
 def make_pallas_core_k(cfg: RaftConfig, lanes: int, tile_g: int,
-                       interpret: bool, K: int):
+                       interpret: bool, K: int,
+                       resets_bound: Optional[int] = None):
     """K-ticks-per-launch megakernel builder.
+
+    NEGATIVE RESULT, KEPT AS REFERENCE (round-5 decision, VERDICT r04 weak
+    #6): K=2/4/8 measured ~1.5x SLOWER than K=1 on hardware (ROUND4.md item
+    1) — no production path uses this. It stays because it is the committed
+    evidence ruling out the launch-overhead hypothesis, and round 5 added
+    the draw-table overflow guard (r4 ADVICE high) so its bit-compat
+    invariant now fails loudly rather than silently. Tests are marked
+    @pytest.mark.archival.
 
     The phase-cut probe (scripts/probe_phase_cuts.py, round 4) shows the
     1-tick kernel is DMA/overhead-bound: a kernel truncated to phases F+0
@@ -333,11 +360,20 @@ def make_pallas_core_k(cfg: RaftConfig, lanes: int, tile_g: int,
 
     Returns build_call(flags) -> (call, sfields, aux_names) where call takes
     [state fields..., aux K-slabs..., el_table (N*W, lanes), b_table
-    (N*K, lanes)] and returns the post-K-tick state fields (aliased)."""
+    (N*K, lanes)] and returns the post-K-tick state fields (aliased) plus a
+    final (N, lanes) i32 OVERFLOW count: nonzero where a node's counter
+    advance exceeded the draw-table window (table offsets are clamped so
+    the selected draw is in-window-but-wrong; the caller MUST treat any
+    nonzero overflow as invalidating the whole launch — make_pallas_scan
+    raises). `resets_bound` overrides the structural per-tick bound
+    (tests shrink it to exercise the overflow path)."""
     N, C = cfg.n_nodes, cfg.log_capacity
     assert lanes % tile_g == 0, (lanes, tile_g)
     log_dt = jnp.int16 if cfg.log_dtype == "int16" else _I32
-    W = resets_per_tick_bound(N) * K
+    if resets_bound is None:
+        resets_bound = resets_per_tick_bound(
+            N, cfg.uses_mailbox and cfg.delay_lo == 0)
+    W = resets_bound * K
 
     field_shapes = {
         **{k: (N, tile_g) for k in STATE_FIELDS},
@@ -368,18 +404,6 @@ def make_pallas_core_k(cfg: RaftConfig, lanes: int, tile_g: int,
             or (k == "delay" and flags.delay and cfg.delay_lo < cfg.delay_hi)
         )
 
-        def sel(table, Wn, delta):
-            # (N, tile) values: per node, table rows [n*Wn, (n+1)*Wn) at
-            # per-lane offset delta[n] (one (Wn, tile) one-hot contraction
-            # per node — compute is nearly free in this DMA-bound kernel).
-            rows_iota = jax.lax.broadcasted_iota(_I32, (Wn, tile_g), 0)
-            vals = []
-            for n in range(N):
-                oh = rows_iota == delta[n][None]
-                vals.append(jnp.sum(
-                    jnp.where(oh, table[n * Wn:(n + 1) * Wn], 0), axis=0))
-            return jnp.stack(vals)
-
         def kernel(*refs):
             n_in = len(sfields) + len(aux_names)
             ins = dict(zip(sfields, refs[:len(sfields)]))
@@ -387,7 +411,27 @@ def make_pallas_core_k(cfg: RaftConfig, lanes: int, tile_g: int,
                      zip(aux_names, refs[len(sfields):n_in])}
             el_tab = refs[n_in][...].astype(_I32)
             b_tab = refs[n_in + 1][...].astype(_I32)
-            outs = dict(zip(sfields, refs[n_in + 2:]))
+            outs = dict(zip(sfields + ("overflow",), refs[n_in + 2:]))
+            ov = {"m": jnp.zeros((N, tile_g), _I32)}
+
+            def sel(table, Wn, delta):
+                # (N, tile) values: per node, table rows [n*Wn, (n+1)*Wn) at
+                # per-lane offset delta[n] (one (Wn, tile) one-hot contraction
+                # per node — compute is nearly free in this DMA-bound kernel).
+                # An offset past the window means the structural reset bound
+                # was violated: CLAMP (so a draw is still selected and the
+                # kernel stays well-defined) and COUNT into the overflow
+                # output — the caller must discard the launch (r4 ADVICE:
+                # the old silent 0-draw diverged bit-wise with no error).
+                ov["m"] = ov["m"] + (delta >= Wn).astype(_I32)
+                delta = jnp.minimum(delta, Wn - 1)
+                rows_iota = jax.lax.broadcasted_iota(_I32, (Wn, tile_g), 0)
+                vals = []
+                for n in range(N):
+                    oh = rows_iota == delta[n][None]
+                    vals.append(jnp.sum(
+                        jnp.where(oh, table[n * Wn:(n + 1) * Wn], 0), axis=0))
+                return jnp.stack(vals)
             # Same widen-at-entry boundary as the 1-tick kernel (Mosaic int16
             # layout crash on columnar rows): narrow in HBM, int32 inside.
             s = {}
@@ -416,6 +460,7 @@ def make_pallas_core_k(cfg: RaftConfig, lanes: int, tile_g: int,
             for k in sfields:
                 outs[k][...] = (s[k] if k in ("log_term", "log_cmd")
                                 else s[k].astype(kernel_field_dtype(cfg, k)))
+            outs["overflow"][...] = ov["m"]
 
         def field_dtype(k):
             return kernel_field_dtype(cfg, k)
@@ -427,8 +472,9 @@ def make_pallas_core_k(cfg: RaftConfig, lanes: int, tile_g: int,
             jax.ShapeDtypeStruct(
                 tuple(field_shapes[k][:-1]) + (lanes,), field_dtype(k))
             for k in sfields
-        ]
+        ] + [jax.ShapeDtypeStruct((N, lanes), _I32)]  # overflow counts
         out_specs = [block_spec(field_shapes[k]) for k in sfields]
+        out_specs += [block_spec((N, tile_g))]
         call = pl.pallas_call(
             kernel,
             grid=(lanes // tile_g,),
@@ -443,16 +489,21 @@ def make_pallas_core_k(cfg: RaftConfig, lanes: int, tile_g: int,
     return build_call
 
 
-def draw_tables(cfg: RaftConfig, tkeys, bkeys, t_ctr, b_ctr, K: int):
+def draw_tables(cfg: RaftConfig, tkeys, bkeys, t_ctr, b_ctr, K: int,
+                resets_bound: Optional[int] = None):
     """The K-launch counter-keyed draw tables (XLA, outside the kernel):
     el_table (N*W, G) rows n*W + j = draw_uniform_keyed(tkeys, t_ctr0 + j)
     for node n; b_table (N*K, G) likewise over bkeys/b_ctr0. Same counted
     threefry as the per-tick path — table entry == that path's draw at the
-    same counter, bit for bit."""
+    same counter, bit for bit. `resets_bound` must match the kernel's
+    (make_pallas_core_k)."""
     from raft_kotlin_tpu.utils import rng as rngmod
 
     N = cfg.n_nodes
-    W = resets_per_tick_bound(N) * K
+    if resets_bound is None:
+        resets_bound = resets_per_tick_bound(
+            N, cfg.uses_mailbox and cfg.delay_lo == 0)
+    W = resets_bound * K
 
     def tab(keys, ctr0, Wn, lo, hi):
         draws = jnp.stack([rngmod.draw_uniform_keyed(keys, ctr0 + j, lo, hi)
@@ -468,7 +519,8 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
                      tile_g: Optional[int] = None,
                      interpret: Optional[bool] = None,
                      k_per_launch: int = 1,
-                     jitted: bool = True):
+                     jitted: bool = True,
+                     _resets_bound: Optional[int] = None):
     """Multi-tick Pallas runner with a FLAT int32 scan carry.
 
     Scanning make_pallas_tick converts RaftState <-> the kernel's flat int32
@@ -482,7 +534,11 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
     With k_per_launch = K > 1, full launches run through the K-tick kernel
     (make_pallas_core_k: state crosses HBM once per K ticks) and the
     n_ticks % K remainder through the 1-tick kernel — still bit-identical
-    (same phase_body, same counted draws via the launch tables).
+    (same phase_body, same counted draws via the launch tables). K > 1
+    requires jitted=True: the kernel's draw-table overflow flag is
+    host-checked after each call and raises RuntimeError on violation of
+    the structural reset bound (clamped draws are WRONG bits — r4 ADVICE).
+    `_resets_bound` is a test-only override of that bound.
 
     Returns run(state, rng) -> state (jitted; rng rides as an operand so the
     compilation is seed-independent, as everywhere else)."""
@@ -497,8 +553,13 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
     if interpret and G % tile_g:
         tile_g = G
     build_call = make_pallas_core(cfg, G, tile_g, interpret)
-    build_call_k = (make_pallas_core_k(cfg, G, tile_g, interpret, K)
+    build_call_k = (make_pallas_core_k(cfg, G, tile_g, interpret, K,
+                                       resets_bound=_resets_bound)
                     if K > 1 else None)
+    if K > 1 and not jitted:
+        raise ValueError(
+            "k_per_launch > 1 requires jitted=True: the draw-table overflow "
+            "flag must be host-materialized and checked after each call")
     sfields = state_fields(tick_mod.make_flags(cfg))
     n_launch, rem = divmod(n_ticks, K) if K > 1 else (0, n_ticks)
 
@@ -538,25 +599,46 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
                 [p[nm].astype(_I16) if nm in _BOOL_AUX else p[nm]
                  for p in per], axis=0) for nm in aux_names]
             el_tab, b_tab = draw_tables(
-                cfg, tkeys, bkeys, s["t_ctr"], s["b_ctr"], K)
+                cfg, tkeys, bkeys, s["t_ctr"], s["b_ctr"], K,
+                resets_bound=_resets_bound)
             outs = call(*([s[k] for k in sfields_k] + slabs
                           + [el_tab, b_tab]))
-            return (dict(zip(sfields_k, outs)), t + K), None
+            # Last output = the launch's (N, G) draw-table overflow counts.
+            return ((dict(zip(sfields_k, outs[:-1])), t + K),
+                    jnp.sum(outs[-1]))
 
         flat_t = (flat, state.tick)
+        ov_total = jnp.zeros((), _I32)
         if n_launch:
-            flat_t, _ = jax.lax.scan(body_k, flat_t, None, length=n_launch)
+            flat_t, ovs = jax.lax.scan(body_k, flat_t, None, length=n_launch)
+            ov_total = jnp.sum(ovs)
         if rem:
             flat_t, _ = jax.lax.scan(body, flat_t, None, length=rem)
         flat, t = flat_t
         s, _ = cast_flat_out(cfg, [flat[k] for k in sfields], sfields,
                              with_dirty=False)
-        return RaftState(**tick_mod.unflatten_state(cfg, s), tick=t)
+        end = RaftState(**tick_mod.unflatten_state(cfg, s), tick=t)
+        return (end, ov_total) if K > 1 else end
 
     # jitted=False hands the traceable fn to callers that embed it in a
     # larger jit (bench.measure reduces the end state to scalars INSIDE one
     # jit — a nested pjit would materialize the multi-GB state at the inner
     # call boundary, the exact harness tax the reduction exists to avoid).
+    if K > 1:
+        inner = jax.jit(run)
+
+        def checked(state, rng):
+            end, ov = inner(state, rng)
+            if int(jax.device_get(ov)):
+                raise RuntimeError(
+                    f"K-tick kernel draw-table overflow: a node consumed "
+                    f"more election-timer resets within one {K}-tick launch "
+                    f"than the structural bound covers "
+                    f"(resets_per_tick_bound) — the launch's draws were "
+                    f"clamped and its bits are INVALID; results discarded")
+            return end
+
+        return checked
     return jax.jit(run) if jitted else run
 
 
@@ -579,8 +661,9 @@ def default_tile(cfg: RaftConfig, lanes: int, interpret: bool,
         log_rows //= 2  # i16 rows cost half the VMEM of the i32 model rows
     aux_rows = K * (3 * N * N + 5 * N + 1) + N
     if K > 1:
-        # el table N*(3N+1)K + backoff table N*K rows.
-        aux_rows += K * N * (3 * N + 2)
+        # el table N*rb*K + backoff table N*K rows + the overflow output.
+        rb = resets_per_tick_bound(N, cfg.uses_mailbox and cfg.delay_lo == 0)
+        aux_rows += K * N * (rb + 1) + N
     rows = 2 * (n_2d * N + 4 * N * N) + log_rows + aux_rows
     if cfg.uses_mailbox:
         # §10 mailbox: 13 pair-shaped state fields (in + aliased out) + delay aux.

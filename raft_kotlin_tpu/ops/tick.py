@@ -144,7 +144,8 @@ class BodyFlags:
     sharded: bool = False
 
 
-def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
+def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
+               fcache: Optional[dict] = None):
     """Advance the phase lattice F,0-5 one tick, mutating `s` in place.
 
     `s` maps STATE_FIELDS to RANK-2 values: (N, G) per-node grids, (N*N, G) pair
@@ -154,14 +155,32 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
     Returns el_dirty (N, G) bool: nodes whose election timer reset in phases 2-5 and
     whose el_left must be materialized by the caller as the draw at t_ctr - 1
     (SEMANTICS.md §7 deferral — el_left's only reader is phase 1).
+
+    `fcache` (batched engine only; ops/deep_cache.py): the frontier-value
+    cache dict, mutated in place. When present, the phase-5 read batch is
+    served from the cached frontier values plus one small budgeted refill
+    take per log array instead of the full ~4N+1-rows-per-node takes, and
+    an "ov" (G,) bool entry is ADDED to the dict: True where a needed value
+    was unavailable (budget overflow / consumed-invalid) — the caller must
+    then discard the tick's bits and re-run on the plain engine.
     """
     N, C, maj = cfg.n_nodes, cfg.log_capacity, cfg.majority
     G = s["term"].shape[-1]
     # Probe-only phase ablation (scripts/probe_phase_cuts.py): compile the
     # lattice cut after phase k — output bits are then MEANINGLESS; used
     # exclusively for per-phase timing attribution on hardware. Read at trace
-    # time so probes can sweep without reloading the module.
+    # time so probes can sweep without reloading the module. A leftover env
+    # var (probe crash) would silently poison every later compile, so any
+    # active cut is announced LOUDLY at trace time (r4 ADVICE).
     cut = int(os.environ.get("RAFT_PHASE_CUT", "99"))
+    if cut < 99:
+        import warnings
+
+        warnings.warn(
+            f"RAFT_PHASE_CUT={cut} is active: this tick is compiled with the "
+            "phase lattice TRUNCATED and its output bits are meaningless. "
+            "Probe-only — unset RAFT_PHASE_CUT for real simulations.",
+            stacklevel=2)
 
     # Logs live as PER-NODE (C, G) slices for the duration of the phase
     # lattice (static slices of the flat (N*C, G) layout — free in XLA,
@@ -205,6 +224,7 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
     # SPMD-partitioner abort on sharded runs. Grid mode for dyn configs.
     use_columnar = not flags.dyn_log
 
+    use_fc = batched_logs and fcache is not None
     if batched_logs:
         # node -> chronological [(local_rows (G,), term_v, cmd_v, wr)] of
         # deferred phase-0/5 writes; values kept int32, narrowed at
@@ -214,6 +234,43 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
         pending = {n: [] for n in range(1, N + 1)}
         defer = {"on": False}
         ldt_b = lt[0].dtype
+
+        def rt(v):
+            # Storage-dtype roundtrip: cache values must equal what a read
+            # AFTER the (narrowing) store would see.
+            return v.astype(ldt_b).astype(_I32)
+
+        if use_fc:
+            from raft_kotlin_tpu.ops import deep_cache
+
+            # Unstack the cache to per-row lists for cheap (G,) updates in
+            # the pair loop (the columnar-view trick); restacked at exit.
+            fcl = {k: [fcache[k][i] for i in range(fcache[k].shape[0])]
+                   for k in deep_cache.FIELDS}
+            fc_ov = {"v": jnp.zeros((G,), dtype=bool)}
+
+            def fc_patch_write(n, wr, slot, term_v, cmd_v):
+                """A deferred write of (term_v, cmd_v) at n's physical
+                `slot` (mask wr) patches every cache entry whose (log, row)
+                it hits — value AND validity (a write fully determines the
+                row's content)."""
+                tv, cv = rt(term_v), rt(cmd_v)
+                for q in range(1, N + 1):
+                    pi = pair(n, q)
+                    niq = prow("next_index", n, q).astype(_I32)
+                    for key, roff, val in (("f_pli", -2, tv),
+                                           ("f_ent_t", -1, tv),
+                                           ("f_ent_c", -1, cv)):
+                        hit = wr & (slot == niq + roff)
+                        fcl[key][pi] = jnp.where(hit, val, fcl[key][pi])
+                        okk = deep_cache.ok_name(key)
+                        fcl[okk][pi] = fcl[okk][pi] | hit
+                for l2 in range(1, N + 1):
+                    pi = pair(l2, n)
+                    nil = prow("next_index", l2, n).astype(_I32)
+                    hit = wr & (slot == nil - 2)
+                    fcl["f_ppli"][pi] = jnp.where(hit, tv, fcl["f_ppli"][pi])
+                    fcl["ok_ppli"][pi] = fcl["ok_ppli"][pi] | hit
 
         def patch(name, node, row, v):
             """Overlay node's pending (deferred) writes onto a raw gather of
@@ -361,9 +418,52 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
             # scatter, never matched by patch (read rows are < C).
             row_eff = jnp.where(wr, jnp.clip(slot, 0, C - 1), C)
             pending[n].append((row_eff, term_v, cmd_v, wr))
+            if use_fc:
+                slot32 = slot.astype(_I32)
+                li32, i32 = li.astype(_I32), i.astype(_I32)
+                li_new = jnp.where(app, li32 + 1, i32 + 1)
+                fc_patch_write(n, wr, slot32, term_v, cmd_v)
+                # Live lastLogTerm maintenance (§3): the new cache row is
+                # li_new - 1. app writes slot phys_len: the GHOST case
+                # (phys_len != li) leaves the row at its STALE physical
+                # content = the top window's base row (log[li]); otherwise
+                # the row was just written. ovw writes row i = li_new - 1.
+                W_T = deep_cache.W_TOP
+                tw = (n - 1) * W_T
+                ghost = wr & app & (slot32 != li32)
+                fc_ov["v"] = fc_ov["v"] | (ghost & ~fcl["ok_topw"][tw])
+                lt_new = jnp.where(ghost, fcl["f_topw"][tw], rt(term_v))
+                s["last_term"] = _set_row(
+                    s["last_term"], n - 1,
+                    jnp.where(wr, lt_new, col("last_term", n)))
+                # Realign the top window to base li_new: app shifts it down
+                # one (its top slot becomes unknown until the next refill);
+                # ovw (truncation) moves the base backward arbitrarily —
+                # invalidate. Then overlay THIS write where it lands inside
+                # the new window; rows >= C read as 0.
+                old_w = [fcl["f_topw"][tw + j] for j in range(W_T)]
+                old_ok = [fcl["ok_topw"][tw + j] for j in range(W_T)]
+                for j in range(W_T):
+                    if j + 1 < W_T:
+                        sh_v, sh_ok = old_w[j + 1], old_ok[j + 1]
+                    else:
+                        sh_v = jnp.zeros((G,), _I32)
+                        sh_ok = jnp.zeros((G,), dtype=bool)
+                    v = jnp.where(app, sh_v, 0)
+                    ok = app & sh_ok
+                    row_j = li_new + j
+                    hit = slot32 == row_j
+                    oob = row_j >= C
+                    v = jnp.where(oob, 0, jnp.where(hit, rt(term_v), v))
+                    ok = ok | hit | oob
+                    fcl["f_topw"][tw + j] = jnp.where(wr, v, old_w[j])
+                    fcl["ok_topw"][tw + j] = jnp.where(wr, ok, old_ok[j])
+                setcol("last_index", n, wr, jnp.where(app, li + 1, i + 1))
+                setcol("phys_len", n, app, pl + 1)
+                return wr, slot32
             setcol("last_index", n, wr, jnp.where(app, li + 1, i + 1))
             setcol("phys_len", n, app, pl + 1)
-            return
+            return None
         ldt = s["log_term"].dtype  # narrow at write (cfg.log_dtype)
         if flags.dyn_log and not use_slices:
             # Flat masked read-modify-write of one global row per lane.
@@ -451,6 +551,23 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
         s["el_left"] = jnp.where(rst, aux["el_draw_f"], s["el_left"])
         s["el_armed"] = s["el_armed"] | rst
         s["t_ctr"] = s["t_ctr"] + rst.astype(_I32)
+        if use_fc:
+            # Restart wipes the node's OWNED pair frontiers to 0: rows
+            # -2/-1 are out of range and read as 0, so its pair caches
+            # become 0/valid. Its PHYSICAL log is untouched (§3 logical
+            # wipe), so caches where it is the PEER stay correct; f_top's
+            # row moves to last_index = 0, whose stale content is unknown.
+            for a in range(1, N + 1):
+                ra = rst[a - 1]
+                for b in range(1, N + 1):
+                    pi = (a - 1) * N + (b - 1)
+                    for k in deep_cache.PAIR_VALS:
+                        okk = deep_cache.ok_name(k)
+                        fcl[k][pi] = jnp.where(ra, 0, fcl[k][pi])
+                        fcl[okk][pi] = fcl[okk][pi] | ra
+                for j in range(deep_cache.W_TOP):
+                    tw = (a - 1) * deep_cache.W_TOP + j
+                    fcl["ok_topw"][tw] = fcl["ok_topw"][tw] & ~ra
     if flags.links:
         lu = s["link_up"]
         s["link_up"] = lu * (1 - aux["link_fail"]) + (1 - lu) * aux["link_heal"]
@@ -474,6 +591,49 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
         # phase 0 + phase 5 in canonical order from the pre-tick stored log.
         defer["on"] = True
 
+    if use_fc and (flags.periodic or flags.inject):
+        # EARLY top-window refill: a phase-0 GHOST append (post-truncation
+        # cmd_node) consumes f_topw BEFORE the main phase-5 refill runs —
+        # e.g. the tick right after a phase-5 truncation invalidated the
+        # window. Top the windows up here, but only on ticks that actually
+        # inject commands (lax.cond on the aux masks — the take is real
+        # work, and cmd ticks are 1-in-cmd_period).
+        W_T = deep_cache.W_TOP
+        due = jnp.zeros((), dtype=bool)
+        if flags.periodic:
+            due = due | jnp.any(aux["periodic"][0] >= 0)
+        if flags.inject:
+            due = due | jnp.any(aux["inject"] >= 0)
+        ew_rows, ew_ok, ew_v = [], [], []
+        for n in range(1, N + 1):
+            li_e = col("last_index", n).astype(_I32)
+            for j in range(W_T):
+                tw = (n - 1) * W_T + j
+                r = li_e + j
+                ew_rows.append((n - 1) * C + jnp.clip(r, 0, C - 1))
+                ew_ok.append(fcl["ok_topw"][tw] | ~((r >= 0) & (r < C)))
+                ew_v.append(r)
+
+        def _early_refill(_):
+            vals = jnp.take_along_axis(
+                s["log_term"], jnp.stack(ew_rows), axis=0).astype(_I32)
+            out_v, out_ok = [], []
+            for k in range(N * W_T):
+                need = ~ew_ok[k]
+                v = jnp.where((ew_v[k] >= 0) & (ew_v[k] < C), vals[k], 0)
+                out_v.append(jnp.where(need, v, fcl["f_topw"][k]))
+                out_ok.append(jnp.ones_like(fcl["ok_topw"][k]))
+            return jnp.stack(out_v), jnp.stack(out_ok)
+
+        def _early_skip(_):
+            return (jnp.stack([fcl["f_topw"][k] for k in range(N * W_T)]),
+                    jnp.stack([fcl["ok_topw"][k] for k in range(N * W_T)]))
+
+        ev, eo = lax.cond(due, _early_refill, _early_skip, None)
+        for k in range(N * W_T):
+            fcl["f_topw"][k] = ev[k]
+            fcl["ok_topw"][k] = eo[k]
+
     # -- phase 0: command injection (quirk k) -------------------------------
 
     if flags.periodic:
@@ -486,12 +646,14 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
             cmd = aux["inject"][n - 1]
             log_add(n, col("last_index", n), col("term", n), cmd,
                     (cmd >= 0) & col("up", n))
-    if flags.periodic or flags.inject:
+    if (flags.periodic or flags.inject) and not use_fc:
         # Refresh the lastLogTerm cache for nodes phase 0 may have appended
         # to: phase 3 reads state.last_term this same tick, and a ghost
         # append (§3) makes the post-append value a LOG read (slot li-1),
         # not the written term. In batched mode the add was deferred, so the
-        # raw gather is patched with this node's pending writes.
+        # raw gather is patched with this node's pending writes. (fcache
+        # mode maintains last_term LIVE inside log_add — the ghost value
+        # comes from f_top — so no gather is needed here at all.)
         p0_nodes = set([cfg.cmd_node] if flags.periodic else [])
         if flags.inject:
             p0_nodes.update(range(1, N + 1))
@@ -669,6 +831,17 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
     s["match_index"] = (1 - win_rep) * s["match_index"]
     s["hb_armed"] = s["hb_armed"] | win
     s["hb_left"] = jnp.where(win, 0, s["hb_left"])  # initial delay 0
+    if use_fc:
+        # quirk-b jump: the winner's pair frontiers move to commit + 1 —
+        # every cached frontier value of its owned pairs becomes unknown
+        # (the refill below serves the ones phase 5 consumes this tick).
+        for a in range(1, N + 1):
+            wa = win[a - 1]
+            for b in range(1, N + 1):
+                pi = (a - 1) * N + (b - 1)
+                for k in deep_cache.PAIR_VALS:
+                    okk = deep_cache.ok_name(k)
+                    fcl[okk][pi] = fcl[okk][pi] & ~wa
     s["round_state"] = jnp.where(win | dem, IDLE, s["round_state"])
     s["round_state"] = jnp.where(lose, BACKOFF, s["round_state"])
     s["bo_left"] = jnp.where(lose, aux["bdraw"], s["bo_left"])
@@ -706,7 +879,7 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
         if p_plt is None:
             p_plt = log_gather("log_term", p, pli)
         succ = (pli == -1) | ((p_li > pli) & (pli >= 0) & (p_plt == plt))
-        log_add(p, pli + 1, ent_t, ent_c, act5 & succ & has_entry)
+        add_info = log_add(p, pli + 1, ent_t, ent_c, act5 & succ & has_entry)
         resp_term = col("term", p)
         # --- leader processes the response (RaftServer.kt:146-168) ---
         if p != l:
@@ -732,6 +905,38 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
         cnt = sum((prow("match_index", l, q) > l_commit).astype(_I32)
                   for q in range(1, N + 1))
         setcol("commit", l, with_e & (cnt >= maj), l_commit + 1)
+        if use_fc and defer["on"]:
+            # Frontier-cache shift (ops/deep_cache.py): the exchange moved
+            # next_index by +1 (with_e) or -1 (nfail); re-point the cached
+            # rows. All olds are read BEFORE any assignment.
+            pi_lp = pair(l, p)
+            wr_p, slot_p = add_info
+            i32o = ni.astype(_I32)  # pre-update next_index (= pli + 2)
+            o = {k: fcl[k][pi_lp] for k in
+                 ("f_pli", "f_ent_t", "f_ent_c", "f_ppli",
+                  "ok_pli", "ok_ent_t", "ok_ent_c", "ok_ppli")}
+            zero = jnp.zeros((G,), _I32)
+            no = jnp.zeros((G,), dtype=bool)
+            # with_e: pli' = old entry row; entry row i is unknown until
+            # the next write lands there; ppli' (row i-1 of p) is the value
+            # this exchange just wrote — unless the write was a §3 ghost
+            # (slot != i-1), which leaves the stale row unknown here (the
+            # refill serves it on next consume; rare).
+            wrote_im1 = wr_p & (slot_p == i32o - 1)
+            ent_w = rt(ent_t)
+
+            def upd(key, adv_v, adv_ok, rec_v, rec_ok):
+                okk = "ok_" + key[2:]
+                fcl[key][pi_lp] = jnp.where(
+                    with_e, adv_v, jnp.where(nfail, rec_v, o[key]))
+                fcl[okk][pi_lp] = jnp.where(
+                    with_e, adv_ok, jnp.where(nfail, rec_ok, o[okk]))
+
+            upd("f_pli", o["f_ent_t"], o["ok_ent_t"], zero, no)
+            upd("f_ent_t", zero, no, o["f_pli"], o["ok_pli"])
+            upd("f_ent_c", zero, no, zero, no)
+            upd("f_ppli", jnp.where(wrote_im1, ent_w, zero), wrote_im1,
+                zero, no)
 
     def append_deliver(l, p):
         # §10 delivery: response leg at the delivery tick; either-end failure voids
@@ -755,6 +960,99 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
             # log_gather's out-of-[0, C) => 0 convention for a raw take.
             return jnp.where((idx >= 0) & (idx < C), v, 0)
 
+        def inr(r):
+            return (r >= 0) & (r < C)
+
+    fc_cons = {}
+    if use_fc:
+        # ----- frontier-cache refill (ops/deep_cache.py) -----
+        # Demands: cache entries phase 5 will CONSUME this tick that are
+        # invalid and in-range, ranked per lane over a static enumeration
+        # and served by ONE budgeted take per log array. The consumption
+        # masks mirror the loop-head logic exactly (fire/skip use only
+        # state phase 5 itself reads before any exchange).
+        i_all = {(a, b): prow("next_index", a, b)
+                 for a in range(1, N + 1) for b in range(1, N + 1)}
+        li32f = {n: col("last_index", n).astype(_I32) for n in range(1, N + 1)}
+        fire_pre = {}
+        for l in range(1, N + 1):
+            armed_f = col("hb_armed", l) & col("up", l)
+            fire_pre[l] = armed_f & ~(col("hb_left", l) > 0)
+        for l in range(1, N + 1):
+            for p in range(1, N + 1):
+                i32 = i_all[(l, p)].astype(_I32)
+                pli_f = i32 - 2
+                skip_f = (pli_f >= 0) & ~(pli_f < li32f[l])
+                he_f = li32f[l] >= i32
+                skip_f = skip_f | (he_f & (i32 <= 0))
+                fc_cons[(l, p)] = fire_pre[l] & ~skip_f
+
+        # (gate, hard, target node, local row, cache key, cache row index)
+        t_entries, c_entries = [], []
+        for l in range(1, N + 1):
+            for p in range(1, N + 1):
+                pi = pair(l, p)
+                i32 = i_all[(l, p)].astype(_I32)
+                he_f = li32f[l] >= i32
+                cns = fc_cons[(l, p)]
+                t_entries.append((cns & ~fcl["ok_pli"][pi] & inr(i32 - 2),
+                                  True, l, i32 - 2, "f_pli", pi))
+                t_entries.append((cns & he_f & ~fcl["ok_ent_t"][pi]
+                                  & inr(i32 - 1), True, l, i32 - 1,
+                                  "f_ent_t", pi))
+                t_entries.append((cns & ~fcl["ok_ppli"][pi] & inr(i32 - 2),
+                                  True, p, i32 - 2, "f_ppli", pi))
+                c_entries.append((cns & he_f & ~fcl["ok_ent_c"][pi]
+                                  & inr(i32 - 1), True, l, i32 - 1,
+                                  "f_ent_c", pi))
+        for n in range(1, N + 1):
+            # The top window is refilled eagerly but SOFTLY (overflow is
+            # not an error — a later ghost-append consume flags ov itself).
+            for j in range(deep_cache.W_TOP):
+                tw = (n - 1) * deep_cache.W_TOP + j
+                t_entries.append((~fcl["ok_topw"][tw] & inr(li32f[n] + j),
+                                  False, n, li32f[n] + j, "f_topw", tw))
+
+        def fc_refill(entries, budget, log_arr, is_term):
+            rank = jnp.zeros((G,), _I32)
+            rows = jnp.zeros((budget, G), _I32)
+            iota_b = jax.lax.broadcasted_iota(_I32, (budget, G), 0)
+            ranks = []
+            for gate, hard, node, row, key, idx in entries:
+                ranks.append(rank)
+                hot = (iota_b == rank[None]) & gate[None]
+                rows = jnp.where(
+                    hot, ((node - 1) * C + jnp.clip(row, 0, C - 1))[None],
+                    rows)
+                rank = rank + gate.astype(_I32)
+            vals = jnp.take_along_axis(log_arr, rows, axis=0).astype(_I32)
+            # Overlay this tick's deferred (phase-0) writes: the take read
+            # the pre-tick backing store, the cache must hold the logical
+            # current value.
+            for n2 in range(1, N + 1):
+                for prow_w, pt_w, pc_w, pwr_w in pending[n2]:
+                    hit = pwr_w[None] & (
+                        rows == ((n2 - 1) * C + prow_w.astype(_I32))[None])
+                    pv = rt(pt_w if is_term else pc_w)
+                    vals = jnp.where(hit, pv[None], vals)
+            ov_over = jnp.zeros((G,), dtype=bool)
+            for (gate, hard, node, row, key, idx), r in zip(entries, ranks):
+                got = gate & (r < budget)
+                oh = (iota_b == r[None]) & got[None]
+                v = jnp.sum(jnp.where(oh, vals, 0), axis=0)
+                okk = deep_cache.ok_name(key)
+                fcl[key][idx] = jnp.where(got, v, fcl[key][idx])
+                fcl[okk][idx] = fcl[okk][idx] | got
+                if hard:
+                    ov_over = ov_over | (gate & ~got)
+            return ov_over
+
+        fc_ov["v"] = fc_ov["v"] | fc_refill(
+            t_entries, deep_cache.TERM_BUDGET, s["log_term"], True)
+        fc_ov["v"] = fc_ov["v"] | fc_refill(
+            c_entries, deep_cache.CMD_BUDGET, s["log_cmd"], False)
+
+    if batched_logs and not use_fc:
         # ALL of the tick's remaining log reads batched up front. Row
         # indices are known post-phase-4 (see the engine note above); writes
         # that land between here and a pair's consume point are overlaid by
@@ -809,11 +1107,29 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
                 bvals_t[n] = vt[(n - 1) * Rt: n * Rt].astype(_I32)
                 bvals_c[n] = vc[(n - 1) * Rc: n * Rc].astype(_I32)
         else:
+            # FLAT-MERGED takes (round 5): ONE take_along_axis per log array
+            # for ALL nodes' read rows, on the flat (N*C, G) layout with
+            # global rows. The round-5 on-chip probe
+            # (scripts/probe_deep_costs.py) measures the XLA:TPU gather at
+            # ~4-5 ms PER OP at G=13k — nearly independent of C AND of row
+            # count (~0.15 ms marginal per row) — so the per-op floor, not
+            # the row count, dominated the old 2-takes-per-node form
+            # (2N ops = ~86 ms of the 96 ms scalar-output tick attribution).
+            # Rows are already clipped to [0, C), so offsetting by the
+            # node's base cannot alias a neighbor's rows.
+            # Widen BEFORE offsetting: local rows may be int16 (NARROW16
+            # next_index/last_index) and (n-1)*C exceeds int16 at deep C.
+            rows_t_flat = jnp.concatenate(
+                [jnp.stack(brows_t[n]).astype(_I32) + (n - 1) * C
+                 for n in range(1, N + 1)])
+            rows_c_flat = jnp.concatenate(
+                [jnp.stack(brows_c[n]).astype(_I32) + (n - 1) * C
+                 for n in range(1, N + 1)])
+            vt = jnp.take_along_axis(s["log_term"], rows_t_flat, axis=0)
+            vc = jnp.take_along_axis(s["log_cmd"], rows_c_flat, axis=0)
             for n in range(1, N + 1):
-                bvals_t[n] = jnp.take_along_axis(
-                    lt[n - 1], jnp.stack(brows_t[n]), axis=0).astype(_I32)
-                bvals_c[n] = jnp.take_along_axis(
-                    lc[n - 1], jnp.stack(brows_c[n]), axis=0).astype(_I32)
+                bvals_t[n] = vt[(n - 1) * Rt: n * Rt].astype(_I32)
+                bvals_c[n] = vc[(n - 1) * Rc: n * Rc].astype(_I32)
 
     for l in range(1, N + 1):
         raw_armed = col("hb_armed", l)
@@ -841,7 +1157,24 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
             pli = i - 2
             # prevLogTerm: invalid get -> exception -> skip peer (§6 skip rule).
             skip = (pli >= 0) & ~(pli < li_l)
-            if batched_logs:
+            if use_fc:
+                # Frontier-cache consume: the cached values ARE the rows
+                # the old prefetch would have taken (ops/deep_cache.py);
+                # a consumed-invalid entry raises ov — the runner discards
+                # the call's bits and re-runs on the plain engine. The ov
+                # guard uses the LIVE fire/skip masks, NOT the refill-time
+                # fc_cons snapshot: an earlier-iterating leader's append
+                # can raise THIS leader's last_index mid-loop and flip
+                # skip/has_entry, making a read needed that the snapshot
+                # did not demand — that case must fall back, not silently
+                # consume a stale value.
+                pi_lp = pair(l, p)
+                live_cons = fire & ~skip
+                plt = jnp.where(pli >= 0,
+                                bounded(pli, fcl["f_pli"][pi_lp]), -1)
+                fc_ov["v"] = fc_ov["v"] | (
+                    live_cons & inr(pli) & ~fcl["ok_pli"][pi_lp])
+            elif batched_logs:
                 raw_plt = bounded(pli, patch(
                     "log_term", l, brows_t[l][p - 1], bvals_t[l][p - 1]))
                 plt = jnp.where(pli >= 0, raw_plt, -1)
@@ -849,7 +1182,17 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
                 plt = jnp.where(pli >= 0, log_gather("log_term", l, pli), -1)
             has_entry = li_l >= i
             skip = skip | (has_entry & (i <= 0))  # quirk i underflow
-            if batched_logs:
+            if use_fc:
+                ent_t = bounded(i - 1, fcl["f_ent_t"][pi_lp])
+                ent_c = bounded(i - 1, fcl["f_ent_c"][pi_lp])
+                p_plt_b = bounded(pli, fcl["f_ppli"][pi_lp])
+                live_cons = fire & ~skip  # post-underflow-quirk skip
+                need_e = live_cons & has_entry & inr(i - 1)
+                fc_ov["v"] = fc_ov["v"] | (need_e & ~fcl["ok_ent_t"][pi_lp])
+                fc_ov["v"] = fc_ov["v"] | (need_e & ~fcl["ok_ent_c"][pi_lp])
+                fc_ov["v"] = fc_ov["v"] | (
+                    live_cons & inr(pli) & ~fcl["ok_ppli"][pi_lp])
+            elif batched_logs:
                 ent_t = bounded(i - 1, patch(
                     "log_term", l, brows_t[l][N + p - 1], bvals_t[l][N + p - 1]))
                 ent_c = bounded(i - 1, patch(
@@ -890,19 +1233,28 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
             s[name] = d - (d > 0).astype(d.dtype)
 
     if batched_logs:
-        # Apply each node's deferred phase-0/5 writes as one scatter per log
-        # array. Masked entries carry row C and are DROPPED by the scatter.
+        # Apply ALL nodes' deferred phase-0/5 writes as ONE flat scatter per
+        # log array on the (N*C, G) layout. Round-5 A/B on chip: merged
+        # scatters beat per-node (C, G) scatters IN CONTEXT by ~22 ms/tick
+        # (134 vs 157 ms at the config-5 shape) even though the ISOLATED
+        # per-op cost scales with operand height — the flat form writes
+        # s["log_term"] directly and skips the per-node slice rejoin concat,
+        # and the while-body scatter updates the donated buffer in place.
+        # Masked entries carry local row C; in the flat layout that would
+        # alias the NEXT node's row 0, so they redirect to N*C — outside
+        # the whole array — and mode="drop" discards them.
         # Duplicate REAL rows within a lane are possible (two leaders
         # appending to the same slot of one node) and XLA scatter order over
         # duplicates is unspecified — so every entry is first resolved to
         # the LAST real write at its row (chronological pass over this
-        # node's entries): duplicates then carry identical values and the
-        # scatter is deterministic.
+        # node's entries; rows never alias ACROSS nodes): duplicates then
+        # carry identical values and the scatter is deterministic.
+        per_node = {}  # n -> (local rows list, resolved term list, cmd list)
         for n in range(1, N + 1):
             writes = pending[n]
             if not writes:
                 continue
-            rows = jnp.stack([w[0] for w in writes])  # (K, G) local rows
+            rows_l = [w[0].astype(_I32) for w in writes]  # local; C = dropped
             eff_t, eff_c = [], []
             for rk, tk, ck, _wk in writes:
                 et, ec = tk.astype(ldt_b), ck.astype(ldt_b)
@@ -912,12 +1264,60 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
                     ec = jnp.where(hit, cj.astype(ldt_b), ec)
                 eff_t.append(et)
                 eff_c.append(ec)
-            lt[n - 1] = jnp.put_along_axis(
-                lt[n - 1], rows, jnp.stack(eff_t), axis=0, inplace=False,
-                mode="drop")
-            lc[n - 1] = jnp.put_along_axis(
-                lc[n - 1], rows, jnp.stack(eff_c), axis=0, inplace=False,
-                mode="drop")
+            per_node[n] = (rows_l, eff_t, eff_c)
+        if per_node:
+            from raft_kotlin_tpu.ops import deep_scatter
+
+            G_l = s["log_term"].shape[-1]
+            Kmax = max(len(r) for r, _, _ in per_node.values())
+            sc = None
+            if not deep_scatter.DISABLE:
+                sc = deep_scatter.build_scatter(
+                    N, C, Kmax, str(ldt_b), G_l,
+                    jax.default_backend() == "cpu")
+            if sc is not None:
+                # One Pallas pass over both logs: the whole log crosses HBM
+                # exactly once (read + write) and the K-deep one-hot select
+                # chain replaces the XLA scatter lowering (see
+                # ops/deep_scatter.py for the cost model).
+                def padded(items, fill):
+                    # Node slabs padded to Kmax entries; row C = dropped.
+                    out = list(items)
+                    while len(out) < Kmax:
+                        out.append(jnp.full((G_l,), fill, _I32))
+                    return out
+
+                def slab(idx, fill):
+                    return sum((padded(per_node[n][idx]
+                                       if n in per_node else [], fill)
+                                for n in range(1, N + 1)), [])
+
+                rows_all = jnp.stack(slab(0, C))
+                vt_all = jnp.stack(
+                    [v.astype(ldt_b) for v in slab(1, 0)])
+                vc_all = jnp.stack(
+                    [v.astype(ldt_b) for v in slab(2, 0)])
+                s["log_term"], s["log_cmd"] = sc(
+                    s["log_term"], s["log_cmd"], rows_all, vt_all, vc_all)
+            else:
+                # XLA fallback: ONE flat scatter per array. Masked entries
+                # carry local row C; in the flat layout that would alias the
+                # NEXT node's row 0, so redirect to N*C — outside the whole
+                # array — and mode="drop" discards them.
+                all_rows, all_t, all_c = [], [], []
+                for n, (rows_l, eff_t, eff_c) in per_node.items():
+                    rows = jnp.stack(rows_l)
+                    all_rows.append(
+                        jnp.where(rows >= C, N * C, rows + (n - 1) * C))
+                    all_t.append(jnp.stack(eff_t))
+                    all_c.append(jnp.stack(eff_c))
+                rows_cat = jnp.concatenate(all_rows)
+                s["log_term"] = jnp.put_along_axis(
+                    s["log_term"], rows_cat, jnp.concatenate(all_t), axis=0,
+                    inplace=False, mode="drop")
+                s["log_cmd"] = jnp.put_along_axis(
+                    s["log_cmd"], rows_cat, jnp.concatenate(all_c), axis=0,
+                    inplace=False, mode="drop")
 
     # lastLogTerm cache refresh (state.last_term): recomputed from the FINAL
     # log, so the ghost-append quirk (§3) is honored exactly — the cache is
@@ -926,8 +1326,10 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
     # per-pair engines (it replaces the N gathers phase 3 used to issue);
     # the batched engine reads its prefetched last_index-1 base row and
     # overlays this tick's pending writes (a lane whose last_index moved got
-    # its new top slot written this tick, so patch() supplies it).
-    for n in range(1, N + 1):
+    # its new top slot written this tick, so patch() supplies it). The
+    # frontier-cache engine maintains last_term LIVE inside log_add (the
+    # ghost value comes from f_top), so it skips this pass entirely.
+    for n in (() if use_fc else range(1, N + 1)):
         li_f = s["last_index"][n - 1]
         if batched_logs:
             # Stored-value candidates for the final last_index - 1 row: the
@@ -945,10 +1347,19 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags):
             v = log_gather("log_term", n, li_f - 1)
         s["last_term"] = _set_row(s["last_term"], n - 1, v)
 
-    if use_slices:
+    if use_slices and not batched_logs:
         # Rejoin the per-node log slices into the flat (N*C, G) layout.
+        # (The batched engine never writes the slices — its deferred writes
+        # land in the flat arrays directly via the merged scatter above.)
         s["log_term"] = jnp.concatenate(lt, axis=0)
         s["log_cmd"] = jnp.concatenate(lc, axis=0)
+
+    if use_fc:
+        # Restack the frontier cache + the per-lane overflow flag into the
+        # caller's dict (the runner threads them through its scan carry).
+        for k in deep_cache.FIELDS:
+            fcache[k] = jnp.stack(fcl[k])
+        fcache["ov"] = fc_ov["v"]
 
     return aux_dirty["m"]
 

@@ -175,7 +175,8 @@ def _make_shardmap_pallas_tick(cfg: RaftConfig, mesh: Mesh,
     return tick
 
 
-def _make_shardmap_xla_tick(cfg: RaftConfig, mesh: Mesh):
+def _make_shardmap_xla_tick(cfg: RaftConfig, mesh: Mesh,
+                            batched: Optional[bool] = None):
     """The XLA tick with phase_body applied per device shard via jax.shard_map
     (same division of labor as _make_shardmap_pallas_tick: RNG/aux pre-pass
     and deferred-draw post-pass stay globally-sharded XLA; the phase lattice
@@ -187,23 +188,37 @@ def _make_shardmap_xla_tick(cfg: RaftConfig, mesh: Mesh):
     pathological HLO-pass memory, then SIGABRT at execution — consistent
     with the gathers being rewritten into materialized dense forms).
     shard_map keeps the compiled per-shard program identical to the
-    single-device one. Bit-identical either way."""
+    single-device one. Bit-identical either way.
+
+    `batched` selects the per-shard engine: True = the BATCHED deep engine
+    (the single-device fast path) per shard; False = the per-pair FLAT
+    engine; None (default) = batched on accelerators, per-pair flat on CPU.
+    The old always-flat routing was a TPU path decision made from a CPU
+    failure (VERDICT r04 weak #3): the CPU blowup lives in XLA:CPU's
+    compile of the batched gather/scatter program itself, so CPU keeps the
+    flat engine, while TPU shards now run the same engine the single-device
+    config-5 stage uses (shard_map bypasses the SPMD partitioner; the
+    round-5 on-chip A/B lives in BENCH_r05.json shardeddeep_* fields)."""
     from raft_kotlin_tpu.ops import tick as tick_mod
 
     n_dev = math.prod(mesh.devices.shape)
     assert cfg.n_groups % n_dev == 0, "pad_groups first"
     lanes_spec = P(None, ("dcn", "ici"))
+    if batched is None:
+        # Mailbox configs cannot use the batched engine (deliveries make
+        # read rows depend on in-tick slot state) — route them to the
+        # round-2-proven per-pair FLAT sharded program on every platform
+        # rather than letting make_aux's fallback silently select the
+        # never-sharded sliced variant.
+        batched = (mesh.devices.flatten()[0].platform != "cpu"
+                   and not cfg.uses_mailbox)
+    batched_arg: Optional[bool] = None if batched else False
 
     def tick(state: RaftState, rng) -> RaftState:
         base, tkeys, bkeys = rng
-        # batched=False: the per-pair engine per shard. Per-shard widths are
-        # small (op cost immaterial) and XLA:CPU compiles of the batched
-        # program blow up on int16 deep configs; the batched engine remains
-        # the single-device deep-log fast path (bench's config-5 stage).
-        # sharded=True: flat log layout (the round-2-proven sharded program —
-        # see BodyFlags.sharded).
         aux, flags = tick_mod.make_aux(cfg, base, tkeys, bkeys, state,
-                                       None, None, batched=False, sharded=True)
+                                       None, None, batched=batched_arg,
+                                       sharded=not batched)
         sfields = tick_mod.state_fields(flags)
         aux_names = tuple(k for k in tick_mod.AUX_FIELDS if k in aux)
         flat = tick_mod.flatten_state(cfg, state)

@@ -1,0 +1,62 @@
+"""TPU probe: honest end-to-end ms/tick of the deep-log batched engine at
+the bench config-5 shape (G=13_184, C=10_000, N=7, int16 logs), under the
+same measurement discipline as bench.py stage 5 (single jit, scalar
+reductions as outputs, per-tick log_cmd livepin through the scan carry,
+distinct rng per rep).
+
+Round-5 context: scripts/probe_deep_costs.py measured the XLA:TPU gather at
+~4-5 ms PER OP (independent of C, ~0.15 ms marginal per row) — the per-op
+floor, not the row count, dominates. This probe tracks the engine's wall
+time as ops are merged/eliminated.
+
+  python scripts/probe_deep_engine.py [G] [ticks]
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_compilation_cache_dir", os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
+def main():
+    import bench
+    from raft_kotlin_tpu.utils.config import RaftConfig
+
+    G = int(sys.argv[1]) if len(sys.argv) > 1 else 13_184
+    T = int(sys.argv[2]) if len(sys.argv) > 2 else 15
+    cfg = dataclasses.replace(RaftConfig(
+        n_nodes=7, log_capacity=10_000, log_dtype="int16", cmd_period=2,
+        p_drop=0.05, seed=3,
+    ).stressed(10), n_groups=G)
+    print(json.dumps({"devices": str(jax.devices())}), flush=True)
+    t0 = time.perf_counter()
+    times, stats, impl = bench.measure(
+        cfg, T, 3, bench.deep_candidates,
+        summarize=lambda end: {"commit": jnp.sum(
+            jnp.max(end.commit, axis=0).astype(jnp.int32))})
+    best = bench.median(times)
+    print(json.dumps({
+        "probe": "deep_engine", "G": G, "ticks": T, "impl": impl,
+        "ms_per_tick": round(best / T * 1e3, 2),
+        "group_steps_per_sec": round(G * T / best, 1),
+        "commit": stats[times.index(best)]["commit"],
+        "rep_times_s": [round(t, 4) for t in times],
+        "compile_plus_first_s": round(time.perf_counter() - t0, 1),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

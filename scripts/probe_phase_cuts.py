@@ -41,26 +41,30 @@ def main():
     T = 50
     st0 = init_state(cfg)
     prev = 0.0
-    for cut in (0, 1, 2, 3, 4, 99):
-        os.environ["RAFT_PHASE_CUT"] = str(cut)
-        from raft_kotlin_tpu.ops.pallas_tick import make_pallas_scan
-        rngs = [tick_mod.make_rng(dataclasses.replace(
-            cfg, seed=cfg.seed + 1000 * (r + 1))) for r in range(3)]
-        run = make_pallas_scan(cfg, T, interpret=False)
-        try:
-            int(jnp.sum(run(st0, rngs[2]).rounds))
-            ts = []
-            for r in range(2):
-                t0 = time.perf_counter()
-                int(jnp.sum(run(st0, rngs[r]).rounds))
-                ts.append(time.perf_counter() - t0)
-            ms = min(ts) / T * 1e3
-            print(json.dumps({"cut": cut, "ms_per_tick": round(ms, 3),
-                              "delta_ms": round(ms - prev, 3)}), flush=True)
-            prev = ms
-        except Exception as e:
-            print(json.dumps({"cut": cut, "err": str(e)[:200]}), flush=True)
-    os.environ.pop("RAFT_PHASE_CUT", None)
+    # finally-pop (r4 ADVICE): a crash mid-sweep must not leave the
+    # trace-time ablation knob set for later processes sharing this env.
+    try:
+        for cut in (0, 1, 2, 3, 4, 99):
+            os.environ["RAFT_PHASE_CUT"] = str(cut)
+            from raft_kotlin_tpu.ops.pallas_tick import make_pallas_scan
+            rngs = [tick_mod.make_rng(dataclasses.replace(
+                cfg, seed=cfg.seed + 1000 * (r + 1))) for r in range(3)]
+            run = make_pallas_scan(cfg, T, interpret=False)
+            try:
+                int(jnp.sum(run(st0, rngs[2]).rounds))
+                ts = []
+                for r in range(2):
+                    t0 = time.perf_counter()
+                    int(jnp.sum(run(st0, rngs[r]).rounds))
+                    ts.append(time.perf_counter() - t0)
+                ms = min(ts) / T * 1e3
+                print(json.dumps({"cut": cut, "ms_per_tick": round(ms, 3),
+                                  "delta_ms": round(ms - prev, 3)}), flush=True)
+                prev = ms
+            except Exception as e:
+                print(json.dumps({"cut": cut, "err": str(e)[:200]}), flush=True)
+    finally:
+        os.environ.pop("RAFT_PHASE_CUT", None)
 
 
 if __name__ == "__main__":

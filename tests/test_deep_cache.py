@@ -1,0 +1,88 @@
+"""ops/deep_cache — the frontier-value cache deep runner.
+
+The runner must be bit-identical to the per-tick batched engine (they share
+phase_body; the cache only changes WHERE phase 5's read rows come from),
+including through §3 ghost appends, restarts and election churn; and its OV
+fallback must deliver plain-engine bits when the cache overflows. All
+differentials here are CPU-slow (one-core compiles of the big scan body),
+so most are slow-marked; the TPU-gated leg lives in tests/test_tpu_pallas.py
+and the bench deep stage runs the engine end-to-end every round.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from conftest import assert_states_equal
+
+from raft_kotlin_tpu.models.state import init_state
+from raft_kotlin_tpu.ops import deep_cache
+from raft_kotlin_tpu.ops.deep_cache import make_deep_scan
+from raft_kotlin_tpu.ops.tick import make_rng, make_tick
+from raft_kotlin_tpu.utils.config import RaftConfig
+
+
+def _ref(cfg, T, rng):
+    tick = jax.jit(make_tick(cfg))
+    st = init_state(cfg)
+    for _ in range(T):
+        st = tick(st, rng=rng)
+    return jax.device_get(st)
+
+
+def test_fc_runner_holds_steady_state():
+    # Conflict-free steady state: the workload starts AFTER the boot
+    # election settles (cmd_period > el_hi), so logs never diverge and no
+    # ghost/truncation machinery fires. The cache must HOLD (ov False:
+    # every read served from cache + the budgeted refill) and the bits
+    # must match the per-tick batched engine exactly.
+    cfg = RaftConfig(n_groups=8, n_nodes=3, log_capacity=256, cmd_period=30,
+                     seed=7).stressed(10)
+    T = 70
+    rng = make_rng(cfg)
+    end, ov = make_deep_scan(cfg, T, return_state=True)(init_state(cfg), rng)
+    assert not ov, "frontier cache overflowed on a conflict-free config"
+    ref = _ref(cfg, T, rng)
+    assert_states_equal(ref, jax.device_get(end))
+    assert int(np.max(np.asarray(ref.commit))) > 0
+
+
+@pytest.mark.slow
+def test_fc_runner_matches_batched_conflict_churn():
+    # cmd-node appends BEFORE the boot election create log conflicts:
+    # truncations, ghost appends, catch-up walks (plus iid drops). Bits
+    # must match whether or not the cache overflowed (OV reruns plain).
+    cfg = RaftConfig(n_groups=8, n_nodes=3, log_capacity=256, cmd_period=3,
+                     p_drop=0.2, seed=41).stressed(10)
+    T = 60
+    rng = make_rng(cfg)
+    end, _ov = make_deep_scan(cfg, T, return_state=True)(init_state(cfg), rng)
+    assert_states_equal(_ref(cfg, T, rng), jax.device_get(end))
+
+
+@pytest.mark.slow
+def test_fc_runner_matches_batched_fault_soup():
+    # Crash/restart soup: restarts wipe frontiers, wins jump them (quirk
+    # b), ghost appends hit the top window.
+    cfg = RaftConfig(n_groups=8, n_nodes=3, log_capacity=256, cmd_period=3,
+                     p_drop=0.2, p_crash=0.02, p_restart=0.15,
+                     seed=41).stressed(10)
+    T = 150
+    rng = make_rng(cfg)
+    end, _ov = make_deep_scan(cfg, T, return_state=True)(init_state(cfg), rng)
+    assert_states_equal(_ref(cfg, T, rng), jax.device_get(end))
+
+
+@pytest.mark.slow
+def test_fc_runner_ov_fallback_bitexact(monkeypatch):
+    # Starve the refill budget so the cache MUST overflow: the runner has
+    # to detect it and deliver plain-engine bits via the fallback.
+    monkeypatch.setattr(deep_cache, "TERM_BUDGET", 1)
+    monkeypatch.setattr(deep_cache, "CMD_BUDGET", 1)
+    cfg = RaftConfig(n_groups=8, n_nodes=3, log_capacity=256, cmd_period=3,
+                     p_drop=0.2, seed=43).stressed(10)
+    T = 50
+    rng = make_rng(cfg)
+    end, ov = make_deep_scan(cfg, T, return_state=True)(init_state(cfg), rng)
+    assert ov, "a 1-row budget must overflow under replication"
+    assert_states_equal(_ref(cfg, T, rng), jax.device_get(end))

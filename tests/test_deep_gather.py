@@ -9,6 +9,7 @@ hardware leg lives in tests/test_tpu_pallas.py).
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from conftest import assert_states_equal
 
@@ -18,6 +19,7 @@ from raft_kotlin_tpu.ops.tick import make_tick
 from raft_kotlin_tpu.utils.config import RaftConfig
 
 
+@pytest.mark.archival
 def test_kernel_matches_take_along_axis():
     # Raw-op equivalence on random data, both log dtypes, odd node/row counts.
     key = jax.random.PRNGKey(7)
@@ -97,3 +99,27 @@ def test_batched_ghost_append_last_term(monkeypatch):
     for _ in range(150):
         a, b = t_b(a), t_p(b)
     assert_states_equal(jax.device_get(a), jax.device_get(b))
+
+
+def test_batched_scatter_kernel_matches_fallback(monkeypatch):
+    # Round 5: the deferred-write path runs through the Pallas one-hot
+    # scatter kernel (ops/deep_scatter.py) when buildable; the XLA flat
+    # put_along_axis fallback (RAFT_DISABLE_SCATTER_KERNEL) must be
+    # bit-identical through a churny fault-soup run (ghost appends,
+    # overwrites, restarts, dropped masked writes).
+    from raft_kotlin_tpu.ops import deep_scatter
+
+    cfg = RaftConfig(
+        n_groups=8, n_nodes=3, log_capacity=256, cmd_period=3,
+        p_drop=0.2, p_crash=0.02, p_restart=0.15, seed=41,
+    ).stressed(10)
+    st0 = init_state(cfg)
+    t_kernel = jax.jit(make_tick(cfg))
+    a = t_kernel(st0)  # trace NOW, while the kernel path is enabled
+    monkeypatch.setattr(deep_scatter, "DISABLE", True)
+    t_puts = jax.jit(make_tick(cfg))
+    b = t_puts(st0)
+    for _ in range(119):
+        a, b = t_kernel(a), t_puts(b)
+    assert_states_equal(jax.device_get(a), jax.device_get(b))
+    assert int(np.max(np.asarray(a.commit))) > 0
