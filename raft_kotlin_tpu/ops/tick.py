@@ -605,14 +605,22 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
         if flags.inject:
             due = due | jnp.any(aux["inject"] >= 0)
         ew_rows, ew_ok, ew_v = [], [], []
+        need_any = jnp.zeros((), dtype=bool)
         for n in range(1, N + 1):
             li_e = col("last_index", n).astype(_I32)
+            # Only GHOST-STATE nodes (phys > li) can consume the window —
+            # see the main-refill gate note — so only they wake the cond.
+            ghosty_e = col("phys_len", n).astype(_I32) > li_e
             for j in range(W_T):
                 tw = (n - 1) * W_T + j
                 r = li_e + j
                 ew_rows.append((n - 1) * C + jnp.clip(r, 0, C - 1))
                 ew_ok.append(fcl["ok_topw"][tw] | ~((r >= 0) & (r < C)))
                 ew_v.append(r)
+                need_any = need_any | (~ew_ok[-1] & ghosty_e).any()
+        # Fire on command ticks (the consumer) AND only when some window
+        # row is actually missing for a node that could consume it.
+        due = due & need_any
 
         def _early_refill(_):
             vals = jnp.take_along_axis(
@@ -1006,45 +1014,77 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
                                   & inr(i32 - 1), True, l, i32 - 1,
                                   "f_ent_c", pi))
         for n in range(1, N + 1):
-            # The top window is refilled eagerly but SOFTLY (overflow is
-            # not an error — a later ghost-append consume flags ov itself).
+            # Top-window rows, gated on GHOST STATE (phys_len > last_index):
+            # a clean node can never consume f_topw (the §3 ghost consume
+            # requires slot != li, i.e. phys > li), so steady-state gates
+            # are all-False and the cond below skips the whole take; only
+            # post-truncation catch-up nodes demand rows.
+            ghosty = col("phys_len", n).astype(_I32) > li32f[n]
             for j in range(deep_cache.W_TOP):
                 tw = (n - 1) * deep_cache.W_TOP + j
-                t_entries.append((~fcl["ok_topw"][tw] & inr(li32f[n] + j),
+                t_entries.append((~fcl["ok_topw"][tw] & ghosty
+                                  & inr(li32f[n] + j),
                                   False, n, li32f[n] + j, "f_topw", tw))
 
         def fc_refill(entries, budget, log_arr, is_term):
-            rank = jnp.zeros((G,), _I32)
-            rows = jnp.zeros((budget, G), _I32)
-            iota_b = jax.lax.broadcasted_iota(_I32, (budget, G), 0)
-            ranks = []
-            for gate, hard, node, row, key, idx in entries:
-                ranks.append(rank)
-                hot = (iota_b == rank[None]) & gate[None]
-                rows = jnp.where(
-                    hot, ((node - 1) * C + jnp.clip(row, 0, C - 1))[None],
-                    rows)
-                rank = rank + gate.astype(_I32)
-            vals = jnp.take_along_axis(log_arr, rows, axis=0).astype(_I32)
-            # Overlay this tick's deferred (phase-0) writes: the take read
-            # the pre-tick backing store, the cache must hold the logical
-            # current value.
-            for n2 in range(1, N + 1):
-                for prow_w, pt_w, pc_w, pwr_w in pending[n2]:
-                    hit = pwr_w[None] & (
-                        rows == ((n2 - 1) * C + prow_w.astype(_I32))[None])
-                    pv = rt(pt_w if is_term else pc_w)
-                    vals = jnp.where(hit, pv[None], vals)
-            ov_over = jnp.zeros((G,), dtype=bool)
-            for (gate, hard, node, row, key, idx), r in zip(entries, ranks):
-                got = gate & (r < budget)
-                oh = (iota_b == r[None]) & got[None]
-                v = jnp.sum(jnp.where(oh, vals, 0), axis=0)
-                okk = deep_cache.ok_name(key)
-                fcl[key][idx] = jnp.where(got, v, fcl[key][idx])
-                fcl[okk][idx] = fcl[okk][idx] | got
-                if hard:
-                    ov_over = ov_over | (gate & ~got)
+            """Serve `entries` (ranked, budgeted) with one take over
+            `log_arr` — wrapped in lax.cond on ANY demand existing: in
+            steady state every read is patched by writes before it is
+            consumed, so most ticks skip the take (and its distribute
+            chain) entirely; only election/conflict ticks pay it."""
+            any_gate = jnp.zeros((), dtype=bool)
+            for gate, *_ in entries:
+                any_gate = any_gate | jnp.any(gate)
+            keys_idx = [(key, idx) for _, _, _, _, key, idx in entries]
+            cur_v = [fcl[key][idx] for key, idx in keys_idx]
+            cur_ok = [fcl[deep_cache.ok_name(key)][idx]
+                      for key, idx in keys_idx]
+
+            def do(_):
+                rank = jnp.zeros((G,), _I32)
+                rows = jnp.zeros((budget, G), _I32)
+                iota_b = jax.lax.broadcasted_iota(_I32, (budget, G), 0)
+                ranks = []
+                for gate, hard, node, row, key, idx in entries:
+                    ranks.append(rank)
+                    hot = (iota_b == rank[None]) & gate[None]
+                    rows = jnp.where(
+                        hot,
+                        ((node - 1) * C + jnp.clip(row, 0, C - 1))[None],
+                        rows)
+                    rank = rank + gate.astype(_I32)
+                vals = jnp.take_along_axis(log_arr, rows, axis=0).astype(_I32)
+                # Overlay this tick's deferred (phase-0) writes: the take
+                # read the pre-tick backing store, the cache must hold the
+                # logical current value.
+                for n2 in range(1, N + 1):
+                    for prow_w, pt_w, pc_w, pwr_w in pending[n2]:
+                        hit = pwr_w[None] & (
+                            rows == ((n2 - 1) * C
+                                     + prow_w.astype(_I32))[None])
+                        pv = rt(pt_w if is_term else pc_w)
+                        vals = jnp.where(hit, pv[None], vals)
+                ov_over = jnp.zeros((G,), dtype=bool)
+                out_v, out_ok = [], []
+                for (gate, hard, node, row, key, idx), r, cv, cok in zip(
+                        entries, ranks, cur_v, cur_ok):
+                    got = gate & (r < budget)
+                    oh = (iota_b == r[None]) & got[None]
+                    v = jnp.sum(jnp.where(oh, vals, 0), axis=0)
+                    out_v.append(jnp.where(got, v, cv))
+                    out_ok.append(cok | got)
+                    if hard:
+                        ov_over = ov_over | (gate & ~got)
+                return jnp.stack(out_v), jnp.stack(out_ok), ov_over
+
+            def skip_all(_):
+                return (jnp.stack(cur_v), jnp.stack(cur_ok),
+                        jnp.zeros((G,), dtype=bool))
+
+            nv, nok, ov_over = lax.cond(any_gate, do, skip_all, None)
+            for k2, (key, idx) in enumerate(keys_idx):
+                fcl[key][idx] = nv[k2]
+                fcl[deep_cache.ok_name(key)][idx] = nok[k2]
             return ov_over
 
         fc_ov["v"] = fc_ov["v"] | fc_refill(
