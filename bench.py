@@ -427,6 +427,9 @@ def main() -> None:
     deep_steps_per_sec = None
     deep_commit_total = None
     deep_ov = None
+    deep_parity_rate = None
+    deep_parity_n = None  # null = leg did not run (matches rate/impl)
+    deep_parity_impl = None
     deep_times = []
     deep_impl = "xla"
     deep_suspect_reasons = ["stage did not run"]
@@ -468,6 +471,23 @@ def main() -> None:
             deep_steps_per_sec = round(deep_g * deep_ticks / dbest, 1)
             deep_commit_total = dstats[deep_times.index(dbest)]["commit"]
             deep_ov = max(st.get("ov", 0) for st in dstats)
+            # Parity leg at the TRUE config-5 shape (C=10k): sampled groups
+            # vs the native C++ engine, same discipline as stages 3/4b.
+            # (The fc runner is differentially pinned to the plain engine —
+            # tests + the TPU-gated leg — and the plain engine is what
+            # parity_stage traces; impl is reported honestly as such.)
+            try:
+                deep_parity_rate, deep_parity_n, deep_parity_impl = \
+                    parity_stage(deep_cfg, int(os.environ.get(
+                        "RAFT_BENCH_DEEP_PARITY_GROUPS", 64)),
+                        deep_ticks, "xla")
+            except Exception as e:
+                # A missing parity leg is an integrity gap, not a clean
+                # record: mark the stage suspect (same as the other gates).
+                deep_suspect_reasons = list(deep_suspect_reasons) + [
+                    f"deep parity leg failed: {str(e)[:120]}"]
+                print(f"deep parity leg failed: {str(e)[:200]}",
+                      file=sys.stderr)
             break
         except Exception as e:
             print(f"deep-log stage failed at G={deep_g}: {str(e)[:300]}",
@@ -610,6 +630,9 @@ def main() -> None:
         # 1 if any rep's frontier cache overflowed and fell back to the
         # plain engine (that rep's time then includes both runs).
         "deeplog_ov_fallback": deep_ov,
+        "deeplog_parity_rate": deep_parity_rate,
+        "deeplog_parity_groups": deep_parity_n,
+        "deeplog_parity_impl": deep_parity_impl,
         "deeplog_rep_times_s": [round(t, 4) for t in deep_times],
         "deeplog_hbm_gb": round(deep_cfg.hbm_bytes() / 1e9, 2),
         "deeplog_suspect": bool(deep_suspect_reasons),
