@@ -220,15 +220,31 @@ def xla_only(cfg):
     yield scan_runner(make_tick(cfg)), "xla"
 
 
+def sharded_fc_candidate(cfg):
+    """The sharded frontier-cache runner over a 1-device mesh — the
+    production multi-chip engine (ops/deep_cache.make_sharded_deep_scan);
+    used by both the deep stage and the corner A/B so the two stay
+    comparable."""
+    from raft_kotlin_tpu.ops.deep_cache import make_sharded_deep_scan
+    from raft_kotlin_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(jax.devices()[:1])
+    yield (lambda n: make_sharded_deep_scan(cfg, mesh, n)), "shardmap-fcache"
+
+
 def deep_candidates(cfg):
-    """Deep-log stage backends, fastest first: the frontier-cache runner
-    (ops/deep_cache.py — steady-state reads served from cached frontier
-    values, budgeted refill take, OV fallback to the plain engine), then
-    the plain batched XLA engine. (The Pallas megakernel needs the whole
-    (N*C, tile) log block in VMEM — physically impossible at C=10k; see
-    ops/pallas_tick.py.)"""
+    """Deep-log stage backends, fastest first: the SHARDED frontier-cache
+    runner over a 1-device mesh (the production multi-chip engine; the
+    per-shard shard_map program measured FASTER than the same engine
+    under plain jit at this shape), the single-device frontier-cache
+    runner, then the plain batched XLA engine. All three are
+    bit-identical (differential suites + the TPU-gated leg). (The Pallas
+    megakernel needs the whole (N*C, tile) log block in VMEM — physically
+    impossible at C=10k; see ops/pallas_tick.py.)"""
     from raft_kotlin_tpu.ops.deep_cache import make_deep_scan
 
+    if jax.default_backend() != "cpu":
+        yield from sharded_fc_candidate(cfg)
     yield (lambda n: make_deep_scan(cfg, n)), "xla-fcache"
     yield from xla_only(cfg)
 
@@ -554,11 +570,14 @@ def main() -> None:
 
         yield scan_runner(make_tick(cfg_c)), "batched"
 
+
     # Production sharded routing (batched on TPU), the old flat engine, the
     # single-device batched comparator (VERDICT r04 item 2's "within ~20%"
     # target), and the single-device per-pair sliced comparator.
     corner_measure("shardeddeep_gsps", corner_proto, shardmap_candidates())
     if on_accel:
+        corner_measure("shardeddeep_fc_gsps", corner_proto,
+                       sharded_fc_candidate)
         corner_measure("shardeddeep_flat_gsps", corner_proto,
                        shardmap_candidates(batched=False))
     corner_measure("cornerdeep_batched_gsps", corner_proto,

@@ -221,23 +221,213 @@ def make_deep_scan(cfg, n_ticks: int, return_state: bool = False):
 
         return run_state
 
+    # Keyed by the summarize CALLABLE itself (held strongly — an id() key
+    # could be silently reused after GC and return another closure's
+    # reductions).
     jitted = {}
 
     def run(st, rng, summarize=None):
-        key = id(summarize)
-        if key not in jitted:
-            jitted[key] = (
+        if summarize not in jitted:
+            jitted[summarize] = (
                 jax.jit(lambda s, r, f: reductions(
                     *fc_scan(s, f, r), summarize)),
                 jax.jit(lambda s, r: reductions(
                     *plain_scan(s, None, r), summarize)),
             )
-        jfc, jplain = jitted[key]
+        jfc, jplain = jitted[summarize]
         fc = refill_jit(st)
         vals = {k: v for k, v in jfc(st, rng, fc).items()}
         if int(jax.device_get(vals["ov"])):
             vals = {k: v for k, v in jplain(st, rng).items()}
             vals["ov"] = jnp.ones((), _I32)
+        return vals
+
+    run.self_timed = True
+    return run
+
+
+def make_sharded_deep_scan(cfg, mesh, n_ticks: int,
+                           return_state: bool = False):
+    """The frontier-cache deep runner SHARDED over a device mesh — the
+    engine a multi-chip config-5 run executes per shard.
+
+    Division of labor follows parallel/mesh._make_shardmap_xla_tick: the
+    RNG/aux draws stay globally-sharded XLA OUTSIDE shard_map (counted
+    threefry under jax_threefry_partitionable — per-shard local draws
+    would produce different bits), while the phase lattice WITH the
+    frontier cache runs per shard (the cache arrays are groups-minor and
+    shard on their lane axis like every state array; the refill takes and
+    their lax.cond run shard-locally, so a quiet shard skips its takes
+    even while another is refilling). The initial cache fill also runs
+    inside shard_map — take_along_axis must never meet the SPMD
+    partitioner (the CPU blowup parallel/mesh.py documents).
+
+    OV handling matches make_deep_scan: one host check after the scan; on
+    overflow the call re-runs on the plain sharded batched engine
+    (parallel.mesh.make_sharded_run) — bits never depend on the cache.
+
+    run(state, rng=None[, summarize]) -> dict of host scalars (self_timed,
+    bench.measure contract); with return_state=True -> (state, ov)."""
+    import math
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from raft_kotlin_tpu.ops import tick as tick_mod
+    from raft_kotlin_tpu.parallel import mesh as mesh_mod
+
+    G = cfg.n_groups
+    n_dev = math.prod(mesh.devices.shape)
+    assert G % n_dev == 0, "pad_groups first"
+    flags = tick_mod.make_flags(cfg)
+    assert flags.batched, "make_sharded_deep_scan needs a batched config"
+    sfields = tick_mod.state_fields(flags)
+    lanes = P(None, ("dcn", "ici"))
+    FC = FIELDS
+
+    def refill_shard(state):
+        # Per-shard full cache fill (refill_all's math on local arrays;
+        # refill_all only reads .term for the lane width plus the four
+        # arrays below, so a light stand-in object suffices).
+        def body(ni, li, lt, lc):
+            fake = type("S", (), {})()
+            fake.term = ni[0]
+            fake.next_index = ni
+            fake.last_index = li
+            fake.log_term = lt
+            fake.log_cmd = lc
+            fc = refill_all(cfg, fake)
+            return tuple(fc[k] for k in FC)
+
+        outs = jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(P(None, None, ("dcn", "ici")),
+                      lanes,
+                      P(None, None, ("dcn", "ici")),
+                      P(None, None, ("dcn", "ici"))),
+            out_specs=(lanes,) * len(FC),
+            check_vma=False,
+        )(state.next_index, state.last_index, state.log_term, state.log_cmd)
+        return dict(zip(FC, outs))
+
+    def tick_fc(state, fc, rng):
+        base, tkeys, bkeys = rng
+        aux, flags2 = tick_mod.make_aux(cfg, base, tkeys, bkeys, state,
+                                        None, None)
+        aux_names = tuple(k for k in tick_mod.AUX_FIELDS if k in aux)
+        flat = tick_mod.flatten_state(cfg, state)
+        n_s, n_a = len(sfields), len(aux_names)
+
+        def body(*arrs):
+            s = dict(zip(sfields, arrs[:n_s]))
+            a = dict(zip(aux_names, arrs[n_s:n_s + n_a]))
+            fcd = dict(zip(FC, arrs[n_s + n_a:]))
+            el_dirty = tick_mod.phase_body(cfg, s, a, flags2, fcache=fcd)
+            ov = fcd.pop("ov")
+            return (tuple(s[k] for k in sfields)
+                    + tuple(fcd[k] for k in FC)
+                    + (el_dirty, ov[None, :]))
+
+        ins = ([flat[k] for k in sfields] + [aux[k] for k in aux_names]
+               + [fc[k] for k in FC])
+        outs = jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(lanes,) * len(ins),
+            out_specs=(lanes,) * (n_s + len(FC) + 2),
+            check_vma=False,
+        )(*ins)
+        s2 = dict(zip(sfields, outs[:n_s]))
+        fc2 = dict(zip(FC, outs[n_s:n_s + len(FC)]))
+        st2 = tick_mod.finish_tick(
+            cfg, tkeys, tick_mod.unflatten_state(cfg, s2),
+            outs[-2], state.tick)
+        return st2, fc2, outs[-1][0]
+
+    def scan_fc(st, rng):
+        fc0 = refill_shard(st)
+
+        def body(carry, _):
+            s, f, acc, ova = carry
+            s2, f2, ov = tick_fc(s, f, rng)
+            acc = acc + jnp.sum(s2.log_cmd[:, 0, :].astype(_I32))
+            return (s2, f2, acc, ova | jnp.any(ov)), None
+
+        carry0 = (st, fc0, jnp.zeros((), _I32), jnp.zeros((), bool))
+        (end, _, acc, ova), _ = jax.lax.scan(
+            body, carry0, None, length=n_ticks)
+        return end, acc, ova
+
+    # Plain sharded fallback: the per-tick shard_map BATCHED engine
+    # (parallel/mesh's deep route), scanned with the SAME rng operand the
+    # fc scan ran with — the OV rerun must reproduce the rep's bits, not
+    # the cfg-seed's (and is built ONCE, so an overflow rep pays execution,
+    # not a retrace).
+    plain_tick = mesh_mod._make_shardmap_xla_tick(cfg, mesh)
+
+    def scan_plain(st, rng):
+        def body(carry, _):
+            s, acc = carry
+            s2 = plain_tick(s, rng)
+            acc = acc + jnp.sum(s2.log_cmd[:, 0, :].astype(_I32))
+            return (s2, acc), None
+
+        (end, acc), _ = jax.lax.scan(
+            body, (st, jnp.zeros((), _I32)), None, length=n_ticks)
+        return end, acc
+
+    _rng_default: list = []
+
+    def default_rng():
+        if not _rng_default:
+            _rng_default.append(jax.jit(
+                lambda: tick_mod.make_rng(cfg),
+                out_shardings=(NamedSharding(mesh, P()),
+                               NamedSharding(mesh, lanes),
+                               NamedSharding(mesh, lanes)))())
+        return _rng_default[0]
+
+    if return_state:
+        jfc_s = jax.jit(scan_fc)
+        jplain_s = jax.jit(scan_plain)
+
+        def run_state(st, rng=None):
+            rng = rng if rng is not None else default_rng()
+            end, _, ova = jfc_s(st, rng)
+            ov = bool(jax.device_get(ova))
+            if ov:
+                end, _ = jplain_s(st, rng)
+            return end, ov
+
+        return run_state
+
+    # Keyed by the summarize CALLABLE itself (held strongly — an id() key
+    # could be silently reused after GC and return another closure's
+    # reductions).
+    jitted = {}
+
+    def run(st, rng=None, summarize=None):
+        rng = rng if rng is not None else default_rng()
+        if summarize not in jitted:
+            def reduced(s, r):
+                end, acc, ova = scan_fc(s, r)
+                out = {"rounds": jnp.sum(end.rounds), "livepin": acc,
+                       "ov": ova.astype(_I32)}
+                if summarize is not None:
+                    out.update(summarize(end))
+                return out
+
+            def reduced_plain(s, r):
+                end, acc = scan_plain(s, r)
+                out = {"rounds": jnp.sum(end.rounds), "livepin": acc,
+                       "ov": jnp.ones((), _I32)}
+                if summarize is not None:
+                    out.update(summarize(end))
+                return out
+
+            jitted[summarize] = (jax.jit(reduced), jax.jit(reduced_plain))
+        jfc, jplain = jitted[summarize]
+        vals = dict(jfc(st, rng).items())
+        if int(jax.device_get(vals["ov"])):
+            vals = dict(jplain(st, rng).items())
         return vals
 
     run.self_timed = True

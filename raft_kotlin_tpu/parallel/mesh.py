@@ -269,8 +269,11 @@ def make_sharded_run(cfg: RaftConfig, mesh: Mesh, n_ticks: int,
     elif cfg.uses_dyn_log:
         # Deep-log (dyn) configs: phase_body per shard — the SPMD
         # partitioner mishandles the per-lane gather/scatter program (see
-        # _make_shardmap_xla_tick, which also forces the PER-PAIR engine:
-        # sharded deep runs do NOT use the batched engine).
+        # _make_shardmap_xla_tick; round 5 routes accelerator shards to
+        # the BATCHED engine). For multi-TICK deep runs, the faster path
+        # is ops/deep_cache.make_sharded_deep_scan (the frontier-cache
+        # engine per shard) — it carries cache state across ticks, which
+        # this per-tick API cannot.
         shardmap_tick = _make_shardmap_xla_tick(cfg, mesh)
         tick_fn = lambda st, rng: shardmap_tick(st, rng)
     else:

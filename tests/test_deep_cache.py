@@ -86,3 +86,48 @@ def test_fc_runner_ov_fallback_bitexact(monkeypatch):
     end, ov = make_deep_scan(cfg, T, return_state=True)(init_state(cfg), rng)
     assert ov, "a 1-row budget must overflow under replication"
     assert_states_equal(_ref(cfg, T, rng), jax.device_get(end))
+
+
+@pytest.mark.slow
+def test_sharded_fc_runner_matches_unsharded():
+    # The sharded fc runner (shard_map per-shard cache + global aux draws)
+    # over the 8-virtual-device mesh must be bit-identical to the
+    # UNSHARDED per-tick batched engine, with the cache holding per shard.
+    from raft_kotlin_tpu.parallel.mesh import (
+        init_sharded, make_mesh, pad_groups)
+    from raft_kotlin_tpu.ops.deep_cache import make_sharded_deep_scan
+
+    mesh = make_mesh()
+    cfg = pad_groups(RaftConfig(n_groups=16, n_nodes=3, log_capacity=256,
+                                cmd_period=3, p_drop=0.2,
+                                seed=41).stressed(10), mesh)
+    T = 50
+    rng = make_rng(cfg)
+    ref = _ref(cfg, T, rng)
+    end, ov = make_sharded_deep_scan(cfg, mesh, T, return_state=True)(
+        init_sharded(cfg, mesh), rng)
+    assert not ov
+    assert_states_equal(ref, jax.device_get(end))
+
+
+@pytest.mark.slow
+def test_sharded_fc_ov_fallback_bitexact(monkeypatch):
+    # Starved budgets force OV on the sharded runner: the fallback must
+    # rerun the plain sharded engine WITH THE SAME rng operand and match
+    # the unsharded reference bit-for-bit.
+    from raft_kotlin_tpu.parallel.mesh import (
+        init_sharded, make_mesh, pad_groups)
+    from raft_kotlin_tpu.ops.deep_cache import make_sharded_deep_scan
+
+    monkeypatch.setattr(deep_cache, "TERM_BUDGET", 1)
+    monkeypatch.setattr(deep_cache, "CMD_BUDGET", 1)
+    mesh = make_mesh()
+    cfg = pad_groups(RaftConfig(n_groups=16, n_nodes=3, log_capacity=256,
+                                cmd_period=3, p_drop=0.2,
+                                seed=43).stressed(10), mesh)
+    T = 40
+    rng = make_rng(cfg)
+    end, ov = make_sharded_deep_scan(cfg, mesh, T, return_state=True)(
+        init_sharded(cfg, mesh), rng)
+    assert ov, "a 1-row budget must overflow under replication"
+    assert_states_equal(_ref(cfg, T, rng), jax.device_get(end))
