@@ -434,8 +434,17 @@ def main() -> None:
         p_drop=0.05, seed=3,
     ).stressed(10)
     deep_budget = int(os.environ.get("RAFT_BENCH_DEEPLOG_HBM", 13 * 10**9))
-    deep_g = max(128, (deep_proto.max_groups_for_hbm(
-        deep_budget, working_factor=3.5) // 128) * 128)
+    # Round the HBM-ceiling estimate UP to the next 512-lane multiple: the
+    # Pallas scatter kernel runs 4x wider tiles on 512-aligned G (128-lane
+    # tiles cost ~3 ms/tick more at this shape), the ceiling is an estimate
+    # with slack (wf=3.5), and the stage's shrink-on-OOM loop below handles
+    # the case where the rounded-up size genuinely does not fit.
+    deep_est = deep_proto.max_groups_for_hbm(deep_budget, working_factor=3.5)
+    deep_g = max(512, -(-deep_est // 512) * 512)
+    # First OOM retry steps DOWN to the round-down 512-multiple (the old
+    # conservative estimate) before the halving loop — an accurate ceiling
+    # should cost one 512 step, not half the stage's scale.
+    deep_g_floor = max(512, (deep_est // 512) * 512)
     if not on_accel:
         deep_g = 256
     deep_ticks = int(os.environ.get("RAFT_BENCH_DEEPLOG_TICKS", 30))
@@ -508,7 +517,12 @@ def main() -> None:
         except Exception as e:
             print(f"deep-log stage failed at G={deep_g}: {str(e)[:300]}",
                   file=sys.stderr)
-            smaller = max(128, (deep_g // 2 // 128) * 128)
+            if on_accel and deep_g > deep_g_floor:
+                smaller = deep_g_floor
+            elif on_accel:
+                smaller = max(512, (deep_g // 2 // 512) * 512)
+            else:
+                smaller = max(128, (deep_g // 2 // 128) * 128)
             if smaller == deep_g:
                 break  # can't shrink further; report nulls
             deep_g = smaller

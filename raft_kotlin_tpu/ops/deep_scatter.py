@@ -45,10 +45,16 @@ _G_TILES = (512, 256, 128)
 DISABLE = bool(os.environ.get("RAFT_DISABLE_SCATTER_KERNEL"))
 
 
-def _chunk(C: int):
-    """Largest divisor of C that keeps (Cb, tile) slabs of BOTH arrays in
-    VMEM; sublane blocks must be multiples of 8 (ops/deep_gather._chunk)."""
-    for d in range(min(C, 2000), 7, -1):
+def _chunk(C: int, tile: int, itemsize: int):
+    """Largest divisor of C that keeps the live (Cb, tile) slabs of BOTH
+    arrays (in + aliased out + row/val blocks, ~6 block-sized buffers)
+    inside the Mosaic scoped-VMEM budget; sublane blocks must be multiples
+    of 8 (ops/deep_gather._chunk). The cap scales INVERSELY with the lane
+    tile AND the log dtype width — at int16/tile 512 a 2000-row chunk is
+    ~12 MB of live blocks and Mosaic rejects the kernel (observed on
+    hardware at G=12 800)."""
+    cap = min(C, 2000, max(8, int(10e6 / (6 * itemsize * tile))))
+    for d in range(cap, 7, -1):
         if C % d == 0 and d % 8 == 0:
             return d
     return None
@@ -76,7 +82,7 @@ def build_scatter(N: int, C: int, K: int, ldt_name: str, G: int,
     tile = _tile(G, interpret)
     if tile is None:
         return None
-    Cb = _chunk(C)
+    Cb = _chunk(C, tile, ldt.itemsize)
     if Cb is None:
         return None
     n_chunks = C // Cb
