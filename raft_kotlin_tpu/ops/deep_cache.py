@@ -198,11 +198,7 @@ def make_deep_scan(cfg, n_ticks: int, return_state: bool = False):
     plain_scan = scan_of(lambda s, rng: tick_plain(s, rng=rng), False)
 
     def reductions(end, acc, ova, summarize):
-        out = {"rounds": jnp.sum(end.rounds), "livepin": acc,
-               "ov": ova.astype(_I32)}
-        if summarize is not None:
-            out.update(summarize(end))
-        return out
+        return _reduction(end, acc, ova.astype(_I32), summarize)
 
     refill_jit = jax.jit(lambda s: refill_all(cfg, s))
 
@@ -246,10 +242,132 @@ def make_deep_scan(cfg, n_ticks: int, return_state: bool = False):
     return run
 
 
+def _reduction(end, acc, ov, summarize):
+    """THE bench reduction contract (rounds / livepin / ov keys +
+    summarize extras) — one copy, shared by every runner here so the A/B
+    legs measure() compares can never desynchronize on it."""
+    out = {"rounds": jnp.sum(end.rounds), "livepin": acc, "ov": ov}
+    if summarize is not None:
+        out.update(summarize(end))
+    return out
+
+
+def _livepin_scan(tick, n_ticks):
+    """lax.scan of a per-tick sharded engine under the bench livepin
+    discipline (one log_cmd row observed through the carry every tick so
+    XLA cannot dead-carry-eliminate the payload chain — bench.measure's
+    elision trap), with optional per-tick trace emission. The single copy
+    of the plain-scan body shared by the non-fc sharded runners and the
+    fc runner's OV fallback; scan(st, rng[, with_trace]) ->
+    (end, livepin, trace_ys)."""
+    def scan(st, rng, with_trace=False):
+        def body(carry, _):
+            s, acc = carry
+            s2 = tick(s, rng)
+            acc = acc + jnp.sum(s2.log_cmd[:, 0, :].astype(_I32))
+            y = _trace_row(s2) if with_trace else None
+            return (s2, acc), y
+
+        (end, acc), ys = jax.lax.scan(
+            body, (st, jnp.zeros((), _I32)), None, length=n_ticks)
+        return end, acc, ys
+
+    return scan
+
+
+def _sharded_default_rng(cfg, mesh):
+    """Memoized default rng operand computed straight into its mesh
+    placement (init_sharded's pattern — a host-side make_rng + device_put
+    would raise on a multi-process mesh). Shared by every sharded runner
+    here so the out_shardings contract lives in exactly one place."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from raft_kotlin_tpu.ops import tick as tick_mod
+
+    lanes = P(None, ("dcn", "ici"))
+    memo: list = []
+
+    def default_rng():
+        if not memo:
+            memo.append(jax.jit(
+                lambda: tick_mod.make_rng(cfg),
+                out_shardings=(NamedSharding(mesh, P()),
+                               NamedSharding(mesh, lanes),
+                               NamedSharding(mesh, lanes)))())
+        return memo[0]
+
+    return default_rng
+
+
+def _make_sharded_plain_scan(cfg, mesh, n_ticks: int, engine: str,
+                             return_state: bool = False):
+    """The non-fc sharded deep runners behind make_sharded_deep_scan's
+    routing: the per-shard BATCHED or per-pair FLAT shard_map engine
+    (parallel.mesh._make_shardmap_xla_tick) scanned for n_ticks under the
+    SAME run contract as the fc runner (self_timed reduction dict /
+    (state, ov)) — ov is always False here, these engines carry no cache
+    to overflow."""
+    from raft_kotlin_tpu.parallel import mesh as mesh_mod
+
+    tick = mesh_mod._make_shardmap_xla_tick(
+        cfg, mesh, batched=(engine == "batched"))
+    scan = _livepin_scan(lambda s, rng: tick(s, rng), n_ticks)
+    default_rng = _sharded_default_rng(cfg, mesh)
+
+    if return_state:
+        jscan = jax.jit(scan)
+
+        def run_state(st, rng=None):
+            rng = rng if rng is not None else default_rng()
+            end, _, _ys = jscan(st, rng)
+            return end, False
+
+        return run_state
+
+    jitted = {}
+
+    def run(st, rng=None, summarize=None):
+        rng = rng if rng is not None else default_rng()
+        if summarize not in jitted:
+            def reduced(s, r):
+                end, acc, _ys = scan(s, r)
+                return _reduction(end, acc, jnp.zeros((), _I32), summarize)
+
+            jitted[summarize] = jax.jit(reduced)
+        return dict(jitted[summarize](st, rng).items())
+
+    run.self_timed = True
+    return run
+
+
+def _trace_row(st):
+    """The per-tick differential observable (native.oracle.TRACE_FIELDS) —
+    shared by the trace-mode scans the deep parity leg consumes."""
+    return {"role": st.role, "term": st.term, "commit": st.commit,
+            "last_index": st.last_index, "voted_for": st.voted_for,
+            "rounds": st.rounds, "up": st.up}
+
+
 def make_sharded_deep_scan(cfg, mesh, n_ticks: int,
-                           return_state: bool = False):
-    """The frontier-cache deep runner SHARDED over a device mesh — the
-    engine a multi-chip config-5 run executes per shard.
+                           return_state: bool = False,
+                           engine: str = "auto",
+                           trace: bool = False):
+    """The sharded deep-log runner — and, since round 6, the deep band's
+    engine ROUTER: `engine="auto"` (the default every production caller
+    uses) picks the per-shard engine ("fc" | "batched" | "flat") from
+    parallel.mesh.route_deep_engine's measured crossover table by the
+    (log capacity, per-shard lane width) SHAPE — no platform-class pick
+    remains. "fc"/"batched"/"flat" pin an engine explicitly (bench A/B
+    legs, differential tests). All three are bit-identical (the routing
+    differential suite pins them pairwise across the crossover).
+
+    `trace=True` (fc engine only — the deep parity leg's observable):
+    run(state[, rng]) -> (per-tick trace dict of (T, N, G) arrays over
+    native.oracle.TRACE_FIELDS, ov) — on cache overflow the trace is
+    re-collected from the plain sharded engine, so the published bits are
+    plain-engine bits either way (the usual OV contract).
+
+    The fc engine a multi-chip config-5 run executes per shard:
 
     Division of labor follows parallel/mesh._make_shardmap_xla_tick: the
     RNG/aux draws stay globally-sharded XLA OUTSIDE shard_map (counted
@@ -278,6 +396,23 @@ def make_sharded_deep_scan(cfg, mesh, n_ticks: int,
     G = cfg.n_groups
     n_dev = math.prod(mesh.devices.shape)
     assert G % n_dev == 0, "pad_groups first"
+    if engine == "auto":
+        if cfg.uses_mailbox:
+            # §10 deliveries make read rows depend on in-tick slot state:
+            # only the per-pair flat engine is valid under the mailbox
+            # (route_deep_engine's contract leaves this to the caller).
+            engine = "flat"
+        else:
+            engine = mesh_mod.route_deep_engine(
+                cfg.log_capacity, G // n_dev,
+                mesh.devices.flatten()[0].platform)
+    assert engine in ("fc", "batched", "flat"), engine
+    assert not (cfg.uses_mailbox and engine != "flat"), \
+        "mailbox configs support only the per-pair flat engine"
+    if engine != "fc":
+        assert not trace, "trace mode is the fc parity leg's observable"
+        return _make_sharded_plain_scan(cfg, mesh, n_ticks, engine,
+                                        return_state)
     flags = tick_mod.make_flags(cfg)
     assert flags.batched, "make_sharded_deep_scan needs a batched config"
     sfields = tick_mod.state_fields(flags)
@@ -298,7 +433,7 @@ def make_sharded_deep_scan(cfg, mesh, n_ticks: int,
             fc = refill_all(cfg, fake)
             return tuple(fc[k] for k in FC)
 
-        outs = jax.shard_map(
+        outs = mesh_mod.shard_map_compat(
             body, mesh=mesh,
             in_specs=(P(None, None, ("dcn", "ici")),
                       lanes,
@@ -329,7 +464,7 @@ def make_sharded_deep_scan(cfg, mesh, n_ticks: int,
 
         ins = ([flat[k] for k in sfields] + [aux[k] for k in aux_names]
                + [fc[k] for k in FC])
-        outs = jax.shard_map(
+        outs = mesh_mod.shard_map_compat(
             body, mesh=mesh,
             in_specs=(lanes,) * len(ins),
             out_specs=(lanes,) * (n_s + len(FC) + 2),
@@ -342,19 +477,20 @@ def make_sharded_deep_scan(cfg, mesh, n_ticks: int,
             outs[-2], state.tick)
         return st2, fc2, outs[-1][0]
 
-    def scan_fc(st, rng):
+    def scan_fc(st, rng, with_trace=False):
         fc0 = refill_shard(st)
 
         def body(carry, _):
             s, f, acc, ova = carry
             s2, f2, ov = tick_fc(s, f, rng)
             acc = acc + jnp.sum(s2.log_cmd[:, 0, :].astype(_I32))
-            return (s2, f2, acc, ova | jnp.any(ov)), None
+            y = _trace_row(s2) if with_trace else None
+            return (s2, f2, acc, ova | jnp.any(ov)), y
 
         carry0 = (st, fc0, jnp.zeros((), _I32), jnp.zeros((), bool))
-        (end, _, acc, ova), _ = jax.lax.scan(
+        (end, _, acc, ova), ys = jax.lax.scan(
             body, carry0, None, length=n_ticks)
-        return end, acc, ova
+        return end, acc, ova, ys
 
     # Plain sharded fallback: the per-tick shard_map BATCHED engine
     # (parallel/mesh's deep route), scanned with the SAME rng operand the
@@ -362,28 +498,27 @@ def make_sharded_deep_scan(cfg, mesh, n_ticks: int,
     # the cfg-seed's (and is built ONCE, so an overflow rep pays execution,
     # not a retrace).
     plain_tick = mesh_mod._make_shardmap_xla_tick(cfg, mesh)
+    scan_plain = _livepin_scan(lambda s, rng: plain_tick(s, rng), n_ticks)
 
-    def scan_plain(st, rng):
-        def body(carry, _):
-            s, acc = carry
-            s2 = plain_tick(s, rng)
-            acc = acc + jnp.sum(s2.log_cmd[:, 0, :].astype(_I32))
-            return (s2, acc), None
+    default_rng = _sharded_default_rng(cfg, mesh)
 
-        (end, acc), _ = jax.lax.scan(
-            body, (st, jnp.zeros((), _I32)), None, length=n_ticks)
-        return end, acc
+    if trace:
+        # Deep parity leg (VERDICT r5 next-round #6): the HEADLINE engine
+        # itself produces the differential observable. OV contract as
+        # everywhere: an overflow discards the fc trace and re-collects it
+        # from the plain sharded engine with the SAME rng operand.
+        jfc_t = jax.jit(lambda s, r: scan_fc(s, r, True))
+        jplain_t = jax.jit(lambda s, r: scan_plain(s, r, True))
 
-    _rng_default: list = []
+        def run_trace(st, rng=None):
+            rng = rng if rng is not None else default_rng()
+            _, _, ova, ys = jfc_t(st, rng)
+            ov = bool(jax.device_get(ova))
+            if ov:
+                _, _, ys = jplain_t(st, rng)
+            return jax.device_get(ys), ov
 
-    def default_rng():
-        if not _rng_default:
-            _rng_default.append(jax.jit(
-                lambda: tick_mod.make_rng(cfg),
-                out_shardings=(NamedSharding(mesh, P()),
-                               NamedSharding(mesh, lanes),
-                               NamedSharding(mesh, lanes)))())
-        return _rng_default[0]
+        return run_trace
 
     if return_state:
         jfc_s = jax.jit(scan_fc)
@@ -391,10 +526,10 @@ def make_sharded_deep_scan(cfg, mesh, n_ticks: int,
 
         def run_state(st, rng=None):
             rng = rng if rng is not None else default_rng()
-            end, _, ova = jfc_s(st, rng)
+            end, _, ova, _ys = jfc_s(st, rng)
             ov = bool(jax.device_get(ova))
             if ov:
-                end, _ = jplain_s(st, rng)
+                end, _, _ys = jplain_s(st, rng)
             return end, ov
 
         return run_state
@@ -408,20 +543,12 @@ def make_sharded_deep_scan(cfg, mesh, n_ticks: int,
         rng = rng if rng is not None else default_rng()
         if summarize not in jitted:
             def reduced(s, r):
-                end, acc, ova = scan_fc(s, r)
-                out = {"rounds": jnp.sum(end.rounds), "livepin": acc,
-                       "ov": ova.astype(_I32)}
-                if summarize is not None:
-                    out.update(summarize(end))
-                return out
+                end, acc, ova, _ys = scan_fc(s, r)
+                return _reduction(end, acc, ova.astype(_I32), summarize)
 
             def reduced_plain(s, r):
-                end, acc = scan_plain(s, r)
-                out = {"rounds": jnp.sum(end.rounds), "livepin": acc,
-                       "ov": jnp.ones((), _I32)}
-                if summarize is not None:
-                    out.update(summarize(end))
-                return out
+                end, acc, _ys = scan_plain(s, r)
+                return _reduction(end, acc, jnp.ones((), _I32), summarize)
 
             jitted[summarize] = (jax.jit(reduced), jax.jit(reduced_plain))
         jfc, jplain = jitted[summarize]

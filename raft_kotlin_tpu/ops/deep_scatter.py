@@ -4,27 +4,50 @@ The batched deep engine ends each tick with 2 XLA scatters (term + cmd)
 applying ~K resolved rows per node (ops/tick.py deferred writes). The
 round-5 probe model: an XLA scatter's cost scales with OPERAND SIZE (it
 materializes a copy unless the while-body donates in place), and even the
-donated in-context form pays tens of ms at config-5 scale. This kernel
-applies BOTH arrays' writes in ONE pass over the logs:
+donated in-context form pays tens of ms at config-5 scale. This module
+applies BOTH arrays' writes in ONE pass over the logs, as a K-deep one-hot
+select chain over (Cb, tile) slabs: `iota + chunk_offset == row` — compare
+shared by term and cmd (the two arrays write the same rows by
+construction). K is SMALL (~N+1 per node), so the VPU cost (K * C * G
+compares/selects) stays a few ms — the regime where one-hot beats
+gather/scatter lowering. (READS are the opposite: R~36 rows/node makes a
+one-hot read stream VPU-bound, which is why the read side uses XLA takes —
+ops/deep_gather.py docstring.) Rows are LOCAL slot indices in [0, C);
+row == C means "dropped" (masked write) and matches no slab row.
 
-- grid (node, C-chunk, G-tile); each step DMAs one (Cb, tile) slab of
-  log_term AND log_cmd (the whole log crosses HBM exactly once, read +
-  write, ~9 ms at config-5 scale);
-- the write is applied as a K-deep one-hot select chain over the slab:
-  `iota + chunk_offset == row` — compare shared by term and cmd (the two
-  arrays write the same rows by construction). K is SMALL (~N+1 per node),
-  so the VPU cost (K * C * G compares/selects) stays a few ms — the
-  regime where one-hot beats gather/scatter lowering. (READS are the
-  opposite: R~36 rows/node makes a one-hot read stream VPU-bound, which is
-  why the read side uses XLA takes — ops/deep_gather.py docstring.)
-- rows are LOCAL slot indices in [0, C); row == C means "dropped" (masked
-  write) and matches no slab row.
+Two kernel forms (round 6; ROUND5.md priced the grid form at ~22 ms/tick
+against a 9 ms whole-log DMA floor — 2.5x, the last identified write
+lever):
 
-Unlike ops/deep_gather.py (Mosaic's tpu.dynamic_gather 8-row limit), this
-kernel uses only compare/select primitives, so it compiles on real TPU.
-Caller contract: duplicate rows within a lane must already be resolved to
-identical values (the engine's chronological resolution pass), making the
-application order irrelevant.
+1. **DMA form (default)** — grid (N, G//tile) with the C-chunk loop INSIDE
+   the kernel as manual double-buffered `pltpu.make_async_copy` slabs over
+   logs kept in HBM (`memory_space=ANY`, input-output aliased):
+   - a slab only crosses HBM AT ALL if some lane of the tile writes into
+     it (a per-chunk any-hit test on the (K, tile) row block, which is
+     already VMEM-resident). The deferred writes cluster at the per-pair
+     frontier rows, so most (node, tile) steps touch a handful of chunks —
+     the whole-log round-trip "floor" of the grid form was never a floor
+     of the PROBLEM, only of its grid formulation;
+   - touched slabs are pipelined through 2 VMEM slots: chunk c+1's read
+     DMA is issued before chunk c's compute, and chunk c's write-back DMA
+     overlaps chunk c+1's compute — the explicit overlap the grid form's
+     aliased in/out blocks did not get from the automatic pipeliner;
+   - untouched slabs are preserved by the input/output aliasing (the
+     caller's donated buffer IS the output; XLA inserts the defensive copy
+     iff the operand is not donatable, so skipped slabs are correct either
+     way).
+2. **Grid form (fallback)** — the round-5 kernel: grid (N, G//tile,
+   C-chunk) with automatically pipelined (Cb, tile) blocks; every slab
+   crosses HBM read+write once. Selected by `RAFT_SCATTER_GRID=1`, by the
+   sticky module flag FORCE_GRID (bench.py flips it if the DMA form is
+   ever rejected by Mosaic, so one failed compile degrades the stage
+   instead of killing it), or when the DMA form has no valid chunking.
+
+Unlike ops/deep_gather.py (Mosaic's tpu.dynamic_gather 8-row limit), both
+forms use only compare/select primitives plus (for the DMA form) local
+async copies, so they compile on real TPU. Caller contract: duplicate rows
+within a lane must already be resolved to identical values (the engine's
+chronological resolution pass), making the application order irrelevant.
 """
 
 from __future__ import annotations
@@ -36,6 +59,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 _I32 = jnp.int32
 _G_TILES = (512, 256, 128)
@@ -45,15 +69,31 @@ _G_TILES = (512, 256, 128)
 DISABLE = bool(os.environ.get("RAFT_DISABLE_SCATTER_KERNEL"))
 
 
-def _chunk(C: int, tile: int, itemsize: int):
+def env_force_grid() -> bool:
+    """RAFT_SCATTER_GRID parsed as a real flag: '0'/'false'/'' mean OFF
+    (a plain truthiness test would read RAFT_SCATTER_GRID=0 — an operator
+    explicitly requesting the DMA form — as forcing the grid form)."""
+    return os.environ.get(
+        "RAFT_SCATTER_GRID", "").lower() not in ("", "0", "false")
+
+
+# Escape hatch for the DMA form only: fall back to the round-5 grid form.
+# STICKY when set by bench.py's candidate ladder — a Mosaic rejection of
+# the DMA form on some future backend downgrades every later build in the
+# process rather than failing the whole deep stage.
+FORCE_GRID = env_force_grid()
+
+
+def _chunk(C: int, tile: int, itemsize: int, n_bufs: int = 6):
     """Largest divisor of C that keeps the live (Cb, tile) slabs of BOTH
-    arrays (in + aliased out + row/val blocks, ~6 block-sized buffers)
-    inside the Mosaic scoped-VMEM budget; sublane blocks must be multiples
-    of 8 (ops/deep_gather._chunk). The cap scales INVERSELY with the lane
-    tile AND the log dtype width — at int16/tile 512 a 2000-row chunk is
-    ~12 MB of live blocks and Mosaic rejects the kernel (observed on
-    hardware at G=12 800)."""
-    cap = min(C, 2000, max(8, int(10e6 / (6 * itemsize * tile))))
+    arrays (~`n_bufs` block-sized buffers: in + aliased out + row/val
+    blocks for the grid form; 2 slots x 2 arrays + row/val blocks for the
+    DMA form) inside the Mosaic scoped-VMEM budget; sublane blocks must be
+    multiples of 8 (ops/deep_gather._chunk). The cap scales INVERSELY with
+    the lane tile AND the log dtype width — at int16/tile 512 a 2000-row
+    chunk is ~12 MB of live blocks and Mosaic rejects the kernel (observed
+    on hardware at G=12 800)."""
+    cap = min(C, 2000, max(8, int(10e6 / (n_bufs * itemsize * tile))))
     for d in range(cap, 7, -1):
         if C % d == 0 and d % 8 == 0:
             return d
@@ -69,19 +109,11 @@ def _tile(G: int, interpret: bool):
     return None
 
 
-@functools.lru_cache(maxsize=None)
-def build_scatter(N: int, C: int, K: int, ldt_name: str, G: int,
-                  interpret: bool):
-    """-> callable(log_term (N*C, G) ldt, log_cmd (N*C, G) ldt,
-                   rows (N*K, G) i32 LOCAL slots ([0, C); C = dropped),
-                   vals_t (N*K, G) ldt, vals_c (N*K, G) ldt)
-       -> (log_term', log_cmd') with per-lane writes applied.
-    Returns None when no supported tiling exists (caller falls back to XLA
-    scatters)."""
-    ldt = jnp.dtype(ldt_name)
-    tile = _tile(G, interpret)
-    if tile is None:
-        return None
+def _build_scatter_grid(N: int, C: int, K: int, ldt, G: int, tile: int,
+                        interpret: bool):
+    """Round-5 grid form: (node, G-tile, C-chunk) grid, every slab crosses
+    HBM once via the automatic block pipeliner. Returns (call, Kp) or
+    None."""
     Cb = _chunk(C, tile, ldt.itemsize)
     if Cb is None:
         return None
@@ -122,6 +154,163 @@ def build_scatter(N: int, C: int, K: int, ldt_name: str, G: int,
         input_output_aliases={3: 0, 4: 1},
         interpret=interpret,
     )
+    return call, Kp
+
+
+def _build_scatter_dma(N: int, C: int, K: int, ldt, G: int, tile: int,
+                       interpret: bool):
+    """DMA form: grid (node, G-tile); the chunk loop runs inside the kernel
+    over logs left in HBM, with per-chunk any-hit skipping and a depth-1
+    double-buffered pipeline (see module docstring). Returns (call, Kp) or
+    None."""
+    # Live VMEM: 2 slots x 2 arrays of (Cb, tile) ldt + the 3 (Kp, tile)
+    # row/val blocks — model it as 4 block buffers + slack.
+    Cb = _chunk(C, tile, ldt.itemsize, n_bufs=5)
+    if Cb is None:
+        return None
+    n_chunks = C // Cb
+    Kp = -(-K // 8) * 8
+
+    def kernel(rows_ref, vt_ref, vc_ref, lt_hbm, lc_hbm, ot_hbm, oc_hbm,
+               st_buf, sc_buf, sems):
+        n = pl.program_id(0)
+        i = pl.program_id(1)
+        r0 = n * C          # this node's first global log row
+        c0 = i * tile       # this tile's first lane column
+        rows = rows_ref[...]
+        # Per-chunk demand: does ANY lane of this tile write into chunk c?
+        # Dropped rows carry C and land in no chunk (c*Cb + Cb <= C).
+        hits = [jnp.any((rows >= c * Cb) & (rows < (c + 1) * Cb))
+                for c in range(n_chunks)]
+
+        def start_in(c, slot):
+            for hbm, buf, a in ((lt_hbm, st_buf, 0), (lc_hbm, sc_buf, 1)):
+                pltpu.make_async_copy(
+                    hbm.at[pl.ds(r0 + c * Cb, Cb), pl.ds(c0, tile)],
+                    buf.at[slot], sems.at[slot, a, 0]).start()
+
+        def wait_in(c, slot):
+            for hbm, buf, a in ((lt_hbm, st_buf, 0), (lc_hbm, sc_buf, 1)):
+                pltpu.make_async_copy(
+                    hbm.at[pl.ds(r0 + c * Cb, Cb), pl.ds(c0, tile)],
+                    buf.at[slot], sems.at[slot, a, 0]).wait()
+
+        def start_out(c, slot):
+            for hbm, buf, a in ((ot_hbm, st_buf, 0), (oc_hbm, sc_buf, 1)):
+                pltpu.make_async_copy(
+                    buf.at[slot],
+                    hbm.at[pl.ds(r0 + c * Cb, Cb), pl.ds(c0, tile)],
+                    sems.at[slot, a, 1]).start()
+
+        def wait_out(c, slot):
+            for hbm, buf, a in ((ot_hbm, st_buf, 0), (oc_hbm, sc_buf, 1)):
+                pltpu.make_async_copy(
+                    buf.at[slot],
+                    hbm.at[pl.ds(r0 + c * Cb, Cb), pl.ds(c0, tile)],
+                    sems.at[slot, a, 1]).wait()
+
+        @pl.when(hits[0])
+        def _prologue():
+            start_in(0, 0)
+
+        # Per-slot drain bookkeeping (static, unrolled): pending[slot] is
+        # the LAST chunk whose write-back was started from that slot. Every
+        # started out-DMA is waited EXACTLY once — before the slot's next
+        # reuse, or in the epilogue — under the same hits[] predicate that
+        # started it, so no in-flight DMA or signaled semaphore can leak
+        # across grid steps regardless of how sparse the hit pattern is
+        # (the earlier scheme drained only under hits[c+1] & hits[c-1] and
+        # left middle-chunk write-backs undrained on sparse patterns).
+        pending = {}
+        for c in range(n_chunks):
+            slot, nslot = c % 2, (c + 1) % 2
+            if c + 1 < n_chunks:
+                # Drain the other slot's previous occupant before ANY
+                # reuse, then prefetch chunk c+1 into it while chunk c
+                # computes below.
+                p = pending.pop(nslot, None)
+                if p is not None:
+                    @pl.when(hits[p])
+                    def _drain(p=p, nslot=nslot):
+                        wait_out(p, nslot)
+
+                @pl.when(hits[c + 1])
+                def _prefetch(c=c, nslot=nslot):
+                    start_in(c + 1, nslot)
+
+            @pl.when(hits[c])
+            def _process(c=c, slot=slot):
+                wait_in(c, slot)
+                iot = lax.broadcasted_iota(_I32, (Cb, tile), 0) + c * Cb
+                blk_t, blk_c = st_buf[slot], sc_buf[slot]
+                for k in range(K):
+                    hit = iot == rows[k][None, :]
+                    blk_t = jnp.where(hit, vt_ref[k][None, :], blk_t)
+                    blk_c = jnp.where(hit, vc_ref[k][None, :], blk_c)
+                st_buf[slot] = blk_t
+                sc_buf[slot] = blk_c
+                start_out(c, slot)
+
+            pending[slot] = c  # outstanding iff hits[c] (matched wait)
+
+        # Epilogue: drain whatever is still outstanding on either slot —
+        # the next grid step reuses both.
+        for slot, p in sorted(pending.items()):
+            @pl.when(hits[p])
+            def _finish(p=p, slot=slot):
+                wait_out(p, slot)
+
+    call = pl.pallas_call(
+        kernel,
+        grid=(N, G // tile),
+        in_specs=[
+            pl.BlockSpec((Kp, tile), lambda n, i: (n, i)),
+            pl.BlockSpec((Kp, tile), lambda n, i: (n, i)),
+            pl.BlockSpec((Kp, tile), lambda n, i: (n, i)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((N * C, G), ldt),
+            jax.ShapeDtypeStruct((N * C, G), ldt),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2, Cb, tile), ldt),
+            pltpu.VMEM((2, Cb, tile), ldt),
+            pltpu.SemaphoreType.DMA((2, 2, 2)),  # (slot, array, direction)
+        ],
+        input_output_aliases={3: 0, 4: 1},
+        interpret=interpret,
+    )
+    return call, Kp
+
+
+@functools.lru_cache(maxsize=None)
+def build_scatter(N: int, C: int, K: int, ldt_name: str, G: int,
+                  interpret: bool, dma: bool = True):
+    """-> callable(log_term (N*C, G) ldt, log_cmd (N*C, G) ldt,
+                   rows (N*K, G) i32 LOCAL slots ([0, C); C = dropped),
+                   vals_t (N*K, G) ldt, vals_c (N*K, G) ldt)
+       -> (log_term', log_cmd') with per-lane writes applied.
+    `dma=False` pins the round-5 grid form (tests; bench's degraded-mode
+    candidate). Returns None when no supported tiling exists (caller falls
+    back to XLA scatters)."""
+    ldt = jnp.dtype(ldt_name)
+    tile = _tile(G, interpret)
+    if tile is None:
+        return None
+    built = None
+    if dma:
+        built = _build_scatter_dma(N, C, K, ldt, G, tile, interpret)
+    if built is None:
+        built = _build_scatter_grid(N, C, K, ldt, G, tile, interpret)
+    if built is None:
+        return None
+    call, Kp = built
 
     def padded_call(lt, lc, rows, vals_t, vals_c):
         def pad(r, fill):

@@ -258,10 +258,14 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
                 for q in range(1, N + 1):
                     pi = pair(n, q)
                     niq = prow("next_index", n, q).astype(_I32)
-                    for key, roff, val in (("f_pli", -2, tv),
-                                           ("f_ent_t", -1, tv),
-                                           ("f_ent_c", -1, cv)):
-                        hit = wr & (slot == niq + roff)
+                    # Merged overlay masks (r6): f_ent_t and f_ent_c live at
+                    # the same row (ni - 1), so the three keys share two hit
+                    # compares instead of computing three.
+                    hit2 = wr & (slot == niq - 2)
+                    hit1 = wr & (slot == niq - 1)
+                    for key, hit, val in (("f_pli", hit2, tv),
+                                          ("f_ent_t", hit1, tv),
+                                          ("f_ent_c", hit1, cv)):
                         fcl[key][pi] = jnp.where(hit, val, fcl[key][pi])
                         okk = deep_cache.ok_name(key)
                         fcl[okk][pi] = fcl[okk][pi] | hit
@@ -628,8 +632,13 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
             out_v, out_ok = [], []
             for k in range(N * W_T):
                 need = ~ew_ok[k]
-                v = jnp.where((ew_v[k] >= 0) & (ew_v[k] < C), vals[k], 0)
-                out_v.append(jnp.where(need, v, fcl["f_topw"][k]))
+                inr_k = (ew_v[k] >= 0) & (ew_v[k] < C)
+                v = jnp.where(inr_k, vals[k], 0)
+                # Out-of-range window rows STORE 0 instead of retaining the
+                # stale cached value they are about to be marked valid over
+                # — the bound()/oob convention every other refill path keeps
+                # (ADVICE r5 finding 1; rows outside [0, C) read as 0).
+                out_v.append(jnp.where(need | ~inr_k, v, fcl["f_topw"][k]))
                 out_ok.append(jnp.ones_like(fcl["ok_topw"][k]))
             return jnp.stack(out_v), jnp.stack(out_ok)
 
@@ -986,23 +995,21 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
         for l in range(1, N + 1):
             armed_f = col("hb_armed", l) & col("up", l)
             fire_pre[l] = armed_f & ~(col("hb_left", l) > 0)
-        for l in range(1, N + 1):
-            for p in range(1, N + 1):
-                i32 = i_all[(l, p)].astype(_I32)
-                pli_f = i32 - 2
-                skip_f = (pli_f >= 0) & ~(pli_f < li32f[l])
-                he_f = li32f[l] >= i32
-                skip_f = skip_f | (he_f & (i32 <= 0))
-                fc_cons[(l, p)] = fire_pre[l] & ~skip_f
-
-        # (gate, hard, target node, local row, cache key, cache row index)
+        # (gate, hard, target node, local row, cache key, cache row index);
+        # consumption masks and demand entries built in ONE pass per pair
+        # (r6 dead-op pruning: the masks' i32/he_f subterms are shared with
+        # the entry gates instead of being rebuilt in a second loop).
         t_entries, c_entries = [], []
         for l in range(1, N + 1):
             for p in range(1, N + 1):
                 pi = pair(l, p)
                 i32 = i_all[(l, p)].astype(_I32)
+                pli_f = i32 - 2
                 he_f = li32f[l] >= i32
-                cns = fc_cons[(l, p)]
+                skip_f = ((pli_f >= 0) & ~(pli_f < li32f[l])) \
+                    | (he_f & (i32 <= 0))
+                cns = fire_pre[l] & ~skip_f
+                fc_cons[(l, p)] = cns
                 t_entries.append((cns & ~fcl["ok_pli"][pi] & inr(i32 - 2),
                                   True, l, i32 - 2, "f_pli", pi))
                 t_entries.append((cns & he_f & ~fcl["ok_ent_t"][pi]
@@ -1026,71 +1033,90 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
                                   & inr(li32f[n] + j),
                                   False, n, li32f[n] + j, "f_topw", tw))
 
-        def fc_refill(entries, budget, log_arr, is_term):
-            """Serve `entries` (ranked, budgeted) with one take over
-            `log_arr` — wrapped in lax.cond on ANY demand existing: in
-            steady state every read is patched by writes before it is
-            consumed, so most ticks skip the take (and its distribute
-            chain) entirely; only election/conflict ticks pay it."""
+        def fc_refill_all(jobs):
+            """Serve every refill entry list (ranked, budgeted, one take
+            per log array) under ONE shared lax.cond (r6 consolidation:
+            the term and cmd takes used to carry separate conds with
+            separate distribute chains; election/conflict ticks fire them
+            together anyway, and steady-state ticks now skip both in a
+            single branch). In steady state every read is patched by
+            writes before it is consumed, so most ticks skip the takes
+            (and their distribute chains) entirely; only election/conflict
+            ticks pay them. `jobs` = [(entries, budget, log_arr,
+            is_term), ...]. A job whose gates are all-False inside a fired
+            cond takes nothing and changes nothing (got is False
+            everywhere), so the merge is bit-exact with the per-job
+            conds."""
             any_gate = jnp.zeros((), dtype=bool)
-            for gate, *_ in entries:
-                any_gate = any_gate | jnp.any(gate)
-            keys_idx = [(key, idx) for _, _, _, _, key, idx in entries]
-            cur_v = [fcl[key][idx] for key, idx in keys_idx]
-            cur_ok = [fcl[deep_cache.ok_name(key)][idx]
-                      for key, idx in keys_idx]
+            for entries, _b, _arr, _t in jobs:
+                for gate, *_ in entries:
+                    any_gate = any_gate | jnp.any(gate)
+            keys_idx = [[(key, idx) for _, _, _, _, key, idx in entries]
+                        for entries, _b, _arr, _t in jobs]
+            cur_v = [[fcl[key][idx] for key, idx in kj] for kj in keys_idx]
+            cur_ok = [[fcl[deep_cache.ok_name(key)][idx]
+                       for key, idx in kj] for kj in keys_idx]
 
             def do(_):
-                rank = jnp.zeros((G,), _I32)
-                rows = jnp.zeros((budget, G), _I32)
-                iota_b = jax.lax.broadcasted_iota(_I32, (budget, G), 0)
-                ranks = []
-                for gate, hard, node, row, key, idx in entries:
-                    ranks.append(rank)
-                    hot = (iota_b == rank[None]) & gate[None]
-                    rows = jnp.where(
-                        hot,
-                        ((node - 1) * C + jnp.clip(row, 0, C - 1))[None],
-                        rows)
-                    rank = rank + gate.astype(_I32)
-                vals = jnp.take_along_axis(log_arr, rows, axis=0).astype(_I32)
-                # Overlay this tick's deferred (phase-0) writes: the take
-                # read the pre-tick backing store, the cache must hold the
-                # logical current value.
-                for n2 in range(1, N + 1):
-                    for prow_w, pt_w, pc_w, pwr_w in pending[n2]:
-                        hit = pwr_w[None] & (
-                            rows == ((n2 - 1) * C
-                                     + prow_w.astype(_I32))[None])
-                        pv = rt(pt_w if is_term else pc_w)
-                        vals = jnp.where(hit, pv[None], vals)
                 ov_over = jnp.zeros((G,), dtype=bool)
-                out_v, out_ok = [], []
-                for (gate, hard, node, row, key, idx), r, cv, cok in zip(
-                        entries, ranks, cur_v, cur_ok):
-                    got = gate & (r < budget)
-                    oh = (iota_b == r[None]) & got[None]
-                    v = jnp.sum(jnp.where(oh, vals, 0), axis=0)
-                    out_v.append(jnp.where(got, v, cv))
-                    out_ok.append(cok | got)
-                    if hard:
-                        ov_over = ov_over | (gate & ~got)
-                return jnp.stack(out_v), jnp.stack(out_ok), ov_over
+                flat = []
+                for (entries, budget, log_arr, is_term), cvs, coks in zip(
+                        jobs, cur_v, cur_ok):
+                    rank = jnp.zeros((G,), _I32)
+                    rows = jnp.zeros((budget, G), _I32)
+                    iota_b = jax.lax.broadcasted_iota(_I32, (budget, G), 0)
+                    ranks = []
+                    for gate, hard, node, row, key, idx in entries:
+                        ranks.append(rank)
+                        hot = (iota_b == rank[None]) & gate[None]
+                        rows = jnp.where(
+                            hot,
+                            ((node - 1) * C
+                             + jnp.clip(row, 0, C - 1))[None],
+                            rows)
+                        rank = rank + gate.astype(_I32)
+                    vals = jnp.take_along_axis(
+                        log_arr, rows, axis=0).astype(_I32)
+                    # Overlay this tick's deferred (phase-0) writes: the
+                    # take read the pre-tick backing store, the cache must
+                    # hold the logical current value.
+                    for n2 in range(1, N + 1):
+                        for prow_w, pt_w, pc_w, pwr_w in pending[n2]:
+                            hit = pwr_w[None] & (
+                                rows == ((n2 - 1) * C
+                                         + prow_w.astype(_I32))[None])
+                            pv = rt(pt_w if is_term else pc_w)
+                            vals = jnp.where(hit, pv[None], vals)
+                    out_v, out_ok = [], []
+                    for (gate, hard, node, row, key, idx), r, cv, cok in \
+                            zip(entries, ranks, cvs, coks):
+                        got = gate & (r < budget)
+                        oh = (iota_b == r[None]) & got[None]
+                        v = jnp.sum(jnp.where(oh, vals, 0), axis=0)
+                        out_v.append(jnp.where(got, v, cv))
+                        out_ok.append(cok | got)
+                        if hard:
+                            ov_over = ov_over | (gate & ~got)
+                    flat += [jnp.stack(out_v), jnp.stack(out_ok)]
+                return tuple(flat) + (ov_over,)
 
             def skip_all(_):
-                return (jnp.stack(cur_v), jnp.stack(cur_ok),
-                        jnp.zeros((G,), dtype=bool))
+                flat = []
+                for cvs, coks in zip(cur_v, cur_ok):
+                    flat += [jnp.stack(cvs), jnp.stack(coks)]
+                return tuple(flat) + (jnp.zeros((G,), dtype=bool),)
 
-            nv, nok, ov_over = lax.cond(any_gate, do, skip_all, None)
-            for k2, (key, idx) in enumerate(keys_idx):
-                fcl[key][idx] = nv[k2]
-                fcl[deep_cache.ok_name(key)][idx] = nok[k2]
-            return ov_over
+            outs = lax.cond(any_gate, do, skip_all, None)
+            for j, kj in enumerate(keys_idx):
+                nv, nok = outs[2 * j], outs[2 * j + 1]
+                for k2, (key, idx) in enumerate(kj):
+                    fcl[key][idx] = nv[k2]
+                    fcl[deep_cache.ok_name(key)][idx] = nok[k2]
+            return outs[-1]
 
-        fc_ov["v"] = fc_ov["v"] | fc_refill(
-            t_entries, deep_cache.TERM_BUDGET, s["log_term"], True)
-        fc_ov["v"] = fc_ov["v"] | fc_refill(
-            c_entries, deep_cache.CMD_BUDGET, s["log_cmd"], False)
+        fc_ov["v"] = fc_ov["v"] | fc_refill_all(
+            [(t_entries, deep_cache.TERM_BUDGET, s["log_term"], True),
+             (c_entries, deep_cache.CMD_BUDGET, s["log_cmd"], False)])
 
     if batched_logs and not use_fc:
         # ALL of the tick's remaining log reads batched up front. Row
@@ -1210,10 +1236,11 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
                 # consume a stale value.
                 pi_lp = pair(l, p)
                 live_cons = fire & ~skip
+                in_pli = inr(pli)
                 plt = jnp.where(pli >= 0,
                                 bounded(pli, fcl["f_pli"][pi_lp]), -1)
-                fc_ov["v"] = fc_ov["v"] | (
-                    live_cons & inr(pli) & ~fcl["ok_pli"][pi_lp])
+                # Accumulated into fc_ov in ONE merged or below (r6).
+                ov_pli = live_cons & in_pli & ~fcl["ok_pli"][pi_lp]
             elif batched_logs:
                 raw_plt = bounded(pli, patch(
                     "log_term", l, brows_t[l][p - 1], bvals_t[l][p - 1]))
@@ -1228,10 +1255,13 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
                 p_plt_b = bounded(pli, fcl["f_ppli"][pi_lp])
                 live_cons = fire & ~skip  # post-underflow-quirk skip
                 need_e = live_cons & has_entry & inr(i - 1)
-                fc_ov["v"] = fc_ov["v"] | (need_e & ~fcl["ok_ent_t"][pi_lp])
-                fc_ov["v"] = fc_ov["v"] | (need_e & ~fcl["ok_ent_c"][pi_lp])
-                fc_ov["v"] = fc_ov["v"] | (
-                    live_cons & inr(pli) & ~fcl["ok_ppli"][pi_lp])
+                # ONE merged ov accumulation per pair (r6: four separate
+                # (G,) ors used to land here; the guard set is unchanged —
+                # boolean-or is associative, so the flag is bit-identical).
+                fc_ov["v"] = fc_ov["v"] | ov_pli | (
+                    need_e & (~fcl["ok_ent_t"][pi_lp]
+                              | ~fcl["ok_ent_c"][pi_lp])) | (
+                    live_cons & in_pli & ~fcl["ok_ppli"][pi_lp])
             elif batched_logs:
                 ent_t = bounded(i - 1, patch(
                     "log_term", l, brows_t[l][N + p - 1], bvals_t[l][N + p - 1]))
@@ -1311,10 +1341,15 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
             G_l = s["log_term"].shape[-1]
             Kmax = max(len(r) for r, _, _ in per_node.values())
             sc = None
-            if not deep_scatter.DISABLE:
+            backend = jax.default_backend()
+            # Gate on tpu/cpu (ADVICE r5 finding 2): on any OTHER
+            # accelerator the Mosaic-shaped kernel fails at compile time
+            # inside the jitted tick with no fallback; the XLA flat-scatter
+            # branch below works everywhere.
+            if not deep_scatter.DISABLE and backend in ("tpu", "cpu"):
                 sc = deep_scatter.build_scatter(
-                    N, C, Kmax, str(ldt_b), G_l,
-                    jax.default_backend() == "cpu")
+                    N, C, Kmax, str(ldt_b), G_l, backend == "cpu",
+                    dma=not deep_scatter.FORCE_GRID)
             if sc is not None:
                 # One Pallas pass over both logs: the whole log crosses HBM
                 # exactly once (read + write) and the K-deep one-hot select

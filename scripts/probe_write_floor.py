@@ -1,0 +1,192 @@
+"""TPU probe: which hardware floor binds the deep-log WRITE pass (round 6).
+
+ROUND5.md attributed ~22 ms of the 47.8 ms config-5 deep tick to the Pallas
+one-hot write kernel against a 9 ms whole-log DMA floor; round 6 replaced
+the grid-form kernel with a double-buffered manual-DMA form that only moves
+slabs actually containing written rows (ops/deep_scatter.py). This probe
+pins, on the real chip, which floor the write pass now sits on:
+
+1. `copy_floor` — a bare kernel that DMAs every (Cb, tile) slab of both log
+   arrays HBM->VMEM->HBM with no compute: the whole-log round-trip floor
+   the round-5 form was priced against (~9 ms at config-5 scale).
+2. `scatter_grid` / `scatter_dma_*` — the round-5 grid kernel vs the
+   round-6 DMA kernel on the same operands, under two row distributions:
+   - `clustered`: all rows of a lane fall in ONE chunk-sized band (the
+     steady-state frontier shape — most slabs untouched, the skip pays);
+   - `uniform`: rows uniform over [0, C) (adversarial — nearly every slab
+     touched in some lane, the skip cannot pay and the DMA form must hold
+     ~the grid form's cost, not regress).
+3. `k_sweep` — the DMA kernel at K in {1, 8, 16} on uniform rows: separates
+   the select-chain VPU compute from the DMA cost (if time is flat in K,
+   DMA binds; if linear, the chain is the next lever).
+
+Decision tree for the writeup (ROUND6.md):
+- scatter_dma_clustered << copy_floor  -> the whole-log DMA floor no longer
+  binds the write pass at all; the remaining deep-tick gap lives in the
+  phase lattice / cache algebra (probe_phase_cuts.py) or issue latency.
+- scatter_dma_uniform ~= copy_floor    -> DMA-bound in the worst case, as
+  designed (the floor is per-touched-slab, and all slabs are touched).
+- scatter_dma_* >> copy_floor and flat in K -> per-chunk DMA issue latency
+  binds (many small conditional DMAs); fuse chunks or raise Cb.
+
+Writes one JSON line per measurement to stdout; run with
+  python scripts/probe_write_floor.py [G] [C] [N] [K]
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+jax.config.update("jax_compilation_cache_dir", os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+from raft_kotlin_tpu.ops import deep_scatter  # noqa: E402
+
+
+def timeit(fn, reps=3):
+    """fn(rep) -> scalar array; host materialization ends the timed region
+    and operands vary per rep (the axon-tunnel timing discipline every
+    probe in this tree uses)."""
+    float(fn(-1))
+    ts = []
+    for r in range(reps):
+        t0 = time.perf_counter()
+        float(fn(r))
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+def copy_floor_kernel(N, C, G, ldt, interpret):
+    """Whole-log HBM round trip (both arrays, read + write), no compute.
+    Returns None when no supported tiling exists (same graceful contract
+    as the scatter builders — the caller reports and moves on)."""
+    tile = deep_scatter._tile(G, interpret)
+    if tile is None:
+        return None
+    Cb = deep_scatter._chunk(C, tile, jnp.dtype(ldt).itemsize)
+    if Cb is None:
+        return None
+    n_chunks = C // Cb
+
+    def kernel(lt_ref, lc_ref, ot_ref, oc_ref):
+        ot_ref[...] = lt_ref[...]
+        oc_ref[...] = lc_ref[...]
+
+    spec = pl.BlockSpec((Cb, tile), lambda n, i, c: (n * n_chunks + c, i))
+    return pl.pallas_call(
+        kernel,
+        grid=(N, G // tile, n_chunks),
+        in_specs=[spec, spec],
+        out_specs=[spec, spec],
+        out_shape=[jax.ShapeDtypeStruct((N * C, G), ldt)] * 2,
+        input_output_aliases={0: 0, 1: 1},
+        interpret=interpret,
+    )
+
+
+def scan20(call, K, N, C, G, ldt, rows0, vals):
+    """20 applications with carry-dependent rows (nothing foldable)."""
+    @jax.jit
+    def run(lt, lc, rows, off):
+        def body(carry, c):
+            lt2, lc2 = carry
+            r = jnp.where(rows < C, (rows + c + off) % C, C)
+            lt2, lc2 = call(lt2, lc2, r, vals, vals)
+            return (lt2, lc2), None
+
+        (lt2, lc2), _ = jax.lax.scan(
+            body, (lt, lc), jnp.arange(20, dtype=jnp.int32))
+        return jnp.sum(lt2[0].astype(jnp.int32))
+
+    return run
+
+
+def main():
+    G = int(sys.argv[1]) if len(sys.argv) > 1 else 13_312
+    C = int(sys.argv[2]) if len(sys.argv) > 2 else 10_000
+    N = int(sys.argv[3]) if len(sys.argv) > 3 else 7
+    K = int(sys.argv[4]) if len(sys.argv) > 4 else 8
+    interpret = jax.default_backend() == "cpu"
+    if interpret:
+        # Smoke-scale on CPU so the probe is runnable (and CI-checkable)
+        # off-chip; the numbers only mean anything on the TPU.
+        G, C, N = 8, 1024, 3
+    ldt = jnp.int16
+    print(json.dumps({"devices": str(jax.devices()), "G": G, "C": C,
+                      "N": N, "K": K}), flush=True)
+    key = jax.random.PRNGKey(0)
+    lt = jax.random.randint(key, (N * C, G), 0, 90, jnp.int32).astype(ldt)
+    lc = (lt + 3).astype(ldt)
+
+    # 1. whole-log copy floor.
+    floor = copy_floor_kernel(N, C, G, ldt, interpret)
+    if floor is None:
+        print(json.dumps({"probe": "copy_floor", "error": "no tiling"}),
+              flush=True)
+    else:
+        @jax.jit
+        def floor_scan(a, b, off):
+            def body(carry, c):
+                a2, b2 = carry
+                return floor(a2, b2), None
+            (a2, b2), _ = jax.lax.scan(body, (a, b), jnp.arange(20))
+            return jnp.sum(a2[0].astype(jnp.int32)) + off
+
+        t = timeit(lambda rep: floor_scan(lt, lc, rep)) / 20
+        print(json.dumps({"probe": "copy_floor", "ms": round(t * 1e3, 3)}),
+              flush=True)
+
+    # 2. the two kernel forms x two row distributions.
+    kf = jax.random.split(key, 4)
+    uniform = jax.random.randint(kf[1], (N * K, G), 0, C, jnp.int32)
+    base = jax.random.randint(kf[2], (N, 1, G), 0, C - K, jnp.int32)
+    clustered = jnp.clip(
+        base + jnp.arange(K, dtype=jnp.int32)[None, :, None], 0, C - 1
+    ).reshape(N * K, G)
+    vals = jax.random.randint(kf[3], (N * K, G), 1, 50, jnp.int32).astype(ldt)
+    for dma in (False, True):
+        deep_scatter.build_scatter.cache_clear()
+        call = deep_scatter.build_scatter(
+            N, C, K, str(jnp.dtype(ldt)), G, interpret, dma=dma)
+        if call is None:
+            print(json.dumps({"probe": "scatter", "dma": dma,
+                              "error": "no tiling"}), flush=True)
+            continue
+        for dist, rows in (("clustered", clustered), ("uniform", uniform)):
+            run = scan20(call, K, N, C, G, ldt, rows, vals)
+            t = timeit(lambda rep: run(lt, lc, rows, rep)) / 20
+            print(json.dumps({
+                "probe": f"scatter_{'dma' if dma else 'grid'}_{dist}",
+                "ms": round(t * 1e3, 3)}), flush=True)
+
+    # 3. K sweep on the DMA form (uniform rows).
+    for Ks in (1, 8, 16):
+        deep_scatter.build_scatter.cache_clear()
+        call = deep_scatter.build_scatter(
+            N, C, Ks, str(jnp.dtype(ldt)), G, interpret, dma=True)
+        if call is None:
+            continue
+        rows = jax.random.randint(kf[1], (N * Ks, G), 0, C, jnp.int32)
+        v = jax.random.randint(kf[3], (N * Ks, G), 1, 50,
+                               jnp.int32).astype(ldt)
+        run = scan20(call, Ks, N, C, G, ldt, rows, v)
+        t = timeit(lambda rep: run(lt, lc, rows, rep)) / 20
+        print(json.dumps({"probe": "k_sweep_dma", "K": Ks,
+                          "ms": round(t * 1e3, 3)}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,56 @@
+"""bench.py's compact headline tail line (VERDICT r5 missing #3).
+
+The driver stores only the TAIL of bench output; the full JSON record
+outgrew that window in round 5, cutting the north-star fields out of the
+authoritative artifact. bench.emit_lines therefore ends the output with a
+compact line carrying exactly the headline fields — these tests parse that
+LAST line and require every headline field present and small enough that
+no plausible tail window can truncate it.
+"""
+
+import json
+
+import bench
+
+
+def _fake_record():
+    return {
+        "metric": "raft_group_steps_per_sec_per_chip",
+        "value": 39_600_000.0,
+        "elections_per_sec": 3_570_000.0,
+        "parity_rate": 1.0,
+        "deeplog_group_steps_per_sec": 258_008.2,
+        "deeplog_parity_rate": 1.0,
+        "deeplog_parity_impl": "shardmap-fcache",
+        "deeplog_ov_fallback": 0,
+        "suspect": False,
+        # plus the long tail of fields that overflowed the driver window
+        **{f"filler_{i}": [0.1234] * 8 for i in range(80)},
+    }
+
+
+def test_compact_headline_is_last_line_and_complete():
+    record = _fake_record()
+    lines = bench.emit_lines(record)
+    assert len(lines) == 2
+    # Full record first (unchanged contract for human readers/parsers)...
+    assert json.loads(lines[0]) == record
+    # ...compact headline LAST, with every headline field present and equal.
+    last = json.loads(lines[-1])
+    assert last["headline"] is True
+    for k in bench.HEADLINE_FIELDS:
+        assert k in last, k
+        assert last[k] == record[k], k
+    for k in bench.COMPACT_EXTRA_FIELDS:
+        assert k in last, k
+    # Small enough that the driver's tail window always captures it whole.
+    assert len(lines[-1]) < 400, lines[-1]
+
+
+def test_compact_headline_handles_missing_fields():
+    # A failed stage leaves fields None/absent — the compact line must
+    # still emit (null), never raise, or the whole artifact dies with it.
+    lines = bench.emit_lines({"value": 1.0, "suspect": True})
+    last = json.loads(lines[-1])
+    assert last["value"] == 1.0 and last["suspect"] is True
+    assert last["deeplog_group_steps_per_sec"] is None

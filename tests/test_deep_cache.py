@@ -9,7 +9,10 @@ so most are slow-marked; the TPU-gated leg lives in tests/test_tpu_pallas.py
 and the bench deep stage runs the engine end-to-end every round.
 """
 
+import dataclasses
+
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -104,7 +107,10 @@ def test_sharded_fc_runner_matches_unsharded():
     T = 50
     rng = make_rng(cfg)
     ref = _ref(cfg, T, rng)
-    end, ov = make_sharded_deep_scan(cfg, mesh, T, return_state=True)(
+    # engine pinned: on CPU the shape router would (correctly) pick the
+    # per-pair flat engine; this differential exists to pin the fc one.
+    end, ov = make_sharded_deep_scan(cfg, mesh, T, return_state=True,
+                                     engine="fc")(
         init_sharded(cfg, mesh), rng)
     assert not ov
     assert_states_equal(ref, jax.device_get(end))
@@ -127,7 +133,82 @@ def test_sharded_fc_ov_fallback_bitexact(monkeypatch):
                                 seed=43).stressed(10), mesh)
     T = 40
     rng = make_rng(cfg)
-    end, ov = make_sharded_deep_scan(cfg, mesh, T, return_state=True)(
+    end, ov = make_sharded_deep_scan(cfg, mesh, T, return_state=True,
+                                     engine="fc")(
         init_sharded(cfg, mesh), rng)
     assert ov, "a 1-row budget must overflow under replication"
     assert_states_equal(_ref(cfg, T, rng), jax.device_get(end))
+
+
+def test_refill_all_out_of_range_rows_read_zero():
+    # The 'rows outside [0, C) read as 0' invariant on the FULL refill:
+    # last_index near C pushes top-window rows past C, and next_index 0
+    # puts the pair frontiers at rows -2/-1 — all must refill as 0/valid
+    # even though the clipped backing rows hold nonzero garbage.
+    cfg = RaftConfig(n_groups=4, n_nodes=3, log_capacity=256, seed=0)
+    N, C, G = cfg.n_nodes, cfg.log_capacity, cfg.n_groups
+    st = init_state(cfg)
+    li = np.full((N, G), C - 1, np.int32)
+    st = dataclasses.replace(
+        st,
+        log_term=jnp.full((N, C, G), 9, st.log_term.dtype),
+        log_cmd=jnp.full((N, C, G), 8, st.log_cmd.dtype),
+        last_index=jnp.asarray(li, st.last_index.dtype),
+    )
+    fc = jax.device_get(deep_cache.refill_all(cfg, st))
+    W = deep_cache.W_TOP
+    for n in range(N):
+        # j = 0 is row C-1 (in range, reads the stored 9); j >= 1 is oob.
+        assert np.all(fc["f_topw"][n * W] == 9)
+        for j in range(1, W):
+            assert np.all(fc["f_topw"][n * W + j] == 0), (n, j)
+    assert fc["ok_topw"].all()
+    # next_index is 0 at init: frontier rows -2/-1 are oob -> 0, valid.
+    for k in ("f_pli", "f_ent_t", "f_ent_c", "f_ppli"):
+        assert np.all(fc[k] == 0), k
+        assert fc["ok_" + k[2:]].all(), k
+
+
+def test_early_refill_zeroes_out_of_range_window_rows():
+    # ADVICE r5 finding 1: the EARLY top-window refill used to mark ALL
+    # window rows valid while RETAINING the stale cached value of
+    # out-of-range rows. Stage a ghost-state node whose window straddles C
+    # with stale nonzero cached values, fire a command tick, and require
+    # the oob rows to come out 0/valid (the bound()/oob convention).
+    from raft_kotlin_tpu.ops import tick as tick_mod
+
+    cfg = RaftConfig(n_groups=4, n_nodes=3, log_capacity=256, cmd_period=2,
+                     seed=5).stressed(10)
+    N, C, G = cfg.n_nodes, cfg.log_capacity, cfg.n_groups
+    W = deep_cache.W_TOP
+    st = init_state(cfg)
+    li = np.zeros((N, G), np.int32)
+    pl_ = np.zeros((N, G), np.int32)
+    li[1, :] = C - 2      # node 2: window rows C-2, C-1, C, C+1
+    pl_[1, :] = C - 1     # ghost state (phys_len > last_index)
+    st = dataclasses.replace(
+        st,
+        last_index=jnp.asarray(li, st.last_index.dtype),
+        phys_len=jnp.asarray(pl_, st.phys_len.dtype),
+        tick=jnp.asarray(cfg.cmd_period, st.tick.dtype),
+    )
+    rng = tick_mod.make_rng(cfg)
+    base, tkeys, bkeys = rng
+    aux, flags = tick_mod.make_aux(cfg, base, tkeys, bkeys, st, None, None)
+    assert flags.batched and flags.periodic
+    assert bool(jnp.any(aux["periodic"][0] >= 0)), "must be a command tick"
+    fc = deep_cache.init_fields(N, G)
+    f_topw = np.zeros((N * W, G), np.int32)
+    f_topw[1 * W + 2, :] = 77   # stale garbage in node 2's oob rows
+    f_topw[1 * W + 3, :] = 88
+    fc["f_topw"] = jnp.asarray(f_topw)
+    s = tick_mod.flatten_state(cfg, st)
+    tick_mod.phase_body(cfg, s, aux, flags, fcache=fc)
+    out = np.asarray(fc["f_topw"])
+    ok = np.asarray(fc["ok_topw"])
+    assert ok[1 * W + 2].all() and ok[1 * W + 3].all()
+    assert np.all(out[1 * W + 2] == 0), out[1 * W + 2]
+    assert np.all(out[1 * W + 3] == 0), out[1 * W + 3]
+    # The in-range rows refilled from the real log (zeros here), valid.
+    assert ok[1 * W].all() and ok[1 * W + 1].all()
+    assert np.all(out[1 * W] == 0) and np.all(out[1 * W + 1] == 0)

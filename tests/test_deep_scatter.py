@@ -1,0 +1,94 @@
+"""ops/deep_scatter — both write-kernel forms behind the deep engine.
+
+The round-6 DMA form (double-buffered manual slabs, touched-chunk skipping)
+and the round-5 grid form must be bit-equivalent to a reference scatter on
+random data — including multi-chunk capacities (the in-kernel pipeline),
+sublane padding (K not a multiple of 8), dropped rows (row == C) and both
+log dtypes — and the DMA form's chunk skipping must leave untouched slabs
+bit-identical through the input/output aliasing. End-to-end coverage rides
+tests/test_deep_gather.py::test_batched_scatter_kernel_matches_fallback
+(the churny fault-soup differential vs the XLA puts path) and the TPU-gated
+leg in tests/test_tpu_pallas.py.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_kotlin_tpu.ops import deep_scatter
+
+
+def _ref_apply(lt, lc, rows, vt, vc, N, C, K, G):
+    lt, lc = np.array(lt), np.array(lc)
+    for n in range(N):
+        for k in range(K):
+            for g in range(G):
+                r = int(rows[n * K + k, g])
+                if r < C:
+                    lt[n * C + r, g] = vt[n * K + k, g]
+                    lc[n * C + r, g] = vc[n * K + k, g]
+    return lt, lc
+
+
+def _case(key, N, C, K, G, ldt):
+    ks = jax.random.split(key, 5)
+    lt = jax.random.randint(ks[1], (N * C, G), -5, 90, jnp.int32).astype(ldt)
+    lc = jax.random.randint(ks[2], (N * C, G), 0, 70, jnp.int32).astype(ldt)
+    rows = jnp.minimum(
+        jax.random.randint(ks[3], (N * K, G), 0, C + 3, jnp.int32), C)
+    vt = jax.random.randint(ks[4], (N * K, G), 1, 50, jnp.int32)
+    vc = vt + 7
+    # Caller contract: duplicate rows within a lane pre-resolved to the
+    # LAST write's value (the engine's chronological resolution pass).
+    rnp, vtn, vcn = np.array(rows), np.array(vt), np.array(vc)
+    for n in range(N):
+        for g in range(G):
+            last = {}
+            for k in range(K):
+                last[rnp[n * K + k, g]] = k
+            for k in range(K):
+                kk = last[rnp[n * K + k, g]]
+                vtn[n * K + k, g] = vtn[n * K + kk, g]
+                vcn[n * K + k, g] = vcn[n * K + kk, g]
+    return lt, lc, rows, rnp, vtn, vcn
+
+
+@pytest.mark.parametrize("dma", [True, False])
+def test_scatter_forms_match_reference(dma):
+    key = jax.random.PRNGKey(7)
+    # (3, 4096, 5, 8): multi-chunk (4 chunks of 1024 in interpret mode) +
+    # K padded 5 -> 8; (2, 64, 8, 16): single chunk, aligned K;
+    # (3, 256, 11, 8): the deep-band test capacity, K padded 11 -> 16.
+    for ldt in (jnp.int16, jnp.int32):
+        for (N, C, K, G) in ((3, 4096, 5, 8), (2, 64, 8, 16),
+                             (3, 256, 11, 8)):
+            key, sub = jax.random.split(key)
+            lt, lc, rows, rnp, vtn, vcn = _case(sub, N, C, K, G, ldt)
+            want_t, want_c = _ref_apply(lt, lc, rnp, vtn, vcn, N, C, K, G)
+            deep_scatter.build_scatter.cache_clear()
+            call = deep_scatter.build_scatter(
+                N, C, K, str(jnp.dtype(ldt)), G, True, dma=dma)
+            assert call is not None
+            ot, oc = call(lt, lc, rows,
+                          jnp.array(vtn).astype(ldt),
+                          jnp.array(vcn).astype(ldt))
+            assert np.array_equal(np.array(ot), want_t), (str(ldt), N, C, dma)
+            assert np.array_equal(np.array(oc), want_c), (str(ldt), N, C, dma)
+
+
+def test_dma_form_preserves_untouched_chunks():
+    # All rows dropped (row == C): the DMA form issues NO copies at all and
+    # the aliased output must be the input, bit for bit — the correctness
+    # contract the touched-chunk skipping rests on.
+    N, C, K, G = 3, 4096, 8, 8
+    key = jax.random.PRNGKey(3)
+    lt = jax.random.randint(key, (N * C, G), -9, 99, jnp.int32).astype(jnp.int16)
+    lc = (lt + 1).astype(jnp.int16)
+    rows = jnp.full((N * K, G), C, jnp.int32)
+    vals = jnp.full((N * K, G), 42, jnp.int16)
+    deep_scatter.build_scatter.cache_clear()
+    call = deep_scatter.build_scatter(N, C, K, "int16", G, True, dma=True)
+    ot, oc = call(lt, lc, rows, vals, vals)
+    assert np.array_equal(np.array(ot), np.array(lt))
+    assert np.array_equal(np.array(oc), np.array(lc))

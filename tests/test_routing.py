@@ -1,0 +1,91 @@
+"""Shape-aware deep-engine routing (parallel/mesh.route_deep_engine).
+
+Round 6 replaced the static platform-class engine pick with a measured
+crossover table: the router must reproduce every tabulated winner, keep the
+CPU compile-feasibility guard, and — the part that makes routing safe at
+all — every engine it can select (fc, batched, flat; sharded and
+single-device) must be bit-identical, so a routing decision can only ever
+cost time, never bits. The differential lattice runs at CPU-feasible
+shapes; the engines' code paths are shape-independent (the crossover only
+decides which one runs), and the TPU-shape crossover itself is pinned by
+the fast unit test plus bench.py's *_routing_match fields every round.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from conftest import assert_states_equal
+
+from raft_kotlin_tpu.models.state import init_state
+from raft_kotlin_tpu.ops.deep_cache import (
+    make_deep_scan, make_sharded_deep_scan)
+from raft_kotlin_tpu.ops.tick import make_rng, make_tick
+from raft_kotlin_tpu.parallel.mesh import (
+    DEEP_ROUTING_TABLE, init_sharded, make_mesh, pad_groups,
+    route_deep_engine)
+from raft_kotlin_tpu.utils.config import RaftConfig
+
+
+def test_router_matches_measured_table():
+    # Every tabulated shape routes to its own measured winner — the
+    # acceptance gate bench.py re-checks against live data every round.
+    for C, g, winner, _src in DEEP_ROUTING_TABLE:
+        assert route_deep_engine(C, g, "tpu") == winner, (C, g)
+    # The crossover is real: the production deep shape and the small
+    # corner land on DIFFERENT engines (BENCH_r05's own data).
+    assert route_deep_engine(10_000, 13_312, "tpu") == "fc"
+    assert route_deep_engine(1_024, 2_048, "tpu") == "batched"
+    # The true config-5 per-chip shard resolves (provisionally) to fc.
+    assert route_deep_engine(10_000, 3_328, "tpu") == "fc"
+    # CPU: compile-feasibility guard (XLA:CPU batched-program blowup),
+    # not a perf class — flat regardless of shape.
+    assert route_deep_engine(10_000, 13_312, "cpu") == "flat"
+    assert route_deep_engine(1_024, 2_048, "cpu") == "flat"
+    # Platform defaulting resolves without error.
+    assert route_deep_engine(64, 16) in ("fc", "batched", "flat")
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("G,C", [(16, 256), (8, 512)])
+def test_all_routable_engines_bit_identical(G, C):
+    """The full engine lattice at one (G, C) shape: single-device batched
+    (reference), per-pair sliced, per-pair flat, single-device fc, and the
+    three sharded engines over the 8-virtual-device mesh — all bit-exact
+    through a churny replication soup (drops, conflicts, ghost appends)."""
+    mesh = make_mesh()
+    cfg = pad_groups(RaftConfig(n_groups=G, n_nodes=3, log_capacity=C,
+                                cmd_period=3, p_drop=0.2,
+                                seed=41).stressed(10), mesh)
+    T = 40
+    rng = make_rng(cfg)
+    tick = jax.jit(make_tick(cfg))
+    st = init_state(cfg)
+    for _ in range(T):
+        st = tick(st, rng=rng)
+    ref = jax.device_get(st)
+    assert int(np.max(np.asarray(ref.last_index))) > 0  # soup did something
+
+    for label, kw in (("pp-sliced", dict(batched=False)),
+                      ("pp-flat", dict(batched=False, sharded=True))):
+        t2 = jax.jit(make_tick(cfg, **kw))
+        s2 = init_state(cfg)
+        for _ in range(T):
+            s2 = t2(s2, rng=rng)
+        assert_states_equal(ref, jax.device_get(s2))
+
+    end, _ov = make_deep_scan(cfg, T, return_state=True)(
+        init_state(cfg), rng)
+    assert_states_equal(ref, jax.device_get(end))
+
+    for engine in ("fc", "batched", "flat"):
+        run = make_sharded_deep_scan(cfg, mesh, T, return_state=True,
+                                     engine=engine)
+        end, _ov = run(init_sharded(cfg, mesh), rng)
+        assert_states_equal(ref, jax.device_get(end))
+
+    # Whatever the TPU table routes for this per-shard shape is an engine
+    # the lattice just proved bit-identical.
+    n_dev = len(jax.devices())
+    assert route_deep_engine(C, cfg.n_groups // n_dev, "tpu") in (
+        "fc", "batched", "flat")
