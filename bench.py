@@ -32,7 +32,9 @@ Stages (all on one chip; prints exactly ONE JSON line on stdout):
    minimum-traffic roofline anchor).
 7. **Engine corners** — C=1024 deep-band probes: the sharded shard_map+flat
    per-pair program (1-device mesh), the single-device sliced comparator, and
-   the mailbox+deep corner sliced-vs-flat pair (the BodyFlags.sharded payoff).
+   the mailbox+deep corner: per-pair sliced/flat (the BodyFlags.sharded
+   payoff) vs the r7 known-delivery batched and frontier-cache engines
+   (mbdeep_batched/mbdeep_fc), with the mailbox-dimension routing audit.
 
 Baseline derivation for `vs_baseline` (the reference publishes no numbers —
 BASELINE.md): the reference advances ONE group in real time at 1 tick = 100 ms
@@ -180,7 +182,13 @@ def median(xs):
 HEADLINE_FIELDS = ("value", "elections_per_sec", "parity_rate",
                    "deeplog_group_steps_per_sec", "suspect")
 COMPACT_EXTRA_FIELDS = ("deeplog_parity_rate", "deeplog_ov_fallback",
-                        "deeplog_parity_impl")
+                        "deeplog_parity_impl",
+                        # r7: the issue-latency roofline anchor and the
+                        # mailbox-deep engine legs (VERDICT r5 items 5b/4) —
+                        # in the tail so the authoritative artifact can
+                        # never lose them (tests/test_bench_headline.py).
+                        "latency_frac", "mbdeep_batched_gsps",
+                        "mbdeep_fc_gsps")
 
 
 def compact_headline(record: dict) -> str:
@@ -459,6 +467,26 @@ def main() -> None:
         (vpu_counts["arith"] + vpu_counts["move"]) / tick_s / vpu_peak, 3)
         if vpu_peak else None)
 
+    # Third roofline — ISSUE-LATENCY (VERDICT r5 next-round #5b): the
+    # headline sits ~5x under both the HBM and VPU ceilings; the serial
+    # dependency chain is the remaining candidate bound. chain_depth is the
+    # longest path through one phase-body pass (exact jaxpr-DAG walk);
+    # op_latency is MEASURED on this chip by sweeping a serial op chain
+    # (scripts/probe_issue_latency.py is the standalone sweep). latency_frac
+    # = (depth x t_op) / tick_s — the fraction of the tick the critical
+    # chain alone explains; near 1 means the tick IS its dependency chain.
+    from raft_kotlin_tpu.ops.opcount import (
+        measure_op_latency, phase_body_chain_depth)
+
+    try:
+        chain_depth = phase_body_chain_depth(cfg)
+        op_latency = measure_op_latency()
+    except Exception as e:
+        print(f"latency roofline failed: {str(e)[:200]}", file=sys.stderr)
+        chain_depth, op_latency = None, None
+    latency_frac = (round(chain_depth * op_latency / tick_s, 3)
+                    if chain_depth and op_latency else None)
+
     # XLA-vs-Pallas ratio on the same config (perf model; skip if headline
     # already fell back to XLA).
     if impl == "pallas":
@@ -681,9 +709,14 @@ def main() -> None:
             if batched is None:
                 from raft_kotlin_tpu.parallel.mesh import route_deep_engine
 
-                eng = ("flat" if cfg_cc.uses_mailbox or not on_accel else
-                       route_deep_engine(cfg_cc.log_capacity,
-                                         cfg_cc.n_groups))
+                # τ=0 mailbox pins per-pair flat; known-delivery mailbox
+                # (delay_lo >= 1) routes by shape like everything else.
+                eng = ("flat" if (cfg_cc.uses_mailbox
+                                  and not cfg_cc.known_delivery)
+                       or not on_accel
+                       else route_deep_engine(
+                           cfg_cc.log_capacity, cfg_cc.n_groups,
+                           mailbox=cfg_cc.uses_mailbox))
                 label = ("shardmap-flat" if eng == "flat"
                          else "shardmap-batched")
             else:
@@ -723,11 +756,31 @@ def main() -> None:
                    batched_candidates)
     corner_measure("cornerdeep_pp_sliced_gsps", corner_proto,
                    make_pair_candidates(False))
+    # Mailbox+deep corner (r7, VERDICT r5 item 4): the known-delivery
+    # batched and frontier-cache engines under the §10 mailbox vs the
+    # per-pair pair — the production async regime's engine A/B. The
+    # acceptance bar: mbdeep_batched_gsps >= cornerdeep_batched_gsps
+    # (the mailbox no longer pays a slower engine CLASS, only the §10
+    # slot algebra itself).
     mbdeep_cfg = dataclasses.replace(corner_proto, delay_lo=1, delay_hi=3)
     corner_measure("mbdeep_sliced_gsps", mbdeep_cfg,
                    make_pair_candidates(False))
     corner_measure("mbdeep_flat_gsps", mbdeep_cfg,
                    make_pair_candidates(True))
+    corner_measure("mbdeep_batched_gsps", mbdeep_cfg, batched_candidates)
+    if on_accel:
+        corner_measure("mbdeep_fc_gsps", mbdeep_cfg, sharded_fc_candidate)
+        corner_measure("mbdeep_sharded_gsps", mbdeep_cfg,
+                       shardmap_candidates())
+        # Shard_map-pinned batched/flat legs for the routing audit: the
+        # audit must compare all three engines through the SAME harness
+        # (fc only exists sharded), exactly as the sync corner audit does
+        # — the single-device mbdeep_batched/flat comparators above carry
+        # no shard_map dispatch cost and would skew the crossover.
+        corner_measure("mbdeep_shardedbatched_gsps", mbdeep_cfg,
+                       shardmap_candidates(batched=True))
+        corner_measure("mbdeep_shardedflat_gsps", mbdeep_cfg,
+                       shardmap_candidates(batched=False))
 
     # Stage 6b — the TRUE config-5 per-chip shard (VERDICT r5 missing #1):
     # a v4-32 run of BASELINE config 5 is ~100k/32 ≈ 3.1k groups per chip at
@@ -760,7 +813,7 @@ def main() -> None:
         c5_measure("config5_pershard_flat_gsps",
                    shardmap_candidates(batched=False))
 
-    def routing_check(C_shape, g_shape, measured):
+    def routing_check(C_shape, g_shape, measured, mailbox=False):
         """(routed, winner, match) for one benched shape: `measured` maps
         engine name -> gsps (None = leg failed). The match field is the
         acceptance gate for the static crossover table — a False here means
@@ -770,7 +823,7 @@ def main() -> None:
         if not on_accel or not vals:
             return None, None, None
         winner = max(vals, key=vals.get)
-        routed = route_deep_engine(C_shape, g_shape)
+        routed = route_deep_engine(C_shape, g_shape, mailbox=mailbox)
         return routed, winner, routed == winner
 
     c5_routed, c5_winner, c5_match = routing_check(
@@ -783,6 +836,17 @@ def main() -> None:
         {"fc": corner.get("shardeddeep_fc_gsps"),
          "batched": corner.get("shardeddeep_batched_gsps"),
          "flat": corner.get("shardeddeep_flat_gsps")})
+    # Mailbox-dimension routing audit (r7): the table's mailbox entries
+    # against this round's own mbdeep_* measurements at the corner shape —
+    # all three engines through the shard_map harness (like the sync
+    # corner audit), so shard_map dispatch cost cancels out of the
+    # crossover instead of being charged to fc alone.
+    mbdeep_routed, mbdeep_winner, mbdeep_match = routing_check(
+        corner_proto.log_capacity, corner_g,
+        {"fc": corner.get("mbdeep_fc_gsps"),
+         "batched": corner.get("mbdeep_shardedbatched_gsps"),
+         "flat": corner.get("mbdeep_shardedflat_gsps")},
+        mailbox=True)
 
     baseline_group_steps_per_sec = 10.0
     record = dict({
@@ -820,6 +884,12 @@ def main() -> None:
         "achieved_vpu_teraops": round(achieved_vpu / 1e12, 3),
         "vpu_frac": vpu_frac,
         "vpu_frac_upper": vpu_frac_upper,
+        # Third roofline: issue latency (chain depth x measured per-op
+        # latency vs the tick's wall time; scripts/probe_issue_latency.py).
+        "issue_chain_depth": chain_depth,
+        "op_latency_ns": (round(op_latency * 1e9, 2) if op_latency
+                          else None),
+        "latency_frac": latency_frac,
         "pallas_vs_xla": round(pallas_vs_xla, 2),
         "xla_ticks_per_sec": round(xla_ticks_per_sec, 2),
         # §10 mailbox stage (headline fault-soup config + 1-3-tick delays).
@@ -870,6 +940,12 @@ def main() -> None:
         "corner_routed": corner_routed,
         "corner_winner": corner_winner,
         "corner_routing_match": corner_match,
+        # Mailbox-deep corner (r7): known-delivery batched/fc engines under
+        # §10 delays vs the per-pair pair, plus the mailbox routing audit.
+        "mbdeep_delay_ticks": [mbdeep_cfg.delay_lo, mbdeep_cfg.delay_hi],
+        "mbdeep_routed": mbdeep_routed,
+        "mbdeep_winner": mbdeep_winner,
+        "mbdeep_routing_match": mbdeep_match,
         # Engine-corner probes (C=1024 deep band, G=corner_g, group-steps/s):
         # the sharded shard_map+flat program on a 1-device mesh, the
         # single-device per-pair sliced comparator, and the mailbox+deep

@@ -4,8 +4,11 @@ This is the L4 of the rebuild (SURVEY.md §1): where the reference exposes a per
 HTTP API — `GET /` dumps the log, `GET /cmd/{command}` appends a command locally with
 no leader check (reference RaftServer.kt:72-107) — the simulator exposes the same two
 verbs addressed by (group, node): `entries(g, n)` and `cmd(g, n, command)`. Commands
-are strings at this layer, interned to int32 vocabulary ids before they enter the
-kernel (SEMANTICS.md §2), and de-interned on the way out.
+are strings at this layer, interned to vocabulary ids before they enter the
+kernel (SEMANTICS.md §2), and de-interned on the way out. int32 logs get the
+unbounded 1<<30-based id space; int16 logs (the deep config-5 band) get a
+BOUNDED 16384-id vocabulary at 1<<14 (capacity-checked), so the HTTP surface
+can drive deep simulations too (VERDICT r5 weak #6).
 
 Injected commands are queued host-side and delivered in phase 0 of the NEXT tick via
 the kernel's `inject` argument (ops/tick.py) — the discretized equivalent of an HTTP
@@ -32,6 +35,17 @@ _NO_CMD = -1
 # cmd_period workload's raw tick values (ops/tick.py phase 0 writes cmd = tick index).
 INTERN_BASE = 1 << 30
 
+# int16 logs (VERDICT r5 weak #6): ids live in [1 << 14, 2^15) — a BOUNDED
+# vocabulary of 16384 commands that fits the narrow storage dtype, so the L4
+# API can drive the deep config-5 band (log_dtype="int16"). The same
+# no-collision argument holds as long as cmd_period tick values stay below
+# 1 << 14 — and an int16 run past 16384 ticks was already outside the dtype's
+# documented envelope (utils/config.log_dtype: stored commands must fit;
+# the cmd_period workload stores the tick index). intern() raises once the
+# capacity is exhausted rather than silently wrapping into workload space.
+INTERN_BASE16 = 1 << 14
+VOCAB_CAP16 = (1 << 15) - INTERN_BASE16  # 16384 interned commands
+
 
 class Simulator:
     """One live simulation: all groups x nodes, stepped on demand.
@@ -45,12 +59,14 @@ class Simulator:
         """impl: "xla", "pallas" (ops/pallas_tick.py megakernel), or "auto" —
         pallas when running on an accelerator with a lane-aligned group count,
         else xla. Both backends are bit-identical (shared phase_body)."""
-        if cfg.log_dtype != "int32":
-            raise ValueError(
-                "Simulator requires log_dtype='int32': interned command ids "
-                "start at 1<<30 (INTERN_BASE) and cannot be stored in narrow "
-                "logs. Narrow dtypes are for bounded headless sweeps "
-                "(make_run/bench) only.")
+        # log_dtype="int16" (the deep config-5 band) switches to the bounded
+        # 16384-id vocabulary at INTERN_BASE16; int32 keeps the unbounded
+        # 1<<30 base. Either way ids never collide with cmd_period's raw
+        # tick values within the dtype's documented envelope.
+        self._intern_base = (INTERN_BASE16 if cfg.log_dtype == "int16"
+                             else INTERN_BASE)
+        self._vocab_cap = (VOCAB_CAP16 if cfg.log_dtype == "int16"
+                           else None)
         self.cfg = cfg
         self._lock = threading.RLock()
         self._state = state if state is not None else init_state(cfg)
@@ -108,13 +124,19 @@ class Simulator:
     def intern(self, command: str) -> int:
         with self._lock:
             if command not in self._vocab:
-                self._vocab[command] = INTERN_BASE + len(self._rvocab)
+                if (self._vocab_cap is not None
+                        and len(self._rvocab) >= self._vocab_cap):
+                    raise ValueError(
+                        f"int16 vocabulary full ({self._vocab_cap} distinct "
+                        "commands): narrow logs bound the id space — use "
+                        "log_dtype='int32' for unbounded vocabularies")
+                self._vocab[command] = self._intern_base + len(self._rvocab)
                 self._rvocab.append(command)
             return self._vocab[command]
 
     def command_name(self, cmd_id: int) -> str:
         with self._lock:
-            k = cmd_id - INTERN_BASE
+            k = cmd_id - self._intern_base
             if 0 <= k < len(self._rvocab):
                 return self._rvocab[k]
             return str(cmd_id)  # ids injected by cmd_period workload are raw ticks

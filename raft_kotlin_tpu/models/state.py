@@ -100,6 +100,14 @@ class RaftState:
     # in-flight exchange slots per directed (owner, peer) pair, all (N, N, G) i32,
     # [owner-1, peer-1, g]. *_due is the relative delivery countdown (-1 = empty,
     # 0 = deliverable this tick); the rest are the request snapshot taken at send.
+    # KNOWN-DELIVERY invariant (cfg.known_delivery, i.e. delay_lo >= 1): a slot
+    # with due == 0 at tick start was filled on an EARLIER tick, and the pair's
+    # own send (which may refill it) runs AFTER its delivery in the canonical
+    # order — so the slot snapshots a tick reads (aq_pli above all: it names
+    # the delivery handler's prevLog row) are pre-tick state. The mailbox
+    # batched/fcache deep engines (ops/tick.py, r7) precompute the phase-5
+    # read set from exactly this invariant; τ=0 configs (where a slot can be
+    # filled and delivered within one tick) keep the per-pair engine.
     vq_due: Optional[jax.Array] = None    # vote slots (owner = candidate)
     vq_term: Optional[jax.Array] = None
     vq_lli: Optional[jax.Array] = None    # lastLogIndex

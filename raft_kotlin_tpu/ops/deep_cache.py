@@ -60,6 +60,15 @@ _I32 = jnp.int32
 
 # Pair-shaped value fields and the node-shaped top window, canonical order.
 PAIR_VALS = ("f_pli", "f_ent_t", "f_ent_c", "f_ppli")
+# MAILBOX-ONLY second-entry window (r7): row ni of the owner's log — the
+# entry AFTER the frontier. Under known-delivery batching a pair's delivery
+# and its next send share one tick, and the with_e shift makes the OLD
+# f_ent2 row the entry row the send consumes immediately — without this
+# window every same-tick advance+send would consume-invalid and OV the
+# whole call, permanently falling back in replication-heavy mailbox
+# regimes. Synchronous configs never read the post-shift entry row within
+# the shifting tick, so they do not carry (or pay maintenance for) these.
+PAIR_VALS_MB = ("f_ent2_t", "f_ent2_c")
 NODE_VALS = ("f_topw",)
 ALL_VALS = PAIR_VALS + NODE_VALS
 
@@ -74,18 +83,36 @@ def ok_name(k: str) -> str:
 
 FIELDS = ALL_VALS + tuple(ok_name(k) for k in ALL_VALS)
 
+
+def pair_vals_for(mailbox: bool) -> tuple:
+    """Pair-shaped value fields under a config class: the synchronous set,
+    plus the second-entry window for known-delivery mailbox configs."""
+    return PAIR_VALS + (PAIR_VALS_MB if mailbox else ())
+
+
+def fields_for(mailbox: bool) -> tuple:
+    """The cache dict's full field set (values + validity) per class —
+    the scan-carry layout every fc runner threads through its jit.
+    fields_for(False) == FIELDS (the synchronous layout, unchanged)."""
+    vals = pair_vals_for(mailbox) + NODE_VALS
+    return vals + tuple(ok_name(k) for k in vals)
+
+
 # Per-tick refill row budgets (term take, cmd take). Sized so that even a
 # whole-group election win (3 hard entries x N pairs for the winner) plus
 # the soft top-window top-ups fit; exceeding them is not an error, just an
-# OV fallback to the plain engine.
+# OV fallback to the plain engine. Mailbox configs carry the extra
+# second-entry-window and delivery demands, hence the wider _MB budgets.
 TERM_BUDGET = 40
 CMD_BUDGET = 12
+TERM_BUDGET_MB = 48
+CMD_BUDGET_MB = 18
 
 
-def init_fields(N: int, G: int) -> dict:
+def init_fields(N: int, G: int, mailbox: bool = False) -> dict:
     """All-invalid cache (cold start; runners call refill_all instead)."""
     fc = {}
-    for k in PAIR_VALS:
+    for k in pair_vals_for(mailbox):
         fc[k] = jnp.zeros((N * N, G), _I32)
         fc[ok_name(k)] = jnp.zeros((N * N, G), dtype=bool)
     fc["f_topw"] = jnp.zeros((N * W_TOP, G), _I32)
@@ -116,13 +143,23 @@ def refill_all(cfg, state) -> dict:
                                        0, C - 1))
         return rows
 
+    mb = cfg.uses_mailbox  # known-delivery fc configs carry f_ent2_*
     top_rows = [li[n - 1] + j for n in range(1, N + 1) for j in range(W_TOP)]
-    rows_t = (pair_rows(-2, True) + pair_rows(-1, True)
-              + pair_rows(-2, False)
-              + [(n - 1) * C + jnp.clip(top_rows[k], 0, C - 1)
-                 for n in range(1, N + 1)
-                 for k in range((n - 1) * W_TOP, n * W_TOP)])
-    rows_c = pair_rows(-1, True)
+    # (field, take rows, logical rows) segments, in take order.
+    segs_t = [("f_pli", pair_rows(-2, True), ni - 2),
+              ("f_ent_t", pair_rows(-1, True), ni - 1)]
+    segs_c = [("f_ent_c", pair_rows(-1, True), ni - 1)]
+    if mb:
+        segs_t.append(("f_ent2_t", pair_rows(0, True), ni))
+        segs_c.append(("f_ent2_c", pair_rows(0, True), ni))
+    segs_t.append(("f_ppli", pair_rows(-2, False), ni - 2))
+    segs_t.append(("f_topw",
+                   [(n - 1) * C + jnp.clip(top_rows[k], 0, C - 1)
+                    for n in range(1, N + 1)
+                    for k in range((n - 1) * W_TOP, n * W_TOP)],
+                   jnp.stack(top_rows)))
+    rows_t = sum((rows for _, rows, _ in segs_t), [])
+    rows_c = sum((rows for _, rows, _ in segs_c), [])
     vt = jnp.take_along_axis(lt, jnp.stack(rows_t), axis=0).astype(_I32)
     vc = jnp.take_along_axis(lc, jnp.stack(rows_c), axis=0).astype(_I32)
 
@@ -130,15 +167,14 @@ def refill_all(cfg, state) -> dict:
         # 0 outside [0, C) — the engine's log_gather convention.
         return jnp.where((rows >= 0) & (rows < C), vals, 0)
 
-    P = N * N
     fc = {}
-    fc["f_pli"] = bound(vt[:P], ni - 2)
-    fc["f_ent_t"] = bound(vt[P:2 * P], ni - 1)
-    fc["f_ppli"] = bound(vt[2 * P:3 * P], ni - 2)
-    fc["f_topw"] = bound(vt[3 * P:], jnp.stack(top_rows))
-    fc["f_ent_c"] = bound(vc, ni - 1)
-    for k in PAIR_VALS:
-        fc[ok_name(k)] = jnp.ones((P, G), dtype=bool)
+    for vals, segs in ((vt, segs_t), (vc, segs_c)):
+        at = 0
+        for key, rows, logical in segs:
+            fc[key] = bound(vals[at:at + len(rows)], logical)
+            at += len(rows)
+    for k in pair_vals_for(mb):
+        fc[ok_name(k)] = jnp.ones((N * N, G), dtype=bool)
     fc["ok_topw"] = jnp.ones((N * W_TOP, G), dtype=bool)
     return fc
 
@@ -356,10 +392,15 @@ def make_sharded_deep_scan(cfg, mesh, n_ticks: int,
     engine ROUTER: `engine="auto"` (the default every production caller
     uses) picks the per-shard engine ("fc" | "batched" | "flat") from
     parallel.mesh.route_deep_engine's measured crossover table by the
-    (log capacity, per-shard lane width) SHAPE — no platform-class pick
-    remains. "fc"/"batched"/"flat" pin an engine explicitly (bench A/B
+    (log capacity, per-shard lane width, mailbox) SHAPE — no platform-class
+    pick remains. "fc"/"batched"/"flat" pin an engine explicitly (bench A/B
     legs, differential tests). All three are bit-identical (the routing
     differential suite pins them pairwise across the crossover).
+
+    §10 mailbox configs route through the same table for delay_lo >= 1
+    (the known-delivery regime, r7 — ops/tick.py batches the delivery read
+    set up front); τ=0 mailbox configs pin "flat" (per-pair) — the only
+    engine whose reads may depend on same-tick slot state.
 
     `trace=True` (fc engine only — the deep parity leg's observable):
     run(state[, rng]) -> (per-tick trace dict of (T, N, G) arrays over
@@ -397,18 +438,20 @@ def make_sharded_deep_scan(cfg, mesh, n_ticks: int,
     n_dev = math.prod(mesh.devices.shape)
     assert G % n_dev == 0, "pad_groups first"
     if engine == "auto":
-        if cfg.uses_mailbox:
-            # §10 deliveries make read rows depend on in-tick slot state:
-            # only the per-pair flat engine is valid under the mailbox
-            # (route_deep_engine's contract leaves this to the caller).
+        if cfg.uses_mailbox and not cfg.known_delivery:
+            # τ=0 mailbox: a slot can be filled AND delivered within one
+            # tick, so no pre-computable read set exists — per-pair flat
+            # only (route_deep_engine's contract leaves this to callers).
             engine = "flat"
         else:
             engine = mesh_mod.route_deep_engine(
                 cfg.log_capacity, G // n_dev,
-                mesh.devices.flatten()[0].platform)
+                mesh.devices.flatten()[0].platform,
+                mailbox=cfg.uses_mailbox)
     assert engine in ("fc", "batched", "flat"), engine
-    assert not (cfg.uses_mailbox and engine != "flat"), \
-        "mailbox configs support only the per-pair flat engine"
+    assert not (cfg.uses_mailbox and not cfg.known_delivery
+                and engine != "flat"), \
+        "τ=0 mailbox configs support only the per-pair flat engine"
     if engine != "fc":
         assert not trace, "trace mode is the fc parity leg's observable"
         return _make_sharded_plain_scan(cfg, mesh, n_ticks, engine,
@@ -417,7 +460,7 @@ def make_sharded_deep_scan(cfg, mesh, n_ticks: int,
     assert flags.batched, "make_sharded_deep_scan needs a batched config"
     sfields = tick_mod.state_fields(flags)
     lanes = P(None, ("dcn", "ici"))
-    FC = FIELDS
+    FC = fields_for(cfg.uses_mailbox)
 
     def refill_shard(state):
         # Per-shard full cache fill (refill_all's math on local arrays;

@@ -116,13 +116,126 @@ def count_jaxpr_ops(fn, *args) -> dict:
     return acc
 
 
-def phase_body_op_counts(cfg: RaftConfig, g_count: int = 256,
-                         flags: Optional[BodyFlags] = None) -> dict:
-    """Element-op counts of ONE phase_body pass at `cfg`, counted at
-    g_count lanes and scaled to cfg.n_groups (exact: every tensor in the
-    lattice carries the lane axis). Uses the Pallas kernel's interior
-    layout (rank-2, int32 interior, storage-dtype logs) so the count
-    anchors the megakernel's compute side."""
+# ---------------------------------------------------------------------------
+# Issue-latency roofline (VERDICT r5 next-round #5b): the headline megakernel
+# sits at ~17% of BOTH the HBM and VPU ceilings, and the round-5 account was
+# "serial dependency chains" with no measured bound. The third roofline is
+#   min tick time >= chain_depth x per-op issue latency
+# where chain_depth is the longest dependency path through one phase-body
+# pass (a jaxpr DAG walk, below) and the per-op latency is MEASURED on the
+# live chip by timing a serial op chain whose length is swept
+# (measure_op_latency; scripts/probe_issue_latency.py is the standalone
+# sweep). bench.py publishes latency_frac = (depth x t_op) / tick_seconds in
+# the headline tail: a value near 1 says the tick IS its dependency chain
+# and neither bandwidth nor issue-slot counting can explain it further.
+
+
+def _jaxpr_depth(jaxpr, in_depths):
+    """Longest dependency path: list of out-var depths given in-var depths.
+    Every non-free primitive adds 1 along its critical path (an estimate —
+    real issue latencies differ per op; the measured t_op absorbs the
+    average). cond takes the max over branches; while/scan count ONE body
+    pass (phase_body contains neither on the headline path — the guard
+    mirrors _walk's convention)."""
+    env = {}
+
+    def read(v):
+        if not hasattr(v, "aval"):  # literal
+            return 0
+        return env.get(id(v), 0)
+
+    for v, d in zip(jaxpr.invars, in_depths):
+        env[id(v)] = d
+    for eq in jaxpr.eqns:
+        prim = eq.primitive.name
+        din = max((read(v) for v in eq.invars), default=0)
+        sub = []
+        if prim == "cond":
+            sub = [b for b in eq.params["branches"]]
+        elif prim in ("scan", "while"):
+            key = "jaxpr" if prim == "scan" else "body_jaxpr"
+            sub = [eq.params[key]]
+        else:
+            for k in ("jaxpr", "call_jaxpr"):
+                if k in eq.params:
+                    sub = [eq.params[k]]
+                    break
+        if sub:
+            douts = []
+            for s in sub:
+                j = s.jaxpr if hasattr(s, "jaxpr") else s
+                ins = [read(v) for v in eq.invars][-len(j.invars):] \
+                    if len(j.invars) <= len(eq.invars) \
+                    else [din] * len(j.invars)
+                douts.append(_jaxpr_depth(j, ins))
+            dout = [max(ds[i] if i < len(ds) else din for ds in douts)
+                    for i in range(len(eq.outvars))]
+            for v, d in zip(eq.outvars, dout):
+                env[id(v)] = d
+            continue
+        d = din if prim in _FREE else din + 1
+        for v in eq.outvars:
+            env[id(v)] = d
+    return [read(v) for v in jaxpr.outvars]
+
+
+def count_jaxpr_depth(fn, *args) -> int:
+    """Longest dependency chain (op count) through fn(*args)'s jaxpr."""
+    jaxpr = jax.make_jaxpr(fn)(*args)
+    outs = _jaxpr_depth(jaxpr.jaxpr, [0] * len(jaxpr.jaxpr.invars))
+    return max(outs, default=0)
+
+
+def phase_body_chain_depth(cfg: RaftConfig, g_count: int = 128,
+                           flags: Optional[BodyFlags] = None) -> int:
+    """Longest dependency chain of ONE phase_body pass at `cfg` — the op
+    count of the serial critical path (independent of G: the lane axis is
+    data-parallel). The latency-roofline numerator."""
+    _, s_in, a_in, f = _phase_body_shapes(cfg, g_count, flags)
+    return count_jaxpr_depth(f, s_in, a_in)
+
+
+def time_op_chain(k: int, reps: int = 5) -> float:
+    """Min wall time (seconds) of a jitted serial chain of k dependent
+    xorshift rounds (2 elementwise ops per round — non-affine, so XLA
+    cannot algebraically collapse it) on one (8, 128) vreg-sized int32
+    block. The ONE chain/timing definition shared by measure_op_latency
+    (2-point slope, bench.py inline) and scripts/probe_issue_latency.py
+    (least-squares sweep) — so both publish the same t_op roofline."""
+    import time
+
+    x0 = jnp.arange(8 * 128, dtype=jnp.int32).reshape(8, 128)
+
+    @jax.jit
+    def f(x):
+        for _ in range(k):
+            x = x ^ (x << 1)  # 2 dependent ops per round
+        return x
+
+    jax.block_until_ready(f(x0))  # compile + warm
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(f(x0))
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+def measure_op_latency(chain: int = 2048, reps: int = 5):
+    """Measured per-op issue latency (seconds) on the CURRENT backend: time
+    the op chain at two lengths and take the slope (differencing removes
+    dispatch/launch overhead). Returns None if the measurement is
+    degenerate (e.g. a backend that folds the chain)."""
+    t1, t2 = time_op_chain(chain, reps), time_op_chain(2 * chain, reps)
+    slope = (t2 - t1) / chain  # Δrounds = chain -> seconds per round (2 ops)
+    if slope <= 0:
+        return None
+    return slope / 2
+
+
+def _phase_body_shapes(cfg, g_count, flags):
+    """Shared input-shape construction for the op-count and chain-depth
+    walks (one copy of the field/aux shape tables)."""
     from raft_kotlin_tpu.ops.pallas_tick import kernel_field_dtype
 
     N, C = cfg.n_nodes, cfg.log_capacity
@@ -171,7 +284,18 @@ def phase_body_op_counts(cfg: RaftConfig, g_count: int = 256,
         el = tick_mod.phase_body(cfg, s, aux, flags)
         return tuple(s[k] for k in sfields) + (el,)
 
+    return flags, s_in, a_in, f
+
+
+def phase_body_op_counts(cfg: RaftConfig, g_count: int = 256,
+                         flags: Optional[BodyFlags] = None) -> dict:
+    """Element-op counts of ONE phase_body pass at `cfg`, counted at
+    g_count lanes and scaled to cfg.n_groups (exact: every tensor in the
+    lattice carries the lane axis). Uses the Pallas kernel's interior
+    layout (rank-2, int32 interior, storage-dtype logs) so the count
+    anchors the megakernel's compute side."""
+    _, s_in, a_in, f = _phase_body_shapes(cfg, g_count, flags)
     acc = count_jaxpr_ops(f, s_in, a_in)
-    scale = cfg.n_groups / g
+    scale = cfg.n_groups / g_count
     return {"arith": int(acc["arith"] * scale),
             "move": int(acc["move"] * scale)}

@@ -131,10 +131,17 @@ class BodyFlags:
     dyn_log: bool = False
     # Deep-log BATCHED engine (phase-5 reads in 2 takes per node + deferred
     # duplicate-resolved write scatters): the single-device deep-log fast
-    # path. Off under the mailbox (deliveries make read rows depend on
-    # in-tick slot state) and off for SHARDED runs (the SPMD partitioner
-    # aborts on the batched gather/scatter program; per-shard widths are
-    # tiny anyway, so the per-pair engine costs little there).
+    # path. Under the §10 mailbox it additionally requires delay_lo >= 1 —
+    # the KNOWN-DELIVERY regime (r7): every delivery then consumes a slot
+    # filled on an EARLIER tick, so the whole phase-5 read set is
+    # computable at tick start (delivery prevLog rows are the slots' own
+    # aq_pli snapshots; a pair's next_index at its send is pre-tick ni + d
+    # with d in {-1, 0, +1} decided solely by that pair's single delivery
+    # this tick, so send reads live in the static window [ni-3, ni]). τ=0
+    # (delay_lo == 0) keeps the per-pair engine: a slot can be filled AND
+    # delivered within one tick, so no pre-computable read set exists.
+    # Also off when the SPMD partitioner would see the program (sharded
+    # runs route it through shard_map instead — parallel/mesh).
     batched: bool = False
     # True only for runs that are ACTUALLY sharded (parallel/mesh routes the
     # dyn tick through shard_map and sets this): the per-pair dyn engine then
@@ -213,9 +220,18 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
     # list, applying it at end of phase as one duplicate-resolved scatter
     # per node per array, and (c) overlays pending writes onto batched reads
     # at consume time (patch), preserving the canonical pair-order semantics
-    # bit-for-bit. The mailbox path interleaves deliveries with sends (reads
-    # depend on in-tick slot state), so it keeps the per-pair engine.
+    # bit-for-bit. The mailbox path interleaves deliveries with sends, but
+    # for delay_lo >= 1 every delivery is KNOWN at tick start and each
+    # pair's next_index moves by at most its own delivery's ±1 before its
+    # send — so the batch widens to a 4-candidate row window per pair plus
+    # the slots' own aq_pli snapshot rows and stays computable up front
+    # (see the mailbox branch of the batch builder below); only τ=0 keeps
+    # the per-pair engine.
     batched_logs = flags.batched
+    if batched_logs and flags.delay:
+        assert cfg.known_delivery, (
+            "batched deep engines under the mailbox need the known-delivery "
+            "regime (delay_lo >= 1); τ=0 configs keep the per-pair engine")
     logrow_c = None if flags.dyn_log else jax.lax.broadcasted_iota(_I32, (C, G), 0)
     # The columnar view pays off inside the Mosaic megakernel (grid rebuilds
     # measured ~31% of it); deep-log (dyn) configs are XLA-only, where the
@@ -245,8 +261,12 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
 
             # Unstack the cache to per-row lists for cheap (G,) updates in
             # the pair loop (the columnar-view trick); restacked at exit.
+            # Known-delivery mailbox configs carry the extra second-entry
+            # window fields (deep_cache.PAIR_VALS_MB).
+            fc_fields = deep_cache.fields_for(flags.delay)
+            fc_pvals = deep_cache.pair_vals_for(flags.delay)
             fcl = {k: [fcache[k][i] for i in range(fcache[k].shape[0])]
-                   for k in deep_cache.FIELDS}
+                   for k in fc_fields}
             fc_ov = {"v": jnp.zeros((G,), dtype=bool)}
 
             def fc_patch_write(n, wr, slot, term_v, cmd_v):
@@ -263,9 +283,15 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
                     # compares instead of computing three.
                     hit2 = wr & (slot == niq - 2)
                     hit1 = wr & (slot == niq - 1)
-                    for key, hit, val in (("f_pli", hit2, tv),
-                                          ("f_ent_t", hit1, tv),
-                                          ("f_ent_c", hit1, cv)):
+                    targets = [("f_pli", hit2, tv),
+                               ("f_ent_t", hit1, tv),
+                               ("f_ent_c", hit1, cv)]
+                    if flags.delay:
+                        # Second-entry window: row ni (PAIR_VALS_MB).
+                        hit0 = wr & (slot == niq)
+                        targets += [("f_ent2_t", hit0, tv),
+                                    ("f_ent2_c", hit0, cv)]
+                    for key, hit, val in targets:
                         fcl[key][pi] = jnp.where(hit, val, fcl[key][pi])
                         okk = deep_cache.ok_name(key)
                         fcl[okk][pi] = fcl[okk][pi] | hit
@@ -561,14 +587,20 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
             # become 0/valid. Its PHYSICAL log is untouched (§3 logical
             # wipe), so caches where it is the PEER stay correct; f_top's
             # row moves to last_index = 0, whose stale content is unknown.
+            # The mailbox second-entry window (row ni = 0) is IN range and
+            # may hold stale physical content — invalidated, not zeroed
+            # (refilled on demand after the node's next win-jump anyway).
             for a in range(1, N + 1):
                 ra = rst[a - 1]
                 for b in range(1, N + 1):
                     pi = (a - 1) * N + (b - 1)
-                    for k in deep_cache.PAIR_VALS:
+                    for k in fc_pvals:
                         okk = deep_cache.ok_name(k)
                         fcl[k][pi] = jnp.where(ra, 0, fcl[k][pi])
-                        fcl[okk][pi] = fcl[okk][pi] | ra
+                        if k in deep_cache.PAIR_VALS_MB:
+                            fcl[okk][pi] = fcl[okk][pi] & ~ra
+                        else:
+                            fcl[okk][pi] = fcl[okk][pi] | ra
                 for j in range(deep_cache.W_TOP):
                     tw = (a - 1) * deep_cache.W_TOP + j
                     fcl["ok_topw"][tw] = fcl["ok_topw"][tw] & ~ra
@@ -856,7 +888,7 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
             wa = win[a - 1]
             for b in range(1, N + 1):
                 pi = (a - 1) * N + (b - 1)
-                for k in deep_cache.PAIR_VALS:
+                for k in fc_pvals:
                     okk = deep_cache.ok_name(k)
                     fcl[okk][pi] = fcl[okk][pi] & ~wa
     s["round_state"] = jnp.where(win | dem, IDLE, s["round_state"])
@@ -930,8 +962,10 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
             wr_p, slot_p = add_info
             i32o = ni.astype(_I32)  # pre-update next_index (= pli + 2)
             o = {k: fcl[k][pi_lp] for k in
-                 ("f_pli", "f_ent_t", "f_ent_c", "f_ppli",
-                  "ok_pli", "ok_ent_t", "ok_ent_c", "ok_ppli")}
+                 (("f_pli", "f_ent_t", "f_ent_c", "f_ppli",
+                   "ok_pli", "ok_ent_t", "ok_ent_c", "ok_ppli")
+                  + (("f_ent2_t", "f_ent2_c", "ok_ent2_t", "ok_ent2_c")
+                     if flags.delay else ()))}
             zero = jnp.zeros((G,), _I32)
             no = jnp.zeros((G,), dtype=bool)
             # with_e: pli' = old entry row; entry row i is unknown until
@@ -950,15 +984,30 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
                     with_e, adv_ok, jnp.where(nfail, rec_ok, o[okk]))
 
             upd("f_pli", o["f_ent_t"], o["ok_ent_t"], zero, no)
-            upd("f_ent_t", zero, no, o["f_pli"], o["ok_pli"])
-            upd("f_ent_c", zero, no, zero, no)
+            if flags.delay:
+                # Known-delivery regime: the second-entry window rotates
+                # through the entry slot, so a same-tick advance+send
+                # consumes a VALID entry row (the whole point of
+                # PAIR_VALS_MB); recede shifts run the other way. The
+                # receded entry-cmd row (ni - 2's cmd) has no cache source
+                # — unknown, served by the refill on next consume.
+                upd("f_ent_t", o["f_ent2_t"], o["ok_ent2_t"],
+                    o["f_pli"], o["ok_pli"])
+                upd("f_ent_c", o["f_ent2_c"], o["ok_ent2_c"], zero, no)
+                upd("f_ent2_t", zero, no, o["f_ent_t"], o["ok_ent_t"])
+                upd("f_ent2_c", zero, no, o["f_ent_c"], o["ok_ent_c"])
+            else:
+                upd("f_ent_t", zero, no, o["f_pli"], o["ok_pli"])
+                upd("f_ent_c", zero, no, zero, no)
             upd("f_ppli", jnp.where(wrote_im1, ent_w, zero), wrote_im1,
                 zero, no)
 
-    def append_deliver(l, p):
+    def append_deliver(l, p, p_plt=None):
         # §10 delivery: response leg at the delivery tick; either-end failure voids
         # the exchange. No straggler guard — append responses always process
         # against live leader state (the reference never cancels them).
+        # `p_plt` may be supplied pre-gathered (the known-delivery batched /
+        # frontier-cache engines); None = gather inside append_exchange.
         due = prow("aq_due", l, p) == 0
         att = due & edge_ok(p, l)
         req = {k: prow(k, l, p) for k in
@@ -967,7 +1016,7 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
         put_pair("aq_due", l, p, due, jnp.full((G,), -1, dtype=s["aq_due"].dtype))
         append_exchange(l, p, att, req["aq_term"], req["aq_commit"],
                         req["aq_pli"], req["aq_plt"], req["aq_hase"] != 0,
-                        req["aq_ent_t"], req["aq_ent_c"])
+                        req["aq_ent_t"], req["aq_ent_c"], p_plt=p_plt)
 
     if use_columnar:
         enter_cols()  # phase 5 runs on the columnar view
@@ -1012,12 +1061,52 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
                 fc_cons[(l, p)] = cns
                 t_entries.append((cns & ~fcl["ok_pli"][pi] & inr(i32 - 2),
                                   True, l, i32 - 2, "f_pli", pi))
-                t_entries.append((cns & he_f & ~fcl["ok_ent_t"][pi]
+                # Entry-row demands: the SYNC engine consumes ent only when
+                # an entry exists (he_f); a MAILBOX send snapshots the
+                # PHYSICAL row i-1 into the slot for every attempt — the
+                # per-pair engine gathers it unconditionally, so heartbeat
+                # sends need the value too (dead payload when aq_hase is 0,
+                # but bit-visible slot state).
+                ent_gate = cns if flags.delay else cns & he_f
+                t_entries.append((ent_gate & ~fcl["ok_ent_t"][pi]
                                   & inr(i32 - 1), True, l, i32 - 1,
                                   "f_ent_t", pi))
-                t_entries.append((cns & ~fcl["ok_ppli"][pi] & inr(i32 - 2),
-                                  True, p, i32 - 2, "f_ppli", pi))
-                c_entries.append((cns & he_f & ~fcl["ok_ent_c"][pi]
+                if flags.delay:
+                    # Under the mailbox f_ppli is consumed by the DELIVERY
+                    # leg (the handler's prevLog check at the slot's own
+                    # aq_pli snapshot), not the send: demand it for due
+                    # slots whose snapshot still sits at the live frontier
+                    # (aq_pli == ni - 2; win-jumps/restarts break that and
+                    # the consume-time guard raises OV instead of reading
+                    # a row the cache cannot represent).
+                    due_p = prow("aq_due", l, p) == 0
+                    dcons = (due_p & edge_ok(p, l)
+                             & (prow("aq_pli", l, p).astype(_I32)
+                                == i32 - 2)
+                             & (li32f[p] > i32 - 2))
+                    t_entries.append((dcons & ~fcl["ok_ppli"][pi]
+                                      & inr(i32 - 2),
+                                      True, p, i32 - 2, "f_ppli", pi))
+                    # Second-entry window (PAIR_VALS_MB): consumed when a
+                    # due delivery WITH AN ENTRY (the only shift source)
+                    # advances the frontier and the SAME tick's send
+                    # snapshots the new physical row i-1 — the with_e
+                    # shift rotates f_ent2 into f_ent, so it must be valid
+                    # by then (no he gate: physical rows, see ent_gate).
+                    adv_p = (due_p & edge_ok(p, l)
+                             & (prow("aq_hase", l, p) != 0))
+                    g2 = cns & adv_p
+                    t_entries.append((g2 & ~fcl["ok_ent2_t"][pi]
+                                      & inr(i32), True, l, i32,
+                                      "f_ent2_t", pi))
+                    c_entries.append((g2 & ~fcl["ok_ent2_c"][pi]
+                                      & inr(i32), True, l, i32,
+                                      "f_ent2_c", pi))
+                else:
+                    t_entries.append((cns & ~fcl["ok_ppli"][pi]
+                                      & inr(i32 - 2),
+                                      True, p, i32 - 2, "f_ppli", pi))
+                c_entries.append((ent_gate & ~fcl["ok_ent_c"][pi]
                                   & inr(i32 - 1), True, l, i32 - 1,
                                   "f_ent_c", pi))
         for n in range(1, N + 1):
@@ -1114,43 +1203,91 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
                     fcl[deep_cache.ok_name(key)][idx] = nok[k2]
             return outs[-1]
 
+        tb = deep_cache.TERM_BUDGET_MB if flags.delay \
+            else deep_cache.TERM_BUDGET
+        cb = deep_cache.CMD_BUDGET_MB if flags.delay \
+            else deep_cache.CMD_BUDGET
         fc_ov["v"] = fc_ov["v"] | fc_refill_all(
-            [(t_entries, deep_cache.TERM_BUDGET, s["log_term"], True),
-             (c_entries, deep_cache.CMD_BUDGET, s["log_cmd"], False)])
+            [(t_entries, tb, s["log_term"], True),
+             (c_entries, cb, s["log_cmd"], False)])
 
     if batched_logs and not use_fc:
         # ALL of the tick's remaining log reads batched up front. Row
         # indices are known post-phase-4 (see the engine note above); writes
         # that land between here and a pair's consume point are overlaid by
-        # patch(). Node n's batch rows (log_term):
-        #   [0, N)    prevLog reads of n-as-leader (pli(n, q))
-        #   [N, 2N)   entry reads of n-as-leader (i(n, q) - 1)
-        #   [2N, 3N)  n-as-peer prevLog checks (pli(l, n))
-        #   3N        last_index - 1 (the tick-end last_term base)
-        #   [3N+1, 4N+1) n-as-peer GHOST rows (i(l, n) - 1): a §3 ghost
-        #     append (post-truncation, phys_len > last_index) writes slot
-        #     phys_len while moving last_index to i(l, n) + 1, so the
-        #     tick-end cache must read the STALE stored value at i(l, n) —
-        #     a row no write covers (the round-4 review's tick-129
-        #     last_term divergence; tests/test_deep_gather.py pins it).
-        # log_cmd rows: [0, N) entry reads. The final scatter needs no
-        # current-value rows: masked writes carry out-of-range rows and are
-        # DROPPED (mode="drop"), and duplicate real rows are pre-resolved to
-        # the last write's value.
+        # patch().
         i_all = {(a, b): prow("next_index", a, b)
                  for a in range(1, N + 1) for b in range(1, N + 1)}
-        T_LLT, T_GHOST = 3 * N, 3 * N + 1
         brows_t, bvals_t, brows_c, bvals_c = {}, {}, {}, {}
-        for n in range(1, N + 1):
-            brows_t[n] = (
-                [jnp.clip(i_all[(n, q)] - 2, 0, C - 1) for q in range(1, N + 1)]
-                + [jnp.clip(i_all[(n, q)] - 1, 0, C - 1) for q in range(1, N + 1)]
-                + [jnp.clip(i_all[(l, n)] - 2, 0, C - 1) for l in range(1, N + 1)]
-                + [jnp.clip(col("last_index", n) - 1, 0, C - 1)]
-                + [jnp.clip(i_all[(l, n)] - 1, 0, C - 1) for l in range(1, N + 1)]
-            )
-            brows_c[n] = brows_t[n][N:2 * N]
-        Rt, Rc = 4 * N + 1, N
+        if flags.delay:
+            # MAILBOX batch (delay_lo >= 1 — the known-delivery regime):
+            #   - the delivery handler's prevLog check on n reads the
+            #     slot's own snapshot row aq_pli(l, n) — pre-tick state,
+            #     unwritten until that pair's own send (which runs AFTER
+            #     its delivery in the canonical order);
+            #   - a pair's next_index at its send is ni + d with d in
+            #     {-1, 0, +1} decided solely by that pair's single
+            #     delivery (capacity-1 slots; delay_lo >= 1 forbids
+            #     same-tick redelivery), so the send reads live in the
+            #     static window [ni-3, ni] — batch all 4 term candidates
+            #     (3 cmd candidates) and select by d at consume time;
+            #   - the tick-end last_term ghost rows sit at aq_pli(l, n)+1
+            #     (a delivery add at index aq_pli + 1 moves last_index to
+            #     aq_pli + 2, exposing the stale stored row beneath it).
+            # Node n's log_term batch rows:
+            #   [0, 4N)      leader-send candidates ni(n, q) - 3 + k
+            #                (k-th block of N at [k*N, (k+1)*N))
+            #   [4N, 5N)     n-as-peer delivery prevLog rows aq_pli(l, n)
+            #   5N           last_index - 1 (the tick-end last_term base)
+            #   [5N+1, 6N+1) n-as-peer ghost rows aq_pli(l, n) + 1
+            # log_cmd rows: the 3 entry candidates ni(n, q) - 2 + k.
+            T_DEL, T_LLT, T_GHOST = 4 * N, 5 * N, 5 * N + 1
+            for n in range(1, N + 1):
+                ni_n = [i_all[(n, q)].astype(_I32) for q in range(1, N + 1)]
+                aqp_n = [prow("aq_pli", l2, n).astype(_I32)
+                         for l2 in range(1, N + 1)]
+                brows_t[n] = (
+                    sum(([jnp.clip(v - 3 + k, 0, C - 1) for v in ni_n]
+                         for k in range(4)), [])
+                    + [jnp.clip(v, 0, C - 1) for v in aqp_n]
+                    + [jnp.clip(col("last_index", n).astype(_I32) - 1,
+                                0, C - 1)]
+                    + [jnp.clip(v + 1, 0, C - 1) for v in aqp_n]
+                )
+                brows_c[n] = brows_t[n][N:4 * N]
+            Rt, Rc = 6 * N + 1, 3 * N
+        else:
+            # Synchronous batch. Node n's batch rows (log_term):
+            #   [0, N)    prevLog reads of n-as-leader (pli(n, q))
+            #   [N, 2N)   entry reads of n-as-leader (i(n, q) - 1)
+            #   [2N, 3N)  n-as-peer prevLog checks (pli(l, n))
+            #   3N        last_index - 1 (the tick-end last_term base)
+            #   [3N+1, 4N+1) n-as-peer GHOST rows (i(l, n) - 1): a §3
+            #     ghost append (post-truncation, phys_len > last_index)
+            #     writes slot phys_len while moving last_index to
+            #     i(l, n) + 1, so the tick-end cache must read the STALE
+            #     stored value at i(l, n) — a row no write covers (the
+            #     round-4 review's tick-129 last_term divergence;
+            #     tests/test_deep_gather.py pins it).
+            # log_cmd rows: [0, N) entry reads. The final scatter needs no
+            # current-value rows: masked writes carry out-of-range rows
+            # and are DROPPED (mode="drop"), and duplicate real rows are
+            # pre-resolved to the last write's value.
+            T_LLT, T_GHOST = 3 * N, 3 * N + 1
+            for n in range(1, N + 1):
+                brows_t[n] = (
+                    [jnp.clip(i_all[(n, q)] - 2, 0, C - 1)
+                     for q in range(1, N + 1)]
+                    + [jnp.clip(i_all[(n, q)] - 1, 0, C - 1)
+                       for q in range(1, N + 1)]
+                    + [jnp.clip(i_all[(l, n)] - 2, 0, C - 1)
+                       for l in range(1, N + 1)]
+                    + [jnp.clip(col("last_index", n) - 1, 0, C - 1)]
+                    + [jnp.clip(i_all[(l, n)] - 1, 0, C - 1)
+                       for l in range(1, N + 1)]
+                )
+                brows_c[n] = brows_t[n][N:2 * N]
+            Rt, Rc = 4 * N + 1, N
         from raft_kotlin_tpu.ops import deep_gather
 
         gather = None
@@ -1214,7 +1351,32 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
         setcol("hb_left", l, fire & ~l_is_f, cfg.hb_ticks - 1)
         for p in range(1, N + 1):
             if flags.delay:
-                append_deliver(l, p)  # in-flight slots from earlier ticks
+                # In-flight slot from an earlier tick. The known-delivery
+                # engines serve the handler's prevLog check up front: from
+                # the batch (row = the slot's own aq_pli snapshot,
+                # unwritten since batch time — the pair's send runs after
+                # its delivery) or from the f_ppli cache (valid only while
+                # the snapshot still sits at the live frontier ni - 2;
+                # win-jumps/restarts break that and raise OV, never bits).
+                if use_fc:
+                    aqp32 = prow("aq_pli", l, p).astype(_I32)
+                    pi_d = pair(l, p)
+                    need_d = ((prow("aq_due", l, p) == 0) & edge_ok(p, l)
+                              & (aqp32 >= 0)
+                              & (col("last_index", p).astype(_I32) > aqp32))
+                    fc_ov["v"] = fc_ov["v"] | (need_d & (
+                        (aqp32
+                         != prow("next_index", l, p).astype(_I32) - 2)
+                        | ~fcl["ok_ppli"][pi_d]))
+                    append_deliver(l, p,
+                                   p_plt=bounded(aqp32, fcl["f_ppli"][pi_d]))
+                elif batched_logs:
+                    aqp32 = prow("aq_pli", l, p).astype(_I32)
+                    raw_d = patch("log_term", p, brows_t[p][T_DEL + l - 1],
+                                  bvals_t[p][T_DEL + l - 1])
+                    append_deliver(l, p, p_plt=bounded(aqp32, raw_d))
+                else:
+                    append_deliver(l, p)
 
             # Request construction + §5 skip rules, from l's live state at send
             # (post-delivery: a delivery just above may have advanced next_index).
@@ -1241,6 +1403,28 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
                                 bounded(pli, fcl["f_pli"][pi_lp]), -1)
                 # Accumulated into fc_ov in ONE merged or below (r6).
                 ov_pli = live_cons & in_pli & ~fcl["ok_pli"][pi_lp]
+            elif batched_logs and flags.delay:
+                # Known-delivery row selection: i = pre-batch ni + d with
+                # d = this pair's own delivery outcome (+1 entry success,
+                # -1 failure, 0 otherwise — nothing else touches this
+                # pair's next_index inside phase 5). Pick among the 4
+                # batched candidate rows [ni-3, ni] by d; where clipping
+                # collapsed candidates they gathered the same row, so any
+                # branch of the select is the same value.
+                d32 = i.astype(_I32) - i_all[(l, p)].astype(_I32)
+
+                def _sel(rows, vals, j0, _d=d32, _p=p):
+                    j = lambda k: (j0 + k) * N + (_p - 1)
+                    r = jnp.where(_d < 0, rows[j(0)],
+                                  jnp.where(_d > 0, rows[j(2)], rows[j(1)]))
+                    v = jnp.where(_d < 0, vals[j(0)],
+                                  jnp.where(_d > 0, vals[j(2)], vals[j(1)]))
+                    return r, v
+
+                r_pli, v_pli = _sel(brows_t[l], bvals_t[l], 0)
+                plt = jnp.where(
+                    pli >= 0,
+                    bounded(pli, patch("log_term", l, r_pli, v_pli)), -1)
             elif batched_logs:
                 raw_plt = bounded(pli, patch(
                     "log_term", l, brows_t[l][p - 1], bvals_t[l][p - 1]))
@@ -1254,14 +1438,35 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
                 ent_c = bounded(i - 1, fcl["f_ent_c"][pi_lp])
                 p_plt_b = bounded(pli, fcl["f_ppli"][pi_lp])
                 live_cons = fire & ~skip  # post-underflow-quirk skip
-                need_e = live_cons & has_entry & inr(i - 1)
+                # Mailbox sends snapshot the PHYSICAL row i-1 into the
+                # slot whether or not an entry rides along (see ent_gate
+                # at the refill) — the guard must cover heartbeat sends
+                # too; the sync engine only consumes ent with an entry.
+                need_e = live_cons & inr(i - 1) if flags.delay \
+                    else live_cons & has_entry & inr(i - 1)
                 # ONE merged ov accumulation per pair (r6: four separate
                 # (G,) ors used to land here; the guard set is unchanged —
                 # boolean-or is associative, so the flag is bit-identical).
-                fc_ov["v"] = fc_ov["v"] | ov_pli | (
+                ov_send = ov_pli | (
                     need_e & (~fcl["ok_ent_t"][pi_lp]
-                              | ~fcl["ok_ent_c"][pi_lp])) | (
-                    live_cons & in_pli & ~fcl["ok_ppli"][pi_lp])
+                              | ~fcl["ok_ent_c"][pi_lp]))
+                if not flags.delay:
+                    # The SYNC exchange consumes f_ppli at the send; under
+                    # the mailbox only the DELIVERY leg does (guarded
+                    # there) — guarding it here too would OV every post-
+                    # win-jump send whose pli is in range, systematically
+                    # falling the whole call back on election ticks.
+                    ov_send = ov_send | (
+                        live_cons & in_pli & ~fcl["ok_ppli"][pi_lp])
+                fc_ov["v"] = fc_ov["v"] | ov_send
+            elif batched_logs and flags.delay:
+                # Entry rows: term candidates sit one block above the plt
+                # window (blocks 1..3 = rows ni-2..ni); cmd candidates are
+                # the whole cmd batch (blocks 0..2 = rows ni-2..ni).
+                r_et, v_et = _sel(brows_t[l], bvals_t[l], 1)
+                ent_t = bounded(i - 1, patch("log_term", l, r_et, v_et))
+                r_ec, v_ec = _sel(brows_c[l], bvals_c[l], 0)
+                ent_c = bounded(i - 1, patch("log_cmd", l, r_ec, v_ec))
             elif batched_logs:
                 ent_t = bounded(i - 1, patch(
                     "log_term", l, brows_t[l][N + p - 1], bvals_t[l][N + p - 1]))
@@ -1432,7 +1637,7 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
     if use_fc:
         # Restack the frontier cache + the per-lane overflow flag into the
         # caller's dict (the runner threads them through its scan carry).
-        for k in deep_cache.FIELDS:
+        for k in fc_fields:
             fcache[k] = jnp.stack(fcl[k])
         fcache["ov"] = fc_ov["v"]
 
@@ -1455,7 +1660,11 @@ def make_flags(cfg: RaftConfig, inject_present: bool = False,
         # builder forces this back off — Mosaic needs the one-hot form, and
         # deep-log configs never reach Pallas anyway via choose_impl).
         dyn_log=dyn,
-        batched=dyn and not cfg.uses_mailbox and batched is not False,
+        # Mailbox configs take the batched engines only in the
+        # known-delivery regime (delay_lo >= 1 — see BodyFlags.batched);
+        # τ=0 stays per-pair on every path, even when `batched` pins True.
+        batched=dyn and (not cfg.uses_mailbox or cfg.known_delivery)
+        and batched is not False,
         sharded=dyn and sharded,
     )
 

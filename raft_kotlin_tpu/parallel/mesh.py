@@ -71,42 +71,64 @@ def shard_map_compat(f, mesh, in_specs, out_specs, check_vma=False):
 # entry cites its bench artifact; bench.py re-measures all three engines at
 # each tabulated shape every round and publishes *_routing_match fields so a
 # stale entry is a visible artifact, not a silent misroute.
+#
+# Round 7 adds a MAILBOX dimension: for delay_lo >= 1 (the known-delivery
+# regime) the batched/fc engines run under the §10 mailbox too (ops/tick.py
+# r7), with their own crossover — the mailbox pays extra per-pair slot
+# algebra AND a wider read batch (6N+1 vs 4N+1 term rows), so the mailbox
+# entries are pinned separately. τ=0 (delay_lo == 0) never reaches the
+# table: callers route it to "flat"/per-pair (no pre-computable read set).
 DEEP_ROUTING_TABLE = (
-    # (C, per-shard G, winner, source artifact)
-    (10_000, 13_312, "fc", "BENCH_r05 deeplog: fc 258.0k gsps (3.6x batched"
-                           " per ROUND5.md stage table)"),
-    (10_000, 3_328, "fc", "config5_pershard leg (r6): the true v4-32"
-                          " config-5 per-chip shard; provisional winner ="
-                          " nearest measured neighbor until BENCH_r06's"
-                          " config5_pershard_* fields land"),
-    (1_024, 2_048, "batched", "BENCH_r05 corner: batched 71.1k vs fc 54.2k"
-                              " vs flat 48.1k gsps"),
+    # (C, per-shard G, mailbox, winner, source artifact)
+    (10_000, 13_312, False, "fc",
+     "BENCH_r05 deeplog: fc 258.0k gsps (3.6x batched per ROUND5.md stage"
+     " table)"),
+    (10_000, 3_328, False, "fc",
+     "config5_pershard leg (r6): the true v4-32 config-5 per-chip shard;"
+     " provisional winner = nearest measured neighbor until BENCH_r06's"
+     " config5_pershard_* fields land"),
+    (1_024, 2_048, False, "batched",
+     "BENCH_r05 corner: batched 71.1k vs fc 54.2k vs flat 48.1k gsps"),
+    (10_000, 13_312, True, "fc",
+     "mailbox production shape: provisional winner = the synchronous"
+     " measured winner at the same shape until BENCH_r07's mbdeep_* fields"
+     " land"),
+    (10_000, 3_328, True, "fc",
+     "mailbox config-5 per-chip shard: provisional (see above)"),
+    (1_024, 2_048, True, "batched",
+     "mailbox corner: provisional from BENCH_r05 mbdeep_sliced 60.6k vs"
+     " cornerdeep_batched 76.7k gsps (the per-pair-vs-batched gap the r7"
+     " engines close); re-pinned by BENCH_r07 mbdeep_* + routing_match"),
 )
 
 
 def route_deep_engine(C: int, g_shard: int,
-                      platform: Optional[str] = None) -> str:
+                      platform: Optional[str] = None,
+                      mailbox: bool = False) -> str:
     """Pick the deep-log per-shard engine ("fc" | "batched" | "flat") for a
-    (log capacity, per-shard lane width) shape from DEEP_ROUTING_TABLE —
-    the measured winner at the nearest benched shape in log-space.
+    (log capacity, per-shard lane width[, mailbox]) shape from
+    DEEP_ROUTING_TABLE — the measured winner at the nearest benched shape
+    in log-space within the config's mailbox class.
 
     `platform` (default: jax.default_backend()) carries the one surviving
     NON-perf constraint: XLA:CPU's compile of the batched gather/scatter
     program blows up at real deep widths (the round-2 observation
     _make_shardmap_xla_tick documents), so CPU meshes stay on the per-pair
     flat engine regardless of shape — a compile-feasibility guard, not a
-    perf class. Mailbox configs are handled by the CALLER (deliveries make
-    read rows depend on in-tick slot state, so only "flat" is valid there).
+    perf class. `mailbox=True` selects the mailbox crossover entries and is
+    only meaningful for delay_lo >= 1 (known-delivery): τ=0 mailbox configs
+    are handled by the CALLER (a slot can be filled and delivered within
+    one tick, so only "flat"/per-pair is valid there).
     """
     if platform is None:
         platform = jax.default_backend()
     if platform == "cpu":
         return "flat"
     lc, lg = math.log(max(C, 1)), math.log(max(g_shard, 1))
-    best = min(DEEP_ROUTING_TABLE,
+    best = min((e for e in DEEP_ROUTING_TABLE if e[2] == mailbox),
                key=lambda e: (math.log(e[0]) - lc) ** 2
                + (math.log(e[1]) - lg) ** 2)
-    return best[2]
+    return best[3]
 
 
 def make_mesh(devices: Optional[Sequence[jax.Device]] = None,
@@ -274,23 +296,22 @@ def _make_shardmap_xla_tick(cfg: RaftConfig, mesh: Mesh,
     n_dev = math.prod(mesh.devices.shape)
     assert cfg.n_groups % n_dev == 0, "pad_groups first"
     lanes_spec = P(None, ("dcn", "ici"))
+    if cfg.uses_mailbox and not cfg.known_delivery:
+        # τ=0 mailbox: a slot can be filled and delivered within one tick,
+        # so no pre-computable read set exists — per-pair FLAT regardless
+        # of what the caller pinned (make_flags enforces the same rule).
+        batched = False
     if batched is None:
-        # Mailbox configs cannot use the batched engine (deliveries make
-        # read rows depend on in-tick slot state) — route them to the
-        # round-2-proven per-pair FLAT sharded program on every platform
-        # rather than letting make_aux's fallback silently select the
-        # never-sharded sliced variant. Everything else routes by SHAPE
-        # through the measured crossover table (route_deep_engine, r6) —
-        # the old accelerator-vs-CPU platform-class pick is gone; "fc"
-        # collapses to batched here because this per-tick API carries no
-        # cache state (multi-tick fc runs live in
+        # Route by SHAPE through the measured crossover table
+        # (route_deep_engine, r6; mailbox dimension r7 — for delay_lo >= 1
+        # the known-delivery batched engine runs under the mailbox too).
+        # "fc" collapses to batched here because this per-tick API carries
+        # no cache state (multi-tick fc runs live in
         # ops/deep_cache.make_sharded_deep_scan, which routes itself).
-        if cfg.uses_mailbox:
-            batched = False
-        else:
-            batched = route_deep_engine(
-                cfg.log_capacity, cfg.n_groups // n_dev,
-                mesh.devices.flatten()[0].platform) != "flat"
+        batched = route_deep_engine(
+            cfg.log_capacity, cfg.n_groups // n_dev,
+            mesh.devices.flatten()[0].platform,
+            mailbox=cfg.uses_mailbox) != "flat"
     batched_arg: Optional[bool] = None if batched else False
 
     def tick(state: RaftState, rng) -> RaftState:

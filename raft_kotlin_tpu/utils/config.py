@@ -30,8 +30,10 @@ class RaftConfig:
     # terms < 32768 (terms grow ~1 per election round; at reference-ratio
     # pacing that is >700k ticks, but a degenerate churn config gets there in
     # ~65k) and commands < 32768 (the cmd_period workload stores the tick
-    # index, so runs must stay under 32768 ticks). The Simulator API refuses
-    # int16 outright — its interned command ids start at 1<<30 and cannot fit.
+    # index, so runs must stay under 32768 ticks). The Simulator API accepts
+    # int16 with a BOUNDED vocabulary: interned ids live in [1<<14, 2^15)
+    # (api/simulator.INTERN_BASE16, capacity-checked), which additionally
+    # bounds cmd_period runs to < 16384 ticks for unambiguous de-interning.
     log_dtype: str = "int32"
 
     # Pacing, in ticks. Inclusive uniform ranges match Kotlin's (a..b).random().
@@ -84,6 +86,16 @@ class RaftConfig:
         """Whether exchanges route through the deliverable-at-tick mailbox
         (SEMANTICS.md §10) instead of resolving synchronously within the tick."""
         return self.mailbox or self.delay_hi > 0
+
+    @property
+    def known_delivery(self) -> bool:
+        """Whether every §10 delivery is fully determined at tick start:
+        delay_lo >= 1 forbids same-tick send-and-deliver, so each tick's
+        delivery set comes entirely from slots filled on EARLIER ticks.
+        This is the regime where the batched/frontier-cache deep engines
+        run under the mailbox (ops/tick.py BodyFlags.batched, r7); τ=0
+        mailbox configs keep the per-pair engine."""
+        return self.uses_mailbox and self.delay_lo >= 1
 
     @property
     def uses_dyn_log(self) -> bool:

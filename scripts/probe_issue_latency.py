@@ -1,0 +1,109 @@
+"""Issue-latency roofline probe (VERDICT r5 next-round #5b).
+
+The headline megakernel sits at ~17% of BOTH the HBM and VPU ceilings
+(BENCH_r05 hbm_bw_frac 0.164 / vpu_frac 0.178); the round-5 account was
+"serial dependency chains", unquantified. This probe builds the third
+roofline and anchors it with measurements:
+
+1. per-op issue latency t_op: time jitted serial chains of dependent
+   elementwise ops (xorshift mix — non-affine, so XLA cannot collapse it)
+   on one (8, 128) vreg-sized block, sweeping chain length K; the SLOPE of
+   time-vs-K is the per-op latency with dispatch overhead differenced out
+   (raft_kotlin_tpu.ops.opcount.measure_op_latency is the 2-point version
+   bench.py uses inline);
+2. chain depth D: the longest dependency path through one phase-body pass
+   at the headline config (exact jaxpr-DAG walk,
+   opcount.phase_body_chain_depth);
+3. the bound: min tick time >= D x t_op, published as
+   latency_ticks_per_sec_bound = 1 / (D x t_op), against a directly
+   measured ticks/s of the same config (a short make_run soak).
+
+The claim under test: the bound explains the measured ~372 ticks/s within
+~1.5x. bench.py publishes the same ratio every round as `latency_frac` in
+the headline tail (latency_frac = D x t_op / tick_s; near 1 = the tick IS
+its dependency chain).
+
+  python scripts/probe_issue_latency.py [groups] [ticks]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_compilation_cache_dir", os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
+def sweep_op_latency(chains=(256, 512, 1024, 2048, 4096), reps=7):
+    """Least-squares slope of wall time vs chain length over several K —
+    the sweep version of opcount.measure_op_latency (2 points), so the
+    linearity of the fit is itself published evidence. One chain/timing
+    definition for both: opcount.time_op_chain."""
+    from raft_kotlin_tpu.ops.opcount import time_op_chain
+
+    points = [(k, time_op_chain(k, reps)) for k in chains]
+    n = len(points)
+    sx = sum(k for k, _ in points)
+    sy = sum(t for _, t in points)
+    sxx = sum(k * k for k, _ in points)
+    sxy = sum(k * t for k, t in points)
+    slope = (n * sxy - sx * sy) / (n * sxx - sx * sx)  # s per round (2 ops)
+    return points, (slope / 2 if slope > 0 else None)
+
+
+def main():
+    from raft_kotlin_tpu.models.state import init_state
+    from raft_kotlin_tpu.ops.opcount import phase_body_chain_depth
+    from raft_kotlin_tpu.ops.tick import make_run
+    from raft_kotlin_tpu.utils.config import RaftConfig
+
+    groups = int(sys.argv[1]) if len(sys.argv) > 1 else 102_400
+    ticks = int(sys.argv[2]) if len(sys.argv) > 2 else 100
+    cfg = RaftConfig(
+        n_groups=groups, n_nodes=5, log_capacity=32, cmd_period=10,
+        p_drop=0.25, p_crash=0.01, p_restart=0.08,
+        p_link_fail=0.02, p_link_heal=0.08, seed=0,
+    ).stressed(10)
+
+    points, t_op = sweep_op_latency()
+    depth = phase_body_chain_depth(cfg)
+
+    # Directly measured ticks/s of the same config (XLA engine — the chain
+    # walk models phase_body; the Mosaic kernel compiles the same lattice).
+    run = make_run(cfg, ticks, trace=False)
+    st = init_state(cfg)
+    end, _ = run(st)
+    jax.block_until_ready(end.term)  # warm (compile excluded)
+    t0 = time.perf_counter()
+    end, _ = run(st)
+    jax.block_until_ready(end.term)
+    wall = time.perf_counter() - t0
+
+    tick_s = wall / ticks
+    bound = depth * t_op if t_op else None
+    print(json.dumps({
+        "probe": "issue_latency",
+        "platform": jax.devices()[0].platform,
+        "chain_points_s": [[k, round(t, 6)] for k, t in points],
+        "op_latency_ns": round(t_op * 1e9, 2) if t_op else None,
+        "chain_depth": depth,
+        "groups": groups,
+        "ticks": ticks,
+        "measured_ticks_per_sec": round(1 / tick_s, 2),
+        "latency_bound_ticks_per_sec": (round(1 / bound, 2)
+                                        if bound else None),
+        "latency_frac": round(bound / tick_s, 3) if bound else None,
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

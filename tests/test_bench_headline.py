@@ -23,6 +23,9 @@ def _fake_record():
         "deeplog_parity_rate": 1.0,
         "deeplog_parity_impl": "shardmap-fcache",
         "deeplog_ov_fallback": 0,
+        "latency_frac": 0.712,
+        "mbdeep_batched_gsps": 81_234.5,
+        "mbdeep_fc_gsps": 79_012.3,
         "suspect": False,
         # plus the long tail of fields that overflowed the driver window
         **{f"filler_{i}": [0.1234] * 8 for i in range(80)},
@@ -41,10 +44,16 @@ def test_compact_headline_is_last_line_and_complete():
     for k in bench.HEADLINE_FIELDS:
         assert k in last, k
         assert last[k] == record[k], k
+    # The r7 additions are part of the contract by NAME — the mailbox-deep
+    # engine legs and the issue-latency roofline must ride the tail too
+    # (ISSUE 3 satellite: the authoritative artifact can't lose them).
+    for k in ("latency_frac", "mbdeep_batched_gsps", "mbdeep_fc_gsps"):
+        assert k in bench.COMPACT_EXTRA_FIELDS, k
     for k in bench.COMPACT_EXTRA_FIELDS:
         assert k in last, k
+        assert last[k] == record[k], k
     # Small enough that the driver's tail window always captures it whole.
-    assert len(lines[-1]) < 400, lines[-1]
+    assert len(lines[-1]) < 480, lines[-1]
 
 
 def test_compact_headline_handles_missing_fields():

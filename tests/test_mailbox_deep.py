@@ -1,0 +1,207 @@
+"""Mailbox-deep engines (r7 tentpole): known-delivery read batching.
+
+For `delay_lo >= 1` every §10 delivery consumes a slot filled on an EARLIER
+tick, so the phase-5 read set is computable at tick start — the batched and
+frontier-cache deep engines run under the mailbox (ops/tick.py BodyFlags.
+batched). Claims, differentially tested:
+
+1. Engine bit-identity: the mailbox batched engine == the per-pair engine,
+   tick for tick, across delay windows ([1,1], [1,3], [2,5]), capacities and
+   log dtypes, through churny fault+replication soups (conflicts, ghost
+   appends, straggler rounds crossing restarts).
+2. The frontier-cache engine under the mailbox == per-pair (through the
+   make_deep_scan runner, OV contract included), and all three SHARDED
+   engines (fc/batched/flat over the 8-virtual-device mesh) == per-pair.
+3. τ=0 fallback: delay_lo == 0 (mailbox or 0..hi windows) pins the per-pair
+   engine on every path — flags, sharded runner routing, and the router's
+   caller contract.
+
+Compile budget note: every engine x config pair is a separate multi-minute
+XLA:CPU compile; the module shares ONE base config (MB13) across the fast
+test and the fc/sharded slow tests, and puts the extra windows/dtypes in
+slow tests.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from conftest import assert_states_equal
+
+from raft_kotlin_tpu.models.state import init_state
+from raft_kotlin_tpu.ops.deep_cache import (
+    make_deep_scan, make_sharded_deep_scan)
+from raft_kotlin_tpu.ops.tick import make_flags, make_rng, make_tick
+from raft_kotlin_tpu.parallel.mesh import (
+    init_sharded, make_mesh, pad_groups, route_deep_engine)
+from raft_kotlin_tpu.utils.config import RaftConfig
+
+BASE = dict(n_groups=4, n_nodes=3, log_capacity=256, cmd_period=3,
+            p_drop=0.15, p_crash=0.02, p_restart=0.1, seed=13)
+MB13 = dataclasses.replace(
+    RaftConfig(**BASE).stressed(10), delay_lo=1, delay_hi=3)
+T = 100
+
+_pp_memo = {}
+
+
+def per_pair_run(cfg, n_ticks):
+    """(per-tick commit traces, end state) of the per-pair reference engine
+    — memoized per (cfg, n_ticks): several tests compare against the same
+    reference run."""
+    key = (cfg, n_ticks)
+    if key not in _pp_memo:
+        tick = jax.jit(make_tick(cfg, batched=False))
+        rng = make_rng(cfg)
+        st = init_state(cfg)
+        commits = []
+        for _ in range(n_ticks):
+            st = tick(st, rng=rng)
+            commits.append(np.asarray(st.commit))
+        _pp_memo[key] = (np.stack(commits), jax.device_get(st))
+    return _pp_memo[key]
+
+
+def test_known_delivery_flags_routing():
+    # The engine gate itself, no compiles: batched iff dyn and (no mailbox
+    # or delay_lo >= 1); τ=0 windows pin per-pair even when batched pins
+    # True (make_flags' rule — there is no pre-computable read set).
+    for lo, hi, want in ((1, 1, True), (1, 3, True), (2, 5, True),
+                        (0, 0, False), (0, 3, False)):
+        cfg = dataclasses.replace(MB13, delay_lo=lo, delay_hi=hi,
+                                  mailbox=lo == hi == 0)
+        assert cfg.uses_mailbox and cfg.uses_dyn_log
+        assert cfg.known_delivery == want
+        assert make_flags(cfg).batched == want, (lo, hi)
+        assert not make_flags(cfg, batched=False).batched
+        if not want:
+            assert not make_flags(cfg, batched=True).batched
+    # Non-mailbox deep unaffected; shallow configs never batch.
+    assert make_flags(RaftConfig(**BASE).stressed(10)).batched
+    assert not make_flags(dataclasses.replace(MB13, log_capacity=16)).batched
+
+
+def test_tau0_sharded_runner_pins_per_pair():
+    # The sharded router's τ=0 contract: auto routes to flat; pinning a
+    # batched-class engine is refused at build time (no compile happens).
+    mesh = make_mesh()
+    cfg = pad_groups(dataclasses.replace(MB13, delay_lo=0, delay_hi=2), mesh)
+    for engine in ("fc", "batched"):
+        with pytest.raises(AssertionError):
+            make_sharded_deep_scan(cfg, mesh, 2, engine=engine)
+    # And the mailbox routing table never applies to CPU meshes anyway.
+    assert route_deep_engine(256, cfg.n_groups // 8, "cpu",
+                             mailbox=True) == "flat"
+
+
+def test_mbdeep_batched_matches_per_pair():
+    # Claim 1 at the shared config: full-state bit-identity every 10 ticks
+    # plus the per-tick commitIndex trace (the ISSUE's observable), 100
+    # churny ticks of delay-[1,3] replication with faults.
+    ref_commits, ref_end = per_pair_run(MB13, T)
+    tick = jax.jit(make_tick(MB13))  # auto -> mailbox batched
+    assert make_flags(MB13).batched
+    rng = make_rng(MB13)
+    st = init_state(MB13)
+    for t in range(T):
+        st = tick(st, rng=rng)
+        assert np.array_equal(np.asarray(st.commit), ref_commits[t]), t
+    assert_states_equal(jax.device_get(st), ref_end)
+    # The soup did real replication work (commits advanced).
+    assert int(np.max(ref_commits)) > 0
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("lo,hi", [(1, 1), (2, 5)])
+def test_mbdeep_batched_windows(lo, hi):
+    # Claim 1 across the remaining delay windows: fixed [1,1] (every
+    # exchange exactly one tick in flight) and wide [2,5] (requests
+    # routinely cross round conclusions and restarts).
+    cfg = dataclasses.replace(MB13, delay_lo=lo, delay_hi=hi, seed=17)
+    ref_commits, ref_end = per_pair_run(cfg, T)
+    tick = jax.jit(make_tick(cfg))
+    rng = make_rng(cfg)
+    st = init_state(cfg)
+    for t in range(T):
+        st = tick(st, rng=rng)
+        assert np.array_equal(np.asarray(st.commit), ref_commits[t]), t
+    assert_states_equal(jax.device_get(st), ref_end)
+
+
+@pytest.mark.slow
+def test_mbdeep_batched_int16():
+    # Claim 1 with int16 log storage (the config-5 dtype): the narrow-dtype
+    # roundtrips (patch/scatter widening) under mailbox batching. C stays at
+    # 256 — XLA:CPU compiles of the batched engine grow pathologically with
+    # int16 depth (test_sharding's >30 min note); dtype is the coverage here.
+    # Seed picked by an oracle scan: the soup must actually COMMIT within
+    # the window (several seeds leave both groups leaderless at T=100).
+    cfg = dataclasses.replace(MB13, log_dtype="int16", n_groups=2, seed=29)
+    ref_commits, ref_end = per_pair_run(cfg, 100)
+    tick = jax.jit(make_tick(cfg))
+    rng = make_rng(cfg)
+    st = init_state(cfg)
+    for t in range(100):
+        st = tick(st, rng=rng)
+        assert np.array_equal(np.asarray(st.commit), ref_commits[t]), t
+    assert_states_equal(jax.device_get(st), ref_end)
+    assert int(np.max(ref_commits)) > 0
+
+
+@pytest.mark.slow
+def test_mbdeep_fc_matches_per_pair():
+    # Claim 2: the frontier-cache engine under the mailbox, through the
+    # make_deep_scan runner (refill + budget + OV discipline) — published
+    # bits must equal per-pair bits whether or not the cache held. The
+    # cache DOES hold through this churny soup (measured; ov False), and
+    # the test pins that: an always-OV regression would silently degrade
+    # this to re-testing the batched engine (the OV contract re-runs it),
+    # leaving zero fc coverage with no signal.
+    _, ref_end = per_pair_run(MB13, T)
+    end, ov = make_deep_scan(MB13, T, return_state=True)(
+        init_state(MB13), make_rng(MB13))
+    assert not ov, "fc cache overflowed — fc path no longer exercised"
+    assert_states_equal(jax.device_get(end), ref_end)
+
+
+@pytest.mark.slow
+def test_mbdeep_fc_holds_steady_state():
+    # The PAIR_VALS_MB second-entry window's reason to exist: in a stable-
+    # leader replication regime (no faults, entries flowing, every delivery
+    # advancing the frontier on send ticks) the cache must HOLD — no OV
+    # fallback — or the fc engine would silently degrade to plain+overhead
+    # under the mailbox. Bit-equality alone cannot catch that (the OV
+    # contract hides it), so this pins ov == False directly. Churny runs
+    # (win-jumps, recede bursts) ARE allowed to overflow — that is the
+    # documented fallback, exercised by the other tests.
+    # el 30-35 + seed picked by an oracle scan: one early election burst,
+    # then a stable leader replicating for the rest of the window (commits
+    # 21/22 by T=120) — the regime the cache must survive without OV.
+    cfg = dataclasses.replace(
+        RaftConfig(n_groups=2, n_nodes=3, log_capacity=256, cmd_period=4,
+                   seed=7).stressed(10),
+        delay_lo=2, delay_hi=2, el_lo=30, el_hi=35)
+    Ts = 120
+    end, ov = make_deep_scan(cfg, Ts, return_state=True)(
+        init_state(cfg), make_rng(cfg))
+    assert not ov, "frontier cache overflowed in the steady-state regime"
+    assert int(np.max(np.asarray(end.commit))) > 0  # replication ran
+    _, ref_end = per_pair_run(cfg, Ts)
+    assert_states_equal(jax.device_get(end), ref_end)
+
+
+@pytest.mark.slow
+def test_mbdeep_sharded_engines_bit_identical():
+    # Claim 2, sharded: all three per-shard engines over the 8-virtual-
+    # device mesh (mailbox fields sharded on their lane axis) == per-pair.
+    mesh = make_mesh()
+    cfg = pad_groups(dataclasses.replace(MB13, seed=23), mesh)
+    Ts = 60
+    _, ref_end = per_pair_run(cfg, Ts)
+    for engine in ("fc", "batched", "flat"):
+        run = make_sharded_deep_scan(cfg, mesh, Ts, return_state=True,
+                                     engine=engine)
+        end, _ov = run(init_sharded(cfg, mesh), make_rng(cfg))
+        assert_states_equal(jax.device_get(end), ref_end)

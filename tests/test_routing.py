@@ -30,18 +30,23 @@ from raft_kotlin_tpu.utils.config import RaftConfig
 def test_router_matches_measured_table():
     # Every tabulated shape routes to its own measured winner — the
     # acceptance gate bench.py re-checks against live data every round.
-    for C, g, winner, _src in DEEP_ROUTING_TABLE:
-        assert route_deep_engine(C, g, "tpu") == winner, (C, g)
+    for C, g, mb, winner, _src in DEEP_ROUTING_TABLE:
+        assert route_deep_engine(C, g, "tpu", mailbox=mb) == winner, (C, g)
     # The crossover is real: the production deep shape and the small
     # corner land on DIFFERENT engines (BENCH_r05's own data).
     assert route_deep_engine(10_000, 13_312, "tpu") == "fc"
     assert route_deep_engine(1_024, 2_048, "tpu") == "batched"
     # The true config-5 per-chip shard resolves (provisionally) to fc.
     assert route_deep_engine(10_000, 3_328, "tpu") == "fc"
+    # Mailbox dimension (r7): the known-delivery engines route by the
+    # mailbox entries — same shape, separate crossover class.
+    assert route_deep_engine(10_000, 13_312, "tpu", mailbox=True) == "fc"
+    assert route_deep_engine(1_024, 2_048, "tpu", mailbox=True) == "batched"
     # CPU: compile-feasibility guard (XLA:CPU batched-program blowup),
-    # not a perf class — flat regardless of shape.
+    # not a perf class — flat regardless of shape or mailbox class.
     assert route_deep_engine(10_000, 13_312, "cpu") == "flat"
     assert route_deep_engine(1_024, 2_048, "cpu") == "flat"
+    assert route_deep_engine(10_000, 13_312, "cpu", mailbox=True) == "flat"
     # Platform defaulting resolves without error.
     assert route_deep_engine(64, 16) in ("fc", "batched", "flat")
 
