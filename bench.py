@@ -188,7 +188,12 @@ COMPACT_EXTRA_FIELDS = ("deeplog_parity_rate", "deeplog_ov_fallback",
                         # in the tail so the authoritative artifact can
                         # never lose them (tests/test_bench_headline.py).
                         "latency_frac", "mbdeep_batched_gsps",
-                        "mbdeep_fc_gsps")
+                        "mbdeep_fc_gsps",
+                        # r8 (ISSUE 4): the sub-tile ILP count the headline
+                        # kernel ran with and the measured serial chain
+                        # depth — the round's acceptance gate reads BOTH
+                        # from the authoritative artifact.
+                        "ilp_subtiles", "issue_chain_depth")
 
 
 def compact_headline(record: dict) -> str:
@@ -486,6 +491,21 @@ def main() -> None:
         chain_depth, op_latency = None, None
     latency_frac = (round(chain_depth * op_latency / tick_s, 3)
                     if chain_depth and op_latency else None)
+
+    # Sub-tile ILP (ISSUE 4): the K the headline megakernel ran with —
+    # resolve_scan_geometry is the SAME resolution make_pallas_scan performs
+    # internally (one shared copy), called with the same arguments as the
+    # tick_candidates headline build (interpret=False, defaults otherwise).
+    # 1 when the headline fell back to XLA (no kernel, no sub-tiling).
+    # probe_chain_ilp.py is the K-sweep that re-pins the table entry.
+    try:
+        from raft_kotlin_tpu.ops.pallas_tick import resolve_scan_geometry
+
+        ilp_subtiles = (resolve_scan_geometry(cfg, interpret=False)[1]
+                        if impl == "pallas" else 1)
+    except Exception as e:
+        print(f"ilp routing probe failed: {str(e)[:120]}", file=sys.stderr)
+        ilp_subtiles = 1
 
     # XLA-vs-Pallas ratio on the same config (perf model; skip if headline
     # already fell back to XLA).
@@ -890,6 +910,9 @@ def main() -> None:
         "op_latency_ns": (round(op_latency * 1e9, 2) if op_latency
                           else None),
         "latency_frac": latency_frac,
+        # Sub-tile ILP: independent phase-lattice chains per kernel tile
+        # (ops/pallas_tick.ILP_SUBTILE_TABLE routing).
+        "ilp_subtiles": ilp_subtiles,
         "pallas_vs_xla": round(pallas_vs_xla, 2),
         "xla_ticks_per_sec": round(xla_ticks_per_sec, 2),
         # §10 mailbox stage (headline fault-soup config + 1-3-tick delays).

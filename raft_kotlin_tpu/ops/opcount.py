@@ -187,12 +187,32 @@ def count_jaxpr_depth(fn, *args) -> int:
 
 
 def phase_body_chain_depth(cfg: RaftConfig, g_count: int = 128,
-                           flags: Optional[BodyFlags] = None) -> int:
+                           flags: Optional[BodyFlags] = None,
+                           by_phase: bool = False):
     """Longest dependency chain of ONE phase_body pass at `cfg` — the op
     count of the serial critical path (independent of G: the lane axis is
-    data-parallel). The latency-roofline numerator."""
-    _, s_in, a_in, f = _phase_body_shapes(cfg, g_count, flags)
-    return count_jaxpr_depth(f, s_in, a_in)
+    data-parallel). The latency-roofline numerator.
+
+    `by_phase=True` (ISSUE 4 satellite) returns the PER-PHASE attribution
+    instead: the lattice is re-traced truncated after each phase boundary
+    (phase_body's `cut` — the same ablation scripts/probe_phase_cuts.py
+    times on hardware) and the depth DELTAS are reported as
+    {"F0", "p1", ..., "p5", "total"} — so a future chain cut can be aimed
+    at the deepest phase instead of guessed. Deltas can be 0 (a phase whose
+    chains fit under an earlier phase's depth adds nothing to the critical
+    path)."""
+    if not by_phase:
+        _, s_in, a_in, f = _phase_body_shapes(cfg, g_count, flags)
+        return count_jaxpr_depth(f, s_in, a_in)
+    depths = []
+    for c in (0, 1, 2, 3, 4, 99):
+        _, s_in, a_in, f = _phase_body_shapes(cfg, g_count, flags, cut=c)
+        depths.append(count_jaxpr_depth(f, s_in, a_in))
+    keys = ("F0", "p1", "p2", "p3", "p4", "p5")
+    out = {k: depths[i] - (depths[i - 1] if i else 0)
+           for i, k in enumerate(keys)}
+    out["total"] = depths[-1]
+    return out
 
 
 def time_op_chain(k: int, reps: int = 5) -> float:
@@ -233,9 +253,11 @@ def measure_op_latency(chain: int = 2048, reps: int = 5):
     return slope / 2
 
 
-def _phase_body_shapes(cfg, g_count, flags):
+def _phase_body_shapes(cfg, g_count, flags, cut=None):
     """Shared input-shape construction for the op-count and chain-depth
-    walks (one copy of the field/aux shape tables)."""
+    walks (one copy of the field/aux shape tables). `cut` truncates the
+    traced lattice after that phase (phase_body's explicit-cut path — no
+    env var, no warning; analysis only)."""
     from raft_kotlin_tpu.ops.pallas_tick import kernel_field_dtype
 
     N, C = cfg.n_nodes, cfg.log_capacity
@@ -281,7 +303,7 @@ def _phase_body_shapes(cfg, g_count, flags):
     def f(svals, avals):
         s = dict(zip(sfields, svals))
         aux = dict(zip(aux_names, avals))
-        el = tick_mod.phase_body(cfg, s, aux, flags)
+        el = tick_mod.phase_body(cfg, s, aux, flags, cut=cut)
         return tuple(s[k] for k in sfields) + (el,)
 
     return flags, s_in, a_in, f

@@ -67,6 +67,47 @@ def pick_tile(G: int, total_rows: int = 0) -> Optional[int]:
     return None
 
 
+# ---------------------------------------------------------------------------
+# Sub-tile ILP routing (ISSUE 4; same measured-crossover pattern as
+# parallel.mesh.DEEP_ROUTING_TABLE). The phase lattice is a ~240-op serial
+# dependency chain per lane (opcount.phase_body_chain_depth) and the headline
+# kernel sits ~5x under both the HBM and VPU rooflines (BENCH_r05
+# hbm_bw_frac 0.164 / vpu_frac 0.178) — issue latency, not bandwidth or
+# slots, is the binding resource. Splitting each tile into K independent
+# lane slabs overlaps K chains inside one kernel body; the win saturates
+# when K chains cover the per-op latency or the slab hits the 128-lane vreg
+# floor. Every entry is (tile_g, K, source): provisional pins chosen at the
+# vreg floor, re-measured by scripts/probe_chain_ilp.py's K-sweep and
+# published as `ilp_subtiles` in the bench record every round (the same
+# re-pin discipline as the deep-engine table). K=1 keeps the pre-ILP kernel
+# byte-identical.
+ILP_SUBTILE_TABLE = (
+    (1024, 4, "provisional: 256-lane slabs (2 vregs) x4 chains; re-pinned"
+     " by BENCH_r08 ilp_subtiles + probe_chain_ilp sweep"),
+    (512, 4, "provisional: the 128-lane vreg floor x4 chains — the headline"
+     " tile (probe_stage1_tiles); re-pinned by BENCH_r08"),
+    (256, 2, "provisional: vreg floor allows only 2 slabs"),
+    (128, 1, "single vreg: no split possible below the 128-lane floor"),
+)
+
+
+def route_ilp_subtiles(tile_g: int, platform: Optional[str] = None) -> int:
+    """Sub-tile count K for a megakernel tile of `tile_g` lanes, from the
+    measured table. CPU guard: the interpreter executes ops serially — no
+    issue latency to hide — and K multiplies trace size, so interpret/CPU
+    runs stay at K=1 (tests pin K explicitly when they want the sub-tiled
+    program on CPU). Unknown tiles (interpreter-only shapes) fall back to
+    K=1; hardware tiles are exactly the _TILES ladder, all tabulated."""
+    if platform is None:
+        platform = jax.default_backend()
+    if platform == "cpu":
+        return 1
+    for t, k, _src in ILP_SUBTILE_TABLE:
+        if t == tile_g and tile_g % k == 0:
+            return k
+    return 1
+
+
 def choose_impl(cfg: RaftConfig) -> str:
     """Canonical backend auto-selection (Simulator, CLI, bench all use this):
     "pallas" when running on an accelerator AND the megakernel is buildable for
@@ -109,14 +150,38 @@ def kernel_field_dtype(cfg: RaftConfig, k: str):
     return _I32
 
 
-def make_pallas_core(cfg: RaftConfig, lanes: int, tile_g: int, interpret: bool):
+def make_pallas_core(cfg: RaftConfig, lanes: int, tile_g: int, interpret: bool,
+                     subtiles: int = 1):
     """Per-flags builder of the raw megakernel over arrays with `lanes` lane columns
     (the flat phase_body layout). Used with lanes = n_groups for single-device runs
     (make_pallas_tick) and lanes = the per-device shard width under shard_map
     (parallel.mesh.make_sharded_run(impl="pallas")). Returns build_call(flags) ->
-    (callable(*flat_int32_arrays) -> flat outputs + el_dirty, aux_names)."""
+    (callable(*flat_int32_arrays) -> flat outputs + el_dirty, aux_names).
+
+    `subtiles` = K > 1 runs SUB-TILE ILP (ISSUE 4): the kernel interior
+    splits each loaded (rows, tile_g) block into K contiguous lane slabs and
+    runs the phase lattice on each slab as an INDEPENDENT chain — groups are
+    embarrassingly independent, so the K copies of the ~240-op serial
+    dependency chain (opcount.phase_body_chain_depth) carry no edges between
+    them and the scheduler can interleave their issue, hiding the per-chain
+    op latency up to K-fold. Bit-exact by construction: every phase_body op
+    is elementwise over lanes (reductions run over rows), so which lanes
+    share an op never changes any lane's value. HBM blocks, loads and
+    stores are IDENTICAL to the K=1 kernel (one load + one store per array;
+    the split is on loaded values, re-concatenated before the store), so
+    the VMEM tile model is unchanged. K must divide tile_g; on hardware the
+    sub-slab must stay lane-register aligned (tile_g/K a multiple of 128 —
+    route_ilp_subtiles enforces this; tests pass arbitrary K in interpret
+    mode)."""
     N, C = cfg.n_nodes, cfg.log_capacity
     assert lanes % tile_g == 0, (lanes, tile_g)
+    SUB = max(1, subtiles)
+    assert tile_g % SUB == 0, (tile_g, subtiles)
+    if not interpret and SUB > 1:
+        assert (tile_g // SUB) % 128 == 0, (
+            f"sub-tile width {tile_g // SUB} must be a multiple of the "
+            f"128-lane vreg on hardware (tile_g={tile_g}, K={SUB})")
+    sub_w = tile_g // SUB
     # Log blocks travel in the STORAGE dtype (cfg.log_dtype): int16 halves
     # the VMEM footprint and the VPU data movement of the dominant one-hot
     # log ops (Mosaic packs 16-bit lanes 2x). Everything else is int32.
@@ -170,24 +235,43 @@ def make_pallas_core(cfg: RaftConfig, lanes: int, tile_g: int, interpret: bool):
             # no faster anyway (probe_headline_dtypes). Logs keep their
             # storage dtype: their (C, tile) one-hot ops are rank-2 and the
             # int16 log kernel is TPU-proven (TPU_PALLAS variant_int16_logs).
-            s = {}
+            loaded = {k: ins[k][...] for k in sfields + aux_names}
+            parts = {k: [] for k in sfields}
+            el_parts = []
+            for kk in range(SUB):
+                # SUB independent lane slabs, SUB independent phase-lattice
+                # chains (no dataflow edges between iterations) — the
+                # sub-tile ILP. SUB == 1 skips the value slicing entirely
+                # (byte-identical program to the pre-ILP kernel).
+                def slab(v):
+                    return v if SUB == 1 else \
+                        v[:, kk * sub_w:(kk + 1) * sub_w]
+                s = {}
+                for k in sfields:
+                    v = slab(loaded[k])
+                    if k in _BOOL_STATE:
+                        s[k] = v != 0
+                    elif k in ("log_term", "log_cmd"):
+                        s[k] = v
+                    else:
+                        s[k] = v.astype(_I32)
+                aux = {}
+                for k in aux_names:
+                    v = slab(loaded[k])
+                    aux[k] = (v != 0) if k in _BOOL_AUX else v.astype(_I32)
+                el_dirty = tick_mod.phase_body(cfg, s, aux, flags)
+                for k in sfields:
+                    parts[k].append(
+                        s[k] if k in ("log_term", "log_cmd")
+                        else s[k].astype(kernel_field_dtype(cfg, k)))
+                el_parts.append(el_dirty.astype(_I32))
+
+            def join(ps):
+                return ps[0] if SUB == 1 else jnp.concatenate(ps, axis=1)
+
             for k in sfields:
-                v = ins[k][...]
-                if k in _BOOL_STATE:
-                    s[k] = v != 0
-                elif k in ("log_term", "log_cmd"):
-                    s[k] = v
-                else:
-                    s[k] = v.astype(_I32)
-            aux = {}
-            for k in aux_names:
-                v = ins[k][...]
-                aux[k] = (v != 0) if k in _BOOL_AUX else v.astype(_I32)
-            el_dirty = tick_mod.phase_body(cfg, s, aux, flags)
-            for k in sfields:
-                outs[k][...] = (s[k] if k in ("log_term", "log_cmd")
-                                else s[k].astype(kernel_field_dtype(cfg, k)))
-            outs["el_dirty"][...] = el_dirty.astype(_I32)
+                outs[k][...] = join(parts[k])
+            outs["el_dirty"][...] = join(el_parts)
 
         def field_dtype(k):
             return kernel_field_dtype(cfg, k)
@@ -253,21 +337,22 @@ def cast_flat_out(cfg, outs, sfields, with_dirty: bool = True):
 
 
 def make_pallas_tick(cfg: RaftConfig, tile_g: Optional[int] = None,
-                     interpret: Optional[bool] = None):
+                     interpret: Optional[bool] = None,
+                     ilp_subtiles: Optional[int] = None):
     """Build tick(state, inject=None, fault_cmd=None[, rng]) -> state — same
     contract and same bits as ops.tick.make_tick(cfg), different compilation
-    strategy."""
+    strategy. `ilp_subtiles` pins the sub-tile ILP count (make_pallas_core);
+    None = route_ilp_subtiles' per-shape pick (1 on CPU/interpret)."""
     N, C, G = cfg.n_nodes, cfg.log_capacity, cfg.n_groups
     default_rng: list = []  # derived lazily; wrappers always pass rng explicitly
 
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
-    if tile_g is None:
-        tile_g = default_tile(cfg, G, interpret)
-    if interpret and G % tile_g:
-        tile_g = G  # interpreter: one tile, no alignment constraints
+    tile_g, ilp_subtiles = resolve_scan_geometry(
+        cfg, interpret, 1, tile_g, ilp_subtiles)
 
-    build_call = make_pallas_core(cfg, G, tile_g, interpret)
+    build_call = make_pallas_core(cfg, G, tile_g, interpret,
+                                  subtiles=ilp_subtiles)
 
     def tick(
         state: RaftState,
@@ -515,12 +600,36 @@ def draw_tables(cfg: RaftConfig, tkeys, bkeys, t_ctr, b_ctr, K: int,
             tab(bkeys, b_ctr, K, cfg.bo_lo, cfg.bo_hi))
 
 
+def resolve_scan_geometry(cfg: RaftConfig,
+                          interpret: Optional[bool] = None,
+                          k_per_launch: int = 1,
+                          tile_g: Optional[int] = None,
+                          ilp_subtiles: Optional[int] = None):
+    """The (tile_g, ilp_subtiles) a make_pallas_scan call with these same
+    arguments resolves to — THE single copy of that resolution, so reporting
+    surfaces (bench.py's `ilp_subtiles` field) read the geometry the
+    headline kernel actually runs with instead of re-deriving it."""
+    G = cfg.n_groups
+    K = max(1, k_per_launch)
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    if tile_g is None:
+        tile_g = default_tile(cfg, G, interpret, k_per_launch=K)
+    if interpret and G % tile_g:
+        tile_g = G
+    if ilp_subtiles is None:
+        ilp_subtiles = route_ilp_subtiles(
+            tile_g, "cpu" if interpret else None)
+    return tile_g, ilp_subtiles
+
+
 def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
                      tile_g: Optional[int] = None,
                      interpret: Optional[bool] = None,
                      k_per_launch: int = 1,
                      jitted: bool = True,
-                     _resets_bound: Optional[int] = None):
+                     _resets_bound: Optional[int] = None,
+                     ilp_subtiles: Optional[int] = None):
     """Multi-tick Pallas runner with a FLAT int32 scan carry.
 
     Scanning make_pallas_tick converts RaftState <-> the kernel's flat int32
@@ -540,6 +649,10 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
     the structural reset bound (clamped draws are WRONG bits — r4 ADVICE).
     `_resets_bound` is a test-only override of that bound.
 
+    `ilp_subtiles` pins the 1-tick kernel's sub-tile ILP count
+    (make_pallas_core; None = route_ilp_subtiles per shape, 1 on CPU).
+    The archival K-tick kernel stays at K_sub=1.
+
     Returns run(state, rng) -> state (jitted; rng rides as an operand so the
     compilation is seed-independent, as everywhere else)."""
     import types
@@ -548,11 +661,10 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
     K = max(1, k_per_launch)
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
-    if tile_g is None:
-        tile_g = default_tile(cfg, G, interpret, k_per_launch=K)
-    if interpret and G % tile_g:
-        tile_g = G
-    build_call = make_pallas_core(cfg, G, tile_g, interpret)
+    tile_g, ilp_subtiles = resolve_scan_geometry(
+        cfg, interpret, K, tile_g, ilp_subtiles)
+    build_call = make_pallas_core(cfg, G, tile_g, interpret,
+                                  subtiles=ilp_subtiles)
     build_call_k = (make_pallas_core_k(cfg, G, tile_g, interpret, K,
                                        resets_bound=_resets_bound)
                     if K > 1 else None)

@@ -113,6 +113,56 @@ def _rep_rows(vals, N):
     )
 
 
+def _tree_reduce(op, terms):
+    """Balanced fold of an ASSOCIATIVE `op` over `terms`: ceil(log2 n) op
+    depth instead of the linear left-fold chain Python's sum()/reduce()
+    build. Used on the tick's critical path (ISSUE 4 chain shortening);
+    bit-exact for the ops it is applied to here (integer add, boolean or —
+    associative and commutative, so any association yields the same bits)."""
+    terms = list(terms)
+    assert terms
+    while len(terms) > 1:
+        nxt = [op(terms[i], terms[i + 1])
+               for i in range(0, len(terms) - 1, 2)]
+        if len(terms) % 2:
+            nxt.append(terms[-1])
+        terms = nxt
+    return terms[0]
+
+
+def _kth_largest(terms, k):
+    """Per-lane k-th largest (1-based) of the (G,)-valued `terms`, via a
+    bitonic sorting network of jnp.minimum/maximum pairs — O(log^2 n) op
+    DEPTH. This is the chain-shortening form of the phase-5 quorum test:
+    #{v : v > c} >= k  <=>  kth_largest(values) > c (exact for integers),
+    which moves the O(n)-deep accumulate-and-count chain OFF the leader's
+    commit cell — the network depends only on the match_index rows, and the
+    commit chain grows by one compare + one select per exchange instead of
+    the whole tally. Padding uses the dtype's minimum, which sorts below
+    every real value (match_index is always >= 0)."""
+    n = len(terms)
+    assert 1 <= k <= n
+    p = 1 << (n - 1).bit_length()
+    if p > n:
+        sent = jnp.full(terms[0].shape, jnp.iinfo(terms[0].dtype).min,
+                        terms[0].dtype)
+        terms = list(terms) + [sent] * (p - n)
+    a = list(terms)
+    kk = 2
+    while kk <= p:
+        j = kk // 2
+        while j >= 1:
+            for i in range(p):
+                m = i ^ j
+                if m > i:
+                    lo = jnp.minimum(a[i], a[m])
+                    hi = jnp.maximum(a[i], a[m])
+                    a[i], a[m] = (lo, hi) if (i & kk) == 0 else (hi, lo)
+            j //= 2
+        kk *= 2
+    return a[p - k]  # ascending order: slot p - k is the k-th largest
+
+
 @dataclasses.dataclass(frozen=True)
 class BodyFlags:
     """Static switches: which optional phases the compiled body includes."""
@@ -152,7 +202,7 @@ class BodyFlags:
 
 
 def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
-               fcache: Optional[dict] = None):
+               fcache: Optional[dict] = None, cut: Optional[int] = None):
     """Advance the phase lattice F,0-5 one tick, mutating `s` in place.
 
     `s` maps STATE_FIELDS to RANK-2 values: (N, G) per-node grids, (N*N, G) pair
@@ -170,6 +220,11 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
     an "ov" (G,) bool entry is ADDED to the dict: True where a needed value
     was unavailable (budget overflow / consumed-invalid) — the caller must
     then discard the tick's bits and re-run on the plain engine.
+
+    `cut` truncates the lattice after phase `cut` (output bits then
+    MEANINGLESS — analysis only): opcount's per-phase chain-depth
+    attribution passes it explicitly; None reads the RAFT_PHASE_CUT env
+    var (scripts/probe_phase_cuts.py's on-hardware timing ablation).
     """
     N, C, maj = cfg.n_nodes, cfg.log_capacity, cfg.majority
     G = s["term"].shape[-1]
@@ -178,16 +233,20 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
     # exclusively for per-phase timing attribution on hardware. Read at trace
     # time so probes can sweep without reloading the module. A leftover env
     # var (probe crash) would silently poison every later compile, so any
-    # active cut is announced LOUDLY at trace time (r4 ADVICE).
-    cut = int(os.environ.get("RAFT_PHASE_CUT", "99"))
-    if cut < 99:
-        import warnings
+    # active cut is announced LOUDLY at trace time (r4 ADVICE). An EXPLICIT
+    # `cut` (opcount's by-phase attribution) skips the warning — the caller
+    # asked for the truncation and never runs the bits.
+    if cut is None:
+        cut = int(os.environ.get("RAFT_PHASE_CUT", "99"))
+        if cut < 99:
+            import warnings
 
-        warnings.warn(
-            f"RAFT_PHASE_CUT={cut} is active: this tick is compiled with the "
-            "phase lattice TRUNCATED and its output bits are meaningless. "
-            "Probe-only — unset RAFT_PHASE_CUT for real simulations.",
-            stacklevel=2)
+            warnings.warn(
+                f"RAFT_PHASE_CUT={cut} is active: this tick is compiled with "
+                "the phase lattice TRUNCATED and its output bits are "
+                "meaningless. Probe-only — unset RAFT_PHASE_CUT for real "
+                "simulations.",
+                stacklevel=2)
 
     # Logs live as PER-NODE (C, G) slices for the duration of the phase
     # lattice (static slices of the flat (N*C, G) layout — free in XLA,
@@ -338,7 +397,6 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
             view[k] = [s[k][i] for i in range(N)]
         for k in _PAIRV:
             view[k] = [s[k][i] for i in range(N * N)]
-        view["__dirty"] = [aux_dirty["m"][i] for i in range(N)]
 
     def _stack_rows(rows):
         # Bool rows restack through int32: Mosaic lowers i1 concat via an i8
@@ -350,7 +408,6 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
     def exit_cols():
         for k in _COLF + _PAIRV:
             s[k] = _stack_rows(view[k])
-        aux_dirty["m"] = _stack_rows(view["__dirty"])
         view.clear()
 
     def col(name, n):
@@ -437,8 +494,10 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
         # needs phys_len < C; overwrite needs i < last_index <= C).
         li = col("last_index", n)
         pl = col("phys_len", n)
-        app = mask & (i == li) & (pl < C)
-        ovw = mask & (i < li) & (i >= 0)
+        # `mask` is the deepest input (it carries the exchange's succ/demote
+        # chain) — joined LAST so the local compares issue ahead of it.
+        app = ((i == li) & (pl < C)) & mask
+        ovw = ((i < li) & (i >= 0)) & mask
         wr = app | ovw
         slot = jnp.where(app, pl, i)
         if batched_logs and defer["on"]:
@@ -451,7 +510,9 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
             if use_fc:
                 slot32 = slot.astype(_I32)
                 li32, i32 = li.astype(_I32), i.astype(_I32)
-                li_new = jnp.where(app, li32 + 1, i32 + 1)
+                # app implies i == li, so the post-write last_index is i + 1
+                # in BOTH branches — no select, and no li on the chain.
+                li_new = i32 + 1
                 fc_patch_write(n, wr, slot32, term_v, cmd_v)
                 # Live lastLogTerm maintenance (§3): the new cache row is
                 # li_new - 1. app writes slot phys_len: the GHOST case
@@ -488,10 +549,10 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
                     ok = ok | hit | oob
                     fcl["f_topw"][tw + j] = jnp.where(wr, v, old_w[j])
                     fcl["ok_topw"][tw + j] = jnp.where(wr, ok, old_ok[j])
-                setcol("last_index", n, wr, jnp.where(app, li + 1, i + 1))
+                setcol("last_index", n, wr, i + 1)  # app => i == li: both branches = i+1
                 setcol("phys_len", n, app, pl + 1)
                 return wr, slot32
-            setcol("last_index", n, wr, jnp.where(app, li + 1, i + 1))
+            setcol("last_index", n, wr, i + 1)  # app => i == li: both branches = i+1
             setcol("phys_len", n, app, pl + 1)
             return None
         ldt = s["log_term"].dtype  # narrow at write (cfg.log_dtype)
@@ -517,7 +578,7 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
             oh = (logrow_c == slot[None, :]) & wr[None, :]
             lt[n - 1] = jnp.where(oh, term_v.astype(ldt)[None, :], lt[n - 1])
             lc[n - 1] = jnp.where(oh, cmd_v.astype(ldt)[None, :], lc[n - 1])
-        setcol("last_index", n, wr, jnp.where(app, li + 1, i + 1))
+        setcol("last_index", n, wr, i + 1)  # app => i == li: both branches = i+1
         setcol("phys_len", n, app, pl + 1)
 
     # Election-timer resets (SEMANTICS.md §7): each reset consumes one counted draw
@@ -528,18 +589,43 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
     # Phase-F restarts must reset immediately (phase 1 reads them this same tick);
     # their draw (at pre-tick t_ctr, which phase F consumes first) is aux.el_draw_f.
     # (Constant built by comparison, not a dense bool literal — Mosaic-safe.)
+    #
+    # Chain shortening (ISSUE 4): the PER-EXCHANGE resets of phases 3/5 are
+    # deferred a second time — nothing between an exchange and the end of the
+    # tick reads el_armed, t_ctr, or the dirty mask (el_armed/el_left: phase 1
+    # only; t_ctr: the caller's materialization), and every deferred update is
+    # a boolean or / integer count — associative and commutative. So the
+    # exchanges just APPEND their masks here, and one balanced tree-reduce at
+    # tick end applies them: the old serial or/add chains (~2 ops per exchange
+    # woven through the pair loops' critical path) collapse to log depth off
+    # the path. Grid-phase resets (F, 2, 4) stay inline: they are two grid
+    # ops each and phase 1 reads phase F's.
     aux_dirty = {"m": jnp.zeros((N, G), dtype=_I32) > 0}
+    deferred_resets: dict = {n: [] for n in range(1, N + 1)}
 
     def reset_el_timer_col(n, mask):
-        ctr = col("t_ctr", n)
-        if view:
-            view["el_armed"][n - 1] = view["el_armed"][n - 1] | mask
-            view["t_ctr"][n - 1] = ctr + mask.astype(_I32)
-            view["__dirty"][n - 1] = view["__dirty"][n - 1] | mask
+        deferred_resets[n].append(mask)
+
+    def flush_resets():
+        """Apply the phases-3/5 deferred timer resets: per node, ONE balanced
+        count of its reset masks (reset count = t_ctr advance; count > 0 =
+        armed/dirty). Runs on the GRID form (callers flush after exit_cols),
+        including at the cut-truncated early returns so the by-phase depth
+        attribution sees the same program shape as a real tick."""
+        if not any(deferred_resets.values()):
             return
-        s["el_armed"] = _set_row(s["el_armed"], n - 1, col("el_armed", n) | mask)
-        setcol("t_ctr", n, mask, ctr + 1)
-        aux_dirty["m"] = _set_row(aux_dirty["m"], n - 1, aux_dirty["m"][n - 1] | mask)
+        cnts = []
+        for n in range(1, N + 1):
+            ms = deferred_resets[n]
+            cnts.append(_tree_reduce(
+                jnp.add, [m.astype(_I32) for m in ms]) if ms
+                else jnp.zeros((G,), _I32))
+            deferred_resets[n] = []
+        cnt_g = jnp.stack(cnts)
+        hit = cnt_g != 0
+        s["el_armed"] = s["el_armed"] | hit
+        s["t_ctr"] = s["t_ctr"] + cnt_g.astype(s["t_ctr"].dtype)
+        aux_dirty["m"] = aux_dirty["m"] | hit
 
     def reset_el_timer_grid(mask):
         s["el_armed"] = s["el_armed"] | mask
@@ -609,16 +695,22 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
         s["link_up"] = lu * (1 - aux["link_fail"]) + (1 - lu) * aux["link_heal"]
 
     # Effective edge health (§9): iid survival ∧ link health ∧ both ends up.
-    # Evaluated lazily per (a, b) pair so no rank-3 mask is ever built.
+    # HOISTED (ISSUE 4): up/link_up/edge_iid are all fixed after phase F, so
+    # the N^2 directed-pair masks compute ONCE here — one independent wave
+    # the scheduler can issue ahead of the serial pair loops — instead of
+    # being rebuilt at every exchange call site. Balanced (A∧B)∧(C∧D)
+    # association; still rank-2 only, no (N, N, G) mask is ever built.
     up = s["up"]
+    _eok = {}
+    for _a in range(1, N + 1):
+        for _b in range(1, N + 1):
+            _eok[(_a, _b)] = (
+                ((aux["edge_iid"][pair(_a, _b)] != 0)
+                 & (s["link_up"][pair(_a, _b)] != 0))
+                & (up[_a - 1] & up[_b - 1]))
 
     def edge_ok(a, b):
-        return (
-            (aux["edge_iid"][pair(a, b)] != 0)
-            & (s["link_up"][pair(a, b)] != 0)
-            & up[a - 1]
-            & up[b - 1]
-        )
+        return _eok[(a, b)]
 
     if batched_logs:
         # Deferral starts HERE (post-phase-F, so restart wipes are already
@@ -770,6 +862,19 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
         enter_cols()  # phase 3 runs on the columnar view
     lli_h = [col("last_index", n) for n in range(1, N + 1)]
     llt_h = [col("last_term", n) for n in range(1, N + 1)]
+    # Deferred phase-3 tally/demote masks (see vote_exchange): per node,
+    # applied as one balanced tree-reduce after the pair loops.
+    p3_resp = {n: [] for n in range(1, N + 1)}
+    p3_vote = {n: [] for n in range(1, N + 1)}
+    p3_dem = {n: [] for n in range(1, N + 1)}
+    if flags.delay:
+        # §10 due-scan hoist (ISSUE 4): a pair's in-flight slot is written
+        # only by its OWN send/delivery, and each pair's first delivery scan
+        # precedes its send — so all N^2 due tests read pre-phase values and
+        # issue as one independent wave ahead of the serial pair loops. τ=0
+        # second deliveries re-test the just-sent slot live.
+        vdue0 = {(c, p): prow("vq_due", c, p) == 0
+                 for c in range(1, N + 1) for p in range(1, N + 1)}
 
     def delay_for(a, b):
         # §10 per-pair send delay this tick (static constant when lo == hi).
@@ -792,14 +897,18 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
         p_llt = llt_h[p - 1]
         rej_stale = (p_li >= 1) & (req_llt < p_llt)
         rej_short = (p_li >= 1) & (req_llt == p_llt) & (req_lli < p_li)
-        grant_gt = (req_term > p_term) & ~rej_stale & ~rej_short
+        # The rej_* legs read only hoisted log snapshots and request fields
+        # — OFF the term chain — so they pre-combine and the live term
+        # compare joins them in ONE op (the term cells are the phase-3
+        # serial spine; the old left fold put two serial ands on it).
+        grant_gt = (req_term > p_term) & ~(rej_stale | rej_short)
         # Boolean algebra, not where-of-bools (Mosaic i1-select limits):
         # term < p.term -> False; == -> votedFor check (quirk g); > -> log check.
         granted = ((req_term == p_term) & (p_vf == c)) | grant_gt
         adopt = att & grant_gt
         setcol("term", p, adopt, req_term)
         setcol("voted_for", p, adopt, c)
-        setcol("role", p, adopt, FOLLOWER)
+        p3_dem[p].append(adopt)  # role write deferred (same FOLLOWER const)
         reset_el_timer_col(p, adopt)
         resp_term = col("term", p)
         # Candidate tally (RaftServer.kt:209-211). resp_term is compared against
@@ -807,17 +916,28 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
         # processing); within one tick c's term cannot change during its own peer
         # loop, so this is bit-identical to comparing against the request term on
         # the synchronous path.
+        #
+        # The tally WRITES are deferred (ISSUE 4 chain shortening): nothing
+        # in phase 3 reads votes/responses/role (phase 4 is their first
+        # reader), every vote increment commutes, and every phase-3 role
+        # write stores the same FOLLOWER constant — so the per-exchange
+        # serial +1/or chains collapse to one balanced tree-reduce per node
+        # after the pair loops. Masks are still built HERE, from live state
+        # (the quirk-f compare reads c's term at this point in the order).
         tal = att & guard
         put_pair("responded", c, p, tal, 1)
-        setcol("responses", c, tal, col("responses", c) + 1)
-        setcol("role", c, tal & (resp_term > col("term", c)), FOLLOWER)  # quirk f
-        setcol("votes", c, tal & granted, col("votes", c) + 1)
+        p3_resp[c].append(tal)
+        p3_dem[c].append(tal & (resp_term > col("term", c)))  # quirk f
+        p3_vote[c].append(tal & granted)
 
-    def vote_deliver(c, p):
+    def vote_deliver(c, p, due=None):
         # §10 delivery: response leg evaluated at the delivery tick; either-end
         # failure voids the whole exchange. Candidate processing additionally
-        # guarded by the round stamp (straggler cancellation).
-        due = prow("vq_due", c, p) == 0
+        # guarded by the round stamp (straggler cancellation). `due` may be
+        # supplied pre-hoisted (vdue0 — the first scan per pair); None =
+        # live read (the τ=0 same-iteration redelivery).
+        if due is None:
+            due = prow("vq_due", c, p) == 0
         att = due & edge_ok(p, c)
         guard = (col("round_state", c) == ACTIVE) & (
             prow("vq_round", c, p) == col("rounds", c)
@@ -833,11 +953,13 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
         )
         for p in range(1, N + 1):
             if flags.delay:
-                vote_deliver(c, p)  # in-flight slots from earlier ticks
+                # In-flight slots from earlier ticks (hoisted due scan).
+                vote_deliver(c, p, due=vdue0[(c, p)])
+                # Balanced join; responded (just written by this pair's own
+                # delivery above) is the deep input and joins last.
                 att = (
-                    c_attempting
+                    (c_attempting & edge_ok(c, p))  # request leg at send
                     & (prow("responded", c, p) == 0)
-                    & edge_ok(c, p)  # request leg at the send tick
                 )
                 put_pair("vq_term", c, p, att, col("term", c))
                 put_pair("vq_lli", c, p, att, lli_h[c - 1])
@@ -848,10 +970,8 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
                     vote_deliver(c, p)  # τ=0: the just-sent slot, same iteration
             else:
                 att = (
-                    c_attempting
-                    & (prow("responded", c, p) == 0)
-                    & edge_ok(c, p)
-                    & edge_ok(p, c)
+                    (c_attempting & (prow("responded", c, p) == 0))
+                    & (edge_ok(c, p) & edge_ok(p, c))
                 )
                 # Request built from c's live state (RaftServer.kt:200-207);
                 # the log fields come from the hoisted per-node snapshot
@@ -860,11 +980,29 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
                 vote_exchange(c, p, att, col("term", c),
                               lli_h[c - 1], llt_h[c - 1], true_g)
 
+    # Apply the deferred phase-3 tallies/demotes: one balanced reduce per
+    # node (integer adds and same-constant role writes commute — any
+    # association/order yields the same bits as the old in-loop chains).
+    for n2 in range(1, N + 1):
+        if p3_dem[n2]:
+            setcol("role", n2, _tree_reduce(jnp.logical_or, p3_dem[n2]),
+                   FOLLOWER)
+        for field, ms in (("responses", p3_resp[n2]), ("votes", p3_vote[n2])):
+            if not ms:
+                continue
+            cur = col(field, n2)
+            inc = _tree_reduce(jnp.add, [m.astype(cur.dtype) for m in ms])
+            if view:
+                view[field][n2 - 1] = cur + inc
+            else:
+                s[field] = _set_row(s[field], n2 - 1, cur + inc)
+
     # -- phase 4: round conclusions -----------------------------------------
 
     if use_columnar:
         exit_cols()  # phase 4 is grid-wide
     if cut < 4:
+        flush_resets()
         return aux_dirty["m"]
     act = (s["round_state"] == ACTIVE) & up
     concl = act & ((s["responses"] >= maj) | (s["round_left"] <= 0))
@@ -901,6 +1039,7 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
     s["round_age"] = s["round_age"] + ongoing.astype(s["round_age"].dtype)
 
     if cut < 5:
+        flush_resets()
         return aux_dirty["m"]
     # -- phase 5: append / heartbeat ----------------------------------------
 
@@ -917,9 +1056,11 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
             adopt = act5 & (req_term > p_term)
             setcol("term", p, adopt, req_term)
             setcol("voted_for", p, adopt, -1)
-            setcol("role", p, adopt, FOLLOWER)
+            # quirk d: ANY foreign append demotes — adopt ⊆ act5 and both
+            # stores are the same FOLLOWER constant, so the single act5
+            # write covers the adopt one (one select on the role chain).
+            setcol("role", p, act5, FOLLOWER)
             reset_el_timer_col(p, adopt)
-            setcol("role", p, act5, FOLLOWER)  # quirk d: any foreign append
             reset_el_timer_col(p, act5)
         p_li = col("last_index", p)
         p_commit = col("commit", p)
@@ -928,7 +1069,8 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
         if p_plt is None:
             p_plt = log_gather("log_term", p, pli)
         succ = (pli == -1) | ((p_li > pli) & (pli >= 0) & (p_plt == plt))
-        add_info = log_add(p, pli + 1, ent_t, ent_c, act5 & succ & has_entry)
+        add_info = log_add(p, pli + 1, ent_t, ent_c,
+                           (act5 & has_entry) & succ)
         resp_term = col("term", p)
         # --- leader processes the response (RaftServer.kt:146-168) ---
         if p != l:
@@ -943,17 +1085,35 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
         with_e = proc & has_entry
         nfail = act5 & ~demote & ~succ
         ni = prow("next_index", l, p)
-        set_prow("next_index", l, p,
-                 jnp.where(with_e, ni + 1, jnp.where(nfail, ni - 1, ni)))
+        # Arithmetic update instead of the two-deep select cascade: with_e
+        # and nfail are disjoint, so ni + (with_e - nfail) takes the same
+        # value in every branch while the delta computes OFF ni's chain —
+        # the next_index cell advances one op per exchange, not two.
+        d_ni = with_e.astype(ni.dtype) - nfail.astype(ni.dtype)
+        set_prow("next_index", l, p, ni + d_ni)
         mi = prow("match_index", l, p)
         set_prow("match_index", l, p,
                  jnp.where(with_e, mi + 1,
                            jnp.where(proc & ~has_entry, pli + 1, mi)))
-        # Commit advancement (quirk a), evaluated per response.
+        # Commit advancement (quirk a), evaluated per response — in ORDER-
+        # STATISTIC form (ISSUE 4): count(mi > commit) >= maj is exactly
+        # maj-th-largest(mi) > commit for integers, and the selection
+        # network reads ONLY the match_index rows, so the leader's commit
+        # chain grows by one compare + one select per exchange instead of
+        # carrying the whole accumulate-and-count tally (the old form put
+        # ~N+3 serial ops on the commit cell per exchange — the deepest
+        # recurring segment of the phase-5 critical path).
+        # The network runs on the PRE-update row for q == p, bumped +1
+        # unconditionally ("pretend" row): the commit write is masked by
+        # with_e, and exactly there the true post-update row IS mi + 1 — so
+        # the selection depends only on the (older) match_index rows and
+        # issues OFF the exchange's with_e/succ frontier; where ~with_e the
+        # pretend value is never consumed (the write is masked out).
         l_commit = col("commit", l)
-        cnt = sum((prow("match_index", l, q) > l_commit).astype(_I32)
-                  for q in range(1, N + 1))
-        setcol("commit", l, with_e & (cnt >= maj), l_commit + 1)
+        m_maj = _kth_largest(
+            [prow("match_index", l, q) if q != p else mi + 1
+             for q in range(1, N + 1)], maj)
+        setcol("commit", l, with_e & (m_maj > l_commit), l_commit + 1)
         if use_fc and defer["on"]:
             # Frontier-cache shift (ops/deep_cache.py): the exchange moved
             # next_index by +1 (with_e) or -1 (nfail); re-point the cached
@@ -1002,13 +1162,16 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
             upd("f_ppli", jnp.where(wrote_im1, ent_w, zero), wrote_im1,
                 zero, no)
 
-    def append_deliver(l, p, p_plt=None):
+    def append_deliver(l, p, p_plt=None, due=None):
         # §10 delivery: response leg at the delivery tick; either-end failure voids
         # the exchange. No straggler guard — append responses always process
         # against live leader state (the reference never cancels them).
         # `p_plt` may be supplied pre-gathered (the known-delivery batched /
         # frontier-cache engines); None = gather inside append_exchange.
-        due = prow("aq_due", l, p) == 0
+        # `due` may be supplied pre-hoisted (adue0 — the first scan per
+        # pair); None = live read (the τ=0 same-iteration redelivery).
+        if due is None:
+            due = prow("aq_due", l, p) == 0
         att = due & edge_ok(p, l)
         req = {k: prow(k, l, p) for k in
                ("aq_term", "aq_commit", "aq_pli", "aq_plt",
@@ -1020,6 +1183,13 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
 
     if use_columnar:
         enter_cols()  # phase 5 runs on the columnar view
+
+    if flags.delay:
+        # Hoisted §10 due scan, phase-5 leg (same argument as vdue0: a
+        # pair's slot is written only by its own send, which runs after its
+        # delivery — all first-scan due tests are pre-phase values).
+        adue0 = {(l, p): prow("aq_due", l, p) == 0
+                 for l in range(1, N + 1) for p in range(1, N + 1)}
 
     if batched_logs:
         def bounded(idx, v):
@@ -1079,7 +1249,7 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
                     # (aq_pli == ni - 2; win-jumps/restarts break that and
                     # the consume-time guard raises OV instead of reading
                     # a row the cache cannot represent).
-                    due_p = prow("aq_due", l, p) == 0
+                    due_p = adue0[(l, p)]
                     dcons = (due_p & edge_ok(p, l)
                              & (prow("aq_pli", l, p).astype(_I32)
                                 == i32 - 2)
@@ -1361,7 +1531,7 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
                 if use_fc:
                     aqp32 = prow("aq_pli", l, p).astype(_I32)
                     pi_d = pair(l, p)
-                    need_d = ((prow("aq_due", l, p) == 0) & edge_ok(p, l)
+                    need_d = (adue0[(l, p)] & edge_ok(p, l)
                               & (aqp32 >= 0)
                               & (col("last_index", p).astype(_I32) > aqp32))
                     fc_ov["v"] = fc_ov["v"] | (need_d & (
@@ -1369,22 +1539,26 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
                          != prow("next_index", l, p).astype(_I32) - 2)
                         | ~fcl["ok_ppli"][pi_d]))
                     append_deliver(l, p,
-                                   p_plt=bounded(aqp32, fcl["f_ppli"][pi_d]))
+                                   p_plt=bounded(aqp32, fcl["f_ppli"][pi_d]),
+                                   due=adue0[(l, p)])
                 elif batched_logs:
                     aqp32 = prow("aq_pli", l, p).astype(_I32)
                     raw_d = patch("log_term", p, brows_t[p][T_DEL + l - 1],
                                   bvals_t[p][T_DEL + l - 1])
-                    append_deliver(l, p, p_plt=bounded(aqp32, raw_d))
+                    append_deliver(l, p, p_plt=bounded(aqp32, raw_d),
+                                   due=adue0[(l, p)])
                 else:
-                    append_deliver(l, p)
+                    append_deliver(l, p, due=adue0[(l, p)])
 
             # Request construction + §5 skip rules, from l's live state at send
             # (post-delivery: a delivery just above may have advanced next_index).
             li_l = col("last_index", l)
             i = prow("next_index", l, p)
             pli = i - 2
-            # prevLogTerm: invalid get -> exception -> skip peer (§6 skip rule).
-            skip = (pli >= 0) & ~(pli < li_l)
+            # prevLogTerm: invalid get -> exception -> skip peer (§6 skip
+            # rule). ~(pli < li) is pli >= li — one compare, not compare+not
+            # (last_index is the deep input here).
+            skip = (pli >= 0) & (pli >= li_l)
             if use_fc:
                 # Frontier-cache consume: the cached values ARE the rows
                 # the old prefetch would have taken (ops/deep_cache.py);
@@ -1478,7 +1652,8 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
             else:
                 ent_t, ent_c = log_gather_tc(l, i - 1)
             if flags.delay:
-                att = fire & ~skip & edge_ok(l, p)  # request leg at send tick
+                # request leg at send tick; skip (the deep input) joins last
+                att = (fire & edge_ok(l, p)) & ~skip
                 put_pair("aq_term", l, p, att, col("term", l))
                 put_pair("aq_commit", l, p, att, col("commit", l))
                 put_pair("aq_pli", l, p, att, pli)
@@ -1491,7 +1666,9 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
                 if cfg.delay_lo == 0:
                     append_deliver(l, p)  # τ=0: same-iteration delivery
             else:
-                skip = skip | ~edge_ok(l, p) | ~edge_ok(p, l)
+                # ~a | ~b = ~(a & b): the two edge legs pre-combine off the
+                # skip chain and join it in one op.
+                skip = skip | ~(edge_ok(l, p) & edge_ok(p, l))
                 act5 = fire & ~skip
                 append_exchange(l, p, act5, col("term", l), col("commit", l),
                                 pli, plt, has_entry, ent_t, ent_c,
@@ -1641,6 +1818,7 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
             fcache[k] = jnp.stack(fcl[k])
         fcache["ov"] = fc_ov["v"]
 
+    flush_resets()
     return aux_dirty["m"]
 
 

@@ -218,6 +218,7 @@ def _make_shardmap_pallas_tick(cfg: RaftConfig, mesh: Mesh,
         cast_flat_out,
         default_tile,
         make_pallas_core,
+        route_ilp_subtiles,
     )
 
     N, G = cfg.n_nodes, cfg.n_groups
@@ -242,7 +243,12 @@ def _make_shardmap_pallas_tick(cfg: RaftConfig, mesh: Mesh,
                 f"choose n_groups as a multiple of n_dev * tile for a tile in "
                 f"{_TILES} that fits the config, or use impl='xla'"
             ) from e
-    build_call = make_pallas_core(cfg, g_local, tile, interpret)
+    # Per-shard sub-tile ILP (ISSUE 4): same measured-table routing as the
+    # single-device kernel; interpret/CPU shards stay at K=1.
+    sub_k = route_ilp_subtiles(
+        tile, "cpu" if interpret else mesh.devices.flatten()[0].platform)
+    build_call = make_pallas_core(cfg, g_local, tile, interpret,
+                                  subtiles=sub_k)
     lanes_spec = P(None, ("dcn", "ici"))
 
     def tick(state: RaftState, rng) -> RaftState:

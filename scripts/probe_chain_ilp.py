@@ -1,0 +1,123 @@
+"""Sub-tile ILP K-sweep (ISSUE 4 tentpole evidence).
+
+The headline megakernel's tick is issue-latency-bound (BENCH_r05
+hbm_bw_frac 0.164 / vpu_frac 0.178; scripts/probe_issue_latency.py): the
+phase lattice is one long serial dependency chain per lane, and the chip
+idles waiting on it. Sub-tile ILP (ops/pallas_tick.make_pallas_core
+`subtiles`) splits each kernel tile into K independent lane slabs whose K
+chains issue concurrently — this probe measures ticks/s as a function of K
+and re-runs the two-point per-op latency fit, so the ILP_SUBTILE_TABLE pins
+are re-measured numbers, not guesses:
+
+1. ticks/s at every feasible K for the shape's tile (K divides tile_g; on
+   hardware the slab stays >= the 128-lane vreg);
+2. the issue-latency roofline at each K: latency_frac_k =
+   (chain_depth x t_op / K) / tick_s — the chain bound an IDEAL K-fold
+   overlap would leave. measured_vs_k1 near the ideal says the overlap is
+   real; flat says another floor binds (the probe's published answer to
+   the acceptance criterion's "which floor binds at the measured K*").
+
+  python scripts/probe_chain_ilp.py [groups] [ticks]
+
+On CPU the kernel runs in interpreter mode: K is still bit-tested (the
+differential suite tests/test_chain_ilp.py), but the timing sweep is only
+meaningful on hardware — the probe still emits the record with
+"platform": "cpu" so the artifact is honest about where it ran.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_compilation_cache_dir", os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
+def feasible_ks(tile_g: int, interpret: bool):
+    ks = []
+    for k in (1, 2, 4, 8):
+        if tile_g % k:
+            continue
+        if not interpret and (tile_g // k) % 128:
+            continue
+        ks.append(k)
+    return ks
+
+
+def main():
+    from raft_kotlin_tpu.models.state import init_state
+    from raft_kotlin_tpu.ops.opcount import (
+        measure_op_latency, phase_body_chain_depth)
+    from raft_kotlin_tpu.ops.pallas_tick import (
+        default_tile, make_pallas_scan, route_ilp_subtiles)
+    from raft_kotlin_tpu.ops.tick import make_rng
+    from raft_kotlin_tpu.utils.config import RaftConfig
+
+    on_accel = jax.default_backend() != "cpu"
+    groups = int(sys.argv[1]) if len(sys.argv) > 1 else (
+        102_400 if on_accel else 512)
+    ticks = int(sys.argv[2]) if len(sys.argv) > 2 else (
+        100 if on_accel else 3)
+    cfg = RaftConfig(
+        n_groups=groups, n_nodes=5, log_capacity=32, cmd_period=10,
+        p_drop=0.25, p_crash=0.01, p_restart=0.08,
+        p_link_fail=0.02, p_link_heal=0.08, seed=0,
+    ).stressed(10)
+
+    interpret = not on_accel
+    tile = default_tile(cfg, cfg.n_groups, interpret)
+    # cut=99 leg of the by-phase walk IS the full depth — trace once.
+    by_phase = phase_body_chain_depth(cfg, by_phase=True)
+    depth = by_phase["total"]
+    t_op = measure_op_latency()
+    rng = make_rng(cfg)
+    st = init_state(cfg)
+
+    sweep = []
+    for k in feasible_ks(tile, interpret):
+        run = make_pallas_scan(cfg, ticks, interpret=interpret,
+                               ilp_subtiles=k)
+        end = run(st, rng)
+        jax.block_until_ready(end.term)  # warm (compile excluded)
+        t0 = time.perf_counter()
+        end = run(st, rng)
+        jax.block_until_ready(end.term)
+        tick_s = (time.perf_counter() - t0) / ticks
+        bound_k = depth * t_op / k if t_op else None
+        sweep.append({
+            "k": k,
+            "ticks_per_sec": round(1 / tick_s, 2),
+            # The chain bound an IDEAL k-fold overlap leaves: near-1 means
+            # the tick still IS its (now 1/k) dependency chain.
+            "latency_frac_ideal": (round(bound_k / tick_s, 3)
+                                   if bound_k else None),
+        })
+
+    base = sweep[0]["ticks_per_sec"] if sweep else None
+    print(json.dumps({
+        "probe": "chain_ilp",
+        "platform": jax.devices()[0].platform,
+        "groups": groups,
+        "ticks": ticks,
+        "tile_g": tile,
+        "routed_k": route_ilp_subtiles(tile),
+        "chain_depth": depth,
+        "chain_depth_by_phase": by_phase,
+        "op_latency_ns": round(t_op * 1e9, 2) if t_op else None,
+        "k_sweep": sweep,
+        "measured_vs_k1": ([round(p["ticks_per_sec"] / base, 3)
+                            for p in sweep] if base else None),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -75,7 +75,12 @@ def main():
     ).stressed(10)
 
     points, t_op = sweep_op_latency()
-    depth = phase_body_chain_depth(cfg)
+    # Per-phase attribution (ISSUE 4 satellite): the depth deltas of the
+    # lattice truncated at each phase boundary — chain cuts get a target,
+    # not a guess (round 8's cut aimed at p5/p3, the two deep phases). Its
+    # cut=99 leg IS the full depth — one set of traces serves both numbers.
+    by_phase = phase_body_chain_depth(cfg, by_phase=True)
+    depth = by_phase["total"]
 
     # Directly measured ticks/s of the same config (XLA engine — the chain
     # walk models phase_body; the Mosaic kernel compiles the same lattice).
@@ -96,6 +101,7 @@ def main():
         "chain_points_s": [[k, round(t, 6)] for k, t in points],
         "op_latency_ns": round(t_op * 1e9, 2) if t_op else None,
         "chain_depth": depth,
+        "chain_depth_by_phase": by_phase,
         "groups": groups,
         "ticks": ticks,
         "measured_ticks_per_sec": round(1 / tick_s, 2),

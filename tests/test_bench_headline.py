@@ -26,6 +26,8 @@ def _fake_record():
         "latency_frac": 0.712,
         "mbdeep_batched_gsps": 81_234.5,
         "mbdeep_fc_gsps": 79_012.3,
+        "ilp_subtiles": 4,
+        "issue_chain_depth": 238,
         "suspect": False,
         # plus the long tail of fields that overflowed the driver window
         **{f"filler_{i}": [0.1234] * 8 for i in range(80)},
@@ -49,11 +51,16 @@ def test_compact_headline_is_last_line_and_complete():
     # (ISSUE 3 satellite: the authoritative artifact can't lose them).
     for k in ("latency_frac", "mbdeep_batched_gsps", "mbdeep_fc_gsps"):
         assert k in bench.COMPACT_EXTRA_FIELDS, k
+    # The r8 additions likewise by NAME (ISSUE 4 CI satellite): the round's
+    # acceptance gate reads the sub-tile ILP count and the measured chain
+    # depth from the authoritative artifact's tail.
+    for k in ("ilp_subtiles", "issue_chain_depth"):
+        assert k in bench.COMPACT_EXTRA_FIELDS, k
     for k in bench.COMPACT_EXTRA_FIELDS:
         assert k in last, k
         assert last[k] == record[k], k
     # Small enough that the driver's tail window always captures it whole.
-    assert len(lines[-1]) < 480, lines[-1]
+    assert len(lines[-1]) < 560, lines[-1]
 
 
 def test_compact_headline_handles_missing_fields():

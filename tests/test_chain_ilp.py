@@ -1,0 +1,140 @@
+"""Sub-tile ILP differential suite (ISSUE 4 tentpole).
+
+ops/pallas_tick.make_pallas_core(subtiles=K) splits each kernel tile into K
+independent lane slabs and runs the phase lattice once per slab — K
+overlapped dependency chains instead of one. The split is bit-exact by
+construction (every phase_body op is elementwise over lanes); these tests
+PIN that: K∈{2,4} sub-tiled kernels against the K=1 baseline, per-tick
+commitIndex traces plus end states, across the sync fault soup, the §10
+mailbox [1,3] window, int16 log storage (the deep-dtype band the kernel
+supports — true deep C>=256 configs are dyn-log and never compile to
+Pallas, see choose_impl), and a crash/restart churn soup.
+
+All runs are CPU interpreter mode; K is pinned explicitly (the router's CPU
+guard returns 1 — tests/test_routing.py pins the table itself). Traces ride
+a lax.scan so each (config, K) costs one compile, not one per tick.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from conftest import assert_states_equal
+
+from raft_kotlin_tpu.models.state import init_state
+from raft_kotlin_tpu.ops.pallas_tick import make_pallas_scan, make_pallas_tick
+from raft_kotlin_tpu.ops.tick import make_rng
+from raft_kotlin_tpu.utils.config import RaftConfig
+
+
+def _traced_run(cfg, n_ticks, K):
+    """(per-tick trace dict, end state) for the sub-tiled kernel at K."""
+    tick_fn = make_pallas_tick(cfg, interpret=True, ilp_subtiles=K)
+    rng = make_rng(cfg)
+
+    @jax.jit
+    def run(st, rng):
+        def body(st, _):
+            st = tick_fn(st, rng=rng)
+            return st, {"commit": st.commit, "term": st.term,
+                        "last_index": st.last_index, "role": st.role}
+        return jax.lax.scan(body, st, None, length=n_ticks)
+
+    end, tr = run(init_state(cfg), rng)
+    return jax.device_get(tr), jax.device_get(end)
+
+
+def _assert_matches(cfg, n_ticks, ks=(2, 4)):
+    ref_tr, ref_end = _traced_run(cfg, n_ticks, K=1)
+    assert int(np.max(ref_tr["commit"])) > 0, "soup did nothing"
+    for K in ks:
+        tr, end = _traced_run(cfg, n_ticks, K=K)
+        for f in ("commit", "term", "last_index", "role"):
+            assert np.array_equal(tr[f], ref_tr[f]), (K, f)
+        assert_states_equal(ref_end, end)
+
+
+def test_subtiled_sync_soup_matches_k1():
+    # The headline regime in miniature: faults, links, drops, workload.
+    cfg = RaftConfig(
+        n_groups=8, n_nodes=3, log_capacity=16, cmd_period=3,
+        p_drop=0.2, p_crash=0.02, p_restart=0.1,
+        p_link_fail=0.05, p_link_heal=0.2, seed=11,
+    ).stressed(10)
+    _assert_matches(cfg, 40)
+
+
+def test_subtiled_mailbox_matches_k1():
+    # §10 mailbox [1, 3]: the production async regime, every exchange
+    # through capacity-1 in-flight slots.
+    cfg = RaftConfig(
+        n_groups=8, n_nodes=3, log_capacity=16, cmd_period=3,
+        p_drop=0.15, delay_lo=1, delay_hi=3, seed=13,
+    ).stressed(10)
+    _assert_matches(cfg, 40, ks=(2,))
+
+
+@pytest.mark.slow
+def test_subtiled_mailbox_k4_and_tau0():
+    cfg = RaftConfig(
+        n_groups=8, n_nodes=3, log_capacity=16, cmd_period=3,
+        p_drop=0.15, delay_lo=1, delay_hi=3, seed=13,
+    ).stressed(10)
+    _assert_matches(cfg, 40, ks=(4,))
+    # τ=0 mailbox (same-tick send+deliver, the double-delivery order).
+    tau0 = RaftConfig(
+        n_groups=8, n_nodes=3, log_capacity=16, cmd_period=3,
+        p_drop=0.15, mailbox=True, delay_lo=0, delay_hi=0, seed=17,
+    ).stressed(10)
+    _assert_matches(tau0, 30, ks=(2,))
+
+
+def test_subtiled_int16_logs_matches_k1():
+    # int16 log storage (cfg.log_dtype) — the narrow-dtype kernel variant.
+    cfg = RaftConfig(
+        n_groups=8, n_nodes=3, log_capacity=64, log_dtype="int16",
+        cmd_period=2, p_drop=0.1, seed=23,
+    ).stressed(10)
+    assert not cfg.uses_dyn_log  # still the Pallas-compilable band
+    _assert_matches(cfg, 30, ks=(2,))
+
+
+@pytest.mark.slow
+def test_subtiled_fault_churn_soup():
+    # Leader-killing churn: heavy crash/restart + link flaps, K∈{2,4},
+    # with the full log arrays in the end-state compare
+    # (assert_states_equal) catching any write-path divergence.
+    cfg = RaftConfig(
+        n_groups=16, n_nodes=5, log_capacity=16, cmd_period=3,
+        p_drop=0.25, p_crash=0.05, p_restart=0.2,
+        p_link_fail=0.1, p_link_heal=0.3, seed=29,
+    ).stressed(10)
+    _assert_matches(cfg, 40)
+
+
+@pytest.mark.slow
+def test_subtiled_scan_runner_matches_k1():
+    # The flat-carry multi-tick runner (what bench's headline actually
+    # executes): end states bit-equal across K, including the deferred
+    # election-draw materialization at the scan boundary.
+    cfg = RaftConfig(
+        n_groups=8, n_nodes=3, log_capacity=16, cmd_period=3,
+        p_drop=0.2, p_crash=0.02, p_restart=0.1, seed=31,
+    ).stressed(10)
+    rng = make_rng(cfg)
+    st = init_state(cfg)
+    ref = jax.device_get(
+        make_pallas_scan(cfg, 40, interpret=True, ilp_subtiles=1)(st, rng))
+    for K in (2, 4):
+        end = jax.device_get(
+            make_pallas_scan(cfg, 40, interpret=True, ilp_subtiles=K)(st, rng))
+        assert_states_equal(ref, end)
+
+
+def test_subtile_constraints():
+    # K must divide the tile; hardware builds additionally hold the
+    # 128-lane vreg floor (asserted inside make_pallas_core).
+    cfg = RaftConfig(n_groups=8, n_nodes=3, log_capacity=16, seed=1)
+    with pytest.raises(AssertionError):
+        make_pallas_tick(cfg, interpret=True, ilp_subtiles=3)  # 8 % 3 != 0
