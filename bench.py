@@ -99,6 +99,11 @@ def measure(cfg, n_ticks, n_reps, impl_candidates, summarize=None):
     -> (times: list[float], stats: list[dict], impl). stats[r] always has
     "rounds" (end-state sum); `summarize(end_state)` may add stage-specific
     JNP SCALARS (traced inside the jit, materialized in the timed region).
+    Runners built with the scan-carry flight recorder (utils/telemetry.py)
+    additionally surface its counters as tel_* keys in stats — the
+    recorder rides the scan carry, so its cost is INSIDE the timed region
+    like any other part of the production tick (the ISSUE-5 <3% overhead
+    acceptance gate measures exactly this configuration).
     """
     from raft_kotlin_tpu.models.state import init_state
     from raft_kotlin_tpu.ops.tick import make_rng
@@ -136,11 +141,12 @@ def measure(cfg, n_ticks, n_reps, impl_candidates, summarize=None):
 
         @jax.jit
         def run(st, rng):
-            res = run_state(st, rng)
-            end, livepin = res if isinstance(res, tuple) else (res, None)
+            end, livepin, tel = _norm_run_result(run_state(st, rng))
             out = {"rounds": jnp.sum(end.rounds)}
             if livepin is not None:
                 out["livepin"] = livepin
+            if tel is not None:
+                out.update({f"tel_{k}": v for k, v in tel.items()})
             if summarize is not None:
                 out.update(summarize(end))
             return out
@@ -163,6 +169,19 @@ def measure(cfg, n_ticks, n_reps, impl_candidates, summarize=None):
             stats.append(vals)
         return times, stats, impl
     raise last_err
+
+
+def _norm_run_result(res):
+    """Normalize a runner's return into (end_state, livepin, telemetry):
+    runners yield RaftState, (state, livepin), (state, telemetry dict) —
+    the Pallas flat-carry runner, which needs no livepin — or
+    (state, livepin, telemetry)."""
+    if not isinstance(res, tuple):
+        return res, None, None
+    if len(res) == 2:
+        end, x = res
+        return (end, None, x) if isinstance(x, dict) else (end, x, None)
+    return res
 
 
 def median(xs):
@@ -193,7 +212,20 @@ COMPACT_EXTRA_FIELDS = ("deeplog_parity_rate", "deeplog_ov_fallback",
                         # kernel ran with and the measured serial chain
                         # depth — the round's acceptance gate reads BOTH
                         # from the authoritative artifact.
-                        "ilp_subtiles", "issue_chain_depth")
+                        "ilp_subtiles", "issue_chain_depth",
+                        # r9 (ISSUE 5): flight-recorder aggregates of the
+                        # headline run (scan-carry telemetry, read back
+                        # once) and the parity triage status — the tail
+                        # records not just THAT parity broke but WHERE.
+                        "tel_elections_started", "tel_commit_advances",
+                        "tel_fault_events", "triage_status")
+
+# Flight-recorder counters published verbatim from the headline run's
+# median rep (stats tel_* keys — utils/telemetry.TELEMETRY_FIELDS).
+def _tel_keys():
+    from raft_kotlin_tpu.utils.telemetry import TELEMETRY_FIELDS
+
+    return tuple(f"tel_{k}" for k in TELEMETRY_FIELDS)
 
 
 def compact_headline(record: dict) -> str:
@@ -214,11 +246,11 @@ def emit_lines(record: dict) -> list:
     return [json.dumps(record), compact_headline(record)]
 
 
-def scan_runner(tick_fn):
-    """builder(n_ticks) -> UNJITTED run(st, rng) -> (end_state, livepin) for
-    a per-tick function (measure() jits exactly once, with the reductions
-    inside — see measure's docstring for why the state must not cross a
-    nested-pjit boundary).
+def scan_runner(tick_fn, telemetry: bool = False):
+    """builder(n_ticks) -> UNJITTED run(st, rng) -> (end_state, livepin[,
+    telemetry]) for a per-tick function (measure() jits exactly once, with
+    the reductions inside — see measure's docstring for why the state must
+    not cross a nested-pjit boundary).
 
     `livepin` accumulates a one-row observation of log_cmd EVERY TICK inside
     the scan carry: log_cmd is pure payload (its gather->scatter chain feeds
@@ -228,17 +260,27 @@ def scan_runner(tick_fn):
     making the final buffer a jit output (which would reinstate the
     copy-on-write tax the scalar outputs exist to avoid). The Pallas
     flat-carry runner needs no pin: a pallas_call is opaque to XLA — dead
-    outputs cannot split the call."""
+    outputs cannot split the call.
+
+    telemetry=True threads the scan-carry flight recorder
+    (utils/telemetry.py) so the timed region includes the production
+    recorder cost and stats surface its counters."""
+    from raft_kotlin_tpu.utils import telemetry as telemetry_mod
+
     def build(n_ticks):
         def run(st, rng):
             def body(carry, _):
-                s, acc = carry
+                s, acc, tel = carry
                 s2 = tick_fn(s, rng=rng)
                 acc = acc + jnp.sum(s2.log_cmd[:, 0, :].astype(jnp.int32))
-                return (s2, acc), None
-            (end, acc), _ = jax.lax.scan(
-                body, (st, jnp.zeros((), jnp.int32)), None, length=n_ticks)
-            return end, acc
+                if tel is not None:
+                    tel = telemetry_mod.telemetry_step(s, s2, tel)
+                return (s2, acc, tel), None
+            tel0 = telemetry_mod.telemetry_zeros() if telemetry else None
+            (end, acc, tel), _ = jax.lax.scan(
+                body, (st, jnp.zeros((), jnp.int32), tel0), None,
+                length=n_ticks)
+            return (end, acc, tel) if telemetry else (end, acc)
         return run
     return build
 
@@ -250,15 +292,19 @@ def tick_candidates(cfg):
     if choose_impl(cfg) == "pallas":
         # Flat-carry multi-tick runner: state<->kernel-form conversions once
         # per call, not once per tick (~0.3 ms/tick on the headline config).
+        # The flight recorder rides the flat carry (ISSUE 5) — the timed
+        # headline IS the recorder-on configuration.
         yield (lambda n: make_pallas_scan(cfg, n, interpret=False,
-                                          jitted=False)), "pallas"
-    yield scan_runner(make_tick(cfg)), "xla"
+                                          jitted=False,
+                                          telemetry=True)), "pallas"
+    yield scan_runner(make_tick(cfg), telemetry=True), "xla"
 
 
 def xla_only(cfg):
     from raft_kotlin_tpu.ops.tick import make_tick
 
-    yield scan_runner(make_tick(cfg)), "xla"
+    # Recorder on, like the pallas leg it is A/B'd against.
+    yield scan_runner(make_tick(cfg), telemetry=True), "xla"
 
 
 def sharded_fc_candidate(cfg):
@@ -305,14 +351,16 @@ def deep_candidates(cfg):
         label = {"fc": "shardmap-fcache" + ("-grid" if grid_now else ""),
                  "batched": "shardmap-batched",
                  "flat": "shardmap-flat"}[routed]
-        yield (lambda n: make_sharded_deep_scan(cfg, mesh, n)), label
+        yield (lambda n: make_sharded_deep_scan(cfg, mesh, n,
+                                                telemetry=True)), label
 
         if routed == "fc" and not grid_now:
             def build_grid(n):
                 deep_scatter.FORCE_GRID = True  # sticky by design
-                return make_sharded_deep_scan(cfg, mesh, n, engine="fc")
+                return make_sharded_deep_scan(cfg, mesh, n, engine="fc",
+                                              telemetry=True)
             yield build_grid, "shardmap-fcache-grid"
-    yield (lambda n: make_deep_scan(cfg, n)), "xla-fcache"
+    yield (lambda n: make_deep_scan(cfg, n, telemetry=True)), "xla-fcache"
     yield from xla_only(cfg)
 
 
@@ -338,11 +386,29 @@ def state_aux_bytes_per_tick(cfg) -> int:
     return 2 * state + aux
 
 
+def _auto_triage(pcfg, ktr, ntr):
+    """Divergence triage on a failed parity leg (ISSUE 5): bisect to the
+    first divergent (tick, group), dump both states, render the explain()
+    window — all to stderr — and return the compact status string the
+    record/tail publish. Never raises (the parity number must survive a
+    triage failure)."""
+    from raft_kotlin_tpu.api.triage import triage, triage_status
+
+    try:
+        div = triage(pcfg, ktr=ktr, otr=ntr, out=sys.stderr)
+        return triage_status(div)
+    except Exception as e:
+        print(f"triage failed: {str(e)[:200]}", file=sys.stderr)
+        return "triage-failed"
+
+
 def parity_stage(cfg, groups, ticks, impl):
     """Kernel (this chip, the SAME impl that produced the headline — a
     Mosaic-only divergence must not hide behind an XLA parity pass) vs the
     native C++ engine over `groups` groups of the same config/seed: fraction
-    of groups whose full traces bit-match."""
+    of groups whose full traces bit-match. On any mismatch the divergence
+    is auto-triaged (api/triage.py) and the compact status returned; a
+    clean leg returns None."""
     from raft_kotlin_tpu.models.state import init_state
     from raft_kotlin_tpu.native.oracle import NativeOracle, trace_parity
     from raft_kotlin_tpu.ops.tick import make_run
@@ -358,9 +424,11 @@ def parity_stage(cfg, groups, ticks, impl):
         _, ktr = make_run(pcfg, ticks, trace=True, impl="xla")(init_state(pcfg))
     ntr = NativeOracle(pcfg).run(ticks)
     ok, first = trace_parity(ktr, ntr)
+    tri = None
     if first:
         print(f"parity: {first}", file=sys.stderr)
-    return float(np.mean(ok)), int(groups), impl
+        tri = _auto_triage(pcfg, ktr, ntr)
+    return float(np.mean(ok)), int(groups), impl, tri
 
 
 def fc_parity_stage(cfg, groups, ticks):
@@ -368,7 +436,8 @@ def fc_parity_stage(cfg, groups, ticks):
     #6): the sharded frontier-cache runner in trace mode over a 1-device
     mesh vs the native C++ engine — closing the transitive chain the old
     plain-engine parity leg left open (deeplog_parity_impl used to report
-    "xla" while the headline came from shardmap-fcache)."""
+    "xla" while the headline came from shardmap-fcache). Auto-triages on
+    mismatch like parity_stage."""
     from raft_kotlin_tpu.models.state import init_state
     from raft_kotlin_tpu.native.oracle import NativeOracle, trace_parity
     from raft_kotlin_tpu.ops.deep_cache import make_sharded_deep_scan
@@ -381,10 +450,12 @@ def fc_parity_stage(cfg, groups, ticks):
     ktr, ov = run(init_state(pcfg), make_rng(pcfg))
     ntr = NativeOracle(pcfg).run(ticks)
     ok, first = trace_parity(ktr, ntr)
+    tri = None
     if first:
         print(f"fc parity: {first}", file=sys.stderr)
+        tri = _auto_triage(pcfg, ktr, ntr)
     impl = "shardmap-fcache" + ("-ovfb" if ov else "")
-    return float(np.mean(ok)), int(groups), impl
+    return float(np.mean(ok)), int(groups), impl, tri
 
 
 def main() -> None:
@@ -529,7 +600,7 @@ def main() -> None:
     churn_elections_per_sec = cstats[ctimes.index(tbest)]["rounds"] / tbest
 
     # Stage 3 — CPU-parity rate (kernel vs native C++ engine, sampled slice).
-    parity_rate, parity_n, parity_impl = parity_stage(
+    parity_rate, parity_n, parity_impl, parity_triage = parity_stage(
         cfg, parity_groups, min(ticks, 200), impl)
 
     # Stage 4b — §10 mailbox at headline scale (VERDICT r03 missing #2): the
@@ -547,8 +618,8 @@ def main() -> None:
     # kernel-vs-C++ differential as stage 3, on the mailbox config — the C++
     # engine speaks §10 (native/raft_oracle.cpp, Dims.mailbox), so the
     # 1-3-tick-delay regime gets an at-scale on-chip parity anchor too.
-    mail_parity_rate, mail_parity_n, mail_parity_impl = parity_stage(
-        mail_cfg, parity_groups, min(ticks, 200), mail_impl)
+    mail_parity_rate, mail_parity_n, mail_parity_impl, mail_parity_triage = \
+        parity_stage(mail_cfg, parity_groups, min(ticks, 200), mail_impl)
 
     # Stage 5 — deep log (BASELINE config 5 shape on one chip): C=10k, N=7,
     # int16 logs, G at the HBM ceiling rounded down to lanes. The scan peak
@@ -581,6 +652,7 @@ def main() -> None:
     deep_parity_rate = None
     deep_parity_n = None  # null = leg did not run (matches rate/impl)
     deep_parity_impl = None
+    deep_parity_triage = None
     deep_times = []
     deep_impl = "xla"
     deep_suspect_reasons = ["stage did not run"]
@@ -642,9 +714,9 @@ def main() -> None:
                     256 if on_accel else 64))
                 if deep_impl.startswith("shardmap-fcache"):
                     try:
-                        deep_parity_rate, deep_parity_n, deep_parity_impl = \
-                            fc_parity_stage(deep_cfg, dpar_groups,
-                                            deep_ticks)
+                        (deep_parity_rate, deep_parity_n, deep_parity_impl,
+                         deep_parity_triage) = fc_parity_stage(
+                            deep_cfg, dpar_groups, deep_ticks)
                     except Exception as e:
                         # e.g. the parity group count breaks the scatter
                         # kernel's tile model at a shape the headline never
@@ -654,13 +726,13 @@ def main() -> None:
                         print("fc parity leg failed, falling back to the "
                               f"plain engine: {str(e)[:200]}",
                               file=sys.stderr)
-                        deep_parity_rate, deep_parity_n, deep_parity_impl \
-                            = parity_stage(deep_cfg, dpar_groups,
-                                           deep_ticks, "xla")
+                        (deep_parity_rate, deep_parity_n, deep_parity_impl,
+                         deep_parity_triage) = parity_stage(
+                            deep_cfg, dpar_groups, deep_ticks, "xla")
                 else:
-                    deep_parity_rate, deep_parity_n, deep_parity_impl = \
-                        parity_stage(deep_cfg, dpar_groups,
-                                     deep_ticks, "xla")
+                    (deep_parity_rate, deep_parity_n, deep_parity_impl,
+                     deep_parity_triage) = parity_stage(
+                        deep_cfg, dpar_groups, deep_ticks, "xla")
             except Exception as e:
                 # A missing parity leg is an integrity gap, not a clean
                 # record: mark the stage suspect (same as the other gates).
@@ -868,6 +940,13 @@ def main() -> None:
          "flat": corner.get("mbdeep_shardedflat_gsps")},
         mailbox=True)
 
+    # Parity triage rollup (ISSUE 5): "clean" when every parity leg
+    # bit-matched; otherwise the FIRST failing leg's compact
+    # "<field>@t<tick>/g<group>" bisection (full report on stderr).
+    triage_status = next(
+        (t for t in (parity_triage, mail_parity_triage, deep_parity_triage)
+         if t is not None), "clean")
+
     baseline_group_steps_per_sec = 10.0
     record = dict({
         "metric": "raft_group_steps_per_sec_per_chip",
@@ -915,6 +994,14 @@ def main() -> None:
         "ilp_subtiles": ilp_subtiles,
         "pallas_vs_xla": round(pallas_vs_xla, 2),
         "xla_ticks_per_sec": round(xla_ticks_per_sec, 2),
+        # Flight-recorder aggregates of the headline run (ISSUE 5): the
+        # scan-carry telemetry counters from the MEDIAN rep, accumulated
+        # on device inside the timed scan and read back once
+        # (utils/telemetry.py documents each counter's semantics).
+        **{k: med_stats.get(k) for k in _tel_keys()},
+        # Parity triage (api/triage.py): bisection status across all
+        # parity legs; per-leg bisection reports go to stderr.
+        "triage_status": triage_status,
         # §10 mailbox stage (headline fault-soup config + 1-3-tick delays).
         "mailbox_group_steps_per_sec": round(mail_steps_per_sec, 1),
         "mailbox_elections_per_sec": round(mail_elections_per_sec, 1),
@@ -924,6 +1011,10 @@ def main() -> None:
         "mailbox_parity_rate": mail_parity_rate,
         "mailbox_parity_groups": mail_parity_n,
         "mailbox_parity_impl": mail_parity_impl,
+        # §10 in-flight high-water from the mailbox stage's recorder (the
+        # occupancy headroom figure for the capacity-1 slot design).
+        "mailbox_tel_inflight_hw": mstats[mail_times.index(mbest)].get(
+            "tel_mailbox_inflight_hw"),
         # Deep-log stage (BASELINE config 5 shape), same integrity envelope
         # as the headline: median of >=3 reps, suspect gates, and a
         # minimum-traffic roofline anchor (state read+written once per tick).
@@ -939,6 +1030,14 @@ def main() -> None:
         "deeplog_parity_rate": deep_parity_rate,
         "deeplog_parity_groups": deep_parity_n,
         "deeplog_parity_impl": deep_parity_impl,
+        # Deep-stage recorder aggregates (the fc engine counts per-tick OV
+        # events into tel_ov_fallbacks; the call-level flag stays above).
+        "deeplog_tel_elections": (
+            dstats[deep_times.index(dbest)].get("tel_elections_started")
+            if deep_steps_per_sec else None),
+        "deeplog_tel_commit_advances": (
+            dstats[deep_times.index(dbest)].get("tel_commit_advances")
+            if deep_steps_per_sec else None),
         "deeplog_rep_times_s": [round(t, 4) for t in deep_times],
         "deeplog_hbm_gb": round(deep_cfg.hbm_bytes() / 1e9, 2),
         "deeplog_suspect": bool(deep_suspect_reasons),

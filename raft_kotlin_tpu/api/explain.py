@@ -169,3 +169,16 @@ def explain(cfg: RaftConfig, group: int, tick_lo: int, tick_hi: int,
     for e in window:
         print(format_event(e), file=out)
     return window
+
+
+def explain_text(cfg: RaftConfig, group: int, tick_lo: int, tick_hi: int,
+                 schedule=None, fault_schedule=None):
+    """explain() rendered into a string: (events, text). The form
+    api/triage.py attaches to a divergence report (the triage artifact
+    carries the narrative, not just a pointer to it)."""
+    import io
+
+    buf = io.StringIO()
+    events = explain(cfg, group, tick_lo, tick_hi, out=buf,
+                     schedule=schedule, fault_schedule=fault_schedule)
+    return events, buf.getvalue()

@@ -1,0 +1,171 @@
+"""Automatic divergence triage (ISSUE 5): localize a TPU-vs-oracle
+bit-exactness failure to the first divergent (tick, group) and hand back
+everything a human needs to read it.
+
+PARITY.md makes bit-exactness against the scalar oracle the project's core
+contract, and the differential suites enforce it — but when a parity leg
+FAILS, the artifact so far has been a one-line "field X diverges first at
+tick=.. group=.." string (native/oracle.trace_parity). This module is the
+mechanical follow-through:
+
+1. **Bisect** — `find_divergence` compares the full per-tick trace
+   matrices and returns the lexicographically FIRST divergent
+   (tick, group) with every field that disagrees there. The traces are
+   already materialized arrays, so the "bisection" is one vectorized
+   argmax over the mismatch mask — exact, no re-execution.
+2. **Dump** — `triage` attaches both sides' complete per-node trace rows
+   at the divergent tick AND the tick before it (the last agreeing
+   state), so the transition that broke is visible without re-running
+   anything.
+3. **Explain** — the [tick - window, tick + window] narrative of the
+   divergent group rendered through api/explain.explain() (the oracle
+   replay with the event sink on): what the canonical serialization says
+   SHOULD have happened around the break.
+
+bench.py runs this automatically whenever a parity stage reports < 1.0
+and publishes a compact `triage_status` in the headline tail
+("clean" / "field@t<tick>/g<group>"), so the authoritative artifact
+records not just THAT parity broke but WHERE.
+
+Layout conventions match native/oracle.trace_parity: kernel traces are
+(T, N, G) groups-minor dicts (ops/tick.make_run(trace=True)); oracle
+traces are (T, G, N) int32 dicts (native.oracle.NativeOracle.run).
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Dict, Optional, TextIO
+
+import numpy as np
+
+from raft_kotlin_tpu.native.oracle import TRACE_FIELDS
+from raft_kotlin_tpu.utils.config import RaftConfig
+
+
+def find_divergence(ktr: Dict, otr: Dict) -> Optional[dict]:
+    """First divergent (tick, group) between a kernel trace `ktr`
+    ((T, N, G) groups-minor) and an oracle trace `otr` ((T, G, N)).
+
+    Returns None when every TRACE_FIELDS array bit-matches; otherwise
+    {"tick", "group", "fields", "kernel", "oracle"} where `fields` lists
+    every divergent field at that (tick, group) — commitIndex mismatches
+    (the ISSUE-5 headline case) surface here like any other field — and
+    kernel/oracle carry the full per-node rows of EVERY trace field there.
+    "First" is lexicographic (tick, then group): the earliest tick with
+    any mismatch, and within it the lowest group id — the canonical
+    bisection target (everything before it agrees bit-for-bit).
+    """
+    fields = [k for k in TRACE_FIELDS if k in ktr and k in otr]
+    assert fields, "no shared trace fields to compare"
+    kv = {k: np.asarray(ktr[k]).transpose(0, 2, 1).astype(np.int64)
+          for k in fields}  # (T, G, N)
+    ov = {k: np.asarray(otr[k]).astype(np.int64) for k in fields}
+    bad = None  # (T, G): any field/node mismatch
+    for k in fields:
+        neq = (kv[k] != ov[k]).any(axis=2)
+        bad = neq if bad is None else (bad | neq)
+    if not bad.any():
+        return None
+    bad_tick = bad.any(axis=1)
+    t = int(np.argmax(bad_tick))        # first True (argmax on bool)
+    g = int(np.argmax(bad[t]))
+    div_fields = [k for k in fields if (kv[k][t, g] != ov[k][t, g]).any()]
+    return {
+        "tick": t,
+        "group": g,
+        "fields": div_fields,
+        "kernel": {k: kv[k][t, g].tolist() for k in fields},
+        "oracle": {k: ov[k][t, g].tolist() for k in fields},
+    }
+
+
+def triage_status(div: Optional[dict]) -> str:
+    """The compact one-token form bench.py's headline tail publishes."""
+    if div is None:
+        return "clean"
+    return f"{div['fields'][0]}@t{div['tick']}/g{div['group']}"
+
+
+def triage(cfg: RaftConfig, n_ticks: Optional[int] = None,
+           ktr: Optional[Dict] = None, otr: Optional[Dict] = None,
+           window: int = 8, impl: str = "xla",
+           out: Optional[TextIO] = None) -> Optional[dict]:
+    """Full divergence triage for `cfg`: bisect, dump, explain.
+
+    `ktr`/`otr` may be supplied (e.g. bench.py's parity stage already holds
+    both); missing sides are produced here — the kernel via
+    ops/tick.make_run(trace=True, impl=impl), the oracle via the native C++
+    engine (bit-identical to the Python oracle by the differential suites).
+    `n_ticks` is required when a side must be produced; otherwise it is
+    read off the supplied traces.
+
+    Returns None when the traces bit-match. On divergence returns the
+    find_divergence dict extended with:
+    - "prev_kernel"/"prev_oracle": both sides' full rows at tick - 1 (the
+      last agreeing state; absent at tick 0),
+    - "explain_window": (lo, hi) tick bounds of the rendered narrative,
+    - "explain_events": the oracle event dicts in that window,
+    - "explain_text": the formatted narrative (api/explain.format_event),
+    and prints a human-readable report to `out` (None = no printing;
+    bench.py passes sys.stderr).
+    """
+    if ktr is None:
+        from raft_kotlin_tpu.models.state import init_state
+        from raft_kotlin_tpu.ops.tick import make_run
+
+        assert n_ticks is not None, "n_ticks needed to produce the kernel trace"
+        _, ktr = make_run(cfg, n_ticks, trace=True, impl=impl)(init_state(cfg))
+    if otr is None:
+        from raft_kotlin_tpu.native.oracle import NativeOracle
+
+        T = n_ticks if n_ticks is not None \
+            else np.asarray(next(iter(ktr.values()))).shape[0]
+        otr = NativeOracle(cfg).run(int(T))
+
+    div = find_divergence(ktr, otr)
+    if div is None:
+        return None
+    t, g = div["tick"], div["group"]
+    if t > 0:
+        kv = {k: np.asarray(ktr[k])[t - 1, :, g].tolist() for k in div["kernel"]}
+        ovp = {k: np.asarray(otr[k])[t - 1, g].tolist() for k in div["oracle"]}
+        div["prev_kernel"], div["prev_oracle"] = kv, ovp
+
+    from raft_kotlin_tpu.api.explain import explain_text
+
+    lo, hi = max(0, t - window), t + window
+    try:
+        events, text = explain_text(cfg, g, lo, hi)
+    except Exception as e:  # the report must survive a replay failure
+        events, text = [], f"(explain replay failed: {e})"
+    div["explain_window"] = (lo, hi)
+    div["explain_events"] = events
+    div["explain_text"] = text
+
+    if out is not None:
+        print(format_report(div), file=out)
+    return div
+
+
+def format_report(div: dict) -> str:
+    """Human-readable triage report (one string; bench.py sends it to
+    stderr so the stdout JSON contract stays intact)."""
+    t, g = div["tick"], div["group"]
+    lines = [
+        f"=== TRIAGE: first divergence at tick={t} group={g} "
+        f"(fields: {', '.join(div['fields'])}) ===",
+        "state at the divergent tick (per node, kernel vs oracle):",
+    ]
+    for k in div["kernel"]:
+        mark = "  <-- DIVERGES" if k in div["fields"] else ""
+        lines.append(f"  {k:>11}: kernel={div['kernel'][k]} "
+                     f"oracle={div['oracle'][k]}{mark}")
+    if "prev_kernel" in div:
+        lines.append(f"last agreeing state (tick {t - 1}):")
+        for k in div["prev_kernel"]:
+            lines.append(f"  {k:>11}: {div['prev_kernel'][k]}")
+    lo, hi = div["explain_window"]
+    lines.append(f"oracle narrative for group {g}, ticks {lo}..{hi}:")
+    lines.append(div["explain_text"].rstrip())
+    return "\n".join(lines)

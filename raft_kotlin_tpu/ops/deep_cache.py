@@ -56,6 +56,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from raft_kotlin_tpu.utils import telemetry as telemetry_mod
+
 _I32 = jnp.int32
 
 # Pair-shaped value fields and the node-shaped top window, canonical order.
@@ -179,7 +181,8 @@ def refill_all(cfg, state) -> dict:
     return fc
 
 
-def make_deep_scan(cfg, n_ticks: int, return_state: bool = False):
+def make_deep_scan(cfg, n_ticks: int, return_state: bool = False,
+                   telemetry: bool = False):
     """Multi-tick runner for the frontier-cached deep engine.
 
     run(state, rng[, summarize]) executes n_ticks through the fcache tick
@@ -191,7 +194,12 @@ def make_deep_scan(cfg, n_ticks: int, return_state: bool = False):
     of host-materializable scalars: rounds, livepin, ov (0/1), plus
     whatever `summarize(end_state)` adds. The callable is marked
     `self_timed` for bench.measure (it manages its own jit; measure times
-    it through the same host-materialization discipline)."""
+    it through the same host-materialization discipline).
+
+    telemetry=True additionally accumulates the scan-carry flight recorder
+    (utils/telemetry.py — incl. per-tick OV events as ov_fallbacks) and
+    merges its counters into the reduction dict as tel_* keys. Bits are
+    untouched (the recorder only reads the states the scan carries)."""
     from raft_kotlin_tpu.models.state import RaftState
     from raft_kotlin_tpu.ops import tick as tick_mod
 
@@ -205,7 +213,8 @@ def make_deep_scan(cfg, n_ticks: int, return_state: bool = False):
         assert flags.batched, "make_deep_scan needs a batched-engine config"
         s = tick_mod.flatten_state(cfg, state)
         fc = dict(fc)
-        el_dirty = tick_mod.phase_body(cfg, s, aux, flags, fcache=fc)
+        with telemetry_mod.engine_scope("xla-fcache"):
+            el_dirty = tick_mod.phase_body(cfg, s, aux, flags, fcache=fc)
         ov = fc.pop("ov")
         st = tick_mod.finish_tick(cfg, tkeys, tick_mod.unflatten_state(cfg, s),
                                   el_dirty, state.tick)
@@ -215,26 +224,31 @@ def make_deep_scan(cfg, n_ticks: int, return_state: bool = False):
         def run(st, fc, rng):
             def body(carry, _):
                 if with_fc:
-                    s, f, acc, ova = carry
+                    s, f, acc, ova, tel = carry
                     s2, f2, ov = tick_fn(s, f, rng)
-                    ova = ova | jnp.any(ov)
+                    ov_t = jnp.any(ov)
+                    ova = ova | ov_t
                 else:
-                    s, f, acc, ova = carry
+                    s, f, acc, ova, tel = carry
                     s2, f2 = tick_fn(s, rng=rng), f
+                    ov_t = None
+                if tel is not None:
+                    tel = telemetry_mod.telemetry_step(s, s2, tel, ov=ov_t)
                 acc = acc + jnp.sum(s2.log_cmd[:, 0, :].astype(_I32))
-                return (s2, f2, acc, ova), None
+                return (s2, f2, acc, ova, tel), None
 
-            carry0 = (st, fc, jnp.zeros((), _I32), jnp.zeros((), bool))
-            (end, _, acc, ova), _ = jax.lax.scan(
+            tel0 = telemetry_mod.telemetry_zeros() if telemetry else None
+            carry0 = (st, fc, jnp.zeros((), _I32), jnp.zeros((), bool), tel0)
+            (end, _, acc, ova, tel), _ = jax.lax.scan(
                 body, carry0, None, length=n_ticks)
-            return end, acc, ova
+            return end, acc, ova, tel
         return run
 
     fc_scan = scan_of(fc_tick, True)
     plain_scan = scan_of(lambda s, rng: tick_plain(s, rng=rng), False)
 
-    def reductions(end, acc, ova, summarize):
-        return _reduction(end, acc, ova.astype(_I32), summarize)
+    def reductions(end, acc, ova, tel, summarize):
+        return _reduction(end, acc, ova.astype(_I32), summarize, tel=tel)
 
     refill_jit = jax.jit(lambda s: refill_all(cfg, s))
 
@@ -245,10 +259,10 @@ def make_deep_scan(cfg, n_ticks: int, return_state: bool = False):
         jplain_s = jax.jit(lambda s, r: plain_scan(s, None, r))
 
         def run_state(st, rng):
-            end, _, ova = jfc_s(st, rng, refill_jit(st))
+            end, _, ova, _tel = jfc_s(st, rng, refill_jit(st))
             ov = bool(jax.device_get(ova))
             if ov:
-                end, _, _ = jplain_s(st, rng)
+                end, _, _, _tel = jplain_s(st, rng)
             return end, ov
 
         return run_state
@@ -270,43 +284,56 @@ def make_deep_scan(cfg, n_ticks: int, return_state: bool = False):
         fc = refill_jit(st)
         vals = {k: v for k, v in jfc(st, rng, fc).items()}
         if int(jax.device_get(vals["ov"])):
+            # The plain rerun carries no cache, so its recorder never sees
+            # OV events — publish the fc attempt's per-tick OV count (the
+            # ticks whose bits the rerun replaced; the counter's semantics)
+            # instead of the rerun's structural 0.
+            fc_ov_ticks = vals.get("tel_ov_fallbacks")
             vals = {k: v for k, v in jplain(st, rng).items()}
             vals["ov"] = jnp.ones((), _I32)
+            if fc_ov_ticks is not None:
+                vals["tel_ov_fallbacks"] = fc_ov_ticks
         return vals
 
     run.self_timed = True
     return run
 
 
-def _reduction(end, acc, ov, summarize):
+def _reduction(end, acc, ov, summarize, tel=None):
     """THE bench reduction contract (rounds / livepin / ov keys +
-    summarize extras) — one copy, shared by every runner here so the A/B
-    legs measure() compares can never desynchronize on it."""
+    summarize extras + optional tel_* flight-recorder counters) — one copy,
+    shared by every runner here so the A/B legs measure() compares can
+    never desynchronize on it."""
     out = {"rounds": jnp.sum(end.rounds), "livepin": acc, "ov": ov}
+    if tel is not None:
+        out.update({f"tel_{k}": v for k, v in tel.items()})
     if summarize is not None:
         out.update(summarize(end))
     return out
 
 
-def _livepin_scan(tick, n_ticks):
+def _livepin_scan(tick, n_ticks, telemetry: bool = False):
     """lax.scan of a per-tick sharded engine under the bench livepin
     discipline (one log_cmd row observed through the carry every tick so
     XLA cannot dead-carry-eliminate the payload chain — bench.measure's
-    elision trap), with optional per-tick trace emission. The single copy
-    of the plain-scan body shared by the non-fc sharded runners and the
-    fc runner's OV fallback; scan(st, rng[, with_trace]) ->
-    (end, livepin, trace_ys)."""
+    elision trap), with optional per-tick trace emission and optional
+    flight-recorder accumulation. The single copy of the plain-scan body
+    shared by the non-fc sharded runners and the fc runner's OV fallback;
+    scan(st, rng[, with_trace]) -> (end, livepin, tel_or_None, trace_ys)."""
     def scan(st, rng, with_trace=False):
         def body(carry, _):
-            s, acc = carry
+            s, acc, tel = carry
             s2 = tick(s, rng)
             acc = acc + jnp.sum(s2.log_cmd[:, 0, :].astype(_I32))
+            if tel is not None:
+                tel = telemetry_mod.telemetry_step(s, s2, tel)
             y = _trace_row(s2) if with_trace else None
-            return (s2, acc), y
+            return (s2, acc, tel), y
 
-        (end, acc), ys = jax.lax.scan(
-            body, (st, jnp.zeros((), _I32)), None, length=n_ticks)
-        return end, acc, ys
+        tel0 = telemetry_mod.telemetry_zeros() if telemetry else None
+        (end, acc, tel), ys = jax.lax.scan(
+            body, (st, jnp.zeros((), _I32), tel0), None, length=n_ticks)
+        return end, acc, tel, ys
 
     return scan
 
@@ -336,7 +363,8 @@ def _sharded_default_rng(cfg, mesh):
 
 
 def _make_sharded_plain_scan(cfg, mesh, n_ticks: int, engine: str,
-                             return_state: bool = False):
+                             return_state: bool = False,
+                             telemetry: bool = False):
     """The non-fc sharded deep runners behind make_sharded_deep_scan's
     routing: the per-shard BATCHED or per-pair FLAT shard_map engine
     (parallel.mesh._make_shardmap_xla_tick) scanned for n_ticks under the
@@ -347,7 +375,8 @@ def _make_sharded_plain_scan(cfg, mesh, n_ticks: int, engine: str,
 
     tick = mesh_mod._make_shardmap_xla_tick(
         cfg, mesh, batched=(engine == "batched"))
-    scan = _livepin_scan(lambda s, rng: tick(s, rng), n_ticks)
+    scan = _livepin_scan(lambda s, rng: tick(s, rng), n_ticks,
+                         telemetry=telemetry)
     default_rng = _sharded_default_rng(cfg, mesh)
 
     if return_state:
@@ -355,7 +384,7 @@ def _make_sharded_plain_scan(cfg, mesh, n_ticks: int, engine: str,
 
         def run_state(st, rng=None):
             rng = rng if rng is not None else default_rng()
-            end, _, _ys = jscan(st, rng)
+            end, _, _tel, _ys = jscan(st, rng)
             return end, False
 
         return run_state
@@ -366,8 +395,9 @@ def _make_sharded_plain_scan(cfg, mesh, n_ticks: int, engine: str,
         rng = rng if rng is not None else default_rng()
         if summarize not in jitted:
             def reduced(s, r):
-                end, acc, _ys = scan(s, r)
-                return _reduction(end, acc, jnp.zeros((), _I32), summarize)
+                end, acc, tel, _ys = scan(s, r)
+                return _reduction(end, acc, jnp.zeros((), _I32), summarize,
+                                  tel=tel)
 
             jitted[summarize] = jax.jit(reduced)
         return dict(jitted[summarize](st, rng).items())
@@ -387,7 +417,8 @@ def _trace_row(st):
 def make_sharded_deep_scan(cfg, mesh, n_ticks: int,
                            return_state: bool = False,
                            engine: str = "auto",
-                           trace: bool = False):
+                           trace: bool = False,
+                           telemetry: bool = False):
     """The sharded deep-log runner — and, since round 6, the deep band's
     engine ROUTER: `engine="auto"` (the default every production caller
     uses) picks the per-shard engine ("fc" | "batched" | "flat") from
@@ -425,6 +456,13 @@ def make_sharded_deep_scan(cfg, mesh, n_ticks: int,
     overflow the call re-runs on the plain sharded batched engine
     (parallel.mesh.make_sharded_run) — bits never depend on the cache.
 
+    `telemetry=True` (reduction mode only) accumulates the scan-carry
+    flight recorder (utils/telemetry.py; per-tick OV events count into
+    ov_fallbacks) and merges tel_* counters into the reduction dict. The
+    recorder reads the globally-sharded states OUTSIDE shard_map, so its
+    scalar reductions are the same class of cross-shard collectives as the
+    livepin — and the per-shard engine program is untouched.
+
     run(state, rng=None[, summarize]) -> dict of host scalars (self_timed,
     bench.measure contract); with return_state=True -> (state, ov)."""
     import math
@@ -455,7 +493,7 @@ def make_sharded_deep_scan(cfg, mesh, n_ticks: int,
     if engine != "fc":
         assert not trace, "trace mode is the fc parity leg's observable"
         return _make_sharded_plain_scan(cfg, mesh, n_ticks, engine,
-                                        return_state)
+                                        return_state, telemetry=telemetry)
     flags = tick_mod.make_flags(cfg)
     assert flags.batched, "make_sharded_deep_scan needs a batched config"
     sfields = tick_mod.state_fields(flags)
@@ -507,12 +545,13 @@ def make_sharded_deep_scan(cfg, mesh, n_ticks: int,
 
         ins = ([flat[k] for k in sfields] + [aux[k] for k in aux_names]
                + [fc[k] for k in FC])
-        outs = mesh_mod.shard_map_compat(
-            body, mesh=mesh,
-            in_specs=(lanes,) * len(ins),
-            out_specs=(lanes,) * (n_s + len(FC) + 2),
-            check_vma=False,
-        )(*ins)
+        with telemetry_mod.engine_scope("shardmap-fcache"):
+            outs = mesh_mod.shard_map_compat(
+                body, mesh=mesh,
+                in_specs=(lanes,) * len(ins),
+                out_specs=(lanes,) * (n_s + len(FC) + 2),
+                check_vma=False,
+            )(*ins)
         s2 = dict(zip(sfields, outs[:n_s]))
         fc2 = dict(zip(FC, outs[n_s:n_s + len(FC)]))
         st2 = tick_mod.finish_tick(
@@ -524,16 +563,20 @@ def make_sharded_deep_scan(cfg, mesh, n_ticks: int,
         fc0 = refill_shard(st)
 
         def body(carry, _):
-            s, f, acc, ova = carry
+            s, f, acc, ova, tel = carry
             s2, f2, ov = tick_fc(s, f, rng)
             acc = acc + jnp.sum(s2.log_cmd[:, 0, :].astype(_I32))
+            ov_t = jnp.any(ov)
+            if tel is not None:
+                tel = telemetry_mod.telemetry_step(s, s2, tel, ov=ov_t)
             y = _trace_row(s2) if with_trace else None
-            return (s2, f2, acc, ova | jnp.any(ov)), y
+            return (s2, f2, acc, ova | ov_t, tel), y
 
-        carry0 = (st, fc0, jnp.zeros((), _I32), jnp.zeros((), bool))
-        (end, _, acc, ova), ys = jax.lax.scan(
+        tel0 = telemetry_mod.telemetry_zeros() if telemetry else None
+        carry0 = (st, fc0, jnp.zeros((), _I32), jnp.zeros((), bool), tel0)
+        (end, _, acc, ova, tel), ys = jax.lax.scan(
             body, carry0, None, length=n_ticks)
-        return end, acc, ova, ys
+        return end, acc, ova, tel, ys
 
     # Plain sharded fallback: the per-tick shard_map BATCHED engine
     # (parallel/mesh's deep route), scanned with the SAME rng operand the
@@ -541,7 +584,8 @@ def make_sharded_deep_scan(cfg, mesh, n_ticks: int,
     # the cfg-seed's (and is built ONCE, so an overflow rep pays execution,
     # not a retrace).
     plain_tick = mesh_mod._make_shardmap_xla_tick(cfg, mesh)
-    scan_plain = _livepin_scan(lambda s, rng: plain_tick(s, rng), n_ticks)
+    scan_plain = _livepin_scan(lambda s, rng: plain_tick(s, rng), n_ticks,
+                               telemetry=telemetry)
 
     default_rng = _sharded_default_rng(cfg, mesh)
 
@@ -555,10 +599,10 @@ def make_sharded_deep_scan(cfg, mesh, n_ticks: int,
 
         def run_trace(st, rng=None):
             rng = rng if rng is not None else default_rng()
-            _, _, ova, ys = jfc_t(st, rng)
+            _, _, ova, _tel, ys = jfc_t(st, rng)
             ov = bool(jax.device_get(ova))
             if ov:
-                _, _, ys = jplain_t(st, rng)
+                _, _, _tel, ys = jplain_t(st, rng)
             return jax.device_get(ys), ov
 
         return run_trace
@@ -569,10 +613,10 @@ def make_sharded_deep_scan(cfg, mesh, n_ticks: int,
 
         def run_state(st, rng=None):
             rng = rng if rng is not None else default_rng()
-            end, _, ova, _ys = jfc_s(st, rng)
+            end, _, ova, _tel, _ys = jfc_s(st, rng)
             ov = bool(jax.device_get(ova))
             if ov:
-                end, _, _ys = jplain_s(st, rng)
+                end, _, _tel, _ys = jplain_s(st, rng)
             return end, ov
 
         return run_state
@@ -586,18 +630,25 @@ def make_sharded_deep_scan(cfg, mesh, n_ticks: int,
         rng = rng if rng is not None else default_rng()
         if summarize not in jitted:
             def reduced(s, r):
-                end, acc, ova, _ys = scan_fc(s, r)
-                return _reduction(end, acc, ova.astype(_I32), summarize)
+                end, acc, ova, tel, _ys = scan_fc(s, r)
+                return _reduction(end, acc, ova.astype(_I32), summarize,
+                                  tel=tel)
 
             def reduced_plain(s, r):
-                end, acc, _ys = scan_plain(s, r)
-                return _reduction(end, acc, jnp.ones((), _I32), summarize)
+                end, acc, tel, _ys = scan_plain(s, r)
+                return _reduction(end, acc, jnp.ones((), _I32), summarize,
+                                  tel=tel)
 
             jitted[summarize] = (jax.jit(reduced), jax.jit(reduced_plain))
         jfc, jplain = jitted[summarize]
         vals = dict(jfc(st, rng).items())
         if int(jax.device_get(vals["ov"])):
+            # As in make_deep_scan: the plain rerun's recorder sees no OV
+            # events, so keep the fc attempt's per-tick fallback count.
+            fc_ov_ticks = vals.get("tel_ov_fallbacks")
             vals = dict(jplain(st, rng).items())
+            if fc_ov_ticks is not None:
+                vals["tel_ov_fallbacks"] = fc_ov_ticks
         return vals
 
     run.self_timed = True

@@ -629,7 +629,8 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
                      k_per_launch: int = 1,
                      jitted: bool = True,
                      _resets_bound: Optional[int] = None,
-                     ilp_subtiles: Optional[int] = None):
+                     ilp_subtiles: Optional[int] = None,
+                     telemetry: bool = False):
     """Multi-tick Pallas runner with a FLAT int32 scan carry.
 
     Scanning make_pallas_tick converts RaftState <-> the kernel's flat int32
@@ -653,12 +654,25 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
     (make_pallas_core; None = route_ilp_subtiles per shape, 1 on CPU).
     The archival K-tick kernel stays at K_sub=1.
 
+    `telemetry=True` threads the scan-carry flight recorder
+    (utils/telemetry.py) through the flat carry — the accumulation reads
+    the pre/post-tick flat state BETWEEN kernel launches (plain XLA
+    reductions; the Mosaic kernel and its bits are untouched) — and run
+    returns (state, telemetry) instead of state. Requires k_per_launch=1:
+    the archival K-tick kernel exposes no per-tick state to read.
+
     Returns run(state, rng) -> state (jitted; rng rides as an operand so the
     compilation is seed-independent, as everywhere else)."""
     import types
 
+    from raft_kotlin_tpu.utils import telemetry as telemetry_mod
+
     N, G = cfg.n_nodes, cfg.n_groups
     K = max(1, k_per_launch)
+    if telemetry and K > 1:
+        raise ValueError(
+            "telemetry needs k_per_launch == 1: the K-tick kernel exposes "
+            "no per-tick state between launches (archival path)")
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
     tile_g, ilp_subtiles = resolve_scan_geometry(
@@ -685,20 +699,29 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
                 flat[k] = flat[k].astype(_I32)
 
         def body(carry, _):
-            s, t = carry
+            s, t, tel = carry
             shim = types.SimpleNamespace(
                 tick=t, t_ctr=s["t_ctr"], b_ctr=s["b_ctr"])
             aux, flags = tick_mod.make_aux(
                 cfg, base, tkeys, bkeys, shim, None, None)
             call, sfields, aux_names = build_call(flags)
-            outs = call(*([s[k] for k in sfields] + cast_aux_in(aux, aux_names)))
+            with telemetry_mod.engine_scope("pallas"):
+                outs = call(*([s[k] for k in sfields]
+                              + cast_aux_in(aux, aux_names)))
             s2 = dict(zip(sfields, outs[:-1]))
             s2["el_left"] = tick_mod.materialize_el(
                 cfg, tkeys, s2, outs[-1] != 0)
-            return (s2, t + 1), None
+            if tel is not None:
+                # Flight recorder on the flat carry (ISSUE 5): plain XLA
+                # reductions over the pre/post kernel-form state — the
+                # kernel itself, its blocks and its bits are untouched.
+                tel = telemetry_mod.telemetry_step_arrays(
+                    telemetry_mod.flat_view(s, N),
+                    telemetry_mod.flat_view(s2, N), tel)
+            return (s2, t + 1, tel), None
 
         def body_k(carry, _):
-            s, t = carry
+            s, t, tel = carry  # tel is None here (telemetry rejects K > 1)
             per, flags = [], None
             for k in range(K):
                 shim = types.SimpleNamespace(
@@ -716,21 +739,24 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
             outs = call(*([s[k] for k in sfields_k] + slabs
                           + [el_tab, b_tab]))
             # Last output = the launch's (N, G) draw-table overflow counts.
-            return ((dict(zip(sfields_k, outs[:-1])), t + K),
+            return ((dict(zip(sfields_k, outs[:-1])), t + K, tel),
                     jnp.sum(outs[-1]))
 
-        flat_t = (flat, state.tick)
+        tel0 = telemetry_mod.telemetry_zeros() if telemetry else None
+        flat_t = (flat, state.tick, tel0)
         ov_total = jnp.zeros((), _I32)
         if n_launch:
             flat_t, ovs = jax.lax.scan(body_k, flat_t, None, length=n_launch)
             ov_total = jnp.sum(ovs)
         if rem:
             flat_t, _ = jax.lax.scan(body, flat_t, None, length=rem)
-        flat, t = flat_t
+        flat, t, tel = flat_t
         s, _ = cast_flat_out(cfg, [flat[k] for k in sfields], sfields,
                              with_dirty=False)
         end = RaftState(**tick_mod.unflatten_state(cfg, s), tick=t)
-        return (end, ov_total) if K > 1 else end
+        if K > 1:
+            return end, ov_total
+        return (end, tel) if telemetry else end
 
     # jitted=False hands the traceable fn to callers that embed it in a
     # larger jit (bench.measure reduces the end state to scalars INSIDE one

@@ -45,6 +45,7 @@ from raft_kotlin_tpu.models.state import (
     RaftState,
 )
 from raft_kotlin_tpu.utils import rng as rngmod
+from raft_kotlin_tpu.utils import telemetry as telemetry_mod
 from raft_kotlin_tpu.utils.config import RaftConfig
 
 _I32 = jnp.int32
@@ -226,6 +227,24 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
     attribution passes it explicitly; None reads the RAFT_PHASE_CUT env
     var (scripts/probe_phase_cuts.py's on-hardware timing ablation).
     """
+    # Phase-scoped profiler regions (ISSUE 5): every op traced in the
+    # lattice carries a raft/<phase> name matching opcount.
+    # phase_body_chain_depth's by-phase attribution keys, so Perfetto op
+    # groups line up with the chain-depth model. Trace-time metadata only.
+    # The try/finally restores the thread-local name stack even when
+    # tracing aborts mid-lattice (e.g. an engine candidate rejected at
+    # trace time) — a leaked scope would prefix every later trace's names.
+    _ps = telemetry_mod.PhaseScopes()
+    try:
+        return _phase_lattice(cfg, s, aux, flags, fcache, cut, _ps)
+    finally:
+        _ps.close()
+
+
+def _phase_lattice(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
+                   fcache: Optional[dict], cut: Optional[int], _ps):
+    """phase_body's lattice (all semantics documented there); `_ps` is the
+    caller-owned profiler scope manager, closed by the caller."""
     N, C, maj = cfg.n_nodes, cfg.log_capacity, cfg.majority
     G = s["term"].shape[-1]
     # Probe-only phase ablation (scripts/probe_phase_cuts.py): compile the
@@ -247,6 +266,8 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
                 "meaningless. Probe-only — unset RAFT_PHASE_CUT for real "
                 "simulations.",
                 stacklevel=2)
+
+    _ps.enter("F0")
 
     # Logs live as PER-NODE (C, G) slices for the duration of the phase
     # lattice (static slices of the flat (N*C, G) layout — free in XLA,
@@ -807,8 +828,10 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
                 s["last_term"], n - 1, jnp.where(li_n >= 1, raw, 0))
 
     if cut < 1:
+        _ps.close()
         return aux_dirty["m"]
     # -- phase 1: timers (independent countdowns) ---------------------------
+    _ps.enter("p1")
 
     armed = s["el_armed"] & up
     left = s["el_left"] - armed.astype(s["el_left"].dtype)
@@ -826,8 +849,10 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
     start_round = start_round | bfire
 
     if cut < 2:
+        _ps.close()
         return aux_dirty["m"]
     # -- phase 2: round starts ---------------------------------------------
+    _ps.enter("p2")
 
     is_cand = s["role"] == CANDIDATE
     init = start_round & is_cand
@@ -846,8 +871,10 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
     reset_el_timer_grid(demoted_bo)
 
     if cut < 3:
+        _ps.close()
         return aux_dirty["m"]
     # -- phase 3: vote exchanges --------------------------------------------
+    _ps.enter("p3")
 
     # Hoisted per-node last-log position/term: INVARIANT across phase 3 (no
     # vote path touches logs or last_index), so the N*N pairs share N reads
@@ -1003,7 +1030,9 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
         exit_cols()  # phase 4 is grid-wide
     if cut < 4:
         flush_resets()
+        _ps.close()
         return aux_dirty["m"]
+    _ps.enter("p4")
     act = (s["round_state"] == ACTIVE) & up
     concl = act & ((s["responses"] >= maj) | (s["round_left"] <= 0))
     is_cand = s["role"] == CANDIDATE
@@ -1040,8 +1069,10 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
 
     if cut < 5:
         flush_resets()
+        _ps.close()
         return aux_dirty["m"]
     # -- phase 5: append / heartbeat ----------------------------------------
+    _ps.enter("p5")
 
     def append_exchange(l, p, act5, req_term, req_commit, pli, plt,
                         has_entry, ent_t, ent_c, p_plt=None):
@@ -1819,6 +1850,7 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
         fcache["ov"] = fc_ov["v"]
 
     flush_resets()
+    _ps.close()
     return aux_dirty["m"]
 
 
@@ -2021,7 +2053,7 @@ def make_tick(cfg: RaftConfig, batched: Optional[bool] = None,
 
 
 def make_run(cfg: RaftConfig, n_ticks: int, trace: bool = True, impl: str = "xla",
-             batched: Optional[bool] = None):
+             batched: Optional[bool] = None, telemetry: bool = False):
     """jitted runner: state -> (state, trace) stepping n_ticks via lax.scan.
 
     trace is a dict of (T, N, G) arrays (role/term/commit/last_index/voted_for/rounds/
@@ -2031,6 +2063,10 @@ def make_run(cfg: RaftConfig, n_ticks: int, trace: bool = True, impl: str = "xla
     batched=False forces the per-pair deep-log engine (BodyFlags.batched) —
     XLA:CPU compiles of the batched engine blow up on int16 deep configs, so
     CPU-bound tests of such configs pass this.
+    telemetry=True additionally threads the scan-carry flight recorder
+    (utils/telemetry.py — scalar counters, read back once) and returns
+    (state, trace, telemetry) instead; the protocol bits are unchanged
+    (the recorder only reads the states the scan already carries).
     """
     if impl == "pallas":
         from raft_kotlin_tpu.ops.pallas_tick import make_pallas_tick
@@ -2042,23 +2078,29 @@ def make_run(cfg: RaftConfig, n_ticks: int, trace: bool = True, impl: str = "xla
 
     @jax.jit
     def run(st, rng):
-        def body(st, _):
-            st = tick_fn(st, rng=rng)
+        def body(carry, _):
+            st, tel = carry
+            with telemetry_mod.engine_scope(impl):
+                st2 = tick_fn(st, rng=rng)
             if trace:
                 out = {
-                    "role": st.role,
-                    "term": st.term,
-                    "commit": st.commit,
-                    "last_index": st.last_index,
-                    "voted_for": st.voted_for,
-                    "rounds": st.rounds,
-                    "up": st.up,
+                    "role": st2.role,
+                    "term": st2.term,
+                    "commit": st2.commit,
+                    "last_index": st2.last_index,
+                    "voted_for": st2.voted_for,
+                    "rounds": st2.rounds,
+                    "up": st2.up,
                 }
             else:
-                out = jnp.sum((st.role == LEADER).astype(_I32), axis=0)
-            return st, out
+                out = jnp.sum((st2.role == LEADER).astype(_I32), axis=0)
+            if telemetry:
+                tel = telemetry_mod.telemetry_step(st, st2, tel)
+            return (st2, tel), out
 
-        return lax.scan(body, st, None, length=n_ticks)
+        tel0 = telemetry_mod.telemetry_zeros() if telemetry else None
+        (end, tel), ys = lax.scan(body, (st, tel0), None, length=n_ticks)
+        return (end, ys, tel) if telemetry else (end, ys)
 
     # rng rides the jit boundary as an operand (seed-independent program).
     return lambda st: run(st, rng)

@@ -35,6 +35,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from raft_kotlin_tpu.models.state import RaftState, init_state
 from raft_kotlin_tpu.ops.tick import make_tick
+from raft_kotlin_tpu.utils import telemetry as telemetry_mod
 from raft_kotlin_tpu.utils.config import RaftConfig
 from raft_kotlin_tpu.constants import LEADER
 
@@ -266,7 +267,9 @@ def _make_shardmap_pallas_tick(cfg: RaftConfig, mesh: Mesh,
             # embarrassingly parallel over lanes, so the check adds nothing.
             check_vma=False,
         )
-        s, el_dirty = cast_flat_out(cfg, shard_call(*ins), sfields)
+        with telemetry_mod.engine_scope("shardmap-pallas"):
+            outs = shard_call(*ins)
+        s, el_dirty = cast_flat_out(cfg, outs, sfields)
         return tick_mod.finish_tick(
             cfg, tkeys, tick_mod.unflatten_state(cfg, s), el_dirty, state.tick)
 
@@ -336,12 +339,13 @@ def _make_shardmap_xla_tick(cfg: RaftConfig, mesh: Mesh,
             return tuple(s[k] for k in sfields) + (el_dirty,)
 
         ins = [flat[k] for k in sfields] + [aux[k] for k in aux_names]
-        outs = shard_map_compat(
-            body, mesh=mesh,
-            in_specs=(lanes_spec,) * len(ins),
-            out_specs=(lanes_spec,) * (len(sfields) + 1),
-            check_vma=False,
-        )(*ins)
+        with telemetry_mod.engine_scope("shardmap-xla"):
+            outs = shard_map_compat(
+                body, mesh=mesh,
+                in_specs=(lanes_spec,) * len(ins),
+                out_specs=(lanes_spec,) * (len(sfields) + 1),
+                check_vma=False,
+            )(*ins)
         s = dict(zip(sfields, outs[:-1]))
         return tick_mod.finish_tick(
             cfg, tkeys, tick_mod.unflatten_state(cfg, s), outs[-1], state.tick)
@@ -350,7 +354,8 @@ def _make_shardmap_xla_tick(cfg: RaftConfig, mesh: Mesh,
 
 
 def make_sharded_run(cfg: RaftConfig, mesh: Mesh, n_ticks: int,
-                     metrics_every: int = 0, impl: str = "xla"):
+                     metrics_every: int = 0, impl: str = "xla",
+                     telemetry: bool = False):
     """Compile run(state [, inject]) -> (state, metrics) sharded over `mesh`.
 
     metrics: dict of cross-group reductions emitted every `metrics_every` ticks
@@ -366,6 +371,13 @@ def make_sharded_run(cfg: RaftConfig, mesh: Mesh, n_ticks: int,
 
     impl: "xla" (default — the SPMD partitioner splits the tick shard-locally) or
     "pallas" (the megakernel per shard via shard_map).
+
+    telemetry=True threads the scan-carry flight recorder
+    (utils/telemetry.py) through the run and returns
+    (state, metrics, telemetry) — the recorder's scalar reductions run on
+    the globally-sharded states OUTSIDE shard_map (the same collective
+    class as the window metrics; zero per-tick host traffic, read back
+    once). Protocol bits are unchanged.
     """
     from raft_kotlin_tpu.ops.tick import make_rng
 
@@ -421,21 +433,33 @@ def make_sharded_run(cfg: RaftConfig, mesh: Mesh, n_ticks: int,
         }
 
     def run(st, rng):
-        one = lambda s, _: (tick_fn(s, rng), None)
+        def one(carry, _):
+            s, tel = carry
+            s2 = tick_fn(s, rng)
+            if tel is not None:
+                tel = telemetry_mod.telemetry_step(s, s2, tel)
+            return (s2, tel), None
+
+        tel0 = telemetry_mod.telemetry_zeros() if telemetry else None
         if not metrics_every:
-            st, _ = jax.lax.scan(one, st, None, length=n_ticks)
-            return st, None
+            (st, tel), _ = jax.lax.scan(one, (st, tel0), None, length=n_ticks)
+            return (st, None, tel) if telemetry else (st, None)
 
-        def win(st, _):
+        def win(carry, _):
+            st, tel = carry
             rounds0 = _rounds_sum(st)
-            st, _ = jax.lax.scan(one, st, None, length=metrics_every)
-            return st, window_metrics(st, rounds0)
+            (st, tel), _ = jax.lax.scan(one, (st, tel), None,
+                                        length=metrics_every)
+            return (st, tel), window_metrics(st, rounds0)
 
-        st, ms = jax.lax.scan(win, st, None, length=n_ticks // metrics_every)
+        (st, tel), ms = jax.lax.scan(win, (st, tel0), None,
+                                     length=n_ticks // metrics_every)
         if n_ticks % metrics_every:
-            st, _ = jax.lax.scan(one, st, None, length=n_ticks % metrics_every)
-        return st, ms
+            (st, tel), _ = jax.lax.scan(one, (st, tel), None,
+                                        length=n_ticks % metrics_every)
+        return (st, ms, tel) if telemetry else (st, ms)
 
-    jitted = jax.jit(run, in_shardings=(sh, rng_sh),
-                     out_shardings=(sh, rep if metrics_every else None))
+    out_sh = (sh, rep if metrics_every else None) + ((rep,) if telemetry
+                                                     else ())
+    jitted = jax.jit(run, in_shardings=(sh, rng_sh), out_shardings=out_sh)
     return lambda st: jitted(st, rng_placed)

@@ -1,0 +1,269 @@
+"""Scan-carry flight recorder + phase/engine profiler scopes (ISSUE 5).
+
+The host-side observability path (utils/metrics.MetricsRecorder over
+make_instrumented_run) is a per-window JSONL stream — right for dashboards,
+wrong inside a production `jit`/`scan`/`shard_map` soak at 100k groups,
+where per-tick (n_ticks,)-shaped metric outputs grow the scan's stacked
+output arrays and anything host-visible forces a device sync. This module
+is the ON-DEVICE recorder: a small fixed-shape pytree of scalar int32
+counters accumulated INSIDE the tick/scan carry — per-tick health costs a
+handful of fused (N, G)-wide reductions and is read back ONCE per run.
+
+Every engine threads the same recorder through its carry:
+- the XLA tick scan        (ops/tick.make_run(telemetry=True)),
+- the Pallas flat-carry    (ops/pallas_tick.make_pallas_scan(telemetry=True)),
+- the deep-log fc/batched  (ops/deep_cache.make_deep_scan /
+                            make_sharded_deep_scan(telemetry=True)),
+- the sharded runner       (parallel/mesh.make_sharded_run(telemetry=True)).
+
+BIT-NEUTRALITY CONTRACT: the recorder reads ONLY the pre/post-tick states
+the engines already produce — ops/tick.phase_body is never touched, so the
+protocol bits are identical with the recorder on or off on every engine
+(tests/test_telemetry.py pins this differentially across the sync,
+mailbox, deep-log, int16, Pallas and sharded suites). For the Pallas
+megakernel the accumulation runs on the flat scan carry BETWEEN kernel
+launches (plain XLA reductions the fusion compiler folds), not inside
+Mosaic: per-tile in-kernel partials would add output blocks and i1
+reductions to a kernel whose bit-exactness is the project's core contract,
+for no additional information — the flat carry already holds the same
+post-tick values the tile wrote.
+
+Counter semantics (all () int32, cumulative over the run; derived from
+state TRANSITIONS, so they are engine-independent by construction):
+
+- elections_started   sum of per-node `rounds` deltas (the ONE canonical
+                      elections definition, shared with utils.metrics and
+                      parallel.mesh).
+- leader_changes      nodes that newly became LIVE leaders this tick
+                      (role -> LEADER with up; a crashed leader's inert
+                      role does not count).
+- votes_granted       vote grants tallied this tick: positive `votes`
+                      movement against a baseline of 0 for nodes that
+                      started a round or restarted this tick (both reset
+                      the tally before re-counting).
+- commit_advances     sum of positive per-node commit deltas (quirk e can
+                      legitimately LOWER a stale follower's commit; those
+                      are not advances).
+- append_accepts      match-frontier advance units: positive match_index
+                      movement over pairs whose owner neither won an
+                      election nor restarted this tick (both wipe the pair
+                      row to 0 — bookkeeping, not replication).
+- append_rejects      next_index decrements over the same owner mask (a
+                      §6.2 reject walks next_index back exactly 1; the
+                      quirk-b win jump and restart wipes are masked out).
+- mailbox_inflight_hw high-water of the §10 in-flight slot count (vq/aq
+                      slots with due >= 0, summed over pairs and groups);
+                      0 on non-mailbox configs.
+- ov_fallbacks        deep-engine frontier-cache overflow events: ticks
+                      whose OV flag fired (the runner re-ran those bits on
+                      the plain engine — time lost, never bits). 0 on
+                      engines that carry no cache.
+- fault_events        §9 liveness transitions (crashes + restarts).
+
+Profiler scopes: PhaseScopes wraps ops/tick.phase_body's lattice in
+`jax.named_scope` regions named exactly after the per-phase chain-depth
+attribution keys (`opcount.phase_body_chain_depth(by_phase=True)`:
+"F0", "p1" ... "p5", under the "raft/" prefix) so a Perfetto/TensorBoard
+trace's op groups line up with the chain-depth model; `engine_scope` tags
+each engine's tick, and `trace_span` is the host-side
+jax.profiler.TraceAnnotation for run-level regions (scripts/
+probe_telemetry.py). All three are trace-time metadata only — they name
+HLO ops, they never add one.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from raft_kotlin_tpu.constants import LEADER
+
+_I32 = jnp.int32
+
+# Canonical counter order (the recorder pytree's field set).
+TELEMETRY_FIELDS = (
+    "elections_started",
+    "leader_changes",
+    "votes_granted",
+    "commit_advances",
+    "append_accepts",
+    "append_rejects",
+    "mailbox_inflight_hw",
+    "ov_fallbacks",
+    "fault_events",
+)
+
+# The state fields one telemetry step reads (node grids (N, G) + pair grids
+# (N, N, G) + optional §10 due slots) — the flat-carry runners build their
+# views from exactly this list.
+TELEMETRY_STATE_FIELDS = (
+    "role", "up", "rounds", "votes", "commit", "match_index", "next_index",
+)
+TELEMETRY_MAILBOX_FIELDS = ("vq_due", "aq_due")
+
+
+def telemetry_zeros() -> Dict[str, jax.Array]:
+    """A fresh recorder: every counter a () int32 zero."""
+    return {k: jnp.zeros((), _I32) for k in TELEMETRY_FIELDS}
+
+
+def _s(x) -> jax.Array:
+    """Whole-array count/sum to a () int32 (bool or int input)."""
+    return jnp.sum(x.astype(_I32))
+
+
+def telemetry_step_arrays(prev: dict, cur: dict, tel: Dict[str, jax.Array],
+                          ov: Optional[jax.Array] = None
+                          ) -> Dict[str, jax.Array]:
+    """One recorder step from pre/post-tick state VIEWS.
+
+    `prev`/`cur` map TELEMETRY_STATE_FIELDS (plus TELEMETRY_MAILBOX_FIELDS
+    when the config runs the §10 mailbox) to arrays in canonical RaftState
+    shapes: node grids (N, G), pair grids (N, N, G), groups-minor. Bool
+    fields may arrive as int stand-ins (the Pallas flat carry) — liveness
+    is read as `!= 0`. `ov` is an optional () scalar (bool/int) counting a
+    deep-engine cache-overflow event this tick. Returns the advanced
+    recorder (a new dict; inputs untouched)."""
+    prev_up = prev["up"] != 0
+    cur_up = cur["up"] != 0
+    lead_prev = (prev["role"] == LEADER) & prev_up
+    lead_cur = (cur["role"] == LEADER) & cur_up
+    new_leader = lead_cur & ~lead_prev
+    restarted = cur_up & ~prev_up
+
+    # Vote-grant baseline: phase 2's round start and phase F's restart both
+    # zero the tally before this tick's grants land, so their delta floor
+    # is 0, everyone else's is the pre-tick tally.
+    new_round = cur["rounds"] > prev["rounds"]
+    base_votes = jnp.where(new_round | restarted, 0,
+                           prev["votes"].astype(_I32))
+    d_votes = cur["votes"].astype(_I32) - base_votes
+
+    # Pair-grid owner mask: the quirk-b win jump (next_index := commit + 1,
+    # match_index := 0) and the restart wipe move the frontiers for
+    # bookkeeping reasons — excluded from accept/reject accounting. Owner =
+    # pair axis 0 (models/state.py [owner-1, peer-1, g]).
+    owner_reset = (new_leader | restarted)[:, None, :]
+    d_mi = cur["match_index"].astype(_I32) - prev["match_index"].astype(_I32)
+    d_ni = cur["next_index"].astype(_I32) - prev["next_index"].astype(_I32)
+
+    out = dict(tel)
+    out["elections_started"] = tel["elections_started"] + _s(
+        cur["rounds"] - prev["rounds"])
+    out["leader_changes"] = tel["leader_changes"] + _s(new_leader)
+    out["votes_granted"] = tel["votes_granted"] + _s(jnp.maximum(d_votes, 0))
+    out["commit_advances"] = tel["commit_advances"] + _s(
+        jnp.maximum(cur["commit"].astype(_I32) - prev["commit"].astype(_I32),
+                    0))
+    out["append_accepts"] = tel["append_accepts"] + _s(
+        jnp.where(owner_reset, 0, jnp.maximum(d_mi, 0)))
+    out["append_rejects"] = tel["append_rejects"] + _s(
+        jnp.where(owner_reset, 0, jnp.maximum(-d_ni, 0)))
+    out["fault_events"] = tel["fault_events"] + _s(prev_up != cur_up)
+    if cur.get("vq_due") is not None:
+        inflight = _s(cur["vq_due"] >= 0) + _s(cur["aq_due"] >= 0)
+        out["mailbox_inflight_hw"] = jnp.maximum(
+            tel["mailbox_inflight_hw"], inflight)
+    if ov is not None:
+        out["ov_fallbacks"] = tel["ov_fallbacks"] + ov.astype(_I32)
+    return out
+
+
+def state_view(state) -> dict:
+    """The telemetry view of a RaftState (shared by every RaftState-carrying
+    runner). Mailbox due slots included when present on the state."""
+    v = {k: getattr(state, k) for k in TELEMETRY_STATE_FIELDS}
+    for k in TELEMETRY_MAILBOX_FIELDS:
+        v[k] = getattr(state, k, None)
+    return v
+
+
+def telemetry_step(prev_state, cur_state, tel: Dict[str, jax.Array],
+                   ov: Optional[jax.Array] = None) -> Dict[str, jax.Array]:
+    """telemetry_step_arrays over two RaftStates (one tick apart)."""
+    return telemetry_step_arrays(
+        state_view(prev_state), state_view(cur_state), tel, ov=ov)
+
+
+def flat_view(flat: dict, n_nodes: int) -> dict:
+    """The telemetry view of the flat rank-2 kernel/phase_body layout
+    (ops/tick.flatten_state: node grids (N, G), pair grids (N*N, G)) —
+    pair grids reshape to the canonical (N, N, G). Free in XLA; used by the
+    Pallas flat-carry runner, which never materializes a RaftState between
+    ticks."""
+    N = n_nodes
+    v = {}
+    for k in TELEMETRY_STATE_FIELDS:
+        a = flat[k]
+        v[k] = a.reshape(N, N, -1) if k in ("match_index", "next_index") \
+            else a
+    for k in TELEMETRY_MAILBOX_FIELDS:
+        a = flat.get(k)
+        v[k] = a.reshape(N, N, -1) if a is not None else None
+    return v
+
+
+def summarize_telemetry(tel: Dict[str, jax.Array]) -> Dict[str, int]:
+    """Host materialization of a recorder — the run's ONE device->host
+    transfer for telemetry (a single batched device_get)."""
+    host = jax.device_get(tel)
+    return {k: int(host[k]) for k in TELEMETRY_FIELDS if k in host}
+
+
+# ---------------------------------------------------------------------------
+# Profiler scopes.
+
+# The phase scope names, identical to opcount.phase_body_chain_depth
+# (by_phase=True) attribution keys — a Perfetto trace groups ops under
+# raft/<name> and the chain-depth model reports depth deltas under <name>,
+# so the two line up column for column. "F0" covers the phase-F fault pass
+# plus phase 0 (the same cut-0 boundary the attribution uses).
+PHASE_SCOPES = ("F0", "p1", "p2", "p3", "p4", "p5")
+SCOPE_PREFIX = "raft"
+
+
+class PhaseScopes:
+    """Sequential jax.named_scope manager for phase_body's LINEAR phase
+    lattice: enter(name) closes the previous phase's scope and opens
+    raft/<name>, so the 2000-line lattice gets phase-named HLO metadata
+    without restructuring it into nested with-blocks. close() must run
+    before every return (including the cut-truncated early returns).
+    Trace-time metadata only — op names, never ops."""
+
+    def __init__(self, prefix: str = SCOPE_PREFIX):
+        self._prefix = prefix
+        self._cm = None
+
+    def enter(self, name: str) -> None:
+        self.close()
+        self._cm = jax.named_scope(f"{self._prefix}/{name}")
+        self._cm.__enter__()
+
+    def close(self) -> None:
+        if self._cm is not None:
+            self._cm.__exit__(None, None, None)
+            self._cm = None
+
+
+def engine_scope(name: str):
+    """named_scope tagging one engine's tick ops (raft/engine/<name>) —
+    names: xla, pallas, xla-fcache, shardmap-xla, shardmap-pallas,
+    shardmap-fcache."""
+    return jax.named_scope(f"{SCOPE_PREFIX}/engine/{name}")
+
+
+@contextlib.contextmanager
+def trace_span(name: str):
+    """Host-side jax.profiler.TraceAnnotation for run-level regions (no-op
+    when the profiler is unavailable). Use around whole dispatches, not
+    inside jit — in-trace regions come from PhaseScopes/engine_scope."""
+    try:
+        ann = jax.profiler.TraceAnnotation(name)
+    except Exception:  # profiler backend absent (some CPU wheels)
+        yield
+        return
+    with ann:
+        yield

@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""Summarize the checked-in BENCH_r*.json driver artifacts and gate on
+regressions (ISSUE 5 satellite).
+
+Each BENCH_rNN.json is a driver artifact: {"n": round, "cmd", "rc",
+"tail": <the last chunk of bench.py stdout>, "parsed": <the full record
+when the tail held one, else null>}. The tail may begin MID-RECORD
+(BENCH_r05 — the very truncation that motivated bench.emit_lines'
+compact tail line), so fields are recovered from the parsed record when
+present and otherwise regex-extracted from the tail text; a field the
+truncation ate is reported as missing, never guessed.
+
+Output: the per-leg trajectory across rounds (ticks/s + group-steps/s
+legs), then the regression check — the LATEST round's value per leg
+against the BEST PRIOR vetted round. Exit status is nonzero when any leg
+regressed by more than REGRESSION_TOL (10%), which wires this script
+into tier-1 as a perf-record gate (tests/test_summarize_bench.py runs it
+over the checked-in records).
+
+Vetting: a round's headline legs enter the baseline only when its record
+carries `"suspect": false` (deep legs: `"deeplog_suspect": false`).
+Rounds predating the measurement-integrity gates (r01/r02 — no suspect
+field at all) are excluded from the baseline: BENCH_r02's headline is the
+timing-trap artifact (306 G gsps, physically impossible) that CREATED
+those gates (VERDICT r02 weak #1), and comparing against it would flag
+every honest round since as a regression.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REGRESSION_TOL = 0.10
+
+# (field, label, suspect-gate field) — the legs with a ticks/s or gsps
+# trajectory worth gating. The suspect-gate names the record field whose
+# literal `false` vets the round for that leg's baseline.
+LEGS = (
+    ("value", "headline gsps", "suspect"),
+    ("ticks_per_sec", "headline ticks/s", "suspect"),
+    ("elections_per_sec", "elections/s", "suspect"),
+    ("mailbox_group_steps_per_sec", "mailbox gsps", "suspect"),
+    ("deeplog_group_steps_per_sec", "deep-log gsps", "deeplog_suspect"),
+)
+
+
+def _extract_field(tail: str, field: str) -> Optional[float]:
+    """Last `"field": <number>` occurrence in the tail text (the compact
+    headline line is emitted last, so the last match is authoritative)."""
+    m = re.findall(rf'"{re.escape(field)}": (-?[0-9][0-9.eE+-]*)', tail)
+    if not m:
+        return None
+    try:
+        return float(m[-1])
+    except ValueError:
+        return None
+
+
+def load_record(path: str) -> Optional[dict]:
+    """One BENCH artifact -> {"round", "legs": {field: value}, "vetted":
+    {field: bool}}; None for an unusable file."""
+    try:
+        with open(path) as f:
+            art = json.load(f)
+    except Exception as e:
+        print(f"{path}: unreadable ({e})", file=sys.stderr)
+        return None
+    tail = art.get("tail") or ""
+    parsed = art.get("parsed") or {}
+    legs: Dict[str, float] = {}
+    vetted: Dict[str, bool] = {}
+    for field, _label, gate in LEGS:
+        v = parsed.get(field)
+        if not isinstance(v, (int, float)):
+            v = _extract_field(tail, field)
+        if v is None:
+            continue
+        legs[field] = float(v)
+        gate_v = parsed.get(gate)
+        if not isinstance(gate_v, bool):
+            m = re.findall(rf'"{re.escape(gate)}": (true|false)', tail)
+            gate_v = (m[-1] == "false") if m else None
+            gate_v = None if gate_v is None else not gate_v  # to "suspect?"
+        # vetted = the gate field exists and says not-suspect.
+        vetted[field] = gate_v is False
+    if not legs:
+        return None
+    rnd = art.get("n")
+    if rnd is None:
+        m = re.search(r"BENCH_r(\d+)", os.path.basename(path))
+        rnd = int(m.group(1)) if m else -1
+    return {"round": int(rnd), "path": os.path.basename(path),
+            "legs": legs, "vetted": vetted}
+
+
+def load_all(pattern: Optional[str] = None) -> List[dict]:
+    pattern = pattern or os.path.join(REPO, "BENCH_r*.json")
+    recs = [r for r in (load_record(p) for p in sorted(glob.glob(pattern)))
+            if r is not None]
+    recs.sort(key=lambda r: r["round"])
+    return recs
+
+
+def check_regressions(recs: List[dict],
+                      tol: float = REGRESSION_TOL
+                      ) -> List[Tuple[str, float, float, int]]:
+    """[(leg label, latest value, best prior vetted value, prior round)]
+    for every leg where latest < (1 - tol) * best prior."""
+    if len(recs) < 2:
+        return []
+    latest = recs[-1]
+    out = []
+    for field, label, _gate in LEGS:
+        cur = latest["legs"].get(field)
+        if cur is None:
+            continue
+        prior = [(r["legs"][field], r["round"]) for r in recs[:-1]
+                 if field in r["legs"] and r["vetted"].get(field)]
+        if not prior:
+            continue
+        best, best_round = max(prior)
+        if cur < (1.0 - tol) * best:
+            out.append((label, cur, best, best_round))
+    return out
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    pattern = argv[0] if argv else None
+    recs = load_all(pattern)
+    if not recs:
+        print("no usable BENCH_r*.json records found", file=sys.stderr)
+        return 2
+
+    # Trajectory table: one row per leg, one column per round.
+    rounds = [r["round"] for r in recs]
+    print("leg".ljust(18) + "".join(f"r{n:02d}".rjust(14) for n in rounds))
+    for field, label, _gate in LEGS:
+        row = [label.ljust(18)]
+        for r in recs:
+            v = r["legs"].get(field)
+            mark = "" if r["vetted"].get(field) else "?"
+            row.append(("-" if v is None
+                        else f"{v:,.1f}{mark}").rjust(14))
+        print("".join(row))
+    print("('?' = unvetted: no suspect:false gate in that round's record;"
+          " excluded from the regression baseline)")
+
+    regs = check_regressions(recs)
+    latest = recs[-1]["round"]
+    for label, cur, best, best_round in regs:
+        print(f"REGRESSION: {label} r{latest:02d} = {cur:,.1f} is "
+              f"{100 * (1 - cur / best):.1f}% below best prior "
+              f"(r{best_round:02d} = {best:,.1f}; tolerance "
+              f"{100 * REGRESSION_TOL:.0f}%)", file=sys.stderr)
+    if regs:
+        return 1
+    print(f"r{latest:02d} within {100 * REGRESSION_TOL:.0f}% of every "
+          "vetted prior-best leg")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

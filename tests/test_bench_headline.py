@@ -28,6 +28,10 @@ def _fake_record():
         "mbdeep_fc_gsps": 79_012.3,
         "ilp_subtiles": 4,
         "issue_chain_depth": 238,
+        "tel_elections_started": 714_213,
+        "tel_commit_advances": 3_912_004,
+        "tel_fault_events": 81_022,
+        "triage_status": "clean",
         "suspect": False,
         # plus the long tail of fields that overflowed the driver window
         **{f"filler_{i}": [0.1234] * 8 for i in range(80)},
@@ -56,11 +60,17 @@ def test_compact_headline_is_last_line_and_complete():
     # depth from the authoritative artifact's tail.
     for k in ("ilp_subtiles", "issue_chain_depth"):
         assert k in bench.COMPACT_EXTRA_FIELDS, k
+    # The r9 additions (ISSUE 5): the flight-recorder aggregates and the
+    # parity triage status ride the authoritative tail by NAME — the round's
+    # acceptance gate reads recorder aggregates + triage from the artifact.
+    for k in ("tel_elections_started", "tel_commit_advances",
+              "tel_fault_events", "triage_status"):
+        assert k in bench.COMPACT_EXTRA_FIELDS, k
     for k in bench.COMPACT_EXTRA_FIELDS:
         assert k in last, k
         assert last[k] == record[k], k
     # Small enough that the driver's tail window always captures it whole.
-    assert len(lines[-1]) < 560, lines[-1]
+    assert len(lines[-1]) < 700, lines[-1]
 
 
 def test_compact_headline_handles_missing_fields():

@@ -119,6 +119,93 @@ def test_recorder_roundtrip(tmp_path):
     assert s["windows"] == 3 and s["elections"]["n"] == 90
 
 
+def test_recorder_record_issues_no_transfers(monkeypatch):
+    # ISSUE 5 satellite: record() must BUFFER on device — no per-call
+    # device->host sync. All host materialization in the recorder routes
+    # through jax.device_get (the module's single transfer point), so
+    # counting calls to it counts transfers; block_until_ready is patched
+    # too to catch any sync-without-transfer sneaking in.
+    import jax
+
+    from raft_kotlin_tpu.utils import metrics as metrics_mod
+
+    calls = {"get": 0, "block": 0}
+    real_get = jax.device_get
+
+    def counting_get(x):
+        calls["get"] += 1
+        return real_get(x)
+
+    def counting_block(x):
+        calls["block"] += 1
+        return x
+
+    monkeypatch.setattr(metrics_mod.jax, "device_get", counting_get)
+    monkeypatch.setattr(metrics_mod.jax, "block_until_ready", counting_block,
+                        raising=False)
+    run = make_instrumented_run(CFG, 10)
+    st = init_state(CFG)
+    rec = MetricsRecorder()
+    for _ in range(5):  # record-per-chunk driven densely: still zero syncs
+        st, m = run(st)
+        rec.record(m)
+    assert calls == {"get": 0, "block": 0}, calls
+    s = rec.summary()  # ONE batched transfer for all five windows
+    assert calls["get"] == 1 and calls["block"] == 0, calls
+    assert s["windows"] == 5 and s["elections"]["n"] == 50
+    rec.close()
+    assert calls["get"] == 1  # nothing left pending
+
+
+def test_recorder_autoflush_bounds_pending(tmp_path):
+    # Crash-loss bound: every autoflush_windows records, one amortized
+    # flush streams the JSONL — a dead process loses at most that many
+    # buffered windows, and live tails see the stream advance mid-run.
+    path = tmp_path / "m.jsonl"
+    run = make_instrumented_run(CFG, 10)
+    st = init_state(CFG)
+    rec = MetricsRecorder(str(path), autoflush_windows=2)
+    for _ in range(5):
+        st, m = run(st)
+        rec.record(m)
+    assert len(rec.windows) == 4 and len(rec._pending) == 1
+    assert len(path.read_text().strip().splitlines()) == 4
+    assert rec.summary()["windows"] == 5
+    rec.close()
+    assert len(path.read_text().strip().splitlines()) == 5
+
+
+def test_invariants_zero_on_mailbox_run():
+    # ISSUE 5 satellite: check_invariants was only exercised on the sync
+    # path — run it over the §10 mailbox production window ([1, 3] delays,
+    # the known-delivery regime the bench's async stage measures).
+    cfg = dataclasses.replace(CFG, delay_lo=1, delay_hi=3, seed=11)
+    run = make_instrumented_run(cfg, TICKS, invariants=True)
+    _, m = run(init_state(cfg))
+    for k, v in m.items():
+        if k.startswith("inv_"):
+            assert int(np.asarray(v).sum()) == 0, (
+                f"{k} nonzero on mailbox [1,3] run")
+
+
+def test_invariants_zero_on_int16_deep_run():
+    # ...and over the int16 deep-log regime (config-5 class): the int16
+    # wrap watch plus every structural invariant must stay zero on a real
+    # churny deep run. batched=False keeps the CPU compile feasible
+    # (XLA:CPU blows up on the batched int16 deep program — ops/tick.py).
+    cfg = RaftConfig(n_groups=8, n_nodes=3, log_capacity=300,
+                     log_dtype="int16", cmd_period=3, p_drop=0.1,
+                     seed=13).stressed(10)
+    run = make_instrumented_run(cfg, 100, invariants=True, impl="xla",
+                                batched=False)
+    _, m = run(init_state(cfg))
+    assert "inv_int16_wrap" in m  # the int16 watch is actually armed
+    for k, v in m.items():
+        if k.startswith("inv_"):
+            assert int(np.asarray(v).sum()) == 0, (
+                f"{k} nonzero on int16 deep run")
+
+
 def test_split_leader_telemetry_counts_same_term_pairs():
     # Hand-build a state with two same-term leaders in group 0 and two
     # different-term leaders in group 1.

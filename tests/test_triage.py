@@ -1,0 +1,125 @@
+"""Divergence triage (api/triage.py, ISSUE 5 tentpole): when a
+TPU-vs-oracle trace pair mismatches, triage must bisect to exactly the
+FIRST divergent (tick, group), dump both sides' states (divergent tick +
+the last agreeing tick), and attach the api/explain narrative window.
+
+The canonical acceptance test injects a single-group single-tick
+corruption into an otherwise bit-identical kernel trace and asserts the
+report names exactly that (tick, group) — no more, no less — with an
+explain() window attached. Clean traces must come back as None/"clean"
+(the bench tail's steady-state value)."""
+
+import io
+
+import numpy as np
+import pytest
+
+from raft_kotlin_tpu.api.triage import (
+    find_divergence,
+    format_report,
+    triage,
+    triage_status,
+)
+from raft_kotlin_tpu.models.state import init_state
+from raft_kotlin_tpu.native.oracle import TRACE_FIELDS, NativeOracle
+from raft_kotlin_tpu.ops.tick import make_run
+from raft_kotlin_tpu.utils.config import RaftConfig
+
+# A fault-soup config the parity suites already pin as bit-identical
+# between the kernel and the native engine — so every divergence below is
+# OURS, injected on purpose.
+CFG = RaftConfig(n_groups=6, n_nodes=3, log_capacity=16, cmd_period=7,
+                 p_drop=0.1, p_crash=0.005, p_restart=0.05, seed=5
+                 ).stressed(10)
+T = 80
+
+
+@pytest.fixture(scope="module")
+def traces():
+    _, ktr = make_run(CFG, T, trace=True)(init_state(CFG))
+    ktr = {k: np.asarray(v) for k, v in ktr.items()}  # (T, N, G)
+    ntr = NativeOracle(CFG).run(T)                    # (T, G, N)
+    return ktr, ntr
+
+
+def _corrupt(ktr, tick, group, field="commit", node=1, delta=7):
+    bad = {k: v.copy() for k, v in ktr.items()}
+    bad[field][tick, node, group] += delta
+    return bad
+
+
+def test_clean_traces_report_clean(traces):
+    ktr, ntr = traces
+    assert find_divergence(ktr, ntr) is None
+    assert triage(CFG, ktr=ktr, otr=ntr) is None
+    assert triage_status(None) == "clean"
+
+
+def test_bisection_localizes_single_corruption(traces):
+    # THE acceptance case: one group, one tick, one field flipped — triage
+    # must name exactly that (tick, group), nothing earlier, nothing else.
+    ktr, ntr = traces
+    tick, group = 41, 3
+    div = find_divergence(_corrupt(ktr, tick, group), ntr)
+    assert div is not None
+    assert (div["tick"], div["group"]) == (tick, group)
+    assert div["fields"] == ["commit"]
+    # The dump carries full per-node rows of EVERY trace field, both sides.
+    for k in TRACE_FIELDS:
+        assert len(div["kernel"][k]) == CFG.n_nodes
+        assert len(div["oracle"][k]) == CFG.n_nodes
+    # The corrupted node disagrees; the oracle row is the uncorrupted truth.
+    assert div["kernel"]["commit"] != div["oracle"]["commit"]
+    assert triage_status(div) == f"commit@t{tick}/g{group}"
+
+
+def test_bisection_is_lexicographic_first(traces):
+    # Two corruptions: the earlier tick wins; within a tick, the lower
+    # group wins — "first divergence" is a total order, not a sample.
+    ktr, ntr = traces
+    bad = _corrupt(_corrupt(ktr, 50, 1), 22, 4, field="term")
+    div = find_divergence(bad, ntr)
+    assert (div["tick"], div["group"]) == (22, 4)
+    assert div["fields"] == ["term"]
+    bad2 = _corrupt(_corrupt(ktr, 30, 5), 30, 2)
+    div2 = find_divergence(bad2, ntr)
+    assert (div2["tick"], div2["group"]) == (30, 2)
+
+
+def test_triage_attaches_prev_state_and_explain_window(traces):
+    ktr, ntr = traces
+    tick, group = 41, 3
+    buf = io.StringIO()
+    div = triage(CFG, ktr=_corrupt(ktr, tick, group), otr=ntr, window=6,
+                 out=buf)
+    assert (div["tick"], div["group"]) == (tick, group)
+    # Last agreeing state rides the report (tick 41 breaks, tick 40 agrees).
+    assert div["prev_kernel"]["commit"] == div["prev_oracle"]["commit"]
+    # explain() narrative window around the break, rendered AND structured.
+    assert div["explain_window"] == (tick - 6, tick + 6)
+    assert isinstance(div["explain_text"], str) and div["explain_text"]
+    assert all(tick - 6 <= e["tick"] <= tick + 6
+               for e in div["explain_events"])
+    # The human-readable report reached `out` and names the bisection.
+    rep = buf.getvalue()
+    assert f"tick={tick} group={group}" in rep
+    assert "DIVERGES" in rep and "oracle narrative" in rep
+    assert format_report(div) in rep
+
+
+def test_triage_produces_missing_sides_itself():
+    # bench.py hands triage both traces, but the standalone workflow may
+    # hand it only a config: both sides get produced internally and a
+    # bit-identical pair reports clean.
+    cfg = RaftConfig(n_groups=4, n_nodes=3, seed=23, cmd_period=25,
+                     cmd_node=2)
+    assert triage(cfg, n_ticks=60) is None
+
+
+def test_corruption_at_tick_zero_has_no_prev(traces):
+    ktr, ntr = traces
+    div = triage(CFG, ktr=_corrupt(ktr, 0, 2, field="term", delta=3),
+                 otr=ntr)
+    assert (div["tick"], div["group"]) == (0, 2)
+    assert "prev_kernel" not in div
+    format_report(div)  # renders without the prev block
