@@ -36,6 +36,14 @@ Stages (all on one chip; prints exactly ONE JSON line on stdout):
    payoff) vs the r7 known-delivery batched and frontier-cache engines
    (mbdeep_batched/mbdeep_fc), with the mailbox-dimension routing audit.
 
+Every leg additionally publishes a safety-invariant verdict (ISSUE 6): the
+headline/churn/mailbox timed legs run the scan-carry Figure-3 monitor ON
+(utils/telemetry.py — latch + history ring inside the measured scan), the
+deep leg runs a dedicated untimed monitored verification at parity scale,
+and any latched violation is auto-triaged to a replayable
+(seed, config, tick, group) with an explain() window (api/triage.py) and
+gates tier-1 via scripts/summarize_bench.py.
+
 Baseline derivation for `vs_baseline` (the reference publishes no numbers —
 BASELINE.md): the reference advances ONE group in real time at 1 tick = 100 ms
 of protocol time (heartbeat 2000 ms = 20 ticks, reference RaftServer.kt:115),
@@ -141,12 +149,18 @@ def measure(cfg, n_ticks, n_reps, impl_candidates, summarize=None):
 
         @jax.jit
         def run(st, rng):
-            end, livepin, tel = _norm_run_result(run_state(st, rng))
+            from raft_kotlin_tpu.utils.telemetry import monitor_scalars
+
+            end, livepin, tel, mon = _norm_run_result(run_state(st, rng))
             out = {"rounds": jnp.sum(end.rounds)}
             if livepin is not None:
                 out["livepin"] = livepin
             if tel is not None:
                 out.update({f"tel_{k}": v for k, v in tel.items()})
+            if mon is not None:
+                # Safety-invariant monitor scalars (ISSUE 6): latch +
+                # counts + history-ring aggregates, flattened to () ints.
+                out.update(monitor_scalars(mon))
             if summarize is not None:
                 out.update(summarize(end))
             return out
@@ -172,16 +186,22 @@ def measure(cfg, n_ticks, n_reps, impl_candidates, summarize=None):
 
 
 def _norm_run_result(res):
-    """Normalize a runner's return into (end_state, livepin, telemetry):
-    runners yield RaftState, (state, livepin), (state, telemetry dict) —
-    the Pallas flat-carry runner, which needs no livepin — or
-    (state, livepin, telemetry)."""
+    """Normalize a runner's return into (end_state, livepin, telemetry,
+    monitor): runners yield RaftState or a tuple of the state plus any of
+    a livepin scalar, a telemetry dict (bare counter keys), and a monitor
+    dict (the finalized carry — "latch_tick" key)."""
     if not isinstance(res, tuple):
-        return res, None, None
-    if len(res) == 2:
-        end, x = res
-        return (end, None, x) if isinstance(x, dict) else (end, x, None)
-    return res
+        return res, None, None, None
+    end, livepin, tel, mon = res[0], None, None, None
+    for x in res[1:]:
+        if isinstance(x, dict):
+            if "latch_tick" in x:
+                mon = x
+            else:
+                tel = x
+        elif x is not None:
+            livepin = x
+    return end, livepin, tel, mon
 
 
 def median(xs):
@@ -218,7 +238,16 @@ COMPACT_EXTRA_FIELDS = ("deeplog_parity_rate", "deeplog_ov_fallback",
                         # once) and the parity triage status — the tail
                         # records not just THAT parity broke but WHERE.
                         "tel_elections_started", "tel_commit_advances",
-                        "tel_fault_events", "triage_status")
+                        "tel_fault_events", "triage_status",
+                        # r10 (ISSUE 6): the safety-invariant monitor's
+                        # per-leg verdicts and the headline history-ring
+                        # aggregates — a latched violation is a gating
+                        # failure (scripts/summarize_bench.py), so the
+                        # authoritative tail must carry the verdicts.
+                        "inv_status", "churn_inv_status",
+                        "mailbox_inv_status", "deeplog_inv_status",
+                        "inv_violations", "inv_ring_commit_hi",
+                        "inv_ring_leaders_hw")
 
 # Flight-recorder counters published verbatim from the headline run's
 # median rep (stats tel_* keys — utils/telemetry.TELEMETRY_FIELDS).
@@ -246,7 +275,7 @@ def emit_lines(record: dict) -> list:
     return [json.dumps(record), compact_headline(record)]
 
 
-def scan_runner(tick_fn, telemetry: bool = False):
+def scan_runner(tick_fn, telemetry: bool = False, monitor: bool = False):
     """builder(n_ticks) -> UNJITTED run(st, rng) -> (end_state, livepin[,
     telemetry]) for a per-tick function (measure() jits exactly once, with
     the reductions inside — see measure's docstring for why the state must
@@ -264,23 +293,35 @@ def scan_runner(tick_fn, telemetry: bool = False):
 
     telemetry=True threads the scan-carry flight recorder
     (utils/telemetry.py) so the timed region includes the production
-    recorder cost and stats surface its counters."""
+    recorder cost and stats surface its counters; monitor=True threads the
+    scan-carry safety-invariant monitor the same way (the <3% overhead
+    gate of scripts/probe_invariants.py measures exactly this timed
+    configuration)."""
     from raft_kotlin_tpu.utils import telemetry as telemetry_mod
 
     def build(n_ticks):
         def run(st, rng):
             def body(carry, _):
-                s, acc, tel = carry
+                s, acc, tel, mon = carry
                 s2 = tick_fn(s, rng=rng)
                 acc = acc + jnp.sum(s2.log_cmd[:, 0, :].astype(jnp.int32))
                 if tel is not None:
                     tel = telemetry_mod.telemetry_step(s, s2, tel)
-                return (s2, acc, tel), None
+                if mon is not None:
+                    mon = telemetry_mod.monitor_step(s, s2, mon)
+                return (s2, acc, tel, mon), None
             tel0 = telemetry_mod.telemetry_zeros() if telemetry else None
-            (end, acc, tel), _ = jax.lax.scan(
-                body, (st, jnp.zeros((), jnp.int32), tel0), None,
+            mon0 = telemetry_mod.monitor_init(
+                st.term.shape[-1], n_ticks, monitor)
+            (end, acc, tel, mon), _ = jax.lax.scan(
+                body, (st, jnp.zeros((), jnp.int32), tel0, mon0), None,
                 length=n_ticks)
-            return (end, acc, tel) if telemetry else (end, acc)
+            out = (end, acc)
+            if telemetry:
+                out = out + (tel,)
+            if monitor:
+                out = out + (telemetry_mod.monitor_finalize(mon),)
+            return out
         return run
     return build
 
@@ -292,19 +333,24 @@ def tick_candidates(cfg):
     if choose_impl(cfg) == "pallas":
         # Flat-carry multi-tick runner: state<->kernel-form conversions once
         # per call, not once per tick (~0.3 ms/tick on the headline config).
-        # The flight recorder rides the flat carry (ISSUE 5) — the timed
-        # headline IS the recorder-on configuration.
+        # The flight recorder (ISSUE 5) AND the safety-invariant monitor
+        # (ISSUE 6) ride the flat carry — the timed headline IS the
+        # recorder-on, monitor-on configuration (probe_invariants.py's
+        # <3% gate measures the same shape; deep legs keep the monitor in
+        # a dedicated untimed verification run instead, the full-log
+        # prefix compares being O(C) per tick).
         yield (lambda n: make_pallas_scan(cfg, n, interpret=False,
                                           jitted=False,
-                                          telemetry=True)), "pallas"
-    yield scan_runner(make_tick(cfg), telemetry=True), "xla"
+                                          telemetry=True,
+                                          monitor=True)), "pallas"
+    yield scan_runner(make_tick(cfg), telemetry=True, monitor=True), "xla"
 
 
 def xla_only(cfg):
     from raft_kotlin_tpu.ops.tick import make_tick
 
-    # Recorder on, like the pallas leg it is A/B'd against.
-    yield scan_runner(make_tick(cfg), telemetry=True), "xla"
+    # Recorder + monitor on, like the pallas leg it is A/B'd against.
+    yield scan_runner(make_tick(cfg), telemetry=True, monitor=True), "xla"
 
 
 def sharded_fc_candidate(cfg):
@@ -400,6 +446,56 @@ def _auto_triage(pcfg, ktr, ntr):
     except Exception as e:
         print(f"triage failed: {str(e)[:200]}", file=sys.stderr)
         return "triage-failed"
+
+
+def _auto_inv_triage(leg_cfg, status, stats, rng_seed=None):
+    """Safety triage on a latched invariant violation (ISSUE 6): replay
+    the run deterministically, confirm the bisection, render the
+    replayable (seed[, rng_seed], config, tick, group) tuple + explain()
+    window to stderr (api/triage.triage_violation). `rng_seed` names the
+    rng-operand seed the latching run ACTUALLY dispatched with (see
+    _leg_inv_status — measure() perturbs the rng per rep over the
+    cfg-seeded initial state). Never raises; returns the status string
+    ("?"-suffixed when the replay did not re-latch the same
+    coordinate)."""
+    if status in (None, "clean"):
+        return status
+    from raft_kotlin_tpu.api.triage import triage_violation
+
+    try:
+        latch = {"tick": stats["inv_latch_tick"],
+                 "group": stats["inv_latch_group"],
+                 "invariant_id": stats["inv_latch_inv"]}
+        rec = triage_violation(leg_cfg, latch, rng_seed=rng_seed,
+                               out=sys.stderr)
+        return rec["status"] + ("" if rec.get("confirmed") else "?")
+    except Exception as e:
+        print(f"invariant triage failed: {str(e)[:200]}", file=sys.stderr)
+        return status
+
+
+def _leg_inv_status(leg_cfg, stats):
+    """A timed leg's safety verdict: non-clean if ANY rep latched — every
+    rep is a distinct run (measure() dispatches rep r with the rng
+    operand seeded cfg.seed + 1000*(r+1) over the cfg-seeded initial
+    state), so the reps are independent verification universes and
+    discarding a non-median latch would silently drop a caught violation.
+    The triage replay reproduces the LATCHING rep's exact split (base
+    initial state + that rep's derived rng seed), so the published
+    replayable tuple re-latches; the aggregate inv_* scalars published
+    next to the verdict stay the median rep's (the leg's representative
+    measurement)."""
+    from raft_kotlin_tpu.utils.telemetry import status_from_scalars
+
+    statuses = [status_from_scalars(s) for s in stats]
+    if all(s is None for s in statuses):
+        return None  # leg ran monitor-off
+    for r, status in enumerate(statuses):
+        if status is not None and status != "clean":
+            return _auto_inv_triage(
+                leg_cfg, status, stats[r],
+                rng_seed=leg_cfg.seed + 1000 * (r + 1))
+    return "clean"
 
 
 def parity_stage(cfg, groups, ticks, impl):
@@ -947,6 +1043,49 @@ def main() -> None:
         (t for t in (parity_triage, mail_parity_triage, deep_parity_triage)
          if t is not None), "clean")
 
+    # Safety-invariant monitor verdicts (ISSUE 6): the timed headline /
+    # churn / mailbox legs run monitor-ON (scan-carry, like the flight
+    # recorder — probe_invariants.py's <3% overhead gate measures exactly
+    # this configuration); a leg's verdict covers EVERY rep (each rep is
+    # a differently-seeded run — _leg_inv_status). The deep leg keeps its
+    # timed reps monitor-OFF (the full-log prefix compares are O(C=10k)
+    # per tick there) and publishes its verdict from a dedicated UNTIMED
+    # verification run of the fc engine at the parity-leg scale. Any
+    # latched violation is auto-triaged (api/triage.triage_violation:
+    # deterministic replay with the latching rep's actual seed +
+    # bisection confirm + explain window, stderr) and
+    # scripts/summarize_bench.py gates tier-1 on a non-clean verdict of
+    # any vetted leg — like a parity miss.
+    from raft_kotlin_tpu.utils.telemetry import status_from_scalars
+
+    mail_med = mstats[mail_times.index(mbest)]
+    inv_status = _leg_inv_status(cfg, stats1)
+    churn_inv_status = _leg_inv_status(churn_cfg, cstats)
+    mailbox_inv_status = _leg_inv_status(mail_cfg, mstats)
+
+    deeplog_inv = {}
+    deeplog_inv_status = None
+    deeplog_inv_groups = None
+    if deep_steps_per_sec:
+        try:
+            from raft_kotlin_tpu.models.state import init_state
+            from raft_kotlin_tpu.ops.deep_cache import make_deep_scan
+            from raft_kotlin_tpu.ops.tick import make_rng
+
+            deeplog_inv_groups = min(deep_g, int(os.environ.get(
+                "RAFT_BENCH_INV_GROUPS", 256 if on_accel else 64)))
+            vcfg = dataclasses.replace(deep_cfg,
+                                       n_groups=deeplog_inv_groups)
+            dv = make_deep_scan(vcfg, deep_ticks, monitor=True)(
+                init_state(vcfg), make_rng(vcfg))
+            deeplog_inv = {k: int(v) for k, v in dv.items()
+                           if k.startswith("inv_")}
+            deeplog_inv_status = _auto_inv_triage(
+                vcfg, status_from_scalars(deeplog_inv), deeplog_inv)
+        except Exception as e:
+            print(f"deep invariant verification leg failed: "
+                  f"{str(e)[:200]}", file=sys.stderr)
+
     baseline_group_steps_per_sec = 10.0
     record = dict({
         "metric": "raft_group_steps_per_sec_per_chip",
@@ -1002,6 +1141,30 @@ def main() -> None:
         # Parity triage (api/triage.py): bisection status across all
         # parity legs; per-leg bisection reports go to stderr.
         "triage_status": triage_status,
+        # Safety-invariant monitor (ISSUE 6): per-leg Figure-3 verdicts
+        # ("clean" or "<invariant>@t<tick>/g<group>", bisection-confirmed
+        # via deterministic replay; "?"-suffixed if the replay did not
+        # re-latch) plus the headline run's history-ring aggregates and
+        # taint coverage (groups where quirk l/a suspends the classical
+        # proofs — utils/telemetry.py documents the gating).
+        "inv_status": inv_status,
+        "inv_violations": med_stats.get("inv_violations"),
+        "inv_taint_restart_groups": med_stats.get(
+            "inv_taint_restart_groups"),
+        "inv_taint_unsafe_groups": med_stats.get("inv_taint_unsafe_groups"),
+        "inv_ring_commit_lo": med_stats.get("inv_ring_commit_lo"),
+        "inv_ring_commit_hi": med_stats.get("inv_ring_commit_hi"),
+        "inv_ring_leaders_hw": med_stats.get("inv_ring_leaders_hw"),
+        "inv_ring_inflight_hw": med_stats.get("inv_ring_inflight_hw"),
+        "churn_inv_status": churn_inv_status,
+        "mailbox_inv_status": mailbox_inv_status,
+        "mailbox_inv_ring_inflight_hw": mail_med.get(
+            "inv_ring_inflight_hw"),
+        "deeplog_inv_status": deeplog_inv_status,
+        "deeplog_inv_groups": deeplog_inv_groups,
+        "deeplog_inv_violations": deeplog_inv.get("inv_violations"),
+        "deeplog_inv_ring_commit_hi": deeplog_inv.get(
+            "inv_ring_commit_hi"),
         # §10 mailbox stage (headline fault-soup config + 1-3-tick delays).
         "mailbox_group_steps_per_sec": round(mail_steps_per_sec, 1),
         "mailbox_elections_per_sec": round(mail_elections_per_sec, 1),

@@ -1,6 +1,12 @@
 """Automatic divergence triage (ISSUE 5): localize a TPU-vs-oracle
 bit-exactness failure to the first divergent (tick, group) and hand back
-everything a human needs to read it.
+everything a human needs to read it. Extended (ISSUE 6) with SAFETY
+triage: a violation latched by the on-device invariant monitor
+(utils/telemetry — the earliest (tick, group, invariant_id) of a run) is
+replayed deterministically, re-bisected to the same coordinate, and
+rendered as a replayable (seed, config, tick, group) tuple with the
+explain() narrative attached (`triage_violation` below — bench.py
+auto-invokes it on any leg whose inv_status is not clean).
 
 PARITY.md makes bit-exactness against the scalar oracle the project's core
 contract, and the differential suites enforce it — but when a parity leg
@@ -146,6 +152,132 @@ def triage(cfg: RaftConfig, n_ticks: Optional[int] = None,
     if out is not None:
         print(format_report(div), file=out)
     return div
+
+
+def triage_violation(cfg: RaftConfig, latch: dict,
+                     window: int = 8, replay: bool = True,
+                     state0=None, rng_seed: Optional[int] = None,
+                     out: Optional[TextIO] = None) -> dict:
+    """Safety-violation triage (ISSUE 6): turn an on-device monitor latch
+    into a replayable, human-readable artifact.
+
+    `latch` is the monitor's first-violation coordinate — {"tick",
+    "group", "invariant_id" or "invariant"} (summarize_monitor's latch
+    dict, or the inv_latch_* scalars bench collects). Returns a dict:
+
+    - "seed"/"rng_seed"/"config"/"tick"/"group"/"invariant"/
+      "invariant_id": the replayable tuple — `make_run(
+      RaftConfig(**config), tick+1, monitor=True, rng=make_rng(
+      replace(cfg, seed=rng_seed)))` from init_state re-latches the same
+      coordinate (counted-threefry determinism: same seeds + config =>
+      same bits => same verdicts). `rng_seed` (default cfg.seed) covers
+      bench.measure's reps, which run the cfg-seeded INITIAL state under
+      a per-rep perturbed rng OPERAND — the replay must reproduce
+      exactly that split or it diverges from tick 0 (init_state's boot
+      election draws are seed-dependent).
+    - "confirmed"/"replay_latch" (replay=True): the device replay was
+      actually performed here, through ops/tick.make_run(monitor=True)
+      over tick+1 ticks, and its latch compared against `latch` — the
+      bisection check. `state0` overrides the replay's initial state
+      (injected-violation tests start from a corrupted state that
+      init_state cannot reproduce).
+    - "explain_window"/"explain_events"/"explain_text": the
+      [tick - window, tick + window] oracle narrative of the latched
+      group (api/explain), same attachment as the parity triage.
+
+    Prints format_violation_report to `out` (None = no printing)."""
+    from raft_kotlin_tpu.utils.telemetry import INVARIANT_IDS
+
+    t, g = int(latch["tick"]), int(latch["group"])
+    iid = latch.get("invariant_id")
+    if iid is None:
+        inv = latch.get("invariant", latch.get("inv"))
+        iid = INVARIANT_IDS.index(inv) if isinstance(inv, str) else inv
+    iid = int(iid)
+    name = INVARIANT_IDS[iid] if 0 <= iid < len(INVARIANT_IDS) \
+        else str(latch.get("invariant"))
+    import dataclasses
+
+    rng_seed = cfg.seed if rng_seed is None else int(rng_seed)
+    rec = {
+        "seed": cfg.seed,
+        "rng_seed": rng_seed,
+        "config": dataclasses.asdict(cfg),
+        "tick": t,
+        "group": g,
+        "invariant": name,
+        "invariant_id": iid,
+        "status": f"{name}@t{t}/g{g}",
+    }
+    if replay:
+        from raft_kotlin_tpu.models.state import init_state
+        from raft_kotlin_tpu.ops.tick import make_rng, make_run
+        from raft_kotlin_tpu.utils.telemetry import summarize_monitor
+
+        st0 = state0 if state0 is not None else init_state(cfg)
+        rng = (make_rng(dataclasses.replace(cfg, seed=rng_seed))
+               if rng_seed != cfg.seed else None)
+        # The repo-wide CPU guard for deep configs: XLA:CPU compiles of
+        # the batched deep engine blow up (ops/tick.py), so the replay
+        # uses the bit-identical per-pair engine there — same verdicts.
+        import jax
+
+        batched = (False if (cfg.uses_dyn_log
+                             and jax.default_backend() == "cpu") else None)
+        try:
+            *_, mon = make_run(cfg, t + 1, trace=False, monitor=True,
+                               batched=batched, rng=rng)(st0)
+            rl = summarize_monitor(mon)["latch"]
+            rec["replay_latch"] = rl
+            rec["confirmed"] = (rl is not None and rl["tick"] == t
+                                and rl["group"] == g
+                                and rl["invariant_id"] == iid)
+        except Exception as e:  # the report must survive a replay failure
+            rec["replay_latch"] = None
+            rec["confirmed"] = False
+            rec["replay_error"] = str(e)[:200]
+
+    from raft_kotlin_tpu.api.explain import explain_text
+
+    lo, hi = max(0, t - window), t + window
+    try:
+        events, text = explain_text(cfg, g, lo, hi)
+    except Exception as e:  # ditto
+        events, text = [], f"(explain replay failed: {e})"
+    rec["explain_window"] = (lo, hi)
+    rec["explain_events"] = events
+    rec["explain_text"] = text
+
+    if out is not None:
+        print(format_violation_report(rec), file=out)
+    return rec
+
+
+def format_violation_report(rec: dict) -> str:
+    """Human-readable safety-triage report (stderr artifact, like
+    format_report — the stdout JSON contract stays intact)."""
+    t, g = rec["tick"], rec["group"]
+    rng_note = ("" if rec.get("rng_seed", rec["seed"]) == rec["seed"]
+                else f" rng_seed={rec['rng_seed']} (perturbed rng operand"
+                " over the cfg-seeded initial state — bench.measure's"
+                " per-rep split)")
+    lines = [
+        f"=== SAFETY TRIAGE: {rec['invariant']} violated first at "
+        f"tick={t} group={g} ===",
+        f"replay tuple: seed={rec['seed']}{rng_note} tick={t} group={g} "
+        f"invariant={rec['invariant']} (config in the record; "
+        f"make_run(cfg, {t + 1}, monitor=True) re-latches it)",
+    ]
+    if "confirmed" in rec:
+        lines.append(
+            f"replay bisection: confirmed={rec['confirmed']} "
+            f"(replay latch: {rec.get('replay_latch')})")
+        if rec.get("replay_error"):
+            lines.append(f"replay error: {rec['replay_error']}")
+    lo, hi = rec["explain_window"]
+    lines.append(f"oracle narrative for group {g}, ticks {lo}..{hi}:")
+    lines.append(rec["explain_text"].rstrip())
+    return "\n".join(lines)
 
 
 def format_report(div: dict) -> str:

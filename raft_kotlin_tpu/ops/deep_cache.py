@@ -182,7 +182,7 @@ def refill_all(cfg, state) -> dict:
 
 
 def make_deep_scan(cfg, n_ticks: int, return_state: bool = False,
-                   telemetry: bool = False):
+                   telemetry: bool = False, monitor: bool = False):
     """Multi-tick runner for the frontier-cached deep engine.
 
     run(state, rng[, summarize]) executes n_ticks through the fcache tick
@@ -198,8 +198,13 @@ def make_deep_scan(cfg, n_ticks: int, return_state: bool = False,
 
     telemetry=True additionally accumulates the scan-carry flight recorder
     (utils/telemetry.py — incl. per-tick OV events as ov_fallbacks) and
-    merges its counters into the reduction dict as tel_* keys. Bits are
-    untouched (the recorder only reads the states the scan carries)."""
+    merges its counters into the reduction dict as tel_* keys;
+    monitor=True accumulates the safety-invariant monitor the same way and
+    merges its scalars as inv_* keys (reduction mode; with
+    return_state=True the call returns (end, ov, monitor-finalized)
+    instead of (end, ov)). On an OV fallback the published monitor verdict
+    is the PLAIN rerun's — the verdict of the bits actually published.
+    Bits are untouched either way (both only read the carried states)."""
     from raft_kotlin_tpu.models.state import RaftState
     from raft_kotlin_tpu.ops import tick as tick_mod
 
@@ -224,31 +229,37 @@ def make_deep_scan(cfg, n_ticks: int, return_state: bool = False,
         def run(st, fc, rng):
             def body(carry, _):
                 if with_fc:
-                    s, f, acc, ova, tel = carry
+                    s, f, acc, ova, tel, mon = carry
                     s2, f2, ov = tick_fn(s, f, rng)
                     ov_t = jnp.any(ov)
                     ova = ova | ov_t
                 else:
-                    s, f, acc, ova, tel = carry
+                    s, f, acc, ova, tel, mon = carry
                     s2, f2 = tick_fn(s, rng=rng), f
                     ov_t = None
                 if tel is not None:
                     tel = telemetry_mod.telemetry_step(s, s2, tel, ov=ov_t)
+                if mon is not None:
+                    mon = telemetry_mod.monitor_step(s, s2, mon)
                 acc = acc + jnp.sum(s2.log_cmd[:, 0, :].astype(_I32))
-                return (s2, f2, acc, ova, tel), None
+                return (s2, f2, acc, ova, tel, mon), None
 
             tel0 = telemetry_mod.telemetry_zeros() if telemetry else None
-            carry0 = (st, fc, jnp.zeros((), _I32), jnp.zeros((), bool), tel0)
-            (end, _, acc, ova, tel), _ = jax.lax.scan(
+            mon0 = telemetry_mod.monitor_init(cfg.n_groups, n_ticks,
+                                              monitor)
+            carry0 = (st, fc, jnp.zeros((), _I32), jnp.zeros((), bool),
+                      tel0, mon0)
+            (end, _, acc, ova, tel, mon), _ = jax.lax.scan(
                 body, carry0, None, length=n_ticks)
-            return end, acc, ova, tel
+            return end, acc, ova, tel, mon
         return run
 
     fc_scan = scan_of(fc_tick, True)
     plain_scan = scan_of(lambda s, rng: tick_plain(s, rng=rng), False)
 
-    def reductions(end, acc, ova, tel, summarize):
-        return _reduction(end, acc, ova.astype(_I32), summarize, tel=tel)
+    def reductions(end, acc, ova, tel, mon, summarize):
+        return _reduction(end, acc, ova.astype(_I32), summarize, tel=tel,
+                          mon=mon)
 
     refill_jit = jax.jit(lambda s: refill_all(cfg, s))
 
@@ -259,10 +270,12 @@ def make_deep_scan(cfg, n_ticks: int, return_state: bool = False,
         jplain_s = jax.jit(lambda s, r: plain_scan(s, None, r))
 
         def run_state(st, rng):
-            end, _, ova, _tel = jfc_s(st, rng, refill_jit(st))
+            end, _, ova, _tel, mon = jfc_s(st, rng, refill_jit(st))
             ov = bool(jax.device_get(ova))
             if ov:
-                end, _, _, _tel = jplain_s(st, rng)
+                end, _, _, _tel, mon = jplain_s(st, rng)
+            if monitor:
+                return end, ov, telemetry_mod.monitor_finalize(mon)
             return end, ov
 
         return run_state
@@ -287,7 +300,9 @@ def make_deep_scan(cfg, n_ticks: int, return_state: bool = False,
             # The plain rerun carries no cache, so its recorder never sees
             # OV events — publish the fc attempt's per-tick OV count (the
             # ticks whose bits the rerun replaced; the counter's semantics)
-            # instead of the rerun's structural 0.
+            # instead of the rerun's structural 0. The monitor's inv_*
+            # keys are NOT restored from the fc attempt: the rerun's
+            # verdict is the verdict of the published bits.
             fc_ov_ticks = vals.get("tel_ov_fallbacks")
             vals = {k: v for k, v in jplain(st, rng).items()}
             vals["ov"] = jnp.ones((), _I32)
@@ -299,41 +314,50 @@ def make_deep_scan(cfg, n_ticks: int, return_state: bool = False,
     return run
 
 
-def _reduction(end, acc, ov, summarize, tel=None):
+def _reduction(end, acc, ov, summarize, tel=None, mon=None):
     """THE bench reduction contract (rounds / livepin / ov keys +
-    summarize extras + optional tel_* flight-recorder counters) — one copy,
-    shared by every runner here so the A/B legs measure() compares can
-    never desynchronize on it."""
+    summarize extras + optional tel_* flight-recorder counters + optional
+    inv_* monitor scalars) — one copy, shared by every runner here so the
+    A/B legs measure() compares can never desynchronize on it."""
     out = {"rounds": jnp.sum(end.rounds), "livepin": acc, "ov": ov}
     if tel is not None:
         out.update({f"tel_{k}": v for k, v in tel.items()})
+    if mon is not None:
+        out.update(telemetry_mod.monitor_scalars(mon))
     if summarize is not None:
         out.update(summarize(end))
     return out
 
 
-def _livepin_scan(tick, n_ticks, telemetry: bool = False):
+def _livepin_scan(tick, n_ticks, telemetry: bool = False,
+                  monitor: bool = False, n_groups: int = 0):
     """lax.scan of a per-tick sharded engine under the bench livepin
     discipline (one log_cmd row observed through the carry every tick so
     XLA cannot dead-carry-eliminate the payload chain — bench.measure's
-    elision trap), with optional per-tick trace emission and optional
-    flight-recorder accumulation. The single copy of the plain-scan body
-    shared by the non-fc sharded runners and the fc runner's OV fallback;
-    scan(st, rng[, with_trace]) -> (end, livepin, tel_or_None, trace_ys)."""
+    elision trap), with optional per-tick trace emission, optional
+    flight-recorder accumulation, and optional safety-invariant monitor
+    accumulation (monitor=True needs n_groups for the taint masks). The
+    single copy of the plain-scan body shared by the non-fc sharded
+    runners and the fc runner's OV fallback;
+    scan(st, rng[, with_trace]) -> (end, livepin, tel, mon, trace_ys)."""
     def scan(st, rng, with_trace=False):
         def body(carry, _):
-            s, acc, tel = carry
+            s, acc, tel, mon = carry
             s2 = tick(s, rng)
             acc = acc + jnp.sum(s2.log_cmd[:, 0, :].astype(_I32))
             if tel is not None:
                 tel = telemetry_mod.telemetry_step(s, s2, tel)
+            if mon is not None:
+                mon = telemetry_mod.monitor_step(s, s2, mon)
             y = _trace_row(s2) if with_trace else None
-            return (s2, acc, tel), y
+            return (s2, acc, tel, mon), y
 
         tel0 = telemetry_mod.telemetry_zeros() if telemetry else None
-        (end, acc, tel), ys = jax.lax.scan(
-            body, (st, jnp.zeros((), _I32), tel0), None, length=n_ticks)
-        return end, acc, tel, ys
+        mon0 = telemetry_mod.monitor_init(n_groups, n_ticks, monitor)
+        (end, acc, tel, mon), ys = jax.lax.scan(
+            body, (st, jnp.zeros((), _I32), tel0, mon0), None,
+            length=n_ticks)
+        return end, acc, tel, mon, ys
 
     return scan
 
@@ -364,7 +388,8 @@ def _sharded_default_rng(cfg, mesh):
 
 def _make_sharded_plain_scan(cfg, mesh, n_ticks: int, engine: str,
                              return_state: bool = False,
-                             telemetry: bool = False):
+                             telemetry: bool = False,
+                             monitor: bool = False):
     """The non-fc sharded deep runners behind make_sharded_deep_scan's
     routing: the per-shard BATCHED or per-pair FLAT shard_map engine
     (parallel.mesh._make_shardmap_xla_tick) scanned for n_ticks under the
@@ -376,7 +401,8 @@ def _make_sharded_plain_scan(cfg, mesh, n_ticks: int, engine: str,
     tick = mesh_mod._make_shardmap_xla_tick(
         cfg, mesh, batched=(engine == "batched"))
     scan = _livepin_scan(lambda s, rng: tick(s, rng), n_ticks,
-                         telemetry=telemetry)
+                         telemetry=telemetry, monitor=monitor,
+                         n_groups=cfg.n_groups)
     default_rng = _sharded_default_rng(cfg, mesh)
 
     if return_state:
@@ -384,7 +410,7 @@ def _make_sharded_plain_scan(cfg, mesh, n_ticks: int, engine: str,
 
         def run_state(st, rng=None):
             rng = rng if rng is not None else default_rng()
-            end, _, _tel, _ys = jscan(st, rng)
+            end, _, _tel, _mon, _ys = jscan(st, rng)
             return end, False
 
         return run_state
@@ -395,9 +421,9 @@ def _make_sharded_plain_scan(cfg, mesh, n_ticks: int, engine: str,
         rng = rng if rng is not None else default_rng()
         if summarize not in jitted:
             def reduced(s, r):
-                end, acc, tel, _ys = scan(s, r)
+                end, acc, tel, mon, _ys = scan(s, r)
                 return _reduction(end, acc, jnp.zeros((), _I32), summarize,
-                                  tel=tel)
+                                  tel=tel, mon=mon)
 
             jitted[summarize] = jax.jit(reduced)
         return dict(jitted[summarize](st, rng).items())
@@ -418,7 +444,8 @@ def make_sharded_deep_scan(cfg, mesh, n_ticks: int,
                            return_state: bool = False,
                            engine: str = "auto",
                            trace: bool = False,
-                           telemetry: bool = False):
+                           telemetry: bool = False,
+                           monitor: bool = False):
     """The sharded deep-log runner — and, since round 6, the deep band's
     engine ROUTER: `engine="auto"` (the default every production caller
     uses) picks the per-shard engine ("fc" | "batched" | "flat") from
@@ -458,10 +485,14 @@ def make_sharded_deep_scan(cfg, mesh, n_ticks: int,
 
     `telemetry=True` (reduction mode only) accumulates the scan-carry
     flight recorder (utils/telemetry.py; per-tick OV events count into
-    ov_fallbacks) and merges tel_* counters into the reduction dict. The
-    recorder reads the globally-sharded states OUTSIDE shard_map, so its
-    scalar reductions are the same class of cross-shard collectives as the
-    livepin — and the per-shard engine program is untouched.
+    ov_fallbacks) and merges tel_* counters into the reduction dict;
+    `monitor=True` (reduction mode only) accumulates the safety-invariant
+    monitor and merges its inv_* scalars — on an OV fallback the rerun's
+    verdict is published (the verdict of the published bits). Both read
+    the globally-sharded states OUTSIDE shard_map, so their reductions
+    are the same class of cross-shard collectives as the livepin — and
+    the per-shard engine program is untouched (group indices in the latch
+    are GLOBAL for the same reason).
 
     run(state, rng=None[, summarize]) -> dict of host scalars (self_timed,
     bench.measure contract); with return_state=True -> (state, ov)."""
@@ -493,7 +524,8 @@ def make_sharded_deep_scan(cfg, mesh, n_ticks: int,
     if engine != "fc":
         assert not trace, "trace mode is the fc parity leg's observable"
         return _make_sharded_plain_scan(cfg, mesh, n_ticks, engine,
-                                        return_state, telemetry=telemetry)
+                                        return_state, telemetry=telemetry,
+                                        monitor=monitor)
     flags = tick_mod.make_flags(cfg)
     assert flags.batched, "make_sharded_deep_scan needs a batched config"
     sfields = tick_mod.state_fields(flags)
@@ -563,20 +595,24 @@ def make_sharded_deep_scan(cfg, mesh, n_ticks: int,
         fc0 = refill_shard(st)
 
         def body(carry, _):
-            s, f, acc, ova, tel = carry
+            s, f, acc, ova, tel, mon = carry
             s2, f2, ov = tick_fc(s, f, rng)
             acc = acc + jnp.sum(s2.log_cmd[:, 0, :].astype(_I32))
             ov_t = jnp.any(ov)
             if tel is not None:
                 tel = telemetry_mod.telemetry_step(s, s2, tel, ov=ov_t)
+            if mon is not None:
+                mon = telemetry_mod.monitor_step(s, s2, mon)
             y = _trace_row(s2) if with_trace else None
-            return (s2, f2, acc, ova | ov_t, tel), y
+            return (s2, f2, acc, ova | ov_t, tel, mon), y
 
         tel0 = telemetry_mod.telemetry_zeros() if telemetry else None
-        carry0 = (st, fc0, jnp.zeros((), _I32), jnp.zeros((), bool), tel0)
-        (end, _, acc, ova, tel), ys = jax.lax.scan(
+        mon0 = telemetry_mod.monitor_init(cfg.n_groups, n_ticks, monitor)
+        carry0 = (st, fc0, jnp.zeros((), _I32), jnp.zeros((), bool),
+                  tel0, mon0)
+        (end, _, acc, ova, tel, mon), ys = jax.lax.scan(
             body, carry0, None, length=n_ticks)
-        return end, acc, ova, tel, ys
+        return end, acc, ova, tel, mon, ys
 
     # Plain sharded fallback: the per-tick shard_map BATCHED engine
     # (parallel/mesh's deep route), scanned with the SAME rng operand the
@@ -585,7 +621,8 @@ def make_sharded_deep_scan(cfg, mesh, n_ticks: int,
     # not a retrace).
     plain_tick = mesh_mod._make_shardmap_xla_tick(cfg, mesh)
     scan_plain = _livepin_scan(lambda s, rng: plain_tick(s, rng), n_ticks,
-                               telemetry=telemetry)
+                               telemetry=telemetry, monitor=monitor,
+                               n_groups=cfg.n_groups)
 
     default_rng = _sharded_default_rng(cfg, mesh)
 
@@ -599,10 +636,10 @@ def make_sharded_deep_scan(cfg, mesh, n_ticks: int,
 
         def run_trace(st, rng=None):
             rng = rng if rng is not None else default_rng()
-            _, _, ova, _tel, ys = jfc_t(st, rng)
+            _, _, ova, _tel, _mon, ys = jfc_t(st, rng)
             ov = bool(jax.device_get(ova))
             if ov:
-                _, _, _tel, ys = jplain_t(st, rng)
+                _, _, _tel, _mon, ys = jplain_t(st, rng)
             return jax.device_get(ys), ov
 
         return run_trace
@@ -613,10 +650,10 @@ def make_sharded_deep_scan(cfg, mesh, n_ticks: int,
 
         def run_state(st, rng=None):
             rng = rng if rng is not None else default_rng()
-            end, _, ova, _tel, _ys = jfc_s(st, rng)
+            end, _, ova, _tel, _mon, _ys = jfc_s(st, rng)
             ov = bool(jax.device_get(ova))
             if ov:
-                end, _, _tel, _ys = jplain_s(st, rng)
+                end, _, _tel, _mon, _ys = jplain_s(st, rng)
             return end, ov
 
         return run_state
@@ -630,14 +667,14 @@ def make_sharded_deep_scan(cfg, mesh, n_ticks: int,
         rng = rng if rng is not None else default_rng()
         if summarize not in jitted:
             def reduced(s, r):
-                end, acc, ova, tel, _ys = scan_fc(s, r)
+                end, acc, ova, tel, mon, _ys = scan_fc(s, r)
                 return _reduction(end, acc, ova.astype(_I32), summarize,
-                                  tel=tel)
+                                  tel=tel, mon=mon)
 
             def reduced_plain(s, r):
-                end, acc, tel, _ys = scan_plain(s, r)
+                end, acc, tel, mon, _ys = scan_plain(s, r)
                 return _reduction(end, acc, jnp.ones((), _I32), summarize,
-                                  tel=tel)
+                                  tel=tel, mon=mon)
 
             jitted[summarize] = (jax.jit(reduced), jax.jit(reduced_plain))
         jfc, jplain = jitted[summarize]

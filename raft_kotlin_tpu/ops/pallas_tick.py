@@ -630,7 +630,8 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
                      jitted: bool = True,
                      _resets_bound: Optional[int] = None,
                      ilp_subtiles: Optional[int] = None,
-                     telemetry: bool = False):
+                     telemetry: bool = False,
+                     monitor: bool = False):
     """Multi-tick Pallas runner with a FLAT int32 scan carry.
 
     Scanning make_pallas_tick converts RaftState <-> the kernel's flat int32
@@ -657,9 +658,12 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
     `telemetry=True` threads the scan-carry flight recorder
     (utils/telemetry.py) through the flat carry — the accumulation reads
     the pre/post-tick flat state BETWEEN kernel launches (plain XLA
-    reductions; the Mosaic kernel and its bits are untouched) — and run
-    returns (state, telemetry) instead of state. Requires k_per_launch=1:
-    the archival K-tick kernel exposes no per-tick state to read.
+    reductions; the Mosaic kernel and its bits are untouched); `monitor=
+    True` threads the scan-carry safety-invariant monitor the same way
+    (Figure-3 checks over the flat views — the logs ride the flat carry
+    in storage dtype, which the checks compare natively). run returns
+    (state[, telemetry][, monitor-finalized]) accordingly. Both require
+    k_per_launch=1: the archival K-tick kernel exposes no per-tick state.
 
     Returns run(state, rng) -> state (jitted; rng rides as an operand so the
     compilation is seed-independent, as everywhere else)."""
@@ -669,10 +673,10 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
 
     N, G = cfg.n_nodes, cfg.n_groups
     K = max(1, k_per_launch)
-    if telemetry and K > 1:
+    if (telemetry or monitor) and K > 1:
         raise ValueError(
-            "telemetry needs k_per_launch == 1: the K-tick kernel exposes "
-            "no per-tick state between launches (archival path)")
+            "telemetry/monitor need k_per_launch == 1: the K-tick kernel "
+            "exposes no per-tick state between launches (archival path)")
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
     tile_g, ilp_subtiles = resolve_scan_geometry(
@@ -699,7 +703,7 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
                 flat[k] = flat[k].astype(_I32)
 
         def body(carry, _):
-            s, t, tel = carry
+            s, t, tel, mon = carry
             shim = types.SimpleNamespace(
                 tick=t, t_ctr=s["t_ctr"], b_ctr=s["b_ctr"])
             aux, flags = tick_mod.make_aux(
@@ -718,10 +722,16 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
                 tel = telemetry_mod.telemetry_step_arrays(
                     telemetry_mod.flat_view(s, N),
                     telemetry_mod.flat_view(s2, N), tel)
-            return (s2, t + 1, tel), None
+            if mon is not None:
+                # Safety-invariant monitor (ISSUE 6): same contract — flat
+                # pre/post views between launches, kernel untouched.
+                mon = telemetry_mod.monitor_step_arrays(
+                    telemetry_mod.monitor_flat_view(s, N),
+                    telemetry_mod.monitor_flat_view(s2, N), mon)
+            return (s2, t + 1, tel, mon), None
 
         def body_k(carry, _):
-            s, t, tel = carry  # tel is None here (telemetry rejects K > 1)
+            s, t, tel, mon = carry  # tel/mon None here (K > 1 rejected)
             per, flags = [], None
             for k in range(K):
                 shim = types.SimpleNamespace(
@@ -739,24 +749,30 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
             outs = call(*([s[k] for k in sfields_k] + slabs
                           + [el_tab, b_tab]))
             # Last output = the launch's (N, G) draw-table overflow counts.
-            return ((dict(zip(sfields_k, outs[:-1])), t + K, tel),
+            return ((dict(zip(sfields_k, outs[:-1])), t + K, tel, mon),
                     jnp.sum(outs[-1]))
 
         tel0 = telemetry_mod.telemetry_zeros() if telemetry else None
-        flat_t = (flat, state.tick, tel0)
+        mon0 = telemetry_mod.monitor_init(G, n_ticks, monitor)
+        flat_t = (flat, state.tick, tel0, mon0)
         ov_total = jnp.zeros((), _I32)
         if n_launch:
             flat_t, ovs = jax.lax.scan(body_k, flat_t, None, length=n_launch)
             ov_total = jnp.sum(ovs)
         if rem:
             flat_t, _ = jax.lax.scan(body, flat_t, None, length=rem)
-        flat, t, tel = flat_t
+        flat, t, tel, mon = flat_t
         s, _ = cast_flat_out(cfg, [flat[k] for k in sfields], sfields,
                              with_dirty=False)
         end = RaftState(**tick_mod.unflatten_state(cfg, s), tick=t)
         if K > 1:
             return end, ov_total
-        return (end, tel) if telemetry else end
+        out = (end,)
+        if telemetry:
+            out = out + (tel,)
+        if monitor:
+            out = out + (telemetry_mod.monitor_finalize(mon),)
+        return out if len(out) > 1 else end
 
     # jitted=False hands the traceable fn to callers that embed it in a
     # larger jit (bench.measure reduces the end state to scalars INSIDE one

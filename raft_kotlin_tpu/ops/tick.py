@@ -2053,7 +2053,8 @@ def make_tick(cfg: RaftConfig, batched: Optional[bool] = None,
 
 
 def make_run(cfg: RaftConfig, n_ticks: int, trace: bool = True, impl: str = "xla",
-             batched: Optional[bool] = None, telemetry: bool = False):
+             batched: Optional[bool] = None, telemetry: bool = False,
+             monitor: bool = False, rng=None):
     """jitted runner: state -> (state, trace) stepping n_ticks via lax.scan.
 
     trace is a dict of (T, N, G) arrays (role/term/commit/last_index/voted_for/rounds/
@@ -2064,9 +2065,16 @@ def make_run(cfg: RaftConfig, n_ticks: int, trace: bool = True, impl: str = "xla
     XLA:CPU compiles of the batched engine blow up on int16 deep configs, so
     CPU-bound tests of such configs pass this.
     telemetry=True additionally threads the scan-carry flight recorder
-    (utils/telemetry.py — scalar counters, read back once) and returns
-    (state, trace, telemetry) instead; the protocol bits are unchanged
-    (the recorder only reads the states the scan already carries).
+    (utils/telemetry.py — scalar counters, read back once);
+    monitor=True threads the scan-carry safety-invariant monitor (Figure-3
+    checks + first-violation latch + history ring, finalized form). The
+    return grows accordingly: (state, trace[, telemetry][, monitor]) —
+    protocol bits are unchanged either way (both only read the states the
+    scan already carries).
+    `rng` overrides the counted-threefry operand (default make_rng(cfg)) —
+    bench.measure dispatches reps with per-rep perturbed rng seeds over the
+    cfg-seeded initial state, and a faithful replay of such a rep
+    (api/triage.triage_violation) must reproduce exactly that split.
     """
     if impl == "pallas":
         from raft_kotlin_tpu.ops.pallas_tick import make_pallas_tick
@@ -2074,12 +2082,13 @@ def make_run(cfg: RaftConfig, n_ticks: int, trace: bool = True, impl: str = "xla
         tick_fn = make_pallas_tick(cfg)
     else:
         tick_fn = make_tick(cfg, batched=batched)
-    rng = make_rng(cfg)
+    if rng is None:
+        rng = make_rng(cfg)
 
     @jax.jit
     def run(st, rng):
         def body(carry, _):
-            st, tel = carry
+            st, tel, mon = carry
             with telemetry_mod.engine_scope(impl):
                 st2 = tick_fn(st, rng=rng)
             if trace:
@@ -2096,11 +2105,20 @@ def make_run(cfg: RaftConfig, n_ticks: int, trace: bool = True, impl: str = "xla
                 out = jnp.sum((st2.role == LEADER).astype(_I32), axis=0)
             if telemetry:
                 tel = telemetry_mod.telemetry_step(st, st2, tel)
-            return (st2, tel), out
+            if monitor:
+                mon = telemetry_mod.monitor_step(st, st2, mon)
+            return (st2, tel, mon), out
 
         tel0 = telemetry_mod.telemetry_zeros() if telemetry else None
-        (end, tel), ys = lax.scan(body, (st, tel0), None, length=n_ticks)
-        return (end, ys, tel) if telemetry else (end, ys)
+        mon0 = telemetry_mod.monitor_init(cfg.n_groups, n_ticks, monitor)
+        (end, tel, mon), ys = lax.scan(body, (st, tel0, mon0), None,
+                                       length=n_ticks)
+        out = (end, ys)
+        if telemetry:
+            out = out + (tel,)
+        if monitor:
+            out = out + (telemetry_mod.monitor_finalize(mon),)
+        return out
 
     # rng rides the jit boundary as an operand (seed-independent program).
     return lambda st: run(st, rng)

@@ -355,7 +355,7 @@ def _make_shardmap_xla_tick(cfg: RaftConfig, mesh: Mesh,
 
 def make_sharded_run(cfg: RaftConfig, mesh: Mesh, n_ticks: int,
                      metrics_every: int = 0, impl: str = "xla",
-                     telemetry: bool = False):
+                     telemetry: bool = False, monitor: bool = False):
     """Compile run(state [, inject]) -> (state, metrics) sharded over `mesh`.
 
     metrics: dict of cross-group reductions emitted every `metrics_every` ticks
@@ -373,11 +373,14 @@ def make_sharded_run(cfg: RaftConfig, mesh: Mesh, n_ticks: int,
     "pallas" (the megakernel per shard via shard_map).
 
     telemetry=True threads the scan-carry flight recorder
-    (utils/telemetry.py) through the run and returns
-    (state, metrics, telemetry) — the recorder's scalar reductions run on
+    (utils/telemetry.py) through the run; monitor=True threads the
+    scan-carry safety-invariant monitor (Figure-3 checks + latch + history
+    ring, finalized form, replicated out). The return grows accordingly:
+    (state, metrics[, telemetry][, monitor]). Both run their reductions on
     the globally-sharded states OUTSIDE shard_map (the same collective
     class as the window metrics; zero per-tick host traffic, read back
-    once). Protocol bits are unchanged.
+    once) — latch group indices are therefore GLOBAL. Protocol bits are
+    unchanged.
     """
     from raft_kotlin_tpu.ops.tick import make_rng
 
@@ -432,34 +435,47 @@ def make_sharded_run(cfg: RaftConfig, mesh: Mesh, n_ticks: int,
                 jnp.int64 if jax.config.jax_enable_x64 else jnp.int32)),
         }
 
+    def _pack(st, ms, tel, mon):
+        out = (st, ms)
+        if telemetry:
+            out = out + (tel,)
+        if monitor:
+            out = out + (telemetry_mod.monitor_finalize(mon),)
+        return out
+
     def run(st, rng):
         def one(carry, _):
-            s, tel = carry
+            s, tel, mon = carry
             s2 = tick_fn(s, rng)
             if tel is not None:
                 tel = telemetry_mod.telemetry_step(s, s2, tel)
-            return (s2, tel), None
+            if mon is not None:
+                mon = telemetry_mod.monitor_step(s, s2, mon)
+            return (s2, tel, mon), None
 
         tel0 = telemetry_mod.telemetry_zeros() if telemetry else None
+        mon0 = telemetry_mod.monitor_init(cfg.n_groups, n_ticks, monitor)
         if not metrics_every:
-            (st, tel), _ = jax.lax.scan(one, (st, tel0), None, length=n_ticks)
-            return (st, None, tel) if telemetry else (st, None)
+            (st, tel, mon), _ = jax.lax.scan(one, (st, tel0, mon0), None,
+                                             length=n_ticks)
+            return _pack(st, None, tel, mon)
 
         def win(carry, _):
-            st, tel = carry
+            st, tel, mon = carry
             rounds0 = _rounds_sum(st)
-            (st, tel), _ = jax.lax.scan(one, (st, tel), None,
-                                        length=metrics_every)
-            return (st, tel), window_metrics(st, rounds0)
+            (st, tel, mon), _ = jax.lax.scan(one, (st, tel, mon), None,
+                                             length=metrics_every)
+            return (st, tel, mon), window_metrics(st, rounds0)
 
-        (st, tel), ms = jax.lax.scan(win, (st, tel0), None,
-                                     length=n_ticks // metrics_every)
+        (st, tel, mon), ms = jax.lax.scan(win, (st, tel0, mon0), None,
+                                          length=n_ticks // metrics_every)
         if n_ticks % metrics_every:
-            (st, tel), _ = jax.lax.scan(one, (st, tel), None,
-                                        length=n_ticks % metrics_every)
-        return (st, ms, tel) if telemetry else (st, ms)
+            (st, tel, mon), _ = jax.lax.scan(one, (st, tel, mon), None,
+                                             length=n_ticks % metrics_every)
+        return _pack(st, ms, tel, mon)
 
-    out_sh = (sh, rep if metrics_every else None) + ((rep,) if telemetry
-                                                     else ())
+    out_sh = ((sh, rep if metrics_every else None)
+              + ((rep,) if telemetry else ())
+              + ((rep,) if monitor else ()))
     jitted = jax.jit(run, in_shardings=(sh, rng_sh), out_shardings=out_sh)
     return lambda st: jitted(st, rng_placed)

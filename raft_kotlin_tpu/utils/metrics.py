@@ -116,6 +116,14 @@ def check_invariants(prev: RaftState, cur: RaftState, cfg: RaftConfig) -> Dict[s
     Note commit monotonicity is deliberately NOT here: quirk e
     (reference RaftServer.kt:270-272) computes min(leaderCommit, last_index), which
     after a log truncation can legitimately LOWER a stale follower's commit.
+    The Figure-3 safety invariants (election safety, log matching, leader
+    completeness, state machine safety) live in utils/telemetry's
+    invariant_matrix — the ONE source of truth shared by the on-device
+    monitor carry and this host path; figure3_counts below is the
+    host-side entry and make_instrumented_run(invariants=True) threads it
+    per tick (quirk-taint masks carried across the scan, SEMANTICS.md §11),
+    including the group-frontier commit-monotonicity form that IS a
+    theorem of the quirk semantics.
     """
     N = cfg.n_nodes
 
@@ -155,6 +163,25 @@ def check_invariants(prev: RaftState, cur: RaftState, cfg: RaftConfig) -> Dict[s
     }
 
 
+def figure3_counts(prev: RaftState, cur: RaftState,
+                   taint_restart: jax.Array, taint_unsafe: jax.Array):
+    """Host-path Figure-3 verdicts for one transition: violation COUNTS per
+    invariant plus the advanced sticky taint masks — a thin wrapper over
+    utils/telemetry.invariant_matrix, which is the ONE definition the
+    on-device monitor carry also runs (tests/test_invariants.py pins the
+    two paths' latches equal differentially). Returns
+    ({"fig3_<invariant>": () i32 count}, taint_restart', taint_unsafe')."""
+    from raft_kotlin_tpu.utils import telemetry as telemetry_mod
+
+    V, tr, tu = telemetry_mod.invariant_matrix(
+        telemetry_mod.monitor_view(prev), telemetry_mod.monitor_view(cur),
+        taint_restart, taint_unsafe)
+    counts = jnp.sum(V.astype(_I32), axis=1)
+    out = {f"fig3_{name}": counts[i]
+           for i, name in enumerate(telemetry_mod.INVARIANT_IDS)}
+    return out, tr, tu
+
+
 def make_instrumented_run(
     cfg: RaftConfig,
     n_ticks: int,
@@ -163,8 +190,10 @@ def make_instrumented_run(
     batched=None,
 ):
     """jitted run(state) -> (state, metrics) where metrics is a dict of (n_ticks,)
-    arrays from `tick_metrics` (plus `check_invariants` counts when invariants=True —
-    the debug mode; ~free, but adds a few reductions per tick). impl as in
+    arrays from `tick_metrics` (plus, when invariants=True — the debug
+    mode — `check_invariants` counts AND the Figure-3 per-tick violation
+    counts from `figure3_counts`, with the quirk-taint masks carried
+    across the scan; ~free, but adds a few reductions per tick). impl as in
     Simulator: "xla", "pallas", or "auto" (ops/pallas_tick.choose_impl).
     `batched=False` forces the per-pair deep-log engine (ops/tick.make_tick —
     XLA:CPU compiles of the batched engine blow up on int16 deep configs, so
@@ -187,15 +216,21 @@ def make_instrumented_run(
 
     @jax.jit
     def run(st, rng):
-        def body(st, _):
+        def body(carry, _):
+            st, tr, tu = carry
             nxt = tick_fn(st, rng=rng)
             out = tick_metrics(st, nxt)
             if invariants:
                 out.update({f"inv_{k}": v
                             for k, v in check_invariants(st, nxt, cfg).items()})
-            return nxt, out
+                fig3, tr, tu = figure3_counts(st, nxt, tr, tu)
+                out.update({f"inv_{k}": v for k, v in fig3.items()})
+            return (nxt, tr, tu), out
 
-        return jax.lax.scan(body, st, None, length=n_ticks)
+        z = jnp.zeros((cfg.n_groups,), dtype=bool)
+        (end, _, _), ms = jax.lax.scan(body, (st, z, z), None,
+                                       length=n_ticks)
+        return end, ms
 
     # rng as a jit operand: the compiled program is seed-independent.
     return lambda st: run(st, rng)

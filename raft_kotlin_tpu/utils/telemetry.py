@@ -1,4 +1,8 @@
-"""Scan-carry flight recorder + phase/engine profiler scopes (ISSUE 5).
+"""Scan-carry flight recorder + phase/engine profiler scopes (ISSUE 5),
+and the on-device Raft safety-invariant monitor (ISSUE 6 — see the
+monitor section below: per-tick Figure-3 checks in the same scan carry,
+a first-violation latch, sticky quirk-taint masks, and a downsampled
+history ring).
 
 The host-side observability path (utils/metrics.MetricsRecorder over
 make_instrumented_run) is a per-window JSONL stream — right for dashboards,
@@ -78,6 +82,7 @@ from typing import Dict, Optional
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from raft_kotlin_tpu.constants import LEADER
 
@@ -211,6 +216,474 @@ def summarize_telemetry(tel: Dict[str, jax.Array]) -> Dict[str, int]:
     transfer for telemetry (a single batched device_get)."""
     host = jax.device_get(tel)
     return {k: int(host[k]) for k in TELEMETRY_FIELDS if k in host}
+
+
+# ---------------------------------------------------------------------------
+# On-device Raft safety-invariant monitor (ISSUE 6).
+#
+# Per-tick vectorized checks of the Figure-3 safety properties (Ongaro &
+# Ousterhout 2014) accumulated in the scan carry of every engine, exactly
+# like the flight recorder above: each invariant is a pre/post-tick STATE
+# reduction, so it is engine-independent and bit-neutral by construction
+# (the monitor only reads the states the scans already carry — phase_body
+# is never touched). The carry holds:
+#
+# - a first-violation LATCH: the lexicographically earliest
+#   (tick, group, invariant_id) of the run, device-resident (-1 = clean),
+# - per-invariant violation counts,
+# - two sticky per-group TAINT masks that encode where the classical
+#   Figure-3 proofs stop applying to the REFERENCE's quirk semantics
+#   (SEMANTICS.md §8/§11 — the implemented invariants are quirk-aware):
+#   * taint_restart — some node restarted since boot (quirk l: no
+#     persistence; a restart wipes votedFor/log, which the Election
+#     Safety / Log Matching / Leader Completeness proofs all require),
+#   * taint_unsafe_commit — a live leader's commit advance topped out on
+#     an entry NOT of its current term (quirk a has no current-term
+#     commit guard; this is exactly the Figure-8 hazard of the paper,
+#     §5.4.2, after which committed-prefix durability is classically
+#     unjustified). NOT sticky: a later commit advance topping out on a
+#     CURRENT-term entry re-justifies the whole prefix (the paper's
+#     indirect-commit rule) and clears it,
+# - a downsampled HISTORY RING: W windows of key health signals (group
+#   commit-frontier min/max, live-leader count, §10 in-flight high-water,
+#   violation count), giving a post-mortem timeline with zero per-tick
+#   host transfers.
+#
+# Invariant ids (INVARIANT_IDS order is the latch's tie-break order):
+#
+# 0 election_safety    ≤1 live leader per (term, group). Exempt: groups
+#                      with taint_restart (a restarted voter re-grants a
+#                      term its pre-restart self already voted in).
+# 1 leader_append_only a node that is a live leader in BOTH states with
+#                      the SAME term never changes the stored content of
+#                      a slot below min(prev, cur) last_index. CONTENT
+#                      form: the readable window may shrink — quirk b/c
+#                      stale self-appends re-add the leader's own entry
+#                      (identical bits) at next_index-1, a §3 overwrite.
+#                      Self-exempting (restart/demotion clears the
+#                      continuing-leader mask); no taint gate.
+# 2 log_matching       same (index, term) on two PRISTINE logs implies
+#                      identical entries up to and including that index.
+#                      Pristine = phys_len == last_index (never truncated):
+#                      quirk j physically retains a truncated tail and
+#                      later re-exposes stale slots, which the reference
+#                      itself then serves — ghost logs are not comparable.
+#                      Exempt: taint_restart (split-brain same-term
+#                      leaders can mint conflicting same-term entries).
+# 3 leader_completeness every live leader's log CONTAINS (entry-for-entry)
+#                      every node's readable committed prefix
+#                      min(commit, last_index). Pristine endpoints only;
+#                      exempt: taint_restart, taint_unsafe_commit, and
+#                      the per-tick stale-append hazard window (a live
+#                      non-leader with an armed heartbeat, or a §10
+#                      in-flight append slot owned by a non-leader —
+#                      quirk-d stale appends legitimately rewrite
+#                      followers then).
+# 4 commit_monotonic   the GROUP commit frontier max_n(commit) never
+#                      decreases, with nodes restarting THIS tick masked
+#                      out of the prev-side max (quirk l wipes commit; a
+#                      quirk-e lowering can never reach the frontier
+#                      holder, so the group form needs no quirk-e gate —
+#                      the per-node form would). State Machine Safety (a).
+# 5 committed_prefix   per node: the STORED content below the pre-tick
+#                      readable committed prefix min(commit, last_index)
+#                      never changes (CONTENT form — readability may
+#                      shrink via quirk-b/c stale self-appends; §3
+#                      retains and later re-exposes the original bits).
+#                      Exempt: the node restarting this tick,
+#                      taint_restart, taint_unsafe_commit (Figure 8 is
+#                      precisely a rewrite below a quirk-a commit), and
+#                      the stale-append hazard window (see id 3).
+#                      State Machine Safety (b).
+#
+# SEMANTICS.md §11 states each check formally; tests/test_invariants.py
+# pins bit-neutrality, host-vs-device latch equality, and exact-coordinate
+# latching of injected violations.
+
+INVARIANT_IDS = (
+    "election_safety",
+    "leader_append_only",
+    "log_matching",
+    "leader_completeness",
+    "commit_monotonic",
+    "committed_prefix",
+)
+N_INVARIANTS = len(INVARIANT_IDS)
+
+# History-ring geometry: W windows per run; the runner picks the stride so
+# the W windows tile the run (monitor_ring_stride). Signals per window:
+# commit_min/commit_max (min/max over the window of the cross-group
+# min/max of the group commit frontier), leaders (peak live-leader count),
+# inflight_hw (§10 slot high-water), violations (sum).
+MONITOR_WINDOWS = 32
+RING_SIGNALS = ("commit_min", "commit_max", "leaders", "inflight_hw",
+                "violations")
+_RING_BIG = jnp.iinfo(jnp.int32).max
+
+# State fields one monitor step reads (canonical shapes: node grids (N, G),
+# logs (N, C, G); plus TELEMETRY_MAILBOX_FIELDS when the config runs §10).
+# hb_armed feeds the stale-append hazard window (see invariant_matrix).
+MONITOR_STATE_FIELDS = ("role", "up", "term", "commit", "last_index",
+                        "phys_len", "hb_armed", "log_term", "log_cmd")
+
+
+def monitor_ring_stride(n_ticks: int, windows: int = MONITOR_WINDOWS) -> int:
+    """Ticks per history-ring window so `windows` windows tile a run of
+    n_ticks (the last window may be partial)."""
+    return max(1, -(-int(n_ticks) // int(windows)))
+
+
+def monitor_init(n_groups: int, n_ticks: int,
+                 enabled: bool = True) -> Optional[Dict[str, jax.Array]]:
+    """THE runner-side monitor-carry constructor: a fresh carry with the
+    ring stride tiling an n_ticks run, or None when the runner's monitor
+    flag is off — one copy of the idiom every engine's scan builder uses,
+    so the carry's construction can never drift between engines."""
+    if not enabled:
+        return None
+    return monitor_zeros(n_groups, monitor_ring_stride(n_ticks))
+
+
+def monitor_zeros(n_groups: int, ring_stride: int = 1,
+                  windows: int = MONITOR_WINDOWS) -> Dict[str, jax.Array]:
+    """A fresh monitor carry. `ring_stride` is baked in as a () int32 so
+    summarize_monitor can decode the ring without out-of-band metadata."""
+    neg1 = jnp.full((), -1, _I32)
+    return {
+        "tick": jnp.zeros((), _I32),
+        "latch_tick": neg1, "latch_group": neg1, "latch_inv": neg1,
+        "viol_total": jnp.zeros((), _I32),
+        "viol_by_inv": jnp.zeros((N_INVARIANTS,), _I32),
+        "taint_restart": jnp.zeros((n_groups,), dtype=bool),
+        "taint_unsafe": jnp.zeros((n_groups,), dtype=bool),
+        "ring_commit_min": jnp.full((windows,), _RING_BIG, _I32),
+        "ring_commit_max": jnp.full((windows,), -1, _I32),
+        "ring_leaders": jnp.zeros((windows,), _I32),
+        "ring_inflight_hw": jnp.zeros((windows,), _I32),
+        "ring_violations": jnp.zeros((windows,), _I32),
+        "ring_stride": jnp.full((), int(ring_stride), _I32),
+    }
+
+
+def invariant_matrix(prev: dict, cur: dict, taint_restart: jax.Array,
+                     taint_unsafe: jax.Array):
+    """The per-tick verdicts: (V, taint_restart', taint_unsafe') where V is
+    a (N_INVARIANTS, G) bool matrix of per-group violations for the
+    transition prev -> cur, with the quirk exemptions above already
+    applied (taints are updated FIRST, so a restart enabling a same-tick
+    violation exempts it — SEMANTICS.md §11). `prev`/`cur` map
+    MONITOR_STATE_FIELDS (+ mailbox dues, unread here) to canonical-shape
+    arrays; bool fields may arrive as int stand-ins (the Pallas flat
+    carry). THE single source of truth for the Figure-3 checks — the
+    host-side path (utils/metrics.figure3_counts) and every engine carry
+    call exactly this function."""
+    lt_p, lc_p = prev["log_term"], prev["log_cmd"]
+    lt_c, lc_c = cur["log_term"], cur["log_cmd"]
+    N, C, G = lt_c.shape
+    slot = lax.broadcasted_iota(_I32, (C, G), 0)
+
+    prev_up = prev["up"] != 0
+    cur_up = cur["up"] != 0
+    restarted = cur_up & ~prev_up                       # (N, G)
+    lead_p = (prev["role"] == LEADER) & prev_up
+    lead = (cur["role"] == LEADER) & cur_up
+    term_p = prev["term"].astype(_I32)
+    term = cur["term"].astype(_I32)
+    li_p = prev["last_index"].astype(_I32)
+    li_c = cur["last_index"].astype(_I32)
+    cm_p = prev["commit"].astype(_I32)
+    cm_c = cur["commit"].astype(_I32)
+
+    # Taints, updated before the gated checks (see docstring). The restart
+    # taint is sticky for the run; the unsafe-commit taint follows the
+    # paper's §5.4.2 rule exactly: a quirk-a commit whose TOP newly
+    # committed slot holds an OLD term is the Figure-8 hazard (sets the
+    # taint), while a commit advance topping out on a CURRENT-term entry
+    # re-justifies the entire prefix below it (clears the taint) — the
+    # classical indirect-commit argument, which re-arms the durability
+    # checks once a live leader commits an entry of its own term.
+    taint_restart = taint_restart | jnp.any(restarted, axis=0)
+    adv = (cm_c > cm_p) & lead & ~restarted
+    unsafe = jnp.zeros((G,), dtype=bool)
+    justify = jnp.zeros((G,), dtype=bool)
+    for n in range(N):
+        top = jnp.sum(jnp.where(slot == cm_c[n][None] - 1,
+                                lt_c[n], 0), axis=0).astype(_I32)
+        top_cur = top == term[n]
+        unsafe = unsafe | (adv[n] & ~top_cur)
+        justify = justify | (adv[n] & top_cur)
+    taint_unsafe = (taint_unsafe | unsafe) & ~(justify & ~unsafe)
+
+    # Stale-append hazard window (per-tick, transient — not a taint): a
+    # DEMOTED leader's still-armed heartbeat fires one last full append
+    # round, and a CANDIDATE ex-leader keeps heartbeating (§5/§8 — the
+    # cancel guard checks FOLLOWER only); under §10, in-flight append
+    # slots from a deposed owner deliver late. Either way a NON-leader
+    # sender can legitimately overwrite a follower's committed/matched
+    # entries with stale content (quirk d never rejects on term). The
+    # cross-node durability checks (3, 5) are masked while such a sender
+    # exists; log_matching survives unmasked (the stale entry keeps its
+    # old term, and the victim's truncation de-pristines it).
+    hb = prev.get("hb_armed")
+    hazard = jnp.zeros((G,), dtype=bool)
+    if hb is not None:
+        hazard = jnp.any((hb != 0) & prev_up
+                         & (prev["role"] != LEADER), axis=0)
+    if prev.get("aq_due") is not None:
+        stale_slot = (prev["aq_due"] >= 0) & ~lead_p[:, None, :]
+        hazard = hazard | jnp.any(stale_slot, axis=(0, 1))
+
+    # 0 — Election Safety: two live leaders sharing a term.
+    two_lead = jnp.zeros((G,), dtype=bool)
+    for a in range(N):
+        for b in range(a + 1, N):
+            two_lead = two_lead | (lead[a] & lead[b] & (term[a] == term[b]))
+    v0 = two_lead & ~taint_restart
+
+    # 1 — Leader Append-Only, CONTENT form: a continuing same-term live
+    # leader never changes the stored content of a slot below its readable
+    # window. The window itself may SHRINK: a stale self-append (quirk b
+    # inits next_index[self] to commit+1 < last_index) re-adds the
+    # leader's own entry at next_index-1, which is a §3 overwrite — same
+    # bits, lower last_index — and the reference does this routinely on
+    # the tick after every election win (and, under §10, τ ticks later).
+    cont = lead & lead_p & (term == term_p)
+    v1 = jnp.zeros((G,), dtype=bool)
+    for n in range(N):
+        keep = slot < jnp.minimum(li_p[n], li_c[n])[None]
+        changed = jnp.any(
+            keep & ((lt_p[n] != lt_c[n]) | (lc_p[n] != lc_c[n])), axis=0)
+        v1 = v1 | (cont[n] & changed)
+
+    # Quirk-j ghost exemption for the cross-node prefix compares: a log
+    # that has EVER truncated keeps phys_len > last_index for the rest of
+    # the node's lifetime (append moves both; only restart rezeroes), so
+    # pristine == "no stale physical tail exists to be re-exposed".
+    pristine = cur["phys_len"].astype(_I32) == li_c    # (N, G)
+
+    # 2/3 — Log Matching + Leader Completeness share the pairwise
+    # entry-mismatch tensors (one (C, G) compare pair per unordered node
+    # pair; N <= 9, unrolled at trace time like the tick's own pair loops).
+    rc = jnp.minimum(cm_c, li_c)                       # readable committed
+    v2 = jnp.zeros((G,), dtype=bool)
+    v3 = jnp.zeros((G,), dtype=bool)
+    for a in range(N):
+        for b in range(a + 1, N):
+            mism = (lt_c[a] != lt_c[b]) | (lc_c[a] != lc_c[b])   # (C, G)
+            both = jnp.minimum(li_c[a], li_c[b])[None]
+            valid = slot < both
+            # Inclusive prefix-mismatch: an entry with matching terms at i
+            # demands identical entries at ALL j <= i (cmd included).
+            bad_pref = jnp.cumsum((mism & valid).astype(_I32), axis=0) > 0
+            v2 = v2 | (pristine[a] & pristine[b] & jnp.any(
+                valid & (lt_c[a] == lt_c[b]) & bad_pref, axis=0))
+            for l, n in ((a, b), (b, a)):
+                lim = jnp.minimum(rc[n], li_c[l])[None]
+                diff = jnp.any(mism & (slot < lim), axis=0)
+                v3 = v3 | (lead[l] & pristine[l] & pristine[n]
+                           & ~restarted[n]
+                           & ((rc[n] > li_c[l]) | diff))
+    v2 = v2 & ~taint_restart
+    v3 = v3 & ~taint_restart & ~taint_unsafe & ~hazard
+
+    # 4 — group commit-frontier monotonicity (restart-masked prev side).
+    fr_prev = jnp.max(jnp.where(restarted, 0, cm_p), axis=0)
+    v4 = jnp.max(cm_c, axis=0) < fr_prev
+
+    # 5 — committed-prefix immutability per node, CONTENT form: the
+    # STORED content of every slot below the pre-tick readable committed
+    # prefix rc = min(commit, last_index) never changes. Readability of
+    # those slots is NOT asserted: a stale self-append (see inv 1) can
+    # legitimately truncate the leader's readable window below its own
+    # commit; §3 retains the physical slots, and later ghost appends
+    # re-expose the ORIGINAL bits — content is what survives quirks b/c/j,
+    # so content is what the implemented invariant protects. A genuine
+    # Figure-8 overwrite rewrites the bits and is caught (when the group
+    # is untainted; quirk-a old-term commits set taint_unsafe first).
+    v5 = jnp.zeros((G,), dtype=bool)
+    for n in range(N):
+        keep = slot < jnp.minimum(cm_p[n], li_p[n])[None]
+        changed = jnp.any(
+            keep & ((lt_p[n] != lt_c[n]) | (lc_p[n] != lc_c[n])), axis=0)
+        v5 = v5 | (~restarted[n] & changed)
+    v5 = v5 & ~taint_restart & ~taint_unsafe & ~hazard
+
+    V = jnp.stack([
+        v0.astype(_I32), v1.astype(_I32), v2.astype(_I32),
+        v3.astype(_I32), v4.astype(_I32), v5.astype(_I32)]) != 0
+    return V, taint_restart, taint_unsafe
+
+
+def monitor_step_arrays(prev: dict, cur: dict, mon: Dict[str, jax.Array]
+                        ) -> Dict[str, jax.Array]:
+    """One monitor step from pre/post-tick state VIEWS: run the checks,
+    fold the verdicts into latch/counters/taints, and advance the history
+    ring. Returns the advanced carry (a new dict; inputs untouched)."""
+    V, tr, tu = invariant_matrix(prev, cur, mon["taint_restart"],
+                                 mon["taint_unsafe"])
+    out = dict(mon)
+    out["taint_restart"], out["taint_unsafe"] = tr, tu
+    tick = mon["tick"]
+    per_inv = jnp.sum(V.astype(_I32), axis=1)          # (N_INVARIANTS,)
+    vc = jnp.sum(per_inv)
+    out["viol_by_inv"] = mon["viol_by_inv"] + per_inv
+    out["viol_total"] = mon["viol_total"] + vc
+
+    # First-violation latch: within the tick, lexicographic (group, inv)
+    # via one masked min over key = group * N_INVARIANTS + inv; across
+    # ticks the scan order makes the first latching tick earliest.
+    key = (lax.broadcasted_iota(_I32, V.shape, 1) * N_INVARIANTS
+           + lax.broadcasted_iota(_I32, V.shape, 0))
+    k = jnp.min(jnp.where(V, key, _RING_BIG))
+    newly = (mon["latch_tick"] < 0) & (vc > 0)
+    out["latch_tick"] = jnp.where(newly, tick, mon["latch_tick"])
+    out["latch_group"] = jnp.where(newly, k // N_INVARIANTS,
+                                   mon["latch_group"])
+    out["latch_inv"] = jnp.where(newly, k % N_INVARIANTS, mon["latch_inv"])
+
+    # History ring: slot (tick // stride) % W; a window's first tick
+    # resets the slot to the signal's identity before combining.
+    stride = mon["ring_stride"]
+    W = mon["ring_violations"].shape[0]
+    hot = lax.iota(_I32, W) == (tick // stride) % W
+    entering = (tick % stride) == 0
+    fr = jnp.max(cur["commit"].astype(_I32), axis=0)   # (G,) group frontier
+    leaders = _s((cur["role"] == LEADER) & (cur["up"] != 0))
+    if cur.get("vq_due") is not None:
+        infl = _s(cur["vq_due"] >= 0) + _s(cur["aq_due"] >= 0)
+    else:
+        infl = jnp.zeros((), _I32)
+
+    def ring(name, val, combine, ident):
+        r = mon[f"ring_{name}"]
+        base = jnp.where(entering, jnp.full_like(r, ident), r)
+        out[f"ring_{name}"] = jnp.where(hot, combine(base, val), r)
+
+    ring("commit_min", jnp.min(fr), jnp.minimum, _RING_BIG)
+    ring("commit_max", jnp.max(fr), jnp.maximum, -1)
+    ring("leaders", leaders, jnp.maximum, 0)
+    ring("inflight_hw", infl, jnp.maximum, 0)
+    ring("violations", vc, jnp.add, 0)
+    out["tick"] = tick + 1
+    return out
+
+
+def monitor_view(state) -> dict:
+    """The monitor view of a RaftState (every RaftState-carrying runner)."""
+    v = {k: getattr(state, k) for k in MONITOR_STATE_FIELDS}
+    for k in TELEMETRY_MAILBOX_FIELDS:
+        v[k] = getattr(state, k, None)
+    return v
+
+
+def monitor_flat_view(flat: dict, n_nodes: int) -> dict:
+    """The monitor view of the flat rank-2 kernel layout (logs (N*C, G) ->
+    (N, C, G)) — the Pallas flat-carry runner's form."""
+    N = n_nodes
+    v = {}
+    for k in MONITOR_STATE_FIELDS:
+        a = flat[k]
+        v[k] = a.reshape(N, -1, a.shape[-1]) if k in ("log_term", "log_cmd") \
+            else a
+    for k in TELEMETRY_MAILBOX_FIELDS:
+        a = flat.get(k)
+        v[k] = a.reshape(N, N, -1) if a is not None else None
+    return v
+
+
+def monitor_step(prev_state, cur_state, mon: Dict[str, jax.Array]
+                 ) -> Dict[str, jax.Array]:
+    """monitor_step_arrays over two RaftStates (one tick apart)."""
+    return monitor_step_arrays(monitor_view(prev_state),
+                               monitor_view(cur_state), mon)
+
+
+def monitor_finalize(mon: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+    """End-of-run form: the (G,)-wide taint masks reduce to group counts
+    (the coverage figure) so the result is O(W) small and shards/replicates
+    trivially out of jit/shard_map. Idempotent."""
+    if "taint_restart" not in mon:
+        return dict(mon)
+    out = {k: v for k, v in mon.items()
+           if k not in ("taint_restart", "taint_unsafe")}
+    out["taint_restart_groups"] = _s(mon["taint_restart"])
+    out["taint_unsafe_groups"] = _s(mon["taint_unsafe"])
+    return out
+
+
+def monitor_scalars(mon: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+    """The monitor as FLAT () int32 scalars under the bench reporting
+    prefix (inv_*) — the form that rides bench.measure's stats dicts and
+    the deep runners' reduction dicts ({k: int(v)} materialization). Ring
+    slots never written hold each signal's identity, so whole-ring
+    aggregates need no used-window mask."""
+    fin = monitor_finalize(mon)
+    return {
+        "inv_violations": fin["viol_total"],
+        "inv_latch_tick": fin["latch_tick"],
+        "inv_latch_group": fin["latch_group"],
+        "inv_latch_inv": fin["latch_inv"],
+        "inv_taint_restart_groups": fin["taint_restart_groups"],
+        "inv_taint_unsafe_groups": fin["taint_unsafe_groups"],
+        "inv_ring_commit_lo": jnp.min(fin["ring_commit_min"]),
+        "inv_ring_commit_hi": jnp.max(fin["ring_commit_max"]),
+        "inv_ring_leaders_hw": jnp.max(fin["ring_leaders"]),
+        "inv_ring_inflight_hw": jnp.max(fin["ring_inflight_hw"]),
+    }
+
+
+def status_from_scalars(stats: Optional[dict]) -> Optional[str]:
+    """The compact per-leg inv_status string from monitor_scalars output
+    (host ints): "clean", or "<invariant>@t<tick>/g<group>". None when the
+    stats carry no monitor (leg ran monitor-off)."""
+    if not stats or "inv_latch_tick" not in stats:
+        return None
+    t = int(stats["inv_latch_tick"])
+    if t < 0:
+        return "clean"
+    name = INVARIANT_IDS[int(stats["inv_latch_inv"])]
+    return f"{name}@t{t}/g{int(stats['inv_latch_group'])}"
+
+
+def summarize_monitor(mon: Dict[str, jax.Array]) -> dict:
+    """Host materialization of a monitor carry (finalized or not) — ONE
+    batched device_get. Returns inv_status, the latch, per-invariant
+    counts, taint coverage, and the history ring decoded into
+    chronological windows (wrap-around handled: long runs keep the LAST
+    W windows)."""
+    host = jax.device_get(monitor_finalize(mon))
+    ticks = int(host["tick"])
+    stride = int(host["ring_stride"])
+    W = len(host["ring_violations"])
+    total_w = -(-ticks // stride) if ticks else 0
+    if total_w <= W:
+        order = list(range(total_w))
+    else:
+        first = total_w % W
+        order = [(first + i) % W for i in range(W)]
+    windows = [{sig: int(host[f"ring_{sig}"][w]) for sig in RING_SIGNALS}
+               for w in order]
+    lt = int(host["latch_tick"])
+    latch = None if lt < 0 else {
+        "tick": lt,
+        "group": int(host["latch_group"]),
+        "invariant_id": int(host["latch_inv"]),
+        "invariant": INVARIANT_IDS[int(host["latch_inv"])],
+    }
+    status = "clean" if latch is None else (
+        f"{latch['invariant']}@t{latch['tick']}/g{latch['group']}")
+    return {
+        "inv_status": status,
+        "latch": latch,
+        "ticks": ticks,
+        "violations": int(host["viol_total"]),
+        "viol_by_inv": {name: int(host["viol_by_inv"][i])
+                        for i, name in enumerate(INVARIANT_IDS)},
+        "taint_restart_groups": int(host["taint_restart_groups"]),
+        "taint_unsafe_groups": int(host["taint_unsafe_groups"]),
+        "ring_stride": stride,
+        "ring": windows,
+    }
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Safety-invariant monitor overhead probe (ISSUE 6): the <3% gate.
+
+A/B the headline program shape with the scan-carry Figure-3 monitor ON
+vs OFF, through `bench.measure` itself — the timing-trap-hardened harness
+(distinct rng per rep, in-region host materialization, median-of-reps)
+and the SAME runner builders the timed headline uses (`bench.scan_runner`
+/ `make_pallas_scan(jitted=False)`), so the probe measures the production
+program, not a lookalike. Both legs run the flight recorder ON (the PR-5
+production baseline — the monitor's cost is measured ON TOP of it,
+which is exactly the ISSUE-6 acceptance comparison "vs PR-5 baseline").
+
+The acceptance gate is < 3% overhead on the headline config; bench.py's
+timed headline runs monitor-ON, so the authoritative number is the BENCH
+record itself — this probe is the standalone sweep and the enforcement
+hook: with --enforce it exits 2 when overhead_frac >= --gate (0.03).
+
+Usage:
+    python scripts/probe_invariants.py [--groups 4096] [--ticks 50]
+        [--reps 3] [--impl auto|xla|pallas] [--mailbox]
+        [--gate 0.03] [--enforce]
+
+Prints one JSON line: ticks/s on/off, overhead_frac, gate_ok, and the
+monitor verdict + history-ring aggregates of the measured run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--groups", type=int, default=4096)
+    ap.add_argument("--ticks", type=int, default=50)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--impl", default="auto",
+                    choices=("auto", "xla", "pallas"))
+    ap.add_argument("--mailbox", action="store_true",
+                    help="add §10 [1,3] delays (inflight_hw ring live)")
+    ap.add_argument("--gate", type=float, default=0.03,
+                    help="overhead_frac acceptance threshold")
+    ap.add_argument("--enforce", action="store_true",
+                    help="exit 2 when overhead_frac >= --gate")
+    args = ap.parse_args()
+
+    import bench
+    from raft_kotlin_tpu.ops.pallas_tick import choose_impl, make_pallas_scan
+    from raft_kotlin_tpu.ops.tick import make_tick
+    from raft_kotlin_tpu.utils.config import RaftConfig
+    from raft_kotlin_tpu.utils.telemetry import status_from_scalars
+
+    # The bench stage-1 fault soup at probe scale (probe_telemetry.py's
+    # config — the same shape bench.py times).
+    cfg = RaftConfig(
+        n_groups=args.groups, n_nodes=5, log_capacity=32, cmd_period=10,
+        p_drop=0.25, p_crash=0.01, p_restart=0.08,
+        p_link_fail=0.02, p_link_heal=0.08, seed=0,
+    ).stressed(10)
+    if args.mailbox:
+        cfg = dataclasses.replace(cfg, delay_lo=1, delay_hi=3)
+    impl = choose_impl(cfg) if args.impl == "auto" else args.impl
+
+    def candidates(monitor):
+        """The SAME builders bench.tick_candidates times, with the
+        monitor switchable (recorder ON in both legs — the PR-5
+        production baseline the overhead is charged against)."""
+        if impl == "pallas":
+            yield (lambda n: make_pallas_scan(cfg, n, interpret=False,
+                                              jitted=False, telemetry=True,
+                                              monitor=monitor)), "pallas"
+        else:
+            yield bench.scan_runner(make_tick(cfg), telemetry=True,
+                                    monitor=monitor), "xla"
+
+    t_off, _, _ = bench.measure(cfg, args.ticks, args.reps,
+                                lambda _cfg: candidates(False))
+    t_on, stats_on, _ = bench.measure(cfg, args.ticks, args.reps,
+                                      lambda _cfg: candidates(True))
+    best_off, best_on = bench.median(t_off), bench.median(t_on)
+    med = stats_on[t_on.index(best_on)]
+    overhead = best_on / best_off - 1.0
+    gate_ok = overhead < args.gate
+
+    print(json.dumps({
+        "impl": impl,
+        "groups": cfg.n_groups,
+        "ticks": args.ticks,
+        "mailbox": bool(args.mailbox),
+        "ticks_per_sec_off": round(args.ticks / best_off, 2),
+        "ticks_per_sec_on": round(args.ticks / best_on, 2),
+        "overhead_frac": round(overhead, 4),
+        "gate": args.gate,
+        "gate_ok": gate_ok,
+        "inv_status": status_from_scalars(med),
+        "monitor": {k: int(v) for k, v in med.items()
+                    if k.startswith("inv_")},
+    }))
+    if args.enforce and not gate_ok:
+        print(f"GATE FAIL: monitor overhead {overhead:.2%} >= "
+              f"{args.gate:.0%}", file=sys.stderr)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

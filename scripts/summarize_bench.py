@@ -12,10 +12,13 @@ truncation ate is reported as missing, never guessed.
 
 Output: the per-leg trajectory across rounds (ticks/s + group-steps/s
 legs), then the regression check — the LATEST round's value per leg
-against the BEST PRIOR vetted round. Exit status is nonzero when any leg
-regressed by more than REGRESSION_TOL (10%), which wires this script
-into tier-1 as a perf-record gate (tests/test_summarize_bench.py runs it
-over the checked-in records).
+against the BEST PRIOR vetted round — and the SAFETY check (ISSUE 6):
+any vetted leg of the latest round whose `*inv_status` verdict is not
+"clean" (the on-device Figure-3 monitor latched a violation). Exit
+status is nonzero when any leg regressed by more than REGRESSION_TOL
+(10%) OR latched a safety violation, which wires this script into
+tier-1 as a perf-and-safety record gate (tests/test_summarize_bench.py
+runs it over the checked-in records).
 
 Vetting: a round's headline legs enter the baseline only when its record
 carries `"suspect": false` (deep legs: `"deeplog_suspect": false`).
@@ -49,6 +52,19 @@ LEGS = (
     ("deeplog_group_steps_per_sec", "deep-log gsps", "deeplog_suspect"),
 )
 
+# (field, label, suspect-gate field) — the per-leg safety-invariant
+# verdicts (ISSUE 6). A vetted leg whose latest-round verdict is anything
+# but "clean" is a GATING failure, exactly like a parity miss: the
+# on-device monitor latched a Figure-3 violation and bench auto-triaged it
+# (the replayable tuple is on that run's stderr). Pre-ISSUE-6 records
+# simply lack the fields and are skipped.
+INV_LEGS = (
+    ("inv_status", "headline inv", "suspect"),
+    ("churn_inv_status", "churn inv", "suspect"),
+    ("mailbox_inv_status", "mailbox inv", "suspect"),
+    ("deeplog_inv_status", "deep-log inv", "deeplog_suspect"),
+)
+
 
 def _extract_field(tail: str, field: str) -> Optional[float]:
     """Last `"field": <number>` occurrence in the tail text (the compact
@@ -60,6 +76,12 @@ def _extract_field(tail: str, field: str) -> Optional[float]:
         return float(m[-1])
     except ValueError:
         return None
+
+
+def _extract_str_field(tail: str, field: str) -> Optional[str]:
+    """Last `"field": "<string>"` occurrence in the tail text."""
+    m = re.findall(rf'"{re.escape(field)}": "([^"]*)"', tail)
+    return m[-1] if m else None
 
 
 def load_record(path: str) -> Optional[dict]:
@@ -75,6 +97,16 @@ def load_record(path: str) -> Optional[dict]:
     parsed = art.get("parsed") or {}
     legs: Dict[str, float] = {}
     vetted: Dict[str, bool] = {}
+
+    def gate_value(gate):
+        gate_v = parsed.get(gate)
+        if not isinstance(gate_v, bool):
+            m = re.findall(rf'"{re.escape(gate)}": (true|false)', tail)
+            gate_v = (m[-1] == "false") if m else None
+            gate_v = None if gate_v is None else not gate_v  # to "suspect?"
+        # vetted = the gate field exists and says not-suspect.
+        return gate_v is False
+
     for field, _label, gate in LEGS:
         v = parsed.get(field)
         if not isinstance(v, (int, float)):
@@ -82,21 +114,24 @@ def load_record(path: str) -> Optional[dict]:
         if v is None:
             continue
         legs[field] = float(v)
-        gate_v = parsed.get(gate)
-        if not isinstance(gate_v, bool):
-            m = re.findall(rf'"{re.escape(gate)}": (true|false)', tail)
-            gate_v = (m[-1] == "false") if m else None
-            gate_v = None if gate_v is None else not gate_v  # to "suspect?"
-        # vetted = the gate field exists and says not-suspect.
-        vetted[field] = gate_v is False
-    if not legs:
+        vetted[field] = gate_value(gate)
+    inv: Dict[str, str] = {}
+    for field, _label, gate in INV_LEGS:
+        v = parsed.get(field)
+        if not isinstance(v, str):
+            v = _extract_str_field(tail, field)
+        if v is None:
+            continue
+        inv[field] = v
+        vetted[field] = gate_value(gate)
+    if not legs and not inv:
         return None
     rnd = art.get("n")
     if rnd is None:
         m = re.search(r"BENCH_r(\d+)", os.path.basename(path))
         rnd = int(m.group(1)) if m else -1
     return {"round": int(rnd), "path": os.path.basename(path),
-            "legs": legs, "vetted": vetted}
+            "legs": legs, "inv": inv, "vetted": vetted}
 
 
 def load_all(pattern: Optional[str] = None) -> List[dict]:
@@ -130,6 +165,22 @@ def check_regressions(recs: List[dict],
     return out
 
 
+def check_violations(recs: List[dict]) -> List[Tuple[str, str]]:
+    """[(leg label, verdict)] for every vetted invariant leg of the LATEST
+    round whose verdict is not "clean" — the safety gate (ISSUE 6)."""
+    if not recs:
+        return []
+    latest = recs[-1]
+    out = []
+    for field, label, _gate in INV_LEGS:
+        v = latest.get("inv", {}).get(field)
+        if v is None or v == "clean":
+            continue
+        if latest["vetted"].get(field):
+            out.append((label, v))
+    return out
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     pattern = argv[0] if argv else None
@@ -159,10 +210,29 @@ def main(argv=None) -> int:
               f"{100 * (1 - cur / best):.1f}% below best prior "
               f"(r{best_round:02d} = {best:,.1f}; tolerance "
               f"{100 * REGRESSION_TOL:.0f}%)", file=sys.stderr)
-    if regs:
+    viols = check_violations(recs)
+    for label, verdict in viols:
+        print(f"SAFETY VIOLATION: {label} r{latest:02d} latched "
+              f"'{verdict}' — the on-device Figure-3 monitor caught a "
+              "safety-invariant break on a vetted leg (replay tuple on "
+              "that bench run's stderr)", file=sys.stderr)
+    # Non-clean verdicts on UNVETTED legs don't gate (an untrustworthy
+    # measurement's verdict is not evidence either way) but must never be
+    # reported as clean — surface them as warnings.
+    latest_rec = recs[-1]
+    unvetted_bad = [(f, v) for f, v in latest_rec.get("inv", {}).items()
+                    if v != "clean" and not latest_rec["vetted"].get(f)]
+    for f, v in unvetted_bad:
+        print(f"WARNING: {f} latched '{v}' on an UNVETTED (suspect) leg — "
+              "not gating, but not clean either", file=sys.stderr)
+    if regs or viols:
         return 1
+    clean_legs = sum(1 for f, v in latest_rec.get("inv", {}).items()
+                     if v == "clean" and latest_rec["vetted"].get(f))
     print(f"r{latest:02d} within {100 * REGRESSION_TOL:.0f}% of every "
-          "vetted prior-best leg")
+          "vetted prior-best leg"
+          + (f"; all {clean_legs} vetted invariant verdicts clean"
+             if clean_legs and not unvetted_bad else ""))
     return 0
 
 

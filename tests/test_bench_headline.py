@@ -32,6 +32,13 @@ def _fake_record():
         "tel_commit_advances": 3_912_004,
         "tel_fault_events": 81_022,
         "triage_status": "clean",
+        "inv_status": "clean",
+        "churn_inv_status": "clean",
+        "mailbox_inv_status": "clean",
+        "deeplog_inv_status": "clean",
+        "inv_violations": 0,
+        "inv_ring_commit_hi": 171,
+        "inv_ring_leaders_hw": 99_214,
         "suspect": False,
         # plus the long tail of fields that overflowed the driver window
         **{f"filler_{i}": [0.1234] * 8 for i in range(80)},
@@ -66,11 +73,23 @@ def test_compact_headline_is_last_line_and_complete():
     for k in ("tel_elections_started", "tel_commit_advances",
               "tel_fault_events", "triage_status"):
         assert k in bench.COMPACT_EXTRA_FIELDS, k
+    # The r10 additions (ISSUE 6): the per-leg safety-invariant verdicts
+    # and the headline history-ring aggregates ride the authoritative
+    # tail by NAME — summarize_bench's safety gate and the round's
+    # acceptance criteria ("clean on every leg") read them from the
+    # artifact.
+    for k in ("inv_status", "churn_inv_status", "mailbox_inv_status",
+              "deeplog_inv_status", "inv_violations",
+              "inv_ring_commit_hi", "inv_ring_leaders_hw"):
+        assert k in bench.COMPACT_EXTRA_FIELDS, k
     for k in bench.COMPACT_EXTRA_FIELDS:
         assert k in last, k
         assert last[k] == record[k], k
-    # Small enough that the driver's tail window always captures it whole.
-    assert len(lines[-1]) < 700, lines[-1]
+    # Small enough that the driver's tail window always captures it whole
+    # (the r10 verdict fields grew the line; a violation status is ~30
+    # chars longer per leg than "clean", so keep generous headroom under
+    # the multi-KB driver window).
+    assert len(lines[-1]) < 1000, lines[-1]
 
 
 def test_compact_headline_handles_missing_fields():

@@ -1,0 +1,557 @@
+"""On-device Raft safety-invariant monitor (utils/telemetry.py, ISSUE 6).
+
+Four contracts, pinned differentially:
+
+1. **Bit-neutrality** — monitor-ON runs are bit-identical to monitor-OFF
+   on per-tick traces and end states across the engines (the monitor only
+   READS the states the scans already carry).
+
+2. **One source of truth** — the device latch (accumulated inside the
+   engine scan carry) equals a HOST recomputation that steps the tick
+   function one tick at a time and applies the same `monitor_step` to
+   each transition, across the sync soup, mailbox [1,3], int16 deep and
+   fc-deep regimes (the two heaviest are slow-tier, PR-5 convention).
+
+3. **Exact-coordinate latching** — an injected violation (a forced second
+   leader in a term; a rewritten committed entry) latches at exactly the
+   corrupted (tick, group) with the lexicographically-first applicable
+   invariant id, and `api/triage.triage_violation` renders the replayable
+   (seed, config, tick, group) tuple with the explain() narrative.
+
+4. **Quirk gating** — the taint masks (restart / unsafe-commit) suppress
+   exactly the checks whose classical proofs the reference's quirks void
+   (SEMANTICS.md §11), so real fault-soup runs stay clean.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from conftest import assert_states_equal
+
+from raft_kotlin_tpu.constants import LEADER
+from raft_kotlin_tpu.models.state import init_state
+from raft_kotlin_tpu.ops.tick import make_rng, make_run, make_tick
+from raft_kotlin_tpu.utils.config import RaftConfig
+from raft_kotlin_tpu.utils.telemetry import (
+    INVARIANT_IDS,
+    MONITOR_WINDOWS,
+    N_INVARIANTS,
+    invariant_matrix,
+    monitor_ring_stride,
+    monitor_scalars,
+    monitor_step,
+    monitor_zeros,
+    status_from_scalars,
+    summarize_monitor,
+)
+
+# The sync fault soup (test_telemetry's config): elections, replication,
+# crashes/restarts, drops — restarts exercise the taint gating.
+SOUP = RaftConfig(n_groups=6, n_nodes=3, log_capacity=16, cmd_period=7,
+                  p_drop=0.1, p_crash=0.005, p_restart=0.05, seed=5
+                  ).stressed(10)
+# A clean replication config: one stable leader, growing commit, no
+# faults — every check fully armed (no taints), used for injections.
+CLEAN = RaftConfig(n_groups=4, n_nodes=3, log_capacity=32, cmd_period=2,
+                   seed=2).stressed(10)
+T = 80
+
+
+def _np_trace(tr):
+    return {k: np.asarray(v) for k, v in tr.items()}
+
+
+def _host_states(cfg, n_ticks, st0=None, batched=None):
+    """The per-tick state sequence [init, post-tick-0, ...] via the jitted
+    single-tick function — the host side of the differential."""
+    tick = make_tick(cfg, batched=batched)
+    rng = make_rng(cfg)
+    jtick = jax.jit(lambda s: tick(s, rng=rng))
+    states = [init_state(cfg) if st0 is None else st0]
+    for _ in range(n_ticks):
+        states.append(jtick(states[-1]))
+    return states
+
+
+_jstep = jax.jit(monitor_step)
+
+
+def _host_monitor(cfg, states):
+    """Host-recomputed monitor: the SAME monitor_step applied to each
+    consecutive state pair, outside any scan."""
+    mon = monitor_zeros(cfg.n_groups, monitor_ring_stride(len(states) - 1))
+    for prev, cur in zip(states[:-1], states[1:]):
+        mon = _jstep(prev, cur, mon)
+    return mon
+
+
+def _assert_bit_neutral(cfg, n_ticks, **kw):
+    end0, tr0 = make_run(cfg, n_ticks, trace=True, **kw)(init_state(cfg))
+    end1, tr1, mon = make_run(cfg, n_ticks, trace=True, monitor=True,
+                              **kw)(init_state(cfg))
+    tr0, tr1 = _np_trace(tr0), _np_trace(tr1)
+    for k in tr0:
+        assert np.array_equal(tr0[k], tr1[k]), (
+            f"field {k} trace differs with the monitor on")
+    assert_states_equal(end0, end1)
+    return tr1, mon
+
+
+def test_monitor_bit_neutral_and_clean_sync_soup():
+    tr, mon = _assert_bit_neutral(SOUP, T)
+    s = summarize_monitor(mon)
+    assert s["inv_status"] == "clean" and s["latch"] is None
+    assert s["violations"] == 0
+    assert int(np.max(tr["commit"])) > 0, "soup did nothing"
+    # Restarts occurred, so the restart taint must actually have bitten
+    # (the gating is exercised, not vacuous).
+    assert s["taint_restart_groups"] > 0
+    assert s["ticks"] == T
+
+
+def test_monitor_host_device_differential_sync_and_mailbox():
+    # Contract 2 on the two fast regimes: the device latch/counters from
+    # the scan carry == the host recomputation over single-tick states.
+    for cfg in (SOUP, dataclasses.replace(SOUP, delay_lo=1, delay_hi=3,
+                                          seed=11)):
+        *_, mon_dev = make_run(cfg, T, trace=False,
+                               monitor=True)(init_state(cfg))
+        mon_host = _host_monitor(cfg, _host_states(cfg, T))
+        assert summarize_monitor(mon_dev) == summarize_monitor(mon_host)
+
+
+def test_monitor_mailbox_ring_sees_inflight():
+    cfg = dataclasses.replace(SOUP, delay_lo=1, delay_hi=3, seed=11)
+    *_, mon = make_run(cfg, T, trace=False, monitor=True)(init_state(cfg))
+    s = summarize_monitor(mon)
+    assert s["inv_status"] == "clean"
+    assert max(w["inflight_hw"] for w in s["ring"]) > 0
+
+
+@pytest.mark.slow
+def test_monitor_host_device_differential_int16_deep():
+    # int16 deep storage, per-pair engine (the XLA:CPU batched-compile
+    # guard the telemetry/metrics suites use). slow: python-loop host side
+    # over a deep config.
+    cfg = RaftConfig(n_groups=8, n_nodes=3, log_capacity=300,
+                     log_dtype="int16", cmd_period=3, p_drop=0.1,
+                     seed=13).stressed(10)
+    Td = 100
+    _assert_bit_neutral(cfg, Td, batched=False)
+    *_, mon_dev = make_run(cfg, Td, trace=False, monitor=True,
+                           batched=False)(init_state(cfg))
+    mon_host = _host_monitor(cfg, _host_states(cfg, Td, batched=False))
+    s = summarize_monitor(mon_dev)
+    assert s == summarize_monitor(mon_host)
+    assert s["inv_status"] == "clean"
+
+
+@pytest.mark.slow
+def test_monitor_host_device_differential_fc_deep():
+    # The frontier-cache deep engine: monitor-on preserves (end, ov), the
+    # reduction dict carries inv_* scalars, and the fc carry's latch ==
+    # the host recomputation over plain batched-engine states (the
+    # engines are bit-identical, so the transitions are the same).
+    # slow: several deep-engine compiles.
+    from raft_kotlin_tpu.ops.deep_cache import make_deep_scan
+
+    cfg = RaftConfig(n_groups=8, n_nodes=3, log_capacity=256, cmd_period=3,
+                     p_drop=0.1, seed=7).stressed(10)
+    Td = 60
+    rng = make_rng(cfg)
+    end0, ov0 = make_deep_scan(cfg, Td, return_state=True)(
+        init_state(cfg), rng)
+    end1, ov1, mon_dev = make_deep_scan(cfg, Td, return_state=True,
+                                        monitor=True)(init_state(cfg), rng)
+    assert ov0 == ov1
+    assert_states_equal(end0, end1)
+    mon_host = _host_monitor(cfg, _host_states(cfg, Td))
+    s = summarize_monitor(mon_dev)
+    assert s == summarize_monitor(mon_host)
+    assert s["inv_status"] == "clean"
+    out = make_deep_scan(cfg, Td, monitor=True)(init_state(cfg), rng)
+    assert int(out["inv_latch_tick"]) == -1
+    assert status_from_scalars({k: int(v) for k, v in out.items()
+                                if k.startswith("inv_")}) == "clean"
+
+
+def test_pallas_flat_carry_monitor_matches_xla():
+    # Engine-independence: the flat-carry monitor (monitor_step_arrays
+    # over kernel-form state between launches) reports the SAME summary
+    # as the XLA scan monitor, and the end state is monitor-neutral.
+    from raft_kotlin_tpu.ops.pallas_tick import make_pallas_scan
+
+    cfg = dataclasses.replace(SOUP, n_groups=8)
+    rng = make_rng(cfg)
+    end0 = make_pallas_scan(cfg, T)(init_state(cfg), rng)
+    end1, mon_p = make_pallas_scan(cfg, T, monitor=True)(
+        init_state(cfg), rng)
+    assert_states_equal(end0, end1)
+    *_, mon_x = make_run(cfg, T, trace=False, monitor=True)(init_state(cfg))
+    assert summarize_monitor(mon_p) == summarize_monitor(mon_x)
+
+
+def test_pallas_monitor_rejects_ktick_kernel():
+    from raft_kotlin_tpu.ops.pallas_tick import make_pallas_scan
+
+    with pytest.raises(ValueError, match="k_per_launch"):
+        make_pallas_scan(SOUP, T, k_per_launch=4, monitor=True)
+
+
+def test_sharded_runner_monitor_matches_xla():
+    # shard_map path over the 8-virtual-device mesh: the monitor's
+    # reductions run on globally-sharded states outside shard_map, so the
+    # latch/ring must equal the single-device monitor (global group ids).
+    from raft_kotlin_tpu.parallel.mesh import (
+        init_sharded, make_mesh, make_sharded_run, pad_groups)
+
+    mesh = make_mesh()
+    cfg = pad_groups(dataclasses.replace(SOUP, seed=3), mesh)
+    T_sh = 60
+    st0, m0 = make_sharded_run(cfg, mesh, T_sh,
+                               metrics_every=10)(init_sharded(cfg, mesh))
+    st1, m1, mon = make_sharded_run(
+        cfg, mesh, T_sh, metrics_every=10,
+        monitor=True)(init_sharded(cfg, mesh))
+    assert_states_equal(st0, st1)
+    for k in m0:
+        assert np.array_equal(np.asarray(m0[k]), np.asarray(m1[k])), k
+    *_, mon_x = make_run(cfg, T_sh, trace=False,
+                         monitor=True)(init_state(cfg))
+    assert summarize_monitor(mon) == summarize_monitor(mon_x)
+
+
+# ---------------------------------------------------------------------------
+# Injected violations: exact-coordinate latching + triage.
+
+def _corrupt_second_leader(st, g):
+    """Force a second live leader in g sharing the existing leader's term
+    (or minting term 1 if the group has none — still two same-term
+    leaders)."""
+    role = np.asarray(st.role).copy()
+    term = np.asarray(st.term).copy()
+    up = np.asarray(st.up).copy()
+    leaders = np.where((role[:, g] == LEADER) & up[:, g])[0]
+    tval = term[leaders[0], g] if len(leaders) else 1
+    a, b = (int(leaders[0]) if len(leaders) else 0), None
+    for n in range(role.shape[0]):
+        if n != a:
+            b = n
+            break
+    for n in (a, b):
+        role[n, g] = LEADER
+        term[n, g] = tval
+        up[n, g] = True
+    return dataclasses.replace(
+        st, role=jnp.asarray(role), term=jnp.asarray(term),
+        up=jnp.asarray(up))
+
+
+def test_injected_second_leader_latches_exact_coordinate():
+    K, G_CORRUPT, TOTAL = 25, 2, 45
+    states = _host_states(CLEAN, TOTAL)
+    mon_clean = _host_monitor(CLEAN, states)
+    assert summarize_monitor(mon_clean)["inv_status"] == "clean"
+    # Corrupt the single transition ending at tick K (post-state of tick
+    # K), then CONTINUE the simulation from the corrupted state.
+    bad = _corrupt_second_leader(states[K + 1], G_CORRUPT)
+    cont = _host_states(CLEAN, TOTAL - K - 1, st0=bad)
+    seq = states[:K + 1] + cont
+    s = summarize_monitor(_host_monitor(CLEAN, seq))
+    assert s["latch"] == {"tick": K, "group": G_CORRUPT,
+                         "invariant_id": 0,
+                         "invariant": "election_safety"}
+    assert s["inv_status"] == f"election_safety@t{K}/g{G_CORRUPT}"
+
+
+def test_injected_committed_rewrite_latches_exact_coordinate():
+    TOTAL = 70
+    states = _host_states(CLEAN, TOTAL)
+
+    def find_target():
+        # First (tick, group, node) past the warmup where a NON-leader
+        # node holds a committed slot 0 already committed in the PRE-tick
+        # state as well (committed_prefix reads the prev-state commit),
+        # in a group whose checks are fully armed at that point — not
+        # unsafe-commit-tainted (the pre-election local-term entries get
+        # quirk-a committed until a current-term commit re-justifies
+        # them, SEMANTICS.md §11) — so the rewrite must register.
+        mon = monitor_zeros(CLEAN.n_groups, 1)
+        taint_u = [np.zeros(CLEAN.n_groups, bool)]
+        for prev, cur in zip(states[:-1], states[1:]):
+            mon = _jstep(prev, cur, mon)
+            taint_u.append(np.array(mon["taint_unsafe"]))
+        for k in range(30, TOTAL - 5):
+            prev_c = np.asarray(states[k].commit)
+            st_k = states[k + 1]
+            commit = np.asarray(st_k.commit)
+            lead_k = (np.asarray(st_k.role) == LEADER) & np.asarray(st_k.up)
+            for g in range(CLEAN.n_groups):
+                if taint_u[k][g]:
+                    continue
+                for i in range(CLEAN.n_nodes):
+                    if (commit[i, g] >= 1 and prev_c[i, g] >= 1
+                            and not lead_k[i, g]):
+                        return k, g, i
+        raise AssertionError("no armed committed coordinate found")
+
+    K, G_CORRUPT, n = find_target()
+    st = states[K + 1]
+    log_cmd = np.asarray(st.log_cmd).copy()
+    log_cmd[n, 0, G_CORRUPT] += 7  # rewrite a committed entry's command
+    bad = dataclasses.replace(st, log_cmd=jnp.asarray(log_cmd))
+    seq = states[:K + 1] + _host_states(CLEAN, TOTAL - K - 1, st0=bad)
+    s = summarize_monitor(_host_monitor(CLEAN, seq))
+    # The rewrite breaks log matching (vs the other pristine follower)
+    # AND committed-prefix immutability at the same coordinate; the latch
+    # takes the lexicographically first id (2). (Leader completeness is
+    # legitimately GATED here: the leader is the cmd-injection node, and
+    # its quirk-b win-tick self-truncation left it non-pristine.)
+    assert (s["latch"]["tick"], s["latch"]["group"]) == (K, G_CORRUPT)
+    assert s["latch"]["invariant"] == "log_matching"
+    assert s["viol_by_inv"]["committed_prefix"] > 0
+
+
+def test_triage_violation_renders_replayable_tuple():
+    from raft_kotlin_tpu.api.triage import (
+        format_violation_report, triage_violation)
+
+    # A corrupted INITIAL state latches at tick 0 through the real device
+    # scan (make_run), and triage's replay re-latches the same coordinate
+    # from the same corrupted state (the deterministic-replay contract).
+    bad0 = _corrupt_second_leader(init_state(CLEAN), 3)
+    *_, mon = make_run(CLEAN, 10, trace=False, monitor=True)(bad0)
+    s = summarize_monitor(mon)
+    assert s["latch"] == {"tick": 0, "group": 3, "invariant_id": 0,
+                          "invariant": "election_safety"}
+    rec = triage_violation(CLEAN, s["latch"], state0=bad0)
+    assert rec["status"] == "election_safety@t0/g3"
+    assert rec["confirmed"] is True
+    assert rec["replay_latch"] == s["latch"]
+    assert (rec["seed"], rec["tick"], rec["group"]) == (CLEAN.seed, 0, 3)
+    assert RaftConfig(**rec["config"]) == CLEAN  # replayable config
+    assert rec["explain_text"]
+    report = format_violation_report(rec)
+    assert "election_safety" in report and "replay tuple" in report
+    # A clean-config replay (no corrupted state supplied) must NOT
+    # confirm — the bisection check is real, not a rubber stamp.
+    rec2 = triage_violation(CLEAN, s["latch"])
+    assert rec2["confirmed"] is False
+
+
+# ---------------------------------------------------------------------------
+# Unit-level: the invariant matrix, lexicographic latch, taints, ring.
+
+def _views(N=3, C=4, G=4):
+    """A benign hand-built monitor view pair (all followers, empty logs)."""
+    def v():
+        return {
+            "role": jnp.zeros((N, G), jnp.int16),
+            "up": jnp.ones((N, G), dtype=bool),
+            "term": jnp.zeros((N, G), jnp.int32),
+            "commit": jnp.zeros((N, G), jnp.int16),
+            "last_index": jnp.zeros((N, G), jnp.int16),
+            "phys_len": jnp.zeros((N, G), jnp.int16),
+            "log_term": jnp.zeros((N, C, G), jnp.int32),
+            "log_cmd": jnp.zeros((N, C, G), jnp.int32),
+            "vq_due": None, "aq_due": None,
+        }
+    return v(), v()
+
+
+def _mat(prev, cur, G=4, tr=None, tu=None):
+    z = jnp.zeros((G,), dtype=bool)
+    V, tr2, tu2 = invariant_matrix(prev, cur,
+                                   z if tr is None else tr,
+                                   z if tu is None else tu)
+    return (np.array(V), np.array(tr2), np.array(tu2))
+
+
+def test_matrix_committed_prefix_content_rewrite_fires_alone():
+    prev, cur = _views()
+    for v in (prev, cur):
+        v["commit"] = v["commit"].at[0, 1].set(2)
+        v["last_index"] = v["last_index"].at[0, 1].set(3)
+        v["phys_len"] = v["phys_len"].at[0, 1].set(3)
+        v["log_term"] = v["log_term"].at[0, :3, 1].set(1)
+    cur["log_cmd"] = cur["log_cmd"].at[0, 1, 1].set(99)  # slot 1 < commit 2
+    V, _, _ = _mat(prev, cur)
+    assert V[INVARIANT_IDS.index("committed_prefix"), 1]
+    V[INVARIANT_IDS.index("committed_prefix"), 1] = False
+    assert not V.any(), "only committed_prefix may fire"
+
+
+def test_matrix_uncommitted_rewrite_does_not_fire():
+    prev, cur = _views()
+    for v in (prev, cur):
+        v["commit"] = v["commit"].at[0, 1].set(1)
+        v["last_index"] = v["last_index"].at[0, 1].set(3)
+        v["phys_len"] = v["phys_len"].at[0, 1].set(3)
+    cur["log_cmd"] = cur["log_cmd"].at[0, 2, 1].set(99)  # slot 2 >= commit
+    V, _, _ = _mat(prev, cur)
+    assert not V.any()
+
+
+def test_matrix_leader_append_only_is_content_based():
+    # A continuing same-term leader whose readable window SHRINKS with
+    # content preserved (the quirk-b/c stale self-append) is legal; a
+    # content rewrite is not.
+    prev, cur = _views()
+    for v in (prev, cur):
+        v["role"] = v["role"].at[0, 0].set(LEADER)
+        v["term"] = v["term"].at[0, 0].set(4)
+        v["log_term"] = v["log_term"].at[0, :3, 0].set(4)
+    prev["last_index"] = prev["last_index"].at[0, 0].set(3)
+    prev["phys_len"] = prev["phys_len"].at[0, 0].set(3)
+    cur["last_index"] = cur["last_index"].at[0, 0].set(2)  # shrink
+    cur["phys_len"] = cur["phys_len"].at[0, 0].set(3)
+    V, _, _ = _mat(prev, cur)
+    assert not V[INVARIANT_IDS.index("leader_append_only")].any()
+    cur["log_cmd"] = cur["log_cmd"].at[0, 0, 0].set(5)    # rewrite
+    V, _, _ = _mat(prev, cur)
+    assert V[INVARIANT_IDS.index("leader_append_only"), 0]
+
+
+def test_matrix_election_safety_and_restart_taint_gate():
+    prev, cur = _views()
+    for n in (0, 1):
+        cur["role"] = cur["role"].at[n, 2].set(LEADER)
+        cur["term"] = cur["term"].at[n, 2].set(7)
+    V, tr, _ = _mat(prev, cur)
+    assert V[0, 2] and not tr[2]
+    # Same split-brain but node 2 of that group restarted this tick:
+    # the restart taint must suppress the check (quirk l).
+    prev["up"] = prev["up"].at[2, 2].set(False)
+    V, tr, _ = _mat(prev, cur)
+    assert tr[2] and not V[0, 2]
+
+
+def test_matrix_unsafe_commit_taint_and_frontier_monotonicity():
+    prev, cur = _views()
+    # A live leader (term 5) advances commit over a term-3 entry: the
+    # quirk-a Figure-8 hazard -> taint_unsafe, no violation by itself.
+    for v in (prev, cur):
+        v["role"] = v["role"].at[0, 0].set(LEADER)
+        v["term"] = v["term"].at[0, 0].set(5)
+        v["last_index"] = v["last_index"].at[0, 0].set(2)
+        v["phys_len"] = v["phys_len"].at[0, 0].set(2)
+        v["log_term"] = v["log_term"].at[0, :2, 0].set(3)
+    cur["commit"] = cur["commit"].at[0, 0].set(1)
+    V, _, tu = _mat(prev, cur)
+    assert tu[0] and not V.any()
+    # Group commit-frontier regression (no restart): commit_monotonic.
+    prev2, cur2 = _views()
+    prev2["commit"] = prev2["commit"].at[1, 3].set(4)
+    V, _, _ = _mat(prev2, cur2)
+    assert V[INVARIANT_IDS.index("commit_monotonic"), 3]
+    # The same regression with the frontier holder restarting this tick
+    # is quirk-l legal (masked).
+    prev2["up"] = prev2["up"].at[1, 3].set(False)
+    V, _, _ = _mat(prev2, cur2)
+    assert not V[INVARIANT_IDS.index("commit_monotonic"), 3]
+
+
+def test_matrix_log_matching_needs_pristine_logs():
+    prev, cur = _views()
+    # Nodes 0/1: same term at slot 1 but different slot-0 entries.
+    for n, t0 in ((0, 1), (1, 2)):
+        for v in (prev, cur):
+            v["last_index"] = v["last_index"].at[n, 0].set(2)
+            v["phys_len"] = v["phys_len"].at[n, 0].set(2)
+            v["log_term"] = v["log_term"].at[n, 0, 0].set(t0)
+            v["log_term"] = v["log_term"].at[n, 1, 0].set(5)
+    V, _, _ = _mat(prev, cur)
+    assert V[INVARIANT_IDS.index("log_matching"), 0]
+    # Node 1's log goes ghost (phys_len > last_index): quirk-j re-exposed
+    # slots are not comparable -> exempt.
+    for v in (prev, cur):
+        v["phys_len"] = v["phys_len"].at[1, 0].set(3)
+    V, _, _ = _mat(prev, cur)
+    assert not V[INVARIANT_IDS.index("log_matching"), 0]
+
+
+def test_latch_is_lexicographic_within_a_tick():
+    # Violations in groups 1 and 3 the same tick -> group 1 wins; within
+    # group 1 election_safety (0), leader_completeness (3 — the minted
+    # empty-log leaders lack node 2's committed entry) and
+    # committed_prefix (5) all fire -> id 0 wins.
+    from raft_kotlin_tpu.utils.telemetry import monitor_step_arrays
+
+    prev, cur = _views()
+    for g in (1, 3):
+        for n in (0, 1):
+            cur["role"] = cur["role"].at[n, g].set(LEADER)
+            cur["term"] = cur["term"].at[n, g].set(2)
+    for v in (prev, cur):
+        v["commit"] = v["commit"].at[2, 1].set(1)
+        v["last_index"] = v["last_index"].at[2, 1].set(1)
+        v["phys_len"] = v["phys_len"].at[2, 1].set(1)
+    cur["log_cmd"] = cur["log_cmd"].at[2, 0, 1].set(9)
+    mon = monitor_zeros(4, 1)
+    mon = monitor_step_arrays(prev, cur, mon)
+    assert int(mon["latch_tick"]) == 0
+    assert int(mon["latch_group"]) == 1
+    assert int(mon["latch_inv"]) == 0
+    assert int(mon["viol_total"]) == 4
+    assert int(mon["viol_by_inv"][INVARIANT_IDS.index("committed_prefix")]) \
+        == 1
+    assert int(mon["viol_by_inv"][
+        INVARIANT_IDS.index("leader_completeness")]) == 1
+
+
+def test_ring_matches_trace_recomputation():
+    # The history ring's windows recomputed on host from the trace must
+    # equal the device ring exactly (commit frontier min/max, live-leader
+    # peak; violations all zero on the clean soup).
+    cfg = SOUP
+    _, tr, mon = make_run(cfg, T, trace=True, monitor=True)(init_state(cfg))
+    tr = _np_trace(tr)
+    s = summarize_monitor(mon)
+    stride = s["ring_stride"]
+    assert stride == monitor_ring_stride(T)
+    fr = tr["commit"].max(axis=1)                       # (T, G) frontier
+    lead = ((tr["role"] == LEADER) & (tr["up"] != 0)).sum(axis=(1, 2))
+    n_win = -(-T // stride)
+    assert len(s["ring"]) == n_win <= MONITOR_WINDOWS
+    for w, win in enumerate(s["ring"]):
+        sl = slice(w * stride, min((w + 1) * stride, T))
+        assert win["commit_min"] == int(fr[sl].min(axis=1).min())
+        assert win["commit_max"] == int(fr[sl].max(axis=1).max())
+        assert win["leaders"] == int(lead[sl].max())
+        assert win["violations"] == 0
+        assert win["inflight_hw"] == 0
+
+
+def test_monitor_scalars_and_status_helpers():
+    mon = monitor_zeros(4, 2)
+    sc = {k: int(v) for k, v in monitor_scalars(mon).items()}
+    assert status_from_scalars(sc) == "clean"
+    assert sc["inv_violations"] == 0
+    assert status_from_scalars({}) is None
+    assert status_from_scalars(None) is None
+    viol = dict(sc, inv_latch_tick=12, inv_latch_group=7,
+                inv_latch_inv=INVARIANT_IDS.index("log_matching"))
+    assert status_from_scalars(viol) == "log_matching@t12/g7"
+    assert len(INVARIANT_IDS) == N_INVARIANTS == 6
+
+
+def test_figure3_host_path_shares_monitor_definitions():
+    # utils/metrics.figure3_counts is a wrapper over the SAME
+    # invariant_matrix: per-tick counts on the instrumented run are zero
+    # on the clean soup, and catch a hand-corrupted transition.
+    from raft_kotlin_tpu.utils.metrics import (
+        figure3_counts, make_instrumented_run)
+
+    run = make_instrumented_run(SOUP, 40, invariants=True)
+    _, m = run(init_state(SOUP))
+    for name in INVARIANT_IDS:
+        assert int(np.asarray(m[f"inv_fig3_{name}"]).sum()) == 0, name
+    st = init_state(CLEAN)
+    bad = _corrupt_second_leader(st, 0)
+    z = jnp.zeros((CLEAN.n_groups,), dtype=bool)
+    counts, _, _ = figure3_counts(st, bad, z, z)
+    assert int(counts["fig3_election_safety"]) == 1

@@ -5,6 +5,7 @@ real simulations (and nonzero on deliberately corrupted states)."""
 import dataclasses
 
 import numpy as np
+import pytest
 
 from raft_kotlin_tpu.constants import LEADER
 from raft_kotlin_tpu.models.state import init_state
@@ -175,10 +176,15 @@ def test_recorder_autoflush_bounds_pending(tmp_path):
     assert len(path.read_text().strip().splitlines()) == 5
 
 
+@pytest.mark.slow
 def test_invariants_zero_on_mailbox_run():
     # ISSUE 5 satellite: check_invariants was only exercised on the sync
     # path — run it over the §10 mailbox production window ([1, 3] delays,
     # the known-delivery regime the bench's async stage measures).
+    # slow since r10 (tier-1 budget): invariants=True now compiles the
+    # Figure-3 checks too; the mailbox regime keeps FAST-tier coverage
+    # through tests/test_invariants.py's mailbox host-vs-device
+    # differential, which runs the same invariant_matrix definitions.
     cfg = dataclasses.replace(CFG, delay_lo=1, delay_hi=3, seed=11)
     run = make_instrumented_run(cfg, TICKS, invariants=True)
     _, m = run(init_state(cfg))
@@ -188,11 +194,19 @@ def test_invariants_zero_on_mailbox_run():
                 f"{k} nonzero on mailbox [1,3] run")
 
 
+@pytest.mark.slow
 def test_invariants_zero_on_int16_deep_run():
     # ...and over the int16 deep-log regime (config-5 class): the int16
     # wrap watch plus every structural invariant must stay zero on a real
     # churny deep run. batched=False keeps the CPU compile feasible
     # (XLA:CPU blows up on the batched int16 deep program — ops/tick.py).
+    # slow since r10: invariants=True now also compiles the Figure-3
+    # per-tick checks (the r10 dedupe), making this the suite's heaviest
+    # single compile; the regime's tier coverage is carried by the
+    # stronger r10 differential on the same shape (tests/
+    # test_invariants.py::test_monitor_host_device_differential_
+    # int16_deep: bit-neutrality + host-vs-device latch equality +
+    # clean verdict), itself slow-tier.
     cfg = RaftConfig(n_groups=8, n_nodes=3, log_capacity=300,
                      log_dtype="int16", cmd_period=3, p_drop=0.1,
                      seed=13).stressed(10)

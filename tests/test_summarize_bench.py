@@ -74,3 +74,40 @@ def test_regression_trigger(tmp_path):
         json.dumps(art(1, 9000.0, suspect="true")))
     (tmp_path / "BENCH_r02.json").write_text(json.dumps(art(2, 300.0)))
     assert sb.main([str(tmp_path / "BENCH_r*.json")]) == 0
+
+
+def test_safety_violation_gate(tmp_path):
+    # ISSUE 6 satellite: a latched Figure-3 violation on a vetted leg of
+    # the LATEST round is a gating failure, exactly like a parity miss.
+    sb = _mod()
+
+    def art(n, inv_status, suspect="false"):
+        tail = json.dumps({"ticks_per_sec": 400.0, "suspect": False,
+                           "inv_status": inv_status,
+                           "mailbox_inv_status": "clean"}) + "\n"
+        tail = tail.replace('"suspect": false', f'"suspect": {suspect}')
+        return {"n": n, "rc": 0, "tail": tail, "parsed": None}
+
+    # Clean verdicts -> clean exit.
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(art(1, "clean")))
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(art(2, "clean")))
+    assert sb.main([str(tmp_path / "BENCH_r*.json")]) == 0
+    # A latched violation on the latest vetted round -> exit 1.
+    (tmp_path / "BENCH_r02.json").write_text(
+        json.dumps(art(2, "committed_prefix@t41/g7")))
+    recs = sb.load_all(str(tmp_path / "BENCH_r*.json"))
+    viols = sb.check_violations(recs)
+    assert viols == [("headline inv", "committed_prefix@t41/g7")]
+    assert sb.main([str(tmp_path / "BENCH_r*.json")]) == 1
+    # The same violation on a SUSPECT (unvetted) leg does not gate —
+    # the suspect flag already marks the round, and an unvetted
+    # measurement's verdict is not trustworthy evidence either way.
+    (tmp_path / "BENCH_r02.json").write_text(
+        json.dumps(art(2, "committed_prefix@t41/g7", suspect="true")))
+    assert sb.check_violations(
+        sb.load_all(str(tmp_path / "BENCH_r*.json"))) == []
+    # A violation on a PRIOR round does not gate the latest clean round.
+    (tmp_path / "BENCH_r01.json").write_text(
+        json.dumps(art(1, "election_safety@t3/g0")))
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(art(2, "clean")))
+    assert sb.main([str(tmp_path / "BENCH_r*.json")]) == 0
