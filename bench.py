@@ -247,7 +247,14 @@ COMPACT_EXTRA_FIELDS = ("deeplog_parity_rate", "deeplog_ov_fallback",
                         "inv_status", "churn_inv_status",
                         "mailbox_inv_status", "deeplog_inv_status",
                         "inv_violations", "inv_ring_commit_hi",
-                        "inv_ring_leaders_hw")
+                        "inv_ring_leaders_hw",
+                        # r11 (ISSUE 7): the fused-tick count the headline
+                        # kernel ran with, the measured fused-vs-T=1
+                        # speedup, and the chain+amortized-launch roofline
+                        # — the round's acceptance gate reads all three
+                        # from the authoritative tail.
+                        "fused_ticks", "fused_vs_t1",
+                        "latency_frac_amortized")
 
 # Flight-recorder counters published verbatim from the headline run's
 # median rep (stats tel_* keys — utils/telemetry.TELEMETRY_FIELDS).
@@ -327,7 +334,8 @@ def scan_runner(tick_fn, telemetry: bool = False, monitor: bool = False):
 
 
 def tick_candidates(cfg):
-    from raft_kotlin_tpu.ops.pallas_tick import choose_impl, make_pallas_scan
+    from raft_kotlin_tpu.ops.pallas_tick import (
+        choose_impl, make_pallas_scan, resolve_fused_geometry)
     from raft_kotlin_tpu.ops.tick import make_tick
 
     if choose_impl(cfg) == "pallas":
@@ -339,11 +347,47 @@ def tick_candidates(cfg):
         # <3% gate measures the same shape; deep legs keep the monitor in
         # a dedicated untimed verification run instead, the full-log
         # prefix compares being O(C) per tick).
+        # fused_ticks routes through FUSED_TICK_TABLE (ISSUE 7): the timed
+        # headline now runs T phase lattices per kernel launch. If Mosaic
+        # rejects the FUSED build at warmup, the ladder degrades to the
+        # proven T=1 kernel (honestly labeled) before falling to XLA.
         yield (lambda n: make_pallas_scan(cfg, n, interpret=False,
                                           jitted=False,
                                           telemetry=True,
                                           monitor=True)), "pallas"
+        try:
+            # Resolve with the SAME snapshot rows the headline builder
+            # carries (recorder+monitor on): the bare model can route a T
+            # the snapshot-laden build falls back from, which would yield
+            # a dead program-identical "nofuse" rung.
+            from raft_kotlin_tpu.ops.pallas_tick import (
+                _snapshot_rows, fused_snapshot_fields)
+
+            _snaps = fused_snapshot_fields(cfg, telemetry=True,
+                                           monitor=True)
+            routed_t = resolve_fused_geometry(
+                cfg, interpret=False,
+                snap_rows=_snapshot_rows(cfg, _snaps))[2]
+        except Exception:
+            routed_t = 1
+        if routed_t > 1:
+            yield (lambda n: make_pallas_scan(cfg, n, interpret=False,
+                                              jitted=False,
+                                              telemetry=True,
+                                              monitor=True,
+                                              fused_ticks=1)), "pallas-nofuse"
     yield scan_runner(make_tick(cfg), telemetry=True, monitor=True), "xla"
+
+
+def pallas_t1_only(cfg):
+    """The fused-vs-T=1 A/B comparator: the headline builder with
+    fused_ticks PINNED to 1, everything else identical (recorder +
+    monitor on, flat carry, jitted=False)."""
+    from raft_kotlin_tpu.ops.pallas_tick import make_pallas_scan
+
+    yield (lambda n: make_pallas_scan(cfg, n, interpret=False, jitted=False,
+                                      telemetry=True, monitor=True,
+                                      fused_ticks=1)), "pallas-t1"
 
 
 def xla_only(cfg):
@@ -510,6 +554,9 @@ def parity_stage(cfg, groups, ticks, impl):
     from raft_kotlin_tpu.ops.tick import make_run
 
     pcfg = dataclasses.replace(cfg, n_groups=groups)
+    # Normalize ladder labels ("pallas-nofuse" etc.) onto make_run's two
+    # impls; trace mode is a T=1 surface either way (the sticky fallback).
+    impl = "pallas" if impl.startswith("pallas") else "xla"
     try:
         run = make_run(pcfg, ticks, trace=True, impl=impl)
         _, ktr = run(init_state(pcfg))
@@ -659,24 +706,70 @@ def main() -> None:
     latency_frac = (round(chain_depth * op_latency / tick_s, 3)
                     if chain_depth and op_latency else None)
 
-    # Sub-tile ILP (ISSUE 4): the K the headline megakernel ran with —
-    # resolve_scan_geometry is the SAME resolution make_pallas_scan performs
-    # internally (one shared copy), called with the same arguments as the
-    # tick_candidates headline build (interpret=False, defaults otherwise).
-    # 1 when the headline fell back to XLA (no kernel, no sub-tiling).
-    # probe_chain_ilp.py is the K-sweep that re-pins the table entry.
+    # Sub-tile ILP (ISSUE 4) + fused ticks (ISSUE 7): the (K, T) the
+    # headline megakernel ran with — resolve_fused_geometry is the SAME
+    # resolution make_pallas_scan performs internally (one shared copy),
+    # called with the same arguments as the tick_candidates headline build
+    # (interpret=False, recorder+monitor snapshot set). 1/1 when the
+    # headline fell back to XLA; T=1 when the ladder degraded to the
+    # "pallas-nofuse" candidate. probe_chain_ilp.py re-pins the K table,
+    # probe_fused_ticks.py the T table.
     try:
-        from raft_kotlin_tpu.ops.pallas_tick import resolve_scan_geometry
+        from raft_kotlin_tpu.ops.pallas_tick import (
+            _snapshot_rows, fused_snapshot_fields, resolve_fused_geometry)
 
-        ilp_subtiles = (resolve_scan_geometry(cfg, interpret=False)[1]
-                        if impl == "pallas" else 1)
+        if impl == "pallas":
+            _snaps = fused_snapshot_fields(cfg, telemetry=True, monitor=True)
+            _, ilp_subtiles, fused_ticks = resolve_fused_geometry(
+                cfg, interpret=False,
+                snap_rows=_snapshot_rows(cfg, _snaps))
+        elif impl == "pallas-nofuse":
+            _, ilp_subtiles, fused_ticks = resolve_fused_geometry(
+                cfg, interpret=False, fused_ticks=1)
+        else:
+            ilp_subtiles, fused_ticks = 1, 1
     except Exception as e:
-        print(f"ilp routing probe failed: {str(e)[:120]}", file=sys.stderr)
-        ilp_subtiles = 1
+        print(f"fused/ilp routing probe failed: {str(e)[:120]}",
+              file=sys.stderr)
+        ilp_subtiles, fused_ticks = 1, 1
+
+    # Fused-vs-T=1 A/B (ISSUE 7): the same builder with fused_ticks pinned
+    # to 1 — the measured launch-amortization payoff, and the source of the
+    # amortized launch-overhead estimate below. Skipped when the headline
+    # itself ran unfused (ratio 1.0 by definition).
+    fused_vs_t1 = 1.0
+    launch_overhead_ns = None
+    if impl == "pallas" and fused_ticks > 1:
+        try:
+            t1_times, _, _ = measure(cfg, ticks, max(2, reps - 1),
+                                     pallas_t1_only)
+            t1_best = median(t1_times)
+            fused_vs_t1 = t1_best / best
+            # Per-launch overhead L from the two-point fit: per-tick time
+            # t(T) = t_work + L/T, so t(1) - t(T) = L (1 - 1/T). A noisy
+            # round can measure fused slower than T=1 (L < 0): publish
+            # null, not a physically impossible negative overhead (the
+            # probe's fit applies the same guard).
+            L = (t1_best - best) / ticks * fused_ticks / (fused_ticks - 1)
+            launch_overhead_ns = round(L * 1e9, 1) if L > 0 else None
+        except Exception as e:
+            print(f"fused-vs-T1 leg failed: {str(e)[:200]}", file=sys.stderr)
+
+    # Amortized issue/launch roofline (ISSUE 7 satellite): the chain floor
+    # plus the measured per-launch overhead amortized over the fused block
+    # — latency_frac against the program the headline ACTUALLY ran, not
+    # the single-tick launch model. Equals latency_frac when unfused or
+    # when the overhead fit is unavailable.
+    latency_frac_amortized = latency_frac
+    if (latency_frac is not None and launch_overhead_ns is not None
+            and chain_depth and op_latency):
+        L_amort = max(launch_overhead_ns, 0.0) * 1e-9 / fused_ticks
+        latency_frac_amortized = round(
+            (chain_depth * op_latency + L_amort) / tick_s, 3)
 
     # XLA-vs-Pallas ratio on the same config (perf model; skip if headline
     # already fell back to XLA).
-    if impl == "pallas":
+    if impl.startswith("pallas"):
         xtimes, _, _ = measure(cfg, ticks, max(2, reps - 1), xla_only)
         xbest = median(xtimes)
         pallas_vs_xla = xbest / best
@@ -1086,6 +1179,25 @@ def main() -> None:
             print(f"deep invariant verification leg failed: "
                   f"{str(e)[:200]}", file=sys.stderr)
 
+    # Fused-engine integrity (ISSUE 7): the jitted=False headline embedding
+    # surfaces the draw-table overflow count through the flight recorder
+    # (tel_fused_draw_overflow); ANY nonzero count across ANY rep of the
+    # fused timed legs means clamped (wrong) draws and poisons the round —
+    # mark the record suspect, exactly like a physically-impossible
+    # bandwidth figure.
+    def _fused_overflow(stats):
+        return max((int(s.get("tel_fused_draw_overflow") or 0)
+                    for s in stats), default=0)
+
+    fused_overflow = _fused_overflow(stats1)
+    churn_fused_overflow = _fused_overflow(cstats)
+    mailbox_fused_overflow = _fused_overflow(mstats)
+    if fused_overflow or churn_fused_overflow or mailbox_fused_overflow:
+        suspect_reasons = list(suspect_reasons) + [
+            f"fused draw-table overflow (headline {fused_overflow} / churn "
+            f"{churn_fused_overflow} / mailbox {mailbox_fused_overflow}): "
+            "clamped draws, fused bits invalid"]
+
     baseline_group_steps_per_sec = 10.0
     record = dict({
         "metric": "raft_group_steps_per_sec_per_chip",
@@ -1131,6 +1243,18 @@ def main() -> None:
         # Sub-tile ILP: independent phase-lattice chains per kernel tile
         # (ops/pallas_tick.ILP_SUBTILE_TABLE routing).
         "ilp_subtiles": ilp_subtiles,
+        # Fused ticks (ISSUE 7): phase lattices per kernel launch
+        # (FUSED_TICK_TABLE routing), the measured fused-vs-T=1 speedup of
+        # the identical builder, the per-launch overhead that A/B implies,
+        # the chain+amortized-launch roofline, and the overflow integrity
+        # counts (nonzero => suspect, see above).
+        "fused_ticks": fused_ticks,
+        "fused_vs_t1": round(fused_vs_t1, 3),
+        "fused_launch_overhead_ns": launch_overhead_ns,
+        "latency_frac_amortized": latency_frac_amortized,
+        "fused_draw_overflow": fused_overflow,
+        "churn_fused_draw_overflow": churn_fused_overflow,
+        "mailbox_fused_draw_overflow": mailbox_fused_overflow,
         "pallas_vs_xla": round(pallas_vs_xla, 2),
         "xla_ticks_per_sec": round(xla_ticks_per_sec, 2),
         # Flight-recorder aggregates of the headline run (ISSUE 5): the

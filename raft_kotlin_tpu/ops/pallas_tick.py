@@ -108,6 +108,101 @@ def route_ilp_subtiles(tile_g: int, platform: Optional[str] = None) -> int:
     return 1
 
 
+# ---------------------------------------------------------------------------
+# Fused-tick routing (ISSUE 7; same measured-crossover pattern as the ILP
+# table above). At ~372 ticks/s the headline kernel uses <20% of BOTH
+# rooflines (BENCH_r05) — the binding floor is one kernel launch plus one
+# serial chain ISSUE per tick. Running T full phase lattices per launch
+# (make_pallas_core(fused_ticks=T)) amortizes the launch across T ticks and
+# keeps state VMEM-resident between them (HBM load once, store once per
+# T-block). The round-5 K-tick kernel measured SLOWER and was archived
+# (make_pallas_core_k below, kept as the negative result); the fused-T
+# engine differs in exactly what that experiment lacked: it composes with
+# the sub-tile ILP (K slabs x T ticks of overlapped chains per launch —
+# round 5 ran one serial T-chain and simply made it T times longer) and it
+# exposes per-tick snapshot outputs so the recorder/monitor harness
+# (PR 5/6) pins bit-neutrality at every fused depth. Entries are
+# (tile_g, T, source); provisional pins are re-measured by
+# scripts/probe_fused_ticks.py's TxK sweep (--pin rewrites this block) and
+# published as `fused_ticks` in the bench record every round. T=1 keeps the
+# pre-fusion kernel byte-identical and is the sticky fallback for
+# CPU/interpret, trace-mode per-tick runners, and any shape whose fused
+# VMEM model does not fit.
+# FUSED_TICK_TABLE[begin] (scripts/probe_fused_ticks.py --pin rewrites)
+FUSED_TICK_TABLE = (
+    (1024, 2, "provisional: widest tile - VMEM bounds the T aux slabs +"
+     " draw tables; re-pinned by BENCH_r06 fused_ticks +"
+     " probe_fused_ticks sweep"),
+    (512, 4, "provisional: the headline tile - 4x launch amortization at"
+     " ~60% of the fused VMEM model; re-pinned by BENCH_r06"),
+    (256, 4, "provisional: same amortization, half the slab VMEM"),
+    (128, 4, "provisional: smallest tile, most launches to amortize"),
+)
+# FUSED_TICK_TABLE[end]
+
+
+def route_fused_ticks(tile_g: int, platform: Optional[str] = None) -> int:
+    """Fused tick count T for a megakernel tile of `tile_g` lanes, from the
+    measured table. CPU guard: the interpreter pays no launch/issue latency
+    to amortize, and T multiplies trace size, so interpret/CPU runs stay at
+    T=1 (tests pin T explicitly when they want the fused program on CPU).
+    Unknown tiles fall back to T=1 — the byte-identical pre-fusion path."""
+    if platform is None:
+        platform = jax.default_backend()
+    if platform == "cpu":
+        return 1
+    for t, T, _src in FUSED_TICK_TABLE:
+        if t == tile_g:
+            return T
+    return 1
+
+
+# Per-tick observables the fused kernel can snapshot (post-tick, one output
+# block per (field, tick)): the union the flight recorder, the safety
+# monitor, and the differential trace surface read between launches.
+FUSED_TRACE_FIELDS = ("role", "term", "commit", "last_index")
+
+
+def fused_snapshot_fields(cfg: RaftConfig, telemetry: bool = False,
+                          monitor: bool = False, trace: bool = False
+                          ) -> tuple:
+    """The ordered state-field set a fused launch must snapshot per tick so
+    the requested observers (recorder / monitor / differential trace) can
+    replay the T per-tick transitions between launches. Ordered canonically
+    (STATE_FIELDS then mailbox) so kernel output lists are deterministic."""
+    from raft_kotlin_tpu.utils.telemetry import (
+        MONITOR_STATE_FIELDS, TELEMETRY_MAILBOX_FIELDS,
+        TELEMETRY_STATE_FIELDS)
+
+    want = []
+    if trace:
+        want += list(FUSED_TRACE_FIELDS)
+    if telemetry:
+        want += list(TELEMETRY_STATE_FIELDS)
+    if monitor:
+        want += list(MONITOR_STATE_FIELDS)
+    if (telemetry or monitor) and cfg.uses_mailbox:
+        want += list(TELEMETRY_MAILBOX_FIELDS)
+    order = {k: i for i, k in enumerate(STATE_FIELDS + MAILBOX_FIELDS)}
+    return tuple(sorted(set(want), key=order.__getitem__))
+
+
+def _snapshot_rows(cfg: RaftConfig, fields) -> int:
+    """Model rows one tick's snapshot output set occupies (VMEM model)."""
+    N, C = cfg.n_nodes, cfg.log_capacity
+    pair = ("responded", "next_index", "match_index",
+            "link_up") + MAILBOX_FIELDS
+    r = 0
+    for k in fields:
+        if k in ("log_term", "log_cmd"):
+            r += N * C
+        elif k in pair:
+            r += N * N
+        else:
+            r += N
+    return r
+
+
 def choose_impl(cfg: RaftConfig) -> str:
     """Canonical backend auto-selection (Simulator, CLI, bench all use this):
     "pallas" when running on an accelerator AND the megakernel is buildable for
@@ -151,12 +246,23 @@ def kernel_field_dtype(cfg: RaftConfig, k: str):
 
 
 def make_pallas_core(cfg: RaftConfig, lanes: int, tile_g: int, interpret: bool,
-                     subtiles: int = 1):
+                     subtiles: int = 1, fused_ticks: int = 1,
+                     resets_bound: Optional[int] = None,
+                     tick_states: tuple = ()):
     """Per-flags builder of the raw megakernel over arrays with `lanes` lane columns
     (the flat phase_body layout). Used with lanes = n_groups for single-device runs
     (make_pallas_tick) and lanes = the per-device shard width under shard_map
     (parallel.mesh.make_sharded_run(impl="pallas")). Returns build_call(flags) ->
     (callable(*flat_int32_arrays) -> flat outputs + el_dirty, aux_names).
+
+    `fused_ticks` = T > 1 builds the FUSED-T engine instead (ISSUE 7): T
+    full phase lattices per launch with state VMEM-resident between ticks,
+    composed with the sub-tile ILP (K slabs x T ticks per launch), counted
+    draws via per-launch tables, el_left materialized in-kernel, and an
+    overflow output replacing el_dirty — see _make_fused_core for the
+    contract (build_call then returns a 4-tuple ending in the snapshot
+    field names). T=1 ignores `resets_bound`/`tick_states` and compiles the
+    byte-identical pre-fusion kernel below.
 
     `subtiles` = K > 1 runs SUB-TILE ILP (ISSUE 4): the kernel interior
     splits each loaded (rows, tile_g) block into K contiguous lane slabs and
@@ -173,6 +279,9 @@ def make_pallas_core(cfg: RaftConfig, lanes: int, tile_g: int, interpret: bool,
     sub-slab must stay lane-register aligned (tile_g/K a multiple of 128 —
     route_ilp_subtiles enforces this; tests pass arbitrary K in interpret
     mode)."""
+    if fused_ticks > 1:
+        return _make_fused_core(cfg, lanes, tile_g, interpret, subtiles,
+                                fused_ticks, resets_bound, tick_states)
     N, C = cfg.n_nodes, cfg.log_capacity
     assert lanes % tile_g == 0, (lanes, tile_g)
     SUB = max(1, subtiles)
@@ -300,6 +409,285 @@ def make_pallas_core(cfg: RaftConfig, lanes: int, tile_g: int, interpret: bool,
     return build_call
 
 
+def _make_fused_core(cfg: RaftConfig, lanes: int, tile_g: int,
+                     interpret: bool, subtiles: int, T: int,
+                     resets_bound: Optional[int], tick_states: tuple):
+    """The fused-T megakernel builder (ISSUE 7): T full phase lattices per
+    pallas_call with state resident in VMEM between ticks — HBM load once,
+    store once per T-block — composed with the sub-tile ILP: each of the K
+    lane slabs runs its own T-tick chain, so the launch overlaps K
+    independent (T x chain)-deep dependency chains. This is the round-5
+    K-tick kernel (make_pallas_core_k, kept below as the archived negative
+    result) revived with what it was missing: ILP composition, snapshot
+    outputs for the PR-5/6 observability harness, and measured routing.
+
+    Randomness stays outside, exactly as in the archival kernel (the
+    bit-compat invariant): per-tick aux masks arrive T-stacked, and the
+    counter-keyed draws (election timeout, backoff) arrive as pre-drawn
+    TABLES over the counter windows the launch can reach (draw_tables);
+    the kernel one-hot-selects entries, so every draw equals the per-tick
+    path's draw at the same counter bit for bit. el_left is materialized
+    in-kernel at each tick boundary (same §7 formula as
+    tick.materialize_el). Offsets past the table window are clamped and
+    COUNTED into the (N, lanes) overflow output — the caller must discard
+    the launch on any nonzero count (make_pallas_scan raises; the
+    jitted=False embedding surfaces it through the flight recorder).
+
+    `tick_states` is the tuple of state fields to snapshot POST-TICK for
+    every fused tick, one output block per (field, tick) — the channel
+    through which the flight recorder, the safety monitor, and the
+    differential trace surface observe the T per-tick transitions between
+    launches without touching phase_body (fused_snapshot_fields picks the
+    set). Snapshots are plain stored outputs in the kernel compute dtypes
+    (int32; logs in storage dtype).
+
+    build_call(flags) -> (call, sfields, aux_names, snap_fields); call
+    takes [state..., aux T-slabs..., el_table (N*W, lanes), b_table
+    (N*T, lanes)] and returns state fields (aliased), the overflow count,
+    then T * len(snap_fields) snapshot blocks (tick-major)."""
+    N, C = cfg.n_nodes, cfg.log_capacity
+    assert lanes % tile_g == 0, (lanes, tile_g)
+    SUB = max(1, subtiles)
+    assert tile_g % SUB == 0, (tile_g, subtiles)
+    if not interpret and SUB > 1:
+        assert (tile_g // SUB) % 128 == 0, (
+            f"sub-tile width {tile_g // SUB} must be a multiple of the "
+            f"128-lane vreg on hardware (tile_g={tile_g}, K={SUB})")
+    sub_w = tile_g // SUB
+    if resets_bound is None:
+        resets_bound = resets_per_tick_bound(
+            N, cfg.uses_mailbox and cfg.delay_lo == 0)
+    W = resets_bound * T
+
+    field_shapes = {
+        **{k: (N, tile_g) for k in STATE_FIELDS},
+        "log_term": (N * C, tile_g), "log_cmd": (N * C, tile_g),
+        "responded": (N * N, tile_g), "next_index": (N * N, tile_g),
+        "match_index": (N * N, tile_g), "link_up": (N * N, tile_g),
+        **{k: (N * N, tile_g) for k in MAILBOX_FIELDS},
+    }
+    aux_rows = {
+        "edge_iid": N * N, "crash_m": N, "restart_m": N, "link_fail": N * N,
+        "link_heal": N * N, "periodic": 1, "delay": N * N,
+    }
+
+    def block_spec(shape):
+        return pl.BlockSpec(shape, lambda i: (0, i))
+
+    @functools.lru_cache(maxsize=None)
+    def build_call(flags: BodyFlags):
+        flags = dataclasses.replace(flags, dyn_log=False, batched=False,
+                                    sharded=False, inject=False)
+        sfields = state_fields(flags)
+        aux_names = tuple(
+            k for k in AUX_FIELDS
+            if (k == "edge_iid")
+            or (k in ("crash_m", "restart_m") and flags.faults)
+            or (k in ("link_fail", "link_heal") and flags.links)
+            or (k == "periodic" and flags.periodic)
+            or (k == "delay" and flags.delay and cfg.delay_lo < cfg.delay_hi)
+        )
+        snap_fields = tuple(k for k in tick_states if k in sfields)
+        snap_names = tuple(f"{k}@{t}" for t in range(T) for k in snap_fields)
+
+        def kernel(*refs):
+            n_in = len(sfields) + len(aux_names)
+            ins = dict(zip(sfields, refs[:len(sfields)]))
+            slabs = {k: r[...] for k, r in
+                     zip(aux_names, refs[len(sfields):n_in])}
+            el_tab = refs[n_in][...].astype(_I32)
+            b_tab = refs[n_in + 1][...].astype(_I32)
+            outs = dict(zip(sfields + ("overflow",) + snap_names,
+                            refs[n_in + 2:]))
+            loaded = {k: ins[k][...] for k in sfields}
+            parts = {k: [] for k in sfields}
+            ov_parts = []
+            snap_parts = {k: [[] for _ in range(T)] for k in snap_fields}
+            for kk in range(SUB):
+                # SUB independent lane slabs = SUB independent T-tick
+                # chains (the ILP x fusion composition: the launch overlaps
+                # SUB chains, each T lattices deep; no dataflow edges
+                # between slabs, so bits are unchanged — the same argument
+                # as the 1-tick sub-tiling).
+                def slab(v):
+                    return v if SUB == 1 else \
+                        v[:, kk * sub_w:(kk + 1) * sub_w]
+                s = {}
+                for k in sfields:
+                    v = slab(loaded[k])
+                    if k in _BOOL_STATE:
+                        s[k] = v != 0
+                    elif k in ("log_term", "log_cmd"):
+                        s[k] = v
+                    else:
+                        s[k] = v.astype(_I32)
+                el_slab, b_slab = slab(el_tab), slab(b_tab)
+                ov = {"m": jnp.zeros((N, sub_w), _I32)}
+
+                def sel(table, Wn, delta):
+                    # (N, sub_w) values: per node, table rows
+                    # [n*Wn, (n+1)*Wn) at per-lane offset delta[n] (one
+                    # one-hot contraction per node). An offset past the
+                    # window means the structural reset bound was violated:
+                    # CLAMP (the kernel stays well-defined) and COUNT into
+                    # the overflow output — the caller must discard the
+                    # launch (the archival kernel's loud-failure contract).
+                    ov["m"] = ov["m"] + (delta >= Wn).astype(_I32)
+                    delta = jnp.minimum(delta, Wn - 1)
+                    rows_iota = jax.lax.broadcasted_iota(
+                        _I32, (Wn, sub_w), 0)
+                    vals = []
+                    for n in range(N):
+                        oh = rows_iota == delta[n][None]
+                        vals.append(jnp.sum(
+                            jnp.where(oh, table[n * Wn:(n + 1) * Wn], 0),
+                            axis=0))
+                    return jnp.stack(vals)
+
+                t0, b0 = s["t_ctr"], s["b_ctr"]
+                for t in range(T):
+                    aux = {}
+                    for name in aux_names:
+                        r = aux_rows[name]
+                        v = slab(slabs[name][t * r:(t + 1) * r])
+                        aux[name] = (v != 0) if name in _BOOL_AUX \
+                            else v.astype(_I32)
+                    if flags.faults:
+                        aux["el_draw_f"] = sel(el_slab, W, s["t_ctr"] - t0)
+                    aux["bdraw"] = sel(b_slab, T, s["b_ctr"] - b0)
+                    el_dirty = tick_mod.phase_body(cfg, s, aux, flags)
+                    d = sel(el_slab, W, s["t_ctr"] - 1 - t0)
+                    s["el_left"] = jnp.where(el_dirty, d, s["el_left"])
+                    for k in snap_fields:
+                        snap_parts[k][t].append(
+                            s[k] if k in ("log_term", "log_cmd")
+                            else s[k].astype(_I32))
+                for k in sfields:
+                    parts[k].append(
+                        s[k] if k in ("log_term", "log_cmd")
+                        else s[k].astype(kernel_field_dtype(cfg, k)))
+                ov_parts.append(ov["m"])
+
+            def join(ps):
+                return ps[0] if SUB == 1 else jnp.concatenate(ps, axis=1)
+
+            for k in sfields:
+                outs[k][...] = join(parts[k])
+            outs["overflow"][...] = join(ov_parts)
+            for t in range(T):
+                for k in snap_fields:
+                    outs[f"{k}@{t}"][...] = join(snap_parts[k][t])
+
+        def snap_dtype(k):
+            return (_I16 if cfg.log_dtype == "int16" else _I32) \
+                if k in ("log_term", "log_cmd") else _I32
+
+        in_specs = [block_spec(field_shapes[k]) for k in sfields]
+        in_specs += [block_spec((T * aux_rows[k], tile_g))
+                     for k in aux_names]
+        in_specs += [block_spec((N * W, tile_g)), block_spec((N * T, tile_g))]
+        out_shapes = [
+            jax.ShapeDtypeStruct(
+                tuple(field_shapes[k][:-1]) + (lanes,),
+                kernel_field_dtype(cfg, k))
+            for k in sfields
+        ] + [jax.ShapeDtypeStruct((N, lanes), _I32)]  # overflow counts
+        out_specs = [block_spec(field_shapes[k]) for k in sfields]
+        out_specs += [block_spec((N, tile_g))]
+        for _t in range(T):
+            for k in snap_fields:
+                rows = field_shapes[k][0]
+                out_shapes.append(
+                    jax.ShapeDtypeStruct((rows, lanes), snap_dtype(k)))
+                out_specs.append(block_spec((rows, tile_g)))
+        call = pl.pallas_call(
+            kernel,
+            grid=(lanes // tile_g,),
+            in_specs=in_specs,
+            out_specs=out_specs,
+            out_shape=out_shapes,
+            input_output_aliases={i: i for i in range(len(sfields))},
+            interpret=interpret,
+        )
+        return call, sfields, aux_names, snap_fields
+
+    return build_call
+
+
+def fused_launch_aux(cfg: RaftConfig, base, tkeys, bkeys, tick0, t_ctr,
+                     b_ctr, T: int, resets_bound: Optional[int] = None):
+    """The XLA pre-pass of one fused launch: draw the T per-tick aux dicts
+    (ops/tick.make_aux over a shim state — every draw is derivable from
+    the pre-launch counters and the tick index) plus the counter-keyed
+    el/backoff draw tables. Shared by every fused call site
+    (make_pallas_scan, make_pallas_tick, parallel/mesh) so the aux
+    assembly — the half of the bit-compat contract that lives OUTSIDE the
+    kernel — exists exactly once. make_aux also stages the per-tick
+    counter-keyed el_draw_f/bdraw draws the fused kernel re-derives from
+    the tables; those dict entries are never passed to the kernel
+    (aux_names excludes them), so they are dead values XLA's DCE prunes
+    at compile — no runtime cost, and the one make_aux stays the single
+    source of every other aux bit. Returns (per_tick_aux, flags,
+    (el_table, b_table))."""
+    import types
+
+    per, flags = [], None
+    for k in range(T):
+        shim = types.SimpleNamespace(tick=tick0 + k, t_ctr=t_ctr,
+                                     b_ctr=b_ctr)
+        aux_k, flags = tick_mod.make_aux(cfg, base, tkeys, bkeys, shim,
+                                         None, None)
+        per.append(aux_k)
+    tabs = draw_tables(cfg, tkeys, bkeys, t_ctr, b_ctr, T,
+                       resets_bound=resets_bound)
+    return per, flags, tabs
+
+
+def fused_aux_slabs(per, aux_names):
+    """T-stack the per-tick aux dicts into the fused kernel's slab operands
+    (bool aux rides as int16 stand-ins, same as cast_aux_in)."""
+    return [jnp.concatenate(
+        [p[nm].astype(_I16) if nm in _BOOL_AUX else p[nm] for p in per],
+        axis=0) for nm in aux_names]
+
+
+def unpack_fused_outputs(outs, sfields, snap_fields, T: int):
+    """Split one fused launch's outputs -> (state dict, overflow (N, G)
+    counts, [per-tick snapshot dicts] — tick-major, matching the kernel's
+    output order)."""
+    ns = len(sfields)
+    s2 = dict(zip(sfields, outs[:ns]))
+    nf = len(snap_fields)
+    ticks = [dict(zip(snap_fields,
+                      outs[ns + 1 + t * nf: ns + 1 + (t + 1) * nf]))
+             for t in range(T)]
+    return s2, outs[ns], ticks
+
+
+def fused_observe(cfg: RaftConfig, prev_flat, tick_flats, tel, mon):
+    """Advance the flight recorder / monitor over the T per-tick
+    transitions of one fused launch, from the kernel's snapshot dicts —
+    the same telemetry_step_arrays / monitor_step_arrays calls the T=1
+    flat-carry runner makes between launches, so the counters and the
+    latch are bit-equal to the unfused run by construction. `prev_flat` is
+    the pre-launch flat state (all fields); each entry of `tick_flats`
+    holds the snapshot subset, which covers every field the views read."""
+    from raft_kotlin_tpu.utils import telemetry as telemetry_mod
+
+    N = cfg.n_nodes
+    for cur in tick_flats:
+        if tel is not None:
+            tel = telemetry_mod.telemetry_step_arrays(
+                telemetry_mod.flat_view(prev_flat, N),
+                telemetry_mod.flat_view(cur, N), tel)
+        if mon is not None:
+            mon = telemetry_mod.monitor_step_arrays(
+                telemetry_mod.monitor_flat_view(prev_flat, N),
+                telemetry_mod.monitor_flat_view(cur, N), mon)
+        prev_flat = cur
+    return tel, mon
+
+
 def cast_aux_in(aux: dict, aux_names):
     """Order-and-cast the aux kernel operands (the aux half of cast_flat_in;
     the flat-carry runner uses it alone — its state already rides in kernel
@@ -338,16 +726,65 @@ def cast_flat_out(cfg, outs, sfields, with_dirty: bool = True):
 
 def make_pallas_tick(cfg: RaftConfig, tile_g: Optional[int] = None,
                      interpret: Optional[bool] = None,
-                     ilp_subtiles: Optional[int] = None):
+                     ilp_subtiles: Optional[int] = None,
+                     fused_ticks: int = 1):
     """Build tick(state, inject=None, fault_cmd=None[, rng]) -> state — same
     contract and same bits as ops.tick.make_tick(cfg), different compilation
     strategy. `ilp_subtiles` pins the sub-tile ILP count (make_pallas_core);
-    None = route_ilp_subtiles' per-shape pick (1 on CPU/interpret)."""
+    None = route_ilp_subtiles' per-shape pick (1 on CPU/interpret).
+
+    `fused_ticks` = T > 1 returns a T-TICK ADVANCER through the fused-T
+    kernel instead (ISSUE 7): tick(state[, rng]) -> state after T ticks,
+    one kernel launch, bit-identical to T per-tick calls. Driver inputs
+    (inject / fault_cmd) are a per-tick API and are rejected — per-tick
+    drivers are a T=1 sticky-fallback surface, like trace mode. The
+    draw-table overflow flag is checked when the call runs EAGERLY
+    (raises RuntimeError); under an outer jit the check cannot run —
+    use make_pallas_scan, whose scan-level channels always surface it."""
     N, C, G = cfg.n_nodes, cfg.log_capacity, cfg.n_groups
     default_rng: list = []  # derived lazily; wrappers always pass rng explicitly
 
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
+    if fused_ticks > 1:
+        tile_g, ilp_subtiles, T_f = resolve_fused_geometry(
+            cfg, interpret, tile_g, ilp_subtiles, fused_ticks)
+        build_call_f = make_pallas_core(cfg, G, tile_g, interpret,
+                                        subtiles=ilp_subtiles,
+                                        fused_ticks=T_f)
+
+        def tick_fused(state, inject=None, fault_cmd=None, rng=None):
+            assert inject is None and fault_cmd is None, (
+                "fused_ticks > 1 takes no per-tick driver inputs "
+                "(inject/fault_cmd are a T=1 surface)")
+            assert state.term.shape[-1] == G
+            if rng is None:
+                if not default_rng:
+                    with jax.ensure_compile_time_eval():
+                        default_rng.append(tick_mod.make_rng(cfg))
+                rng = default_rng[0]
+            base, tkeys, bkeys = rng
+            per, flags, (el_tab, b_tab) = fused_launch_aux(
+                cfg, base, tkeys, bkeys, state.tick, state.t_ctr,
+                state.b_ctr, T_f)
+            call, sfields, aux_names, _snaps = build_call_f(flags)
+            flat = tick_mod.flatten_state(cfg, state)
+            outs = call(*(cast_flat_in(flat, {}, sfields, ())
+                          + fused_aux_slabs(per, aux_names)
+                          + [el_tab, b_tab]))
+            s2, ov, _ = unpack_fused_outputs(outs, sfields, (), T_f)
+            ov_sum = jnp.sum(ov)
+            if not isinstance(ov_sum, jax.core.Tracer) \
+                    and int(jax.device_get(ov_sum)):
+                raise RuntimeError(
+                    "fused-tick kernel draw-table overflow: the launch's "
+                    "draws were clamped and its bits are INVALID")
+            s, _ = cast_flat_out(cfg, [s2[k] for k in sfields], sfields,
+                                 with_dirty=False)
+            return RaftState(**tick_mod.unflatten_state(cfg, s),
+                             tick=state.tick + T_f)
+
+        return tick_fused
     tile_g, ilp_subtiles = resolve_scan_geometry(
         cfg, interpret, 1, tile_g, ilp_subtiles)
 
@@ -623,6 +1060,70 @@ def resolve_scan_geometry(cfg: RaftConfig,
     return tile_g, ilp_subtiles
 
 
+def resolve_fused_geometry(cfg: RaftConfig,
+                           interpret: Optional[bool] = None,
+                           tile_g: Optional[int] = None,
+                           ilp_subtiles: Optional[int] = None,
+                           fused_ticks: Optional[int] = None,
+                           snap_rows: int = 0,
+                           lanes: Optional[int] = None,
+                           platform: Optional[str] = None):
+    """The (tile_g, ilp_subtiles, fused_ticks) a make_pallas_scan call with
+    these arguments resolves to — the fused extension of
+    resolve_scan_geometry, and like it THE single copy of the resolution
+    (bench.py's `fused_ticks`/`ilp_subtiles` fields read the geometry the
+    headline kernel actually runs with; parallel/mesh resolves its
+    per-shard geometry through the same call via `lanes`/`platform`).
+    fused_ticks=None routes through FUSED_TICK_TABLE (1 on CPU/interpret);
+    a ROUTED T that fails the fused VMEM model falls back to T=1 (sticky),
+    while an explicitly PINNED T re-raises — a pin is a demand, not a
+    hint. `lanes` overrides the lane width (default cfg.n_groups; mesh
+    passes the per-device shard width); `platform` overrides the routing
+    platform (mesh passes its devices' platform — jax.default_backend()
+    can disagree with the mesh under virtual-device test pools)."""
+    G = lanes if lanes is not None else cfg.n_groups
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    if platform is None:
+        platform = "cpu" if interpret else None
+    if fused_ticks is None:
+        try:
+            base = tile_g if tile_g is not None else \
+                default_tile(cfg, G, interpret)
+        except ValueError:
+            base = None
+        if base is not None and interpret and G % base:
+            base = G
+        T = route_fused_ticks(base, platform) if base else 1
+    else:
+        T = max(1, fused_ticks)
+    if T > 1:
+        try:
+            tg = tile_g if tile_g is not None else default_tile(
+                cfg, G, interpret, k_per_launch=T, snap_rows=snap_rows)
+            if interpret and G % tg:
+                tg = G
+            k = ilp_subtiles if ilp_subtiles is not None else \
+                route_ilp_subtiles(tg, platform)
+            return tg, k, T
+        except ValueError:
+            if fused_ticks is not None:
+                raise
+            T = 1
+    if lanes is None:
+        tg, k = resolve_scan_geometry(cfg, interpret, 1, tile_g,
+                                      ilp_subtiles)
+        return tg, k, 1
+    # lanes override (per-shard callers): T=1 geometry at the given width.
+    if tile_g is None:
+        tile_g = default_tile(cfg, G, interpret)
+    if interpret and G % tile_g:
+        tile_g = G
+    if ilp_subtiles is None:
+        ilp_subtiles = route_ilp_subtiles(tile_g, platform)
+    return tile_g, ilp_subtiles, 1
+
+
 def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
                      tile_g: Optional[int] = None,
                      interpret: Optional[bool] = None,
@@ -631,7 +1132,9 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
                      _resets_bound: Optional[int] = None,
                      ilp_subtiles: Optional[int] = None,
                      telemetry: bool = False,
-                     monitor: bool = False):
+                     monitor: bool = False,
+                     fused_ticks: Optional[int] = None,
+                     trace: bool = False):
     """Multi-tick Pallas runner with a FLAT int32 scan carry.
 
     Scanning make_pallas_tick converts RaftState <-> the kernel's flat int32
@@ -662,8 +1165,32 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
     True` threads the scan-carry safety-invariant monitor the same way
     (Figure-3 checks over the flat views — the logs ride the flat carry
     in storage dtype, which the checks compare natively). run returns
-    (state[, telemetry][, monitor-finalized]) accordingly. Both require
-    k_per_launch=1: the archival K-tick kernel exposes no per-tick state.
+    (state[, trace][, telemetry][, monitor-finalized]) accordingly. Both
+    require k_per_launch=1: the archival K-tick kernel exposes no per-tick
+    state.
+
+    `fused_ticks` = T (ISSUE 7): full T-blocks run through the FUSED-T
+    kernel (make_pallas_core(fused_ticks=T): T phase lattices per launch,
+    state VMEM-resident between ticks, composed with the sub-tile ILP) and
+    the n_ticks % T remainder through the 1-tick kernel — bit-identical by
+    the same counted-draw-table argument as the archival K path. None =
+    route_fused_ticks per shape (1 on CPU/interpret — the sticky
+    fallback); T=1 compiles the byte-identical pre-fusion program.
+    Telemetry, monitor and trace WORK under fusion: the fused kernel
+    snapshots the observed fields post-tick (fused_snapshot_fields) and
+    the accumulation replays the T transitions between launches on the
+    flat carry, unchanged (fused_observe) — fusion is carry-transparent.
+    The draw-table overflow flag is host-checked per call when jitted=True
+    (raises RuntimeError, the archival kernel's loud-failure contract);
+    jitted=False embeds in a caller's jit where no host check can run, so
+    it requires telemetry=True and surfaces the count as the recorder key
+    `fused_draw_overflow` (bench gates on it) — a ROUTED T quietly falls
+    back to 1 when that channel is missing, a PINNED T raises.
+
+    `trace=True` additionally returns the per-tick differential trace
+    {role, term, commit, last_index}: (n_ticks, N, G) int32 each, identical
+    across T by construction (the fused legs read it from the snapshots) —
+    the test surface tests/test_fused_ticks.py pins.
 
     Returns run(state, rng) -> state (jitted; rng rides as an operand so the
     compilation is seed-independent, as everywhere else)."""
@@ -673,25 +1200,64 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
 
     N, G = cfg.n_nodes, cfg.n_groups
     K = max(1, k_per_launch)
-    if (telemetry or monitor) and K > 1:
+    if (telemetry or monitor or trace) and K > 1:
         raise ValueError(
-            "telemetry/monitor need k_per_launch == 1: the K-tick kernel "
-            "exposes no per-tick state between launches (archival path)")
+            "telemetry/monitor/trace need k_per_launch == 1: the K-tick "
+            "kernel exposes no per-tick state between launches (archival "
+            "path; the production fused path is fused_ticks)")
+    if K > 1 and fused_ticks not in (None, 1):
+        raise ValueError(
+            "k_per_launch (the archival K-tick kernel) and fused_ticks "
+            "(the production fused-T engine) are mutually exclusive")
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
-    tile_g, ilp_subtiles = resolve_scan_geometry(
-        cfg, interpret, K, tile_g, ilp_subtiles)
+    if K > 1:
+        T_f = 1
+        tile_g, ilp_subtiles = resolve_scan_geometry(
+            cfg, interpret, K, tile_g, ilp_subtiles)
+    else:
+        tile_req, ilp_req = tile_g, ilp_subtiles  # caller's pins, if any
+        snap_fields = fused_snapshot_fields(
+            cfg, telemetry=telemetry, monitor=monitor, trace=trace)
+        tile_g, ilp_subtiles, T_f = resolve_fused_geometry(
+            cfg, interpret, tile_g, ilp_subtiles, fused_ticks,
+            snap_rows=_snapshot_rows(cfg, snap_fields))
+        if T_f > 1 and not jitted and not telemetry:
+            if fused_ticks is not None:
+                raise ValueError(
+                    "fused_ticks > 1 with jitted=False needs telemetry="
+                    "True: the runner embeds in the caller's jit, so the "
+                    "draw-table overflow flag's only surfaced channel is "
+                    "the flight recorder (fused_draw_overflow)")
+            # Routed: sticky fallback, no overflow channel — and the
+            # PRE-FUSION geometry is re-resolved from the caller's own
+            # pins, so this path compiles the byte-identical unfused
+            # program (the fused VMEM model may have shrunk the tile).
+            T_f = 1
+            tile_g, ilp_subtiles = resolve_scan_geometry(
+                cfg, interpret, 1, tile_req, ilp_req)
     build_call = make_pallas_core(cfg, G, tile_g, interpret,
                                   subtiles=ilp_subtiles)
     build_call_k = (make_pallas_core_k(cfg, G, tile_g, interpret, K,
                                        resets_bound=_resets_bound)
                     if K > 1 else None)
+    build_call_f = (make_pallas_core(cfg, G, tile_g, interpret,
+                                     subtiles=ilp_subtiles,
+                                     fused_ticks=T_f,
+                                     resets_bound=_resets_bound,
+                                     tick_states=snap_fields)
+                    if K == 1 and T_f > 1 else None)
     if K > 1 and not jitted:
         raise ValueError(
             "k_per_launch > 1 requires jitted=True: the draw-table overflow "
             "flag must be host-materialized and checked after each call")
     sfields = state_fields(tick_mod.make_flags(cfg))
-    n_launch, rem = divmod(n_ticks, K) if K > 1 else (0, n_ticks)
+    if K > 1:
+        n_launch, rem = divmod(n_ticks, K)
+    elif T_f > 1:
+        n_launch, rem = divmod(n_ticks, T_f)
+    else:
+        n_launch, rem = 0, n_ticks
 
     def run(state: RaftState, rng):
         base, tkeys, bkeys = rng
@@ -728,7 +1294,8 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
                 mon = telemetry_mod.monitor_step_arrays(
                     telemetry_mod.monitor_flat_view(s, N),
                     telemetry_mod.monitor_flat_view(s2, N), mon)
-            return (s2, t + 1, tel, mon), None
+            ys = ({f: s2[f] for f in FUSED_TRACE_FIELDS} if trace else None)
+            return (s2, t + 1, tel, mon), ys
 
         def body_k(carry, _):
             s, t, tel, mon = carry  # tel/mon None here (K > 1 rejected)
@@ -752,26 +1319,69 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
             return ((dict(zip(sfields_k, outs[:-1])), t + K, tel, mon),
                     jnp.sum(outs[-1]))
 
+        def body_f(carry, _):
+            # One fused-T launch (ISSUE 7): T phase lattices inside one
+            # pallas_call, aux T-stacked, counted draws via the launch
+            # tables, el_left materialized in-kernel. The recorder/monitor
+            # replay the T per-tick transitions from the kernel's snapshot
+            # outputs — same step functions as the 1-tick body, so their
+            # carries are bit-equal to the unfused run.
+            s, t, tel, mon = carry
+            per, flags, (el_tab, b_tab) = fused_launch_aux(
+                cfg, base, tkeys, bkeys, t, s["t_ctr"], s["b_ctr"], T_f,
+                resets_bound=_resets_bound)
+            call, sfields_f, aux_names, snaps = build_call_f(flags)
+            with telemetry_mod.engine_scope("pallas-fused"):
+                outs = call(*([s[k] for k in sfields_f]
+                              + fused_aux_slabs(per, aux_names)
+                              + [el_tab, b_tab]))
+            s2, ov, ticks_f = unpack_fused_outputs(
+                outs, sfields_f, snaps, T_f)
+            tel, mon = fused_observe(cfg, s, ticks_f, tel, mon)
+            ys = {"ov": jnp.sum(ov)}
+            if trace:
+                ys["trace"] = {f: jnp.stack([p[f] for p in ticks_f])
+                               for f in FUSED_TRACE_FIELDS}
+            return (s2, t + T_f, tel, mon), ys
+
         tel0 = telemetry_mod.telemetry_zeros() if telemetry else None
         mon0 = telemetry_mod.monitor_init(G, n_ticks, monitor)
         flat_t = (flat, state.tick, tel0, mon0)
         ov_total = jnp.zeros((), _I32)
-        if n_launch:
+        traces = []
+        if K > 1 and n_launch:
             flat_t, ovs = jax.lax.scan(body_k, flat_t, None, length=n_launch)
             ov_total = jnp.sum(ovs)
+        elif n_launch:
+            flat_t, ys = jax.lax.scan(body_f, flat_t, None, length=n_launch)
+            ov_total = jnp.sum(ys["ov"])
+            if trace:
+                traces.append({f: v.reshape((n_launch * T_f,) + v.shape[2:])
+                               for f, v in ys["trace"].items()})
         if rem:
-            flat_t, _ = jax.lax.scan(body, flat_t, None, length=rem)
+            flat_t, ys = jax.lax.scan(body, flat_t, None, length=rem)
+            if trace:
+                traces.append(ys)
         flat, t, tel, mon = flat_t
         s, _ = cast_flat_out(cfg, [flat[k] for k in sfields], sfields,
                              with_dirty=False)
         end = RaftState(**tick_mod.unflatten_state(cfg, s), tick=t)
         if K > 1:
             return end, ov_total
+        if telemetry and T_f > 1 and not jitted:
+            # The jitted=False embedding's overflow channel (see docstring).
+            tel = dict(tel)
+            tel["fused_draw_overflow"] = ov_total
         out = (end,)
+        if trace:
+            out = out + ({f: jnp.concatenate([tr[f] for tr in traces])
+                          for f in FUSED_TRACE_FIELDS},)
         if telemetry:
             out = out + (tel,)
         if monitor:
             out = out + (telemetry_mod.monitor_finalize(mon),)
+        if T_f > 1 and jitted:
+            return out + (ov_total,)  # stripped by the checked() wrapper
         return out if len(out) > 1 else end
 
     # jitted=False hands the traceable fn to callers that embed it in a
@@ -793,14 +1403,34 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
             return end
 
         return checked
+    if T_f > 1 and jitted:
+        inner_f = jax.jit(run)
+
+        def checked_f(state, rng):
+            res = inner_f(state, rng)
+            res, ov = res[:-1], res[-1]
+            if int(jax.device_get(ov)):
+                raise RuntimeError(
+                    f"fused-tick kernel draw-table overflow: a node "
+                    f"consumed more election-timer resets within one "
+                    f"{T_f}-tick launch than the structural bound covers "
+                    f"(resets_per_tick_bound) — the launch's draws were "
+                    f"clamped and its bits are INVALID; results discarded")
+            return res if len(res) > 1 else res[0]
+
+        return checked_f
     return jax.jit(run) if jitted else run
 
 
 def default_tile(cfg: RaftConfig, lanes: int, interpret: bool,
-                 k_per_launch: int = 1) -> int:
+                 k_per_launch: int = 1, snap_rows: int = 0) -> int:
     """VMEM-model tile choice for `lanes` lane columns (raises if none fits).
-    k_per_launch > 1 models the K-tick kernel: K aux slabs plus the el/backoff
-    draw tables replace the single-tick aux set."""
+    k_per_launch > 1 models the K-tick/fused-T kernels: K aux slabs plus
+    the el/backoff draw tables replace the single-tick aux set. `snap_rows`
+    adds the fused kernel's per-tick snapshot outputs (rows per tick,
+    _snapshot_rows): plain stored output blocks, not lattice-live
+    temporaries, so they are counted at 1/5 of the model's fitted
+    ~20 B/(row,lane) — i.e. at their ~4 B storage cost."""
     N, C = cfg.n_nodes, cfg.log_capacity
     K = max(1, k_per_launch)
     if interpret:
@@ -818,6 +1448,7 @@ def default_tile(cfg: RaftConfig, lanes: int, interpret: bool,
         # el table N*rb*K + backoff table N*K rows + the overflow output.
         rb = resets_per_tick_bound(N, cfg.uses_mailbox and cfg.delay_lo == 0)
         aux_rows += K * N * (rb + 1) + N
+        aux_rows += -(-K * snap_rows // 5)  # snapshot outputs (see above)
     rows = 2 * (n_2d * N + 4 * N * N) + log_rows + aux_rows
     if cfg.uses_mailbox:
         # §10 mailbox: 13 pair-shaped state fields (in + aliased out) + delay aux.

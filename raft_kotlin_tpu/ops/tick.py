@@ -2054,7 +2054,7 @@ def make_tick(cfg: RaftConfig, batched: Optional[bool] = None,
 
 def make_run(cfg: RaftConfig, n_ticks: int, trace: bool = True, impl: str = "xla",
              batched: Optional[bool] = None, telemetry: bool = False,
-             monitor: bool = False, rng=None):
+             monitor: bool = False, rng=None, fused_ticks: int = 1):
     """jitted runner: state -> (state, trace) stepping n_ticks via lax.scan.
 
     trace is a dict of (T, N, G) arrays (role/term/commit/last_index/voted_for/rounds/
@@ -2075,7 +2075,21 @@ def make_run(cfg: RaftConfig, n_ticks: int, trace: bool = True, impl: str = "xla
     bench.measure dispatches reps with per-rep perturbed rng seeds over the
     cfg-seeded initial state, and a faithful replay of such a rep
     (api/triage.triage_violation) must reproduce exactly that split.
+
+    `fused_ticks` = T > 1 (ISSUE 7) is the XLA REFERENCE SCAN of the fused
+    Pallas engine: the scan body advances T ticks through a lax.fori_loop,
+    so the oracle-side comparison program has the same T-block shape as
+    one fused kernel launch (n_ticks % T remainder ticks run per-tick
+    after the blocks). Bits are identical to T=1 — the fori_loop body IS
+    the per-tick function. Per-tick traces cannot ride a fori_loop, so
+    trace=True keeps T=1 (the sticky fallback, matching the Pallas
+    routing); with trace=False the per-tick leader counts become per-BLOCK
+    (block-end) counts of shape (n_ticks // T, G). Telemetry/monitor
+    accumulate per tick inside the loop, bit-equal to T=1.
     """
+    T_f = max(1, fused_ticks)
+    if trace:
+        T_f = 1  # sticky fallback: per-tick traces need per-tick emission
     if impl == "pallas":
         from raft_kotlin_tpu.ops.pallas_tick import make_pallas_tick
 
@@ -2087,10 +2101,18 @@ def make_run(cfg: RaftConfig, n_ticks: int, trace: bool = True, impl: str = "xla
 
     @jax.jit
     def run(st, rng):
-        def body(carry, _):
+        def one(carry):
             st, tel, mon = carry
             with telemetry_mod.engine_scope(impl):
                 st2 = tick_fn(st, rng=rng)
+            if telemetry:
+                tel = telemetry_mod.telemetry_step(st, st2, tel)
+            if monitor:
+                mon = telemetry_mod.monitor_step(st, st2, mon)
+            return (st2, tel, mon)
+
+        def body(carry, _):
+            st2, tel, mon = one(carry)
             if trace:
                 out = {
                     "role": st2.role,
@@ -2103,16 +2125,26 @@ def make_run(cfg: RaftConfig, n_ticks: int, trace: bool = True, impl: str = "xla
                 }
             else:
                 out = jnp.sum((st2.role == LEADER).astype(_I32), axis=0)
-            if telemetry:
-                tel = telemetry_mod.telemetry_step(st, st2, tel)
-            if monitor:
-                mon = telemetry_mod.monitor_step(st, st2, mon)
             return (st2, tel, mon), out
+
+        def block(carry, _):
+            # One T-block: the fori-loop-over-T body that mirrors a fused
+            # kernel launch's program shape (ISSUE 7).
+            carry = lax.fori_loop(0, T_f, lambda _i, c: one(c), carry)
+            out = jnp.sum((carry[0].role == LEADER).astype(_I32), axis=0)
+            return carry, out
 
         tel0 = telemetry_mod.telemetry_zeros() if telemetry else None
         mon0 = telemetry_mod.monitor_init(cfg.n_groups, n_ticks, monitor)
-        (end, tel, mon), ys = lax.scan(body, (st, tel0, mon0), None,
-                                       length=n_ticks)
+        carry = (st, tel0, mon0)
+        if T_f > 1:
+            n_block, rem = divmod(n_ticks, T_f)
+            carry, ys = lax.scan(block, carry, None, length=n_block)
+            if rem:
+                carry, _ = lax.scan(body, carry, None, length=rem)
+        else:
+            carry, ys = lax.scan(body, carry, None, length=n_ticks)
+        end, tel, mon = carry
         out = (end, ys)
         if telemetry:
             out = out + (tel,)

@@ -204,13 +204,29 @@ def init_sharded(cfg: RaftConfig, mesh: Mesh) -> RaftState:
 
 
 def _make_shardmap_pallas_tick(cfg: RaftConfig, mesh: Mesh,
-                               interpret: Optional[bool] = None):
+                               interpret: Optional[bool] = None,
+                               fused_ticks: Optional[int] = 1,
+                               telemetry: bool = False,
+                               monitor: bool = False):
     """The Pallas megakernel applied per device shard via jax.shard_map.
 
     Division of labor mirrors ops/pallas_tick.make_pallas_tick: the RNG/aux
     pre-pass and the deferred-draw post-pass stay ordinary (globally sharded) XLA
     ops; only the pure flat-state kernel runs inside shard_map, each device
     processing its own (rows, G/n_dev) lane slab. Zero collectives inside the tick.
+
+    `fused_ticks` = T > 1 (ISSUE 7) builds the FUSED-T kernel per shard
+    instead: the returned function advances T ticks per call and returns
+    (state, overflow_count, per_tick_snapshots) — the aux/draw-table
+    pre-pass stays globally-sharded XLA exactly like the 1-tick RNG
+    pre-pass, so the kernel still needs no global group offsets. None =
+    route_fused_ticks at the per-shard tile (1 on CPU meshes — the sticky
+    fallback); a routed T that fails the fused VMEM model falls back to 1.
+    `telemetry`/`monitor` make the fused kernel emit exactly the
+    requested observers' per-tick snapshot set (fused_snapshot_fields —
+    a telemetry-only run never pays the monitor's per-tick log blocks);
+    make_sharded_run replays the T transitions from it, OUTSIDE shard_map
+    as always. The resolved T is exposed as `tick.fused_ticks`.
     """
     from raft_kotlin_tpu.ops import tick as tick_mod
     from raft_kotlin_tpu.ops.pallas_tick import (
@@ -246,11 +262,70 @@ def _make_shardmap_pallas_tick(cfg: RaftConfig, mesh: Mesh,
             ) from e
     # Per-shard sub-tile ILP (ISSUE 4): same measured-table routing as the
     # single-device kernel; interpret/CPU shards stay at K=1.
-    sub_k = route_ilp_subtiles(
-        tile, "cpu" if interpret else mesh.devices.flatten()[0].platform)
+    platform = "cpu" if interpret else mesh.devices.flatten()[0].platform
+    sub_k = route_ilp_subtiles(tile, platform)
+    lanes_spec = P(None, ("dcn", "ici"))
+
+    # Fused-T resolution (ISSUE 7) through THE shared resolution
+    # (resolve_fused_geometry over the PER-SHARD lane width and the
+    # mesh's own platform): route T by the per-shard tile, apply the
+    # fused VMEM model (which may shrink the tile — the ILP K is
+    # re-routed for the tile the kernel actually compiles with), routed-T
+    # falls back sticky to 1, pinned-T raises.
+    from raft_kotlin_tpu.ops.pallas_tick import (
+        _snapshot_rows, fused_aux_slabs, fused_launch_aux,
+        fused_snapshot_fields, resolve_fused_geometry,
+        unpack_fused_outputs)
+
+    snap_fields = (fused_snapshot_fields(cfg, telemetry=telemetry,
+                                         monitor=monitor)
+                   if (telemetry or monitor) else ())
+    tile_f, sub_k_f, T_f = resolve_fused_geometry(
+        cfg, interpret, fused_ticks=fused_ticks,
+        snap_rows=_snapshot_rows(cfg, snap_fields),
+        lanes=g_local, platform=platform)
+    if T_f <= 1:
+        snap_fields = ()
+    if T_f > 1:
+        build_call_f = make_pallas_core(cfg, g_local, tile_f, interpret,
+                                        subtiles=sub_k_f, fused_ticks=T_f,
+                                        tick_states=snap_fields)
+
+        def tick_fused(state: RaftState, rng):
+            base, tkeys, bkeys = rng
+            # The aux/draw-table pre-pass is THE shared fused assembly
+            # (fused_launch_aux/fused_aux_slabs — one copy of the
+            # outside-the-kernel half of the bit-compat contract).
+            per, flags, (el_tab, b_tab) = fused_launch_aux(
+                cfg, base, tkeys, bkeys, state.tick, state.t_ctr,
+                state.b_ctr, T_f)
+            call, sfields, aux_names, snaps = build_call_f(flags)
+            flat = tick_mod.flatten_state(cfg, state)
+            ins = cast_flat_in(flat, {}, sfields, ()) \
+                + fused_aux_slabs(per, aux_names) + [el_tab, b_tab]
+            n_out = len(sfields) + 1 + T_f * len(snaps)
+            shard_call = shard_map_compat(
+                lambda *a: call(*a),
+                mesh=mesh,
+                in_specs=(lanes_spec,) * len(ins),
+                out_specs=(lanes_spec,) * n_out,
+                check_vma=False,
+            )
+            with telemetry_mod.engine_scope("shardmap-pallas-fused"):
+                outs = shard_call(*ins)
+            s2, ov, ticks_f = unpack_fused_outputs(
+                list(outs), sfields, snaps, T_f)
+            s, _ = cast_flat_out(cfg, [s2[k] for k in sfields], sfields,
+                                 with_dirty=False)
+            new_state = RaftState(**tick_mod.unflatten_state(cfg, s),
+                                  tick=state.tick + T_f)
+            return new_state, jnp.sum(ov), ticks_f
+
+        tick_fused.fused_ticks = T_f
+        return tick_fused
+
     build_call = make_pallas_core(cfg, g_local, tile, interpret,
                                   subtiles=sub_k)
-    lanes_spec = P(None, ("dcn", "ici"))
 
     def tick(state: RaftState, rng) -> RaftState:
         base, tkeys, bkeys = rng
@@ -273,6 +348,7 @@ def _make_shardmap_pallas_tick(cfg: RaftConfig, mesh: Mesh,
         return tick_mod.finish_tick(
             cfg, tkeys, tick_mod.unflatten_state(cfg, s), el_dirty, state.tick)
 
+    tick.fused_ticks = 1
     return tick
 
 
@@ -355,7 +431,8 @@ def _make_shardmap_xla_tick(cfg: RaftConfig, mesh: Mesh,
 
 def make_sharded_run(cfg: RaftConfig, mesh: Mesh, n_ticks: int,
                      metrics_every: int = 0, impl: str = "xla",
-                     telemetry: bool = False, monitor: bool = False):
+                     telemetry: bool = False, monitor: bool = False,
+                     fused_ticks: Optional[int] = None):
     """Compile run(state [, inject]) -> (state, metrics) sharded over `mesh`.
 
     metrics: dict of cross-group reductions emitted every `metrics_every` ticks
@@ -381,11 +458,37 @@ def make_sharded_run(cfg: RaftConfig, mesh: Mesh, n_ticks: int,
     class as the window metrics; zero per-tick host traffic, read back
     once) — latch group indices are therefore GLOBAL. Protocol bits are
     unchanged.
-    """
-    from raft_kotlin_tpu.ops.tick import make_rng
 
+    `fused_ticks` (impl="pallas" only; ISSUE 7): T ticks fused per kernel
+    launch per shard (_make_shardmap_pallas_tick) — the sharded headline
+    pays one launch per T-block. None = route_fused_ticks at the
+    per-shard tile (1 on CPU meshes). Sticky T=1 fallbacks: metrics
+    windows that don't tile into T-blocks (metrics_every % T != 0) and
+    runs shorter than T. Telemetry/monitor replay the fused kernel's
+    per-tick snapshots between launches (same reductions, outside
+    shard_map — bit-equal to the unfused run); the fused kernel's
+    draw-table overflow flag is summed across the run and host-checked
+    after each call (RuntimeError on violation, the loud-failure
+    contract).
+    """
+    from raft_kotlin_tpu.ops.tick import flatten_state, make_rng
+
+    fused_block, T_f = None, 1
     if impl == "pallas":
-        shardmap_tick = _make_shardmap_pallas_tick(cfg, mesh)
+        cand = _make_shardmap_pallas_tick(cfg, mesh, fused_ticks=fused_ticks,
+                                          telemetry=telemetry,
+                                          monitor=monitor)
+        T_f = getattr(cand, "fused_ticks", 1)
+        if T_f > 1 and ((metrics_every and metrics_every % T_f)
+                        or n_ticks < T_f):
+            T_f = 1  # sticky fallback: windows/run must tile into T-blocks
+        elif T_f > 1:
+            fused_block = cand
+        if T_f == 1:
+            shardmap_tick = cand if getattr(cand, "fused_ticks", 1) == 1 \
+                else _make_shardmap_pallas_tick(cfg, mesh)
+        else:
+            shardmap_tick = _make_shardmap_pallas_tick(cfg, mesh)
         tick_fn = lambda st, rng: shardmap_tick(st, rng)
     elif cfg.uses_dyn_log:
         # Deep-log (dyn) configs: phase_body per shard — the SPMD
@@ -474,8 +577,85 @@ def make_sharded_run(cfg: RaftConfig, mesh: Mesh, n_ticks: int,
                                              length=n_ticks % metrics_every)
         return _pack(st, ms, tel, mon)
 
+    def run_fused(st, rng):
+        # The fused-T variant (ISSUE 7): full T-blocks through the fused
+        # per-shard kernel, remainder ticks through the 1-tick path; the
+        # recorder/monitor replay the kernel's per-tick snapshots between
+        # launches (fused_observe — the same step reductions, outside
+        # shard_map, so latch group ids stay global and bits stay equal
+        # to the unfused run). Returns _pack(...) + (overflow_total,);
+        # the wrapper below host-checks and strips the overflow.
+        # DELIBERATELY a sibling of run(), not a parameterization of it:
+        # the T=1 sharded runner above is the production path of every
+        # prior round and stays textually untouched; the fused suite
+        # (tests/test_fused_ticks.py) pins the two bit-equal.
+        from raft_kotlin_tpu.ops.pallas_tick import fused_observe
+
+        def one(carry, _):
+            s, tel, mon = carry
+            s2 = tick_fn(s, rng)
+            if tel is not None:
+                tel = telemetry_mod.telemetry_step(s, s2, tel)
+            if mon is not None:
+                mon = telemetry_mod.monitor_step(s, s2, mon)
+            return (s2, tel, mon), None
+
+        def oneblock(carry, _):
+            s, tel, mon = carry
+            s2, ov, ticks_f = fused_block(s, rng)
+            if tel is not None or mon is not None:
+                tel, mon = fused_observe(cfg, flatten_state(cfg, s),
+                                         ticks_f, tel, mon)
+            return (s2, tel, mon), ov
+
+        def steps(carry, k):
+            ov = jnp.zeros((), jnp.int32)
+            nb, r = divmod(k, T_f)
+            if nb:
+                carry, ovs = jax.lax.scan(oneblock, carry, None, length=nb)
+                ov = ov + jnp.sum(ovs)
+            if r:
+                carry, _ = jax.lax.scan(one, carry, None, length=r)
+            return carry, ov
+
+        tel0 = telemetry_mod.telemetry_zeros() if telemetry else None
+        mon0 = telemetry_mod.monitor_init(cfg.n_groups, n_ticks, monitor)
+        if not metrics_every:
+            (st, tel, mon), ov = steps((st, tel0, mon0), n_ticks)
+            return _pack(st, None, tel, mon) + (ov,)
+
+        def win(carry, _):
+            s, tel, mon = carry
+            rounds0 = _rounds_sum(s)
+            carry, ov = steps(carry, metrics_every)
+            return carry, (window_metrics(carry[0], rounds0), ov)
+
+        carry, (ms, ovs) = jax.lax.scan(win, (st, tel0, mon0), None,
+                                        length=n_ticks // metrics_every)
+        ov = jnp.sum(ovs)
+        if n_ticks % metrics_every:
+            carry, ov2 = steps(carry, n_ticks % metrics_every)
+            ov = ov + ov2
+        st, tel, mon = carry
+        return _pack(st, ms, tel, mon) + (ov,)
+
     out_sh = ((sh, rep if metrics_every else None)
               + ((rep,) if telemetry else ())
               + ((rep,) if monitor else ()))
+    if T_f > 1:
+        jitted_f = jax.jit(run_fused, in_shardings=(sh, rng_sh),
+                           out_shardings=out_sh + (rep,))
+
+        def call(st):
+            res = jitted_f(st, rng_placed)
+            res, ov = res[:-1], res[-1]
+            if int(jax.device_get(ov)):
+                raise RuntimeError(
+                    f"fused-tick kernel draw-table overflow inside the "
+                    f"sharded run (T={T_f}): the launch's draws were "
+                    f"clamped and its bits are INVALID; results discarded")
+            return res
+
+        return call
     jitted = jax.jit(run, in_shardings=(sh, rng_sh), out_shardings=out_sh)
     return lambda st: jitted(st, rng_placed)

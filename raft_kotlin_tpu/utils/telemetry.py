@@ -723,8 +723,8 @@ class PhaseScopes:
 
 def engine_scope(name: str):
     """named_scope tagging one engine's tick ops (raft/engine/<name>) —
-    names: xla, pallas, xla-fcache, shardmap-xla, shardmap-pallas,
-    shardmap-fcache."""
+    names: xla, pallas, pallas-fused, xla-fcache, shardmap-xla,
+    shardmap-pallas, shardmap-pallas-fused, shardmap-fcache."""
     return jax.named_scope(f"{SCOPE_PREFIX}/engine/{name}")
 
 

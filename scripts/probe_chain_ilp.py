@@ -84,8 +84,11 @@ def main():
 
     sweep = []
     for k in feasible_ks(tile, interpret):
+        # r11: pin T=1 — this probe isolates the sub-tile ILP dimension;
+        # the routed fused depth would confound every K point (the TxK
+        # grid lives in probe_fused_ticks.py).
         run = make_pallas_scan(cfg, ticks, interpret=interpret,
-                               ilp_subtiles=k)
+                               ilp_subtiles=k, fused_ticks=1)
         end = run(st, rng)
         jax.block_until_ready(end.term)  # warm (compile excluded)
         t0 = time.perf_counter()

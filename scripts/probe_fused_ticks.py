@@ -1,0 +1,217 @@
+"""Fused-tick T x K sweep (ISSUE 7 tentpole evidence + table pinning).
+
+The headline megakernel pays one launch and one serial chain ISSUE per
+tick at <20% of both rooflines (BENCH_r05 hbm_bw_frac 0.164 / vpu_frac
+0.178) — launch+issue latency is the binding floor. The fused-T engine
+(ops/pallas_tick.make_pallas_core(fused_ticks=T)) runs T phase lattices
+per launch, composed with the sub-tile ILP (K independent lane slabs per
+tile, each running its own T-tick chain). This probe measures the full
+(T, K) grid through bench.measure — the SAME timing-trap-hardened harness
+the headline uses (distinct per-rep rng operands, in-region host
+materialization, medians) — so the FUSED_TICK_TABLE pins are re-measured
+numbers, not guesses. Per point it emits:
+
+- ticks/s and the speedup vs the (1, routed-K) baseline — super-linear
+  small-T scaling is the round's acceptance evidence;
+- latency_frac_ideal = (chain_depth x t_op / K) / tick_s — the chain
+  bound an IDEAL K-fold overlap leaves (near 1: the tick still IS its
+  chain; falling with T: the launch share is being amortized away);
+- the amortized per-tick launch overhead implied by the T=1 vs T point
+  (two-point fit t(T) = t_work + L/T), the satellite-2 figure
+  probe_issue_latency.py fits over the full sweep.
+
+The per-phase attribution (`raft/F0..p5`) is emitted once from
+opcount.phase_body_chain_depth(by_phase=True) — the SAME keys the
+jax.named_scope profiler regions carry (utils/telemetry.PHASE_SCOPES), so
+a Perfetto trace of any (T, K) point groups ops under exactly these
+columns; the probe's chain model says which phase to fuse next (p5 holds
+151 of 238 ops at the headline config).
+
+--pin rewrites the FUSED_TICK_TABLE block in ops/pallas_tick.py in place
+with this sweep's measured winner for the probed tile (the
+`# FUSED_TICK_TABLE[begin]/[end]` markers bound the rewrite) — the first
+step of the ROADMAP-2 measure-on-first-use autotune refactor: the table
+stops being a hand-maintained artifact and becomes this probe's output.
+
+  python scripts/probe_fused_ticks.py [groups] [ticks] [--pin]
+
+On CPU the kernel runs in interpreter mode: the (T, K) grid is still
+bit-tested (tests/test_fused_ticks.py), but the timing sweep is only
+meaningful on hardware — the probe still emits the record with
+"platform": "cpu" so the artifact is honest about where it ran, and
+--pin refuses to rewrite the table from CPU timings.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_compilation_cache_dir", os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+PALLAS_TICK_PY = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "raft_kotlin_tpu", "ops", "pallas_tick.py")
+
+
+def feasible_ks(tile_g: int, interpret: bool):
+    ks = []
+    for k in (1, 2, 4):
+        if tile_g % k:
+            continue
+        if not interpret and (tile_g // k) % 128:
+            continue
+        ks.append(k)
+    return ks
+
+
+def pin_table(tile_g: int, best_t: int, source: str) -> None:
+    """Rewrite the probed tile's FUSED_TICK_TABLE entry in place (the
+    marker-bounded block in ops/pallas_tick.py). Other tiles' entries are
+    preserved; the probed tile's line is replaced with the measured pin."""
+    with open(PALLAS_TICK_PY) as f:
+        text = f.read()
+    m = re.search(
+        r"(# FUSED_TICK_TABLE\[begin\][^\n]*\nFUSED_TICK_TABLE = \()"
+        r"(.*?)(\n\)\n# FUSED_TICK_TABLE\[end\])", text, re.DOTALL)
+    if not m:
+        raise RuntimeError("FUSED_TICK_TABLE markers not found")
+    body = m.group(2)
+    entries = re.findall(r"\(\s*(\d+),\s*(\d+),\s*((?:\"[^\"]*\"\s*)+)\)",
+                         body)
+    lines = []
+    seen = False
+    for t, T, src in entries:
+        if int(t) == tile_g:
+            lines.append(f'    ({tile_g}, {best_t}, "{source}"),')
+            seen = True
+        else:
+            src_clean = " ".join(s.strip() for s in src.split("\n"))
+            lines.append(f"    ({t}, {T}, {src_clean.rstrip()}),")
+    if not seen:
+        lines.insert(0, f'    ({tile_g}, {best_t}, "{source}"),')
+    new = m.group(1) + "\n" + "\n".join(lines) + m.group(3)
+    with open(PALLAS_TICK_PY, "w") as f:
+        f.write(text[:m.start()] + new + text[m.end():])
+
+
+def main():
+    import bench
+    from raft_kotlin_tpu.ops.opcount import (
+        measure_op_latency, phase_body_chain_depth)
+    from raft_kotlin_tpu.ops.pallas_tick import (
+        default_tile, make_pallas_scan, route_fused_ticks,
+        route_ilp_subtiles)
+    from raft_kotlin_tpu.utils.config import RaftConfig
+
+    args = [a for a in sys.argv[1:] if a != "--pin"]
+    do_pin = "--pin" in sys.argv[1:]
+    on_accel = jax.default_backend() != "cpu"
+    groups = int(args[0]) if len(args) > 0 else (102_400 if on_accel else 512)
+    ticks = int(args[1]) if len(args) > 1 else (100 if on_accel else 4)
+    reps = int(os.environ.get("RAFT_PROBE_REPS", 3 if on_accel else 1))
+    cfg = RaftConfig(
+        n_groups=groups, n_nodes=5, log_capacity=32, cmd_period=10,
+        p_drop=0.25, p_crash=0.01, p_restart=0.08,
+        p_link_fail=0.02, p_link_heal=0.08, seed=0,
+    ).stressed(10)
+
+    interpret = not on_accel
+    tile = default_tile(cfg, cfg.n_groups, interpret)
+    by_phase = phase_body_chain_depth(cfg, by_phase=True)
+    depth = by_phase["total"]
+    t_op = measure_op_latency()
+
+    def candidates(T, K):
+        def gen(cfg_c):
+            # The headline's own builder shape: recorder+monitor ON, flat
+            # carry, jitted=False (measure() jits once with the reductions
+            # inside) — so a sweep point is the production program at
+            # (T, K), not a bare-kernel microbenchmark.
+            yield (lambda n: make_pallas_scan(
+                cfg_c, n, interpret=interpret, jitted=False,
+                telemetry=True, monitor=True, fused_ticks=T,
+                ilp_subtiles=K)), f"pallas-T{T}K{K}"
+        return gen
+
+    sweep = []
+    base_by_k = {}  # (T=1, K) per-tick time per K — the SAME-K baseline
+    for T in (1, 2, 4, 8):
+        for K in feasible_ks(tile, interpret):
+            try:
+                ts, stats, _impl = bench.measure(
+                    cfg, ticks, reps, candidates(T, K))
+            except Exception as e:
+                sweep.append({"t": T, "k": K, "error": str(e)[:160]})
+                continue
+            best = bench.median(ts)
+            tick_s = best / ticks
+            med = stats[ts.index(best)]
+            if T == 1:
+                base_by_k[K] = tick_s
+            bound_k = depth * t_op / K if t_op else None
+            point = {
+                "t": T, "k": K,
+                "ticks_per_sec": round(1 / tick_s, 2),
+                "latency_frac_ideal": (round(bound_k / tick_s, 3)
+                                       if bound_k else None),
+                "fused_draw_overflow": int(
+                    med.get("tel_fused_draw_overflow") or 0),
+                "rep_times_s": [round(t, 4) for t in ts],
+            }
+            # Speedup/overhead against the (T=1, SAME K) baseline, so the
+            # fusion figure never absorbs the sub-tile-ILP gain.
+            base_k = base_by_k.get(K)
+            if base_k is not None and T > 1:
+                point["speedup_vs_t1"] = round(base_k / tick_s, 3)
+                # Two-point per-launch overhead: t(1)-t(T) = L(1-1/T);
+                # a noisy negative fit publishes null, never a negative
+                # overhead (same guard as probe_issue_latency/bench).
+                L = (base_k - tick_s) * T / (T - 1)
+                point["launch_overhead_amortized_ns"] = (
+                    round(L / T * 1e9, 1) if L > 0 else None)
+            sweep.append(point)
+
+    valid = [p for p in sweep
+             if "error" not in p and not p["fused_draw_overflow"]]
+    winner = max(valid, key=lambda p: p["ticks_per_sec"]) if valid else None
+    record = {
+        "probe": "fused_ticks",
+        "platform": jax.devices()[0].platform,
+        "groups": groups,
+        "ticks": ticks,
+        "tile_g": tile,
+        "routed_t": route_fused_ticks(tile),
+        "routed_k": route_ilp_subtiles(tile),
+        "chain_depth": depth,
+        "chain_depth_by_phase": by_phase,  # == raft/F0..p5 scope keys
+        "op_latency_ns": round(t_op * 1e9, 2) if t_op else None,
+        "tk_sweep": sweep,
+        "winner": winner,
+        "pinned": False,
+    }
+    if do_pin and winner:
+        if not on_accel:
+            print("--pin refused: CPU interpreter timings cannot pin a "
+                  "hardware table", file=sys.stderr)
+        else:
+            src = (f"probe_fused_ticks {time.strftime('%Y-%m-%d')}: "
+                   f"{winner['ticks_per_sec']} ticks/s at T={winner['t']} "
+                   f"K={winner['k']} (G={groups})")
+            pin_table(tile, winner["t"], src)
+            record["pinned"] = True
+    print(json.dumps(record), flush=True)
+
+
+if __name__ == "__main__":
+    main()

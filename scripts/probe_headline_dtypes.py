@@ -43,7 +43,8 @@ def main():
         st0 = init_state(cfg)
         rngs = [make_rng(dataclasses.replace(cfg, seed=cfg.seed + 1000 * (r + 1)))
                 for r in range(4)]
-        run = make_pallas_scan(cfg, T, interpret=False)
+        # r11: pin T=1 — the dtype A/B targets the per-tick kernel.
+        run = make_pallas_scan(cfg, T, interpret=False, fused_ticks=1)
         int(jnp.sum(run(st0, rngs[3]).rounds))
         ts = []
         for r in range(3):

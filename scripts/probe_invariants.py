@@ -67,6 +67,20 @@ def main() -> int:
         cfg = dataclasses.replace(cfg, delay_lo=1, delay_hi=3)
     impl = choose_impl(cfg) if args.impl == "auto" else args.impl
 
+    # Both legs run the SAME fused depth (r11): the monitor-on snapshot
+    # set is the larger one, so resolve T against it and pin it for both —
+    # otherwise the off leg could route a deeper fusion than the on leg
+    # and the A/B would charge the difference to the monitor.
+    fused_t = 1
+    if impl == "pallas":
+        from raft_kotlin_tpu.ops.pallas_tick import (
+            _snapshot_rows, fused_snapshot_fields, resolve_fused_geometry)
+
+        fused_t = resolve_fused_geometry(
+            cfg, interpret=False,
+            snap_rows=_snapshot_rows(cfg, fused_snapshot_fields(
+                cfg, telemetry=True, monitor=True)))[2]
+
     def candidates(monitor):
         """The SAME builders bench.tick_candidates times, with the
         monitor switchable (recorder ON in both legs — the PR-5
@@ -74,6 +88,7 @@ def main() -> int:
         if impl == "pallas":
             yield (lambda n: make_pallas_scan(cfg, n, interpret=False,
                                               jitted=False, telemetry=True,
+                                              fused_ticks=fused_t,
                                               monitor=monitor)), "pallas"
         else:
             yield bench.scan_runner(make_tick(cfg), telemetry=True,

@@ -49,7 +49,10 @@ def main():
             from raft_kotlin_tpu.ops.pallas_tick import make_pallas_scan
             rngs = [tick_mod.make_rng(dataclasses.replace(
                 cfg, seed=cfg.seed + 1000 * (r + 1))) for r in range(3)]
-            run = make_pallas_scan(cfg, T, interpret=False)
+            # r11: pin T=1 — this probe ablates the per-tick kernel;
+            # fusion would confound the phase-cut deltas.
+            run = make_pallas_scan(cfg, T, interpret=False,
+                               fused_ticks=1)
             try:
                 int(jnp.sum(run(st0, rngs[2]).rounds))
                 ts = []

@@ -75,10 +75,16 @@ def main() -> None:
     def candidates(telemetry):
         """The SAME builders bench.tick_candidates times, with the
         recorder switchable — measure() jits once with the reductions
-        inside, so both legs pay identical harness costs."""
+        inside, so both legs pay identical harness costs. Both legs pin
+        fused_ticks=1 (r11): the recorder-off leg has no surfaced channel
+        for the fused draw-table overflow flag (jitted=False embedding),
+        and an A/B across DIFFERENT fused depths would charge fusion's
+        win to the recorder — the per-tick recorder cost is the same
+        step reductions either way (fused_observe replays them), so the
+        T=1 overhead measured here is the production figure."""
         if impl == "pallas":
             yield (lambda n: make_pallas_scan(cfg, n, interpret=False,
-                                              jitted=False,
+                                              jitted=False, fused_ticks=1,
                                               telemetry=telemetry)), "pallas"
         else:
             yield bench.scan_runner(make_tick(cfg),

@@ -50,6 +50,13 @@ LEGS = (
     ("elections_per_sec", "elections/s", "suspect"),
     ("mailbox_group_steps_per_sec", "mailbox gsps", "suspect"),
     ("deeplog_group_steps_per_sec", "deep-log gsps", "deeplog_suspect"),
+    # r11 (ISSUE 7): the fused legs gate too. The timed headline/churn/
+    # mailbox legs ARE the fused engine once FUSED_TICK_TABLE routes T>1
+    # (their ticks/s+gsps rows above catch an absolute regression); this
+    # row additionally catches a fusion-specific collapse — a round whose
+    # fused-vs-T=1 speedup drops >10% below the best prior vetted round
+    # (e.g. the kernel silently degrading to the nofuse ladder rung).
+    ("fused_vs_t1", "fused-vs-T1 speedup", "suspect"),
 )
 
 # (field, label, suspect-gate field) — the per-leg safety-invariant

@@ -39,6 +39,9 @@ def _fake_record():
         "inv_violations": 0,
         "inv_ring_commit_hi": 171,
         "inv_ring_leaders_hw": 99_214,
+        "fused_ticks": 4,
+        "fused_vs_t1": 1.31,
+        "latency_frac_amortized": 0.81,
         "suspect": False,
         # plus the long tail of fields that overflowed the driver window
         **{f"filler_{i}": [0.1234] * 8 for i in range(80)},
@@ -81,6 +84,13 @@ def test_compact_headline_is_last_line_and_complete():
     for k in ("inv_status", "churn_inv_status", "mailbox_inv_status",
               "deeplog_inv_status", "inv_violations",
               "inv_ring_commit_hi", "inv_ring_leaders_hw"):
+        assert k in bench.COMPACT_EXTRA_FIELDS, k
+    # The r11 additions (ISSUE 7): the fused-tick count, the measured
+    # fused-vs-T=1 speedup and the chain+amortized-launch roofline ride
+    # the authoritative tail by NAME — the round's acceptance gate and
+    # summarize_bench's fused-leg regression row read them from the
+    # artifact.
+    for k in ("fused_ticks", "fused_vs_t1", "latency_frac_amortized"):
         assert k in bench.COMPACT_EXTRA_FIELDS, k
     for k in bench.COMPACT_EXTRA_FIELDS:
         assert k in last, k

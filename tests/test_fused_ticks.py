@@ -1,0 +1,242 @@
+"""Fused-tick differential suite (ISSUE 7 tentpole).
+
+ops/pallas_tick.make_pallas_core(fused_ticks=T) runs T full phase lattices
+per kernel launch with state VMEM-resident between ticks — the revived
+round-5 K-tick kernel, now composed with the sub-tile ILP and carrying
+per-tick snapshot outputs for the recorder/monitor/trace harness. These
+tests PIN the bit contract: fused T ∈ {2, 4, 8} against the T=1 baseline,
+per-tick role/term/commit/last_index traces AND full end states, across
+the sync fault soup, the §10 mailbox [1, 3] window, the τ=0 double-delivery
+regime, int16 log storage, a 5-node crash/restart churn soup, and the
+sharded runner (8-device CPU mesh), plus flight-recorder COUNTER equality
+and safety-monitor LATCH/ring equality (fused ≡ unfused) — the PR-5/6
+bit-neutrality harness surviving fusion by construction.
+
+All runs are CPU interpreter mode; T is pinned explicitly (the router's
+CPU guard returns 1 — tests/test_routing.py pins the table itself). The
+heaviest differentials (mailbox/τ=0, the 5-node T∈{4,8} churn, the deep
+sharded sweep) are slow-tiered: a fused launch compiles T unrolled phase
+lattices, which is exactly the compile cost the tier-1 budget cannot
+absorb at every (config, T) point.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from conftest import assert_states_equal
+
+from raft_kotlin_tpu.models.state import init_state
+from raft_kotlin_tpu.ops.pallas_tick import (
+    FUSED_TRACE_FIELDS,
+    make_pallas_scan,
+    make_pallas_tick,
+)
+from raft_kotlin_tpu.ops.tick import make_rng
+from raft_kotlin_tpu.utils.config import RaftConfig
+
+SOUP = RaftConfig(
+    n_groups=8, n_nodes=3, log_capacity=8, cmd_period=3,
+    p_drop=0.2, p_crash=0.02, p_restart=0.1, seed=11,
+).stressed(10)
+
+
+def _traced_run(cfg, n_ticks, T, K=1):
+    """(per-tick trace dict, end state) through the fused scan at T —
+    T=1 reads the trace from the per-tick body, T>1 from the fused
+    kernel's snapshot outputs (the same channel the recorder rides)."""
+    run = make_pallas_scan(cfg, n_ticks, interpret=True, fused_ticks=T,
+                           ilp_subtiles=K, trace=True)
+    end, tr = run(init_state(cfg), make_rng(cfg))
+    return jax.device_get(tr), jax.device_get(end)
+
+
+def _assert_fused_matches(cfg, n_ticks, ts=(2,), K=1, require_commit=True):
+    ref_tr, ref_end = _traced_run(cfg, n_ticks, T=1)
+    if require_commit:
+        assert int(np.max(ref_tr["commit"])) > 0, "soup did nothing"
+    else:
+        # Workload-free pacing configs: elections are the activity proof.
+        assert int(np.max(ref_tr["term"])) > 0, "soup did nothing"
+    for T in ts:
+        tr, end = _traced_run(cfg, n_ticks, T=T, K=K)
+        for f in FUSED_TRACE_FIELDS:
+            assert np.array_equal(tr[f], ref_tr[f]), (T, f)
+        assert_states_equal(ref_end, end)
+
+
+def test_fused_sync_soup_t2_with_remainder():
+    # The headline regime in miniature; n_ticks=21 with T=2 exercises both
+    # in-scan paths (10 fused launches + 1 remainder tick through the
+    # 1-tick kernel) and the snapshot-trace channel — 21 because the
+    # soup's first commit lands at tick 19 (the vacuousness floor).
+    _assert_fused_matches(SOUP, 21, ts=(2,))
+
+
+@pytest.mark.slow
+def test_fused_telemetry_and_monitor_equality():
+    # Recorder counters and monitor latch/ring/taints must be EQUAL fused
+    # vs unfused — the PR-5/6 harness is the fused engine's bit-neutrality
+    # proof (fused_observe replays the same per-tick step reductions from
+    # the kernel's snapshots). The fused leg runs the bench embedding
+    # (jitted=False under an outer jit), so the recorder's
+    # fused_draw_overflow channel is exercised — and zero — on the same
+    # compile. Slow tier: the tier-1 budget (870 s) was already within
+    # ~4% of full before this round; the fast tier keeps the sync-soup
+    # trace differential (which pins the same snapshot channel this test
+    # reads) and the routing/guard pins.
+    cfg = SOUP
+    T = 20
+    rng = make_rng(cfg)
+    st = init_state(cfg)
+    e0, tel0, mon0 = make_pallas_scan(cfg, T, interpret=True, fused_ticks=1,
+                                      telemetry=True, monitor=True)(st, rng)
+    runner = make_pallas_scan(cfg, T, interpret=True, fused_ticks=2,
+                              jitted=False, telemetry=True, monitor=True)
+    e1, tel1, mon1 = jax.jit(runner)(st, rng)
+    assert_states_equal(jax.device_get(e0), jax.device_get(e1))
+    assert int(tel1.pop("fused_draw_overflow")) == 0
+    for k in tel0:
+        assert int(tel0[k]) == int(tel1[k]), k
+    # Faults fired, so the equality is not vacuous.
+    assert int(tel0["fault_events"]) > 0
+    for k in mon0:
+        assert np.array_equal(np.asarray(mon0[k]), np.asarray(mon1[k])), k
+
+
+@pytest.mark.slow
+def test_fused_int16_logs_matches_t1():
+    cfg = RaftConfig(
+        n_groups=8, n_nodes=3, log_capacity=16, log_dtype="int16",
+        cmd_period=2, p_drop=0.1, seed=23,
+    ).stressed(10)
+    assert not cfg.uses_dyn_log  # still the Pallas-compilable band
+    _assert_fused_matches(cfg, 20, ts=(2,))
+
+
+@pytest.mark.slow
+def test_fused_tick_advancer_matches_scan():
+    # make_pallas_tick(fused_ticks=T): the T-tick advancer is the same
+    # launch as one fused scan block.
+    cfg = SOUP
+    rng = make_rng(cfg)
+    st = init_state(cfg)
+    adv = make_pallas_tick(cfg, interpret=True, fused_ticks=2)
+    sp = adv(adv(st, rng=rng), rng=rng)
+    sf = make_pallas_scan(cfg, 4, interpret=True, fused_ticks=2)(st, rng)
+    assert_states_equal(jax.device_get(sp), jax.device_get(sf))
+
+
+def test_fused_overflow_raises_and_guards():
+    # Draw-table overflow must fail LOUDLY (the archival kernel's
+    # contract): with the structural reset bound shrunk to 1 per tick,
+    # churn pacing overflows within a few launches and the jitted runner
+    # must raise instead of silently clamping to wrong draws.
+    churn = RaftConfig(n_groups=16, n_nodes=3, log_capacity=8, seed=1,
+                       el_lo=2, el_hi=3, hb_ticks=2, round_ticks=3,
+                       retry_ticks=2, bo_lo=2, bo_hi=3)
+    rng = make_rng(churn)
+    run = make_pallas_scan(churn, 12, interpret=True, fused_ticks=2,
+                           _resets_bound=1)
+    with pytest.raises(RuntimeError, match="overflow"):
+        run(init_state(churn), rng)
+    # jitted=False embeds in a caller's jit — no host check is possible,
+    # so a PINNED fused depth without the recorder channel must refuse
+    # (the zero-overflow recorder channel itself is pinned on the same
+    # compile as test_fused_telemetry_and_monitor_equality).
+    with pytest.raises(ValueError, match="telemetry"):
+        make_pallas_scan(SOUP, 8, interpret=True, fused_ticks=2,
+                         jitted=False)
+    # The archival K path and the fused path are mutually exclusive.
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        make_pallas_scan(SOUP, 8, interpret=True, k_per_launch=2,
+                         fused_ticks=2)
+
+
+@pytest.mark.slow
+def test_fused_overflow_clean_at_real_bound():
+    # With the real structural bound the same churn pacing runs clean and
+    # bit-matches T=1 (no spurious overflow, no clamped draw in range).
+    churn = RaftConfig(n_groups=16, n_nodes=3, log_capacity=8, seed=1,
+                       el_lo=2, el_hi=3, hb_ticks=2, round_ticks=3,
+                       retry_ticks=2, bo_lo=2, bo_hi=3)
+    _assert_fused_matches(churn, 13, ts=(4,), require_commit=False)
+
+
+@pytest.mark.slow
+def test_fused_mailbox_and_tau0_matches_t1():
+    # §10 mailbox [1, 3]: the production async regime — every exchange
+    # through capacity-1 in-flight slots, the widest reset-bound window.
+    mb = RaftConfig(
+        n_groups=8, n_nodes=3, log_capacity=16, cmd_period=3,
+        p_drop=0.15, delay_lo=1, delay_hi=3, seed=13,
+    ).stressed(10)
+    _assert_fused_matches(mb, 40, ts=(2, 4))
+    # τ=0 (same-tick send+deliver, the double-delivery order whose extra
+    # reset sites the 8N-3 bound covers).
+    tau0 = RaftConfig(
+        n_groups=8, n_nodes=3, log_capacity=16, cmd_period=3,
+        p_drop=0.15, mailbox=True, delay_lo=0, delay_hi=0, seed=17,
+    ).stressed(10)
+    _assert_fused_matches(tau0, 30, ts=(2,))
+
+
+@pytest.mark.slow
+def test_fused_t8_sync_soup():
+    # The deepest routed fusion on the 3-node soup (T=8: 3 launches + no
+    # remainder at 24 ticks) — T=8 on the bigger 5-node lattice would
+    # multiply an already-minutes compile 25/9-fold for no new dataflow,
+    # so the depth is pinned here and the node count below.
+    _assert_fused_matches(SOUP, 24, ts=(8,))
+
+
+@pytest.mark.slow
+def test_fused_5node_churn_t4_with_ilp():
+    # Leader-killing 5-node churn at T=4, composed with sub-tile ILP
+    # (K=2: 2 slabs x 4 ticks per launch), full log arrays in the
+    # end-state compare (assert_states_equal) catching any write-path
+    # divergence.
+    cfg = RaftConfig(
+        n_groups=16, n_nodes=5, log_capacity=16, cmd_period=3,
+        p_drop=0.25, p_crash=0.05, p_restart=0.2,
+        p_link_fail=0.1, p_link_heal=0.3, seed=29,
+    ).stressed(10)
+    # 40 ticks: the soup's commit floor (the r8 ILP suite uses the same
+    # length on this config); T=4 divides it exactly — the remainder path
+    # is covered by the sync-soup fast test.
+    _assert_fused_matches(cfg, 40, ts=(4,), K=2)
+
+
+@pytest.mark.slow
+def test_fused_sharded_runner_matches_t1():
+    # The sharded runner (parallel/mesh) over the 8-device CPU mesh:
+    # fused T ∈ {2, 4} end states, window metrics, recorder counters and
+    # monitor carry all equal to the per-tick sharded run — including the
+    # remainder path (T=14 with fused 4 = 3 blocks + 2 remainder ticks)
+    # and the metrics-window tiling (metrics_every=4 % T == 0 keeps the
+    # fused path; the % T != 0 case falls back sticky to T=1).
+    from raft_kotlin_tpu.parallel.mesh import (
+        init_sharded, make_mesh, make_sharded_run, pad_groups)
+
+    cfg = dataclasses.replace(SOUP, seed=31)
+    mesh = make_mesh()
+    cfg = pad_groups(cfg, mesh)
+    st0 = init_sharded(cfg, mesh)
+    ref, m0, tel0, mon0 = make_sharded_run(
+        cfg, mesh, 14, metrics_every=4, impl="pallas",
+        telemetry=True, monitor=True)(st0)
+    for T in (2, 4):
+        stF, mF, telF, monF = make_sharded_run(
+            cfg, mesh, 14, metrics_every=4, impl="pallas",
+            telemetry=True, monitor=True, fused_ticks=T)(st0)
+        assert_states_equal(jax.device_get(ref), jax.device_get(stF))
+        for k in m0:
+            assert np.array_equal(np.asarray(m0[k]), np.asarray(mF[k])), k
+        for k in tel0:
+            assert int(tel0[k]) == int(telF[k]), k
+        for k in mon0:
+            assert np.array_equal(np.asarray(mon0[k]),
+                                  np.asarray(monF[k])), k

@@ -54,6 +54,43 @@ def test_ilp_subtile_router_matches_table():
     assert route_ilp_subtiles(520, "tpu") == 1
 
 
+def test_fused_tick_router_matches_table():
+    # ISSUE 7: the fused-tick T table (ops/pallas_tick.FUSED_TICK_TABLE)
+    # routes every tabulated megakernel tile to its pinned T on hardware —
+    # the pick bench.py publishes as `fused_ticks` and
+    # probe_fused_ticks.py re-measures (and --pin rewrites) every round.
+    from raft_kotlin_tpu.ops.pallas_tick import (
+        _TILES, FUSED_TICK_TABLE, route_fused_ticks)
+
+    for tile, T, _src in FUSED_TICK_TABLE:
+        assert route_fused_ticks(tile, "tpu") == T, (tile, T)
+        assert T >= 1, (tile, T)
+    # Every hardware tile the VMEM model can pick is tabulated.
+    tabulated = {t for t, _T, _s in FUSED_TICK_TABLE}
+    assert set(_TILES) <= tabulated, set(_TILES) - tabulated
+    # CPU guard: the interpreter pays no launch/issue latency to amortize,
+    # so CPU/interpret runs stay T=1 (tests pin T explicitly instead) —
+    # the byte-identity guarantee for the whole CPU differential suite.
+    for tile, _T, _src in FUSED_TICK_TABLE:
+        assert route_fused_ticks(tile, "cpu") == 1, tile
+    # Unknown (interpreter-only) tiles fall back to T=1 on any platform.
+    assert route_fused_ticks(520, "tpu") == 1
+
+
+def test_fused_geometry_resolution():
+    # resolve_fused_geometry is THE shared resolution bench reads: a
+    # pinned T survives; interpret resolves T=1 when unpinned; the
+    # archival K path and trace-mode fallbacks are covered in
+    # tests/test_fused_ticks.py.
+    from raft_kotlin_tpu.ops.pallas_tick import resolve_fused_geometry
+
+    cfg = RaftConfig(n_groups=512, n_nodes=3, log_capacity=8, seed=1)
+    tg, k, T = resolve_fused_geometry(cfg, interpret=True)
+    assert T == 1 and k == 1  # CPU sticky fallback
+    tg, k, T = resolve_fused_geometry(cfg, interpret=True, fused_ticks=4)
+    assert T == 4  # a pin is a demand
+
+
 def test_router_matches_measured_table():
     # Every tabulated shape routes to its own measured winner — the
     # acceptance gate bench.py re-checks against live data every round.
