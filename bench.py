@@ -254,7 +254,14 @@ COMPACT_EXTRA_FIELDS = ("deeplog_parity_rate", "deeplog_ov_fallback",
                         # — the round's acceptance gate reads all three
                         # from the authoritative tail.
                         "fused_ticks", "fused_vs_t1",
-                        "latency_frac_amortized")
+                        "latency_frac_amortized",
+                        # r12 (ISSUE 9): the fuzz smoke leg's verdict,
+                        # universe count and deterministic corpus hash —
+                        # a non-clean fuzz verdict is a gating failure
+                        # (summarize_bench check_violations) and the hash
+                        # pins corpus reproducibility in the artifact.
+                        "fuzz_universes", "fuzz_inv_status",
+                        "fuzz_corpus_hash")
 
 # Flight-recorder counters published verbatim from the headline run's
 # median rep (stats tel_* keys — utils/telemetry.TELEMETRY_FIELDS).
@@ -1179,6 +1186,40 @@ def main() -> None:
             print(f"deep invariant verification leg failed: "
                   f"{str(e)[:200]}", file=sys.stderr)
 
+    # Fuzz smoke leg (ISSUE 9): a small deterministic simulation-fuzzing
+    # batch — 512 universes x 200 ticks (>= 100k universe-ticks) of mixed
+    # per-group fault lattices + scripted partitions through the monitored
+    # farm runner (api/fuzz.py). Publishes the verdict, the deterministic
+    # corpus hash (same farm inputs => same bytes), and the per-universe
+    # coverage evidence; a non-clean verdict is a GATING failure
+    # (scripts/summarize_bench.py), exactly like the other inv legs.
+    fuzz_universes = None
+    fuzz_universe_ticks = None
+    fuzz_inv_status = None
+    fuzz_corpus_hash = None
+    fuzz_coverage = {}
+    try:
+        from raft_kotlin_tpu.api import fuzz as fuzz_mod
+
+        fuzz_g = int(os.environ.get("RAFT_BENCH_FUZZ_GROUPS", 512))
+        fuzz_t = int(os.environ.get("RAFT_BENCH_FUZZ_TICKS", 200))
+        fuzz_cfg = fuzz_mod.smoke_config(fuzz_g)
+        from raft_kotlin_tpu.utils.telemetry import trace_span
+
+        with trace_span("bench/fuzz"):
+            fz = fuzz_mod.fuzz_farm(fuzz_cfg, fuzz_t, verbose=False)
+        fuzz_universes = fz["universes"]
+        fuzz_universe_ticks = fz["universe_ticks"]
+        fuzz_inv_status = fz["inv_status"]
+        fuzz_corpus_hash = fz["corpus_hash"]
+        fuzz_coverage = fz["coverage"]
+        for rec in fz["records"]:
+            print(f"FUZZ VIOLATION: {rec['status']} universe="
+                  f"{rec['universe_id']} replay_confirmed="
+                  f"{rec['replay_confirmed']}", file=sys.stderr)
+    except Exception as e:
+        print(f"fuzz smoke leg failed: {str(e)[:300]}", file=sys.stderr)
+
     # Fused-engine integrity (ISSUE 7): the jitted=False headline embedding
     # surfaces the draw-table overflow count through the flight recorder
     # (tel_fused_draw_overflow); ANY nonzero count across ANY rep of the
@@ -1289,6 +1330,20 @@ def main() -> None:
         "deeplog_inv_violations": deeplog_inv.get("inv_violations"),
         "deeplog_inv_ring_commit_hi": deeplog_inv.get(
             "inv_ring_commit_hi"),
+        # Fuzz smoke leg (ISSUE 9): the deterministic simulation-fuzzing
+        # batch's verdict, corpus hash (reproducibility pin: equal farm
+        # inputs => equal corpus bytes => equal hash) and per-universe
+        # coverage — evidence that the bank's heterogeneity actually bit
+        # (api/fuzz.py; scripts/fuzz_farm.py is the nightly-scale CLI).
+        "fuzz_universes": fuzz_universes,
+        "fuzz_universe_ticks": fuzz_universe_ticks,
+        "fuzz_inv_status": fuzz_inv_status,
+        "fuzz_corpus_hash": fuzz_corpus_hash,
+        "fuzz_fault_universes": fuzz_coverage.get("fault_universes"),
+        "fuzz_taint_restart_universes": fuzz_coverage.get(
+            "taint_restart_universes"),
+        "fuzz_taint_unsafe_universes": fuzz_coverage.get(
+            "taint_unsafe_universes"),
         # §10 mailbox stage (headline fault-soup config + 1-3-tick delays).
         "mailbox_group_steps_per_sec": round(mail_steps_per_sec, 1),
         "mailbox_elections_per_sec": round(mail_elections_per_sec, 1),

@@ -72,6 +72,9 @@ struct Inputs {
   const int32_t *inject;         // [T][G][N] command id, -1 = none (phase 0)
   const uint8_t *fault_cmd;      // [T][G][N] 0 none / 1 crash / 2 restart (phase F)
   const int32_t *delay;          // [T][G][N][N] §10 send delays (null if lo == hi)
+  const uint8_t *leader_iso;     // [T][G] §12 leader-isolation active window:
+                                 // edges touching a pre-phase-F live leader are
+                                 // down this tick (abi v3; null = off)
 };
 
 // Post-tick trace sink, [T][G][N] each; any may be null.
@@ -232,7 +235,21 @@ static void tick_group(Group& gr, const Dims& d, const Inputs& in, int32_t t,
   auto iid_ok = [&](int a, int b) -> bool {
     return !in.edge_ok || in.edge_ok[gNN + (a - 1) * N + (b - 1)];
   };
+
+  // §12 leader isolation: snapshot the PRE-phase-F live leaders; during an
+  // active window every edge touching one is down (self-edges exempt) —
+  // the same pre-tick-role semantics as the kernel's make_aux fold and the
+  // Python oracle's sched_down.
+  bool iso_active =
+      in.leader_iso && in.leader_iso[(int64_t)rel_t * d.G + gr.g];
+  uint8_t was_lead[64] = {0};
+  if (iso_active)
+    for (int n = 1; n <= N; n++)
+      was_lead[n - 1] = *gr.f(s.up, n) && *gr.f(s.role, n) == LEADER;
+
   auto ok = [&](int a, int b) -> bool {   // §9 effective edge health
+    if (iso_active && a != b && (was_lead[a - 1] || was_lead[b - 1]))
+      return false;
     return *gr.f(s.up, a) && *gr.f(s.up, b) && *gr.nn(s.link_up, a, b) && iid_ok(a, b);
   };
 
@@ -573,7 +590,9 @@ int raft_run(const Dims* dims, State* state, const Inputs* inputs, Trace* trace)
   return 0;
 }
 
-int raft_abi_version() { return 2; }  // v2: §10 mailbox (Dims.delay_*/mailbox,
+int raft_abi_version() { return 3; }  // v3: Inputs.leader_iso (§12 scenario
+                                      // partition programs).
+                                      // v2: §10 mailbox (Dims.delay_*/mailbox,
                                       // State.vq_*/aq_*, Inputs.delay)
 
 }  // extern "C"

@@ -1,0 +1,454 @@
+"""Deterministic simulation-fuzzing farm (ROADMAP item 5 / ISSUE 9).
+
+The repo's throughput is ~40M group-steps/s of FoundationDB-style
+deterministic-simulation capacity (PAPERS.md names that harness as the
+lineage of the triage design); this module spends it on verification.
+Every group of a farm batch is a distinct, reproducible UNIVERSE: its
+fault lattice (drop/crash/restart/link probabilities as integer-exact
+23-bit thresholds), delay window and scripted partition program are
+sampled from a counted threefry stream keyed by
+(farm_seed, universe_id) — utils/rng.sample_scenario_bank via
+`RaftConfig.scenario` (utils/config.ScenarioSpec), threaded through every
+engine's rng operand by ops/tick.make_rng. The on-device monitor
+(utils/telemetry, PR 6) checks the Figure-3 invariants per tick, latches
+the first violation, and — with `monitor_groups` — accumulates per-
+universe stress counters, all in the scan carry: a batch costs ONE device
+round trip.
+
+The farm loop (`fuzz_farm` / scripts/fuzz_farm.py):
+1. run monitored+recorded batches over the sampled manifest,
+2. on a latch, AUTO-SHRINK the violation (`shrink_violation`): tighten
+   the tick horizon while the latch persists, then zero the scenario's
+   fault channels one at a time keeping only the ones the violation
+   needs,
+3. write the minimal replayable artifact — (farm_seed, universe params,
+   config, tick, group, invariant) — to a JSONL corpus whose bytes are a
+   pure function of the farm inputs (`corpus_hash` pins determinism),
+4. re-confirm by replay: `replay_artifact` re-runs the shrunk config
+   from scratch and requires the latch at the exact coordinate, and
+   pure (non-mutated) violations additionally go through
+   api/triage.triage_violation for the device-replay + explain()
+   narrative.
+
+A correct implementation never latches, so the farm's own acceptance
+machinery is exercised through SEEDED MUTATION (`committed_rewrite_mutator`
+/ `twin_leader_mutator`): a deliberately broken transition injected
+inside the scan at an exact (tick, group), which must latch, shrink to
+zero fault channels, and replay at exactly the injected coordinate
+(tests/test_fuzz.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_kotlin_tpu.utils import telemetry as telemetry_mod
+from raft_kotlin_tpu.utils.config import RaftConfig, ScenarioSpec, config_from_dict
+
+_I32 = jnp.int32
+
+CORPUS_SCHEMA = "raft-fuzz-v1"
+
+
+def _cpu_batched_guard(cfg: RaftConfig) -> Optional[bool]:
+    """The repo-wide CPU guard: XLA:CPU compiles of the batched deep
+    engine blow up (ops/tick.py), so deep configs take the per-pair
+    engine on CPU — bit-identical, just slower."""
+    return False if (cfg.uses_dyn_log
+                     and jax.default_backend() == "cpu") else None
+
+
+def make_batch_runner(cfg: RaftConfig, n_ticks: int,
+                      mutator: Optional[Callable] = None):
+    """run(state0?) -> (end_state, telemetry, RAW per-group monitor carry)
+    for one monitored+recorded batch — the farm's engine. One jit, one
+    scan, per-universe counters in the carry (monitor_groups), monitor
+    returned UN-finalized so the (G,) taint masks and PER_GROUP_KEYS are
+    readable (telemetry.universe_stats).
+
+    `mutator(state, tick_scalar) -> state` is the seeded-mutation hook:
+    applied to the POST-tick state inside the scan, BEFORE the monitor
+    step — a deliberately broken transition the monitor must catch."""
+    from raft_kotlin_tpu.models.state import init_state
+    from raft_kotlin_tpu.ops.tick import make_rng, make_tick
+
+    tick_fn = make_tick(cfg, batched=_cpu_batched_guard(cfg))
+    rng = make_rng(cfg)
+
+    @jax.jit
+    def run(st, rng):
+        def body(carry, _):
+            s, tel, mon = carry
+            s2 = tick_fn(s, rng=rng)
+            if mutator is not None:
+                s2 = mutator(s2, s.tick)
+            tel = telemetry_mod.telemetry_step(s, s2, tel)
+            mon = telemetry_mod.monitor_step(s, s2, mon)
+            return (s2, tel, mon), None
+
+        tel0 = telemetry_mod.telemetry_zeros()
+        mon0 = telemetry_mod.monitor_init(cfg.n_groups, n_ticks,
+                                          per_group=True)
+        (end, tel, mon), _ = jax.lax.scan(body, (st, tel0, mon0), None,
+                                          length=n_ticks)
+        return end, tel, mon
+
+    def call(state0=None):
+        st = state0 if state0 is not None else init_state(cfg)
+        return run(st, rng)
+
+    return call
+
+
+def run_fuzz_batch(cfg: RaftConfig, n_ticks: int,
+                   mutator: Optional[Callable] = None) -> dict:
+    """One monitored farm batch -> a host-side result dict:
+    - "summary": telemetry.summarize_monitor (inv_status, latch, ring...),
+    - "latch": the first-violation coordinate or None,
+    - "telemetry": flight-recorder counters,
+    - "universe": per-group numpy arrays (grp_elections/grp_fault_events/
+      grp_violations + taint masks — the stress-ranking channel),
+    - "coverage": scalar coverage figures (universes with any fault
+      event / election / taint — the "bank actually bit" evidence)."""
+    end, tel, mon = make_batch_runner(cfg, n_ticks, mutator=mutator)()
+    summary = telemetry_mod.summarize_monitor(mon)
+    uni = telemetry_mod.universe_stats(mon)
+    cov = {
+        "fault_universes": int(np.sum(uni["grp_fault_events"] > 0)),
+        "election_universes": int(np.sum(uni["grp_elections"] > 0)),
+        "taint_restart_universes": int(np.sum(uni["taint_restart"])),
+        "taint_unsafe_universes": int(np.sum(uni["taint_unsafe"])),
+        "violation_universes": int(np.sum(uni["grp_violations"] > 0)),
+    }
+    return {
+        "summary": summary,
+        "latch": summary["latch"],
+        "telemetry": telemetry_mod.summarize_telemetry(tel),
+        "universe": uni,
+        "coverage": cov,
+    }
+
+
+# -- auto-shrinking ----------------------------------------------------------
+
+def scenario_channels(cfg: RaftConfig):
+    """The fault channels a shrink pass can zero, in deterministic order:
+    [(name, zeroed config)] — spec channels first, then any scalar
+    baselines the config carries."""
+    out = []
+    spec = cfg.scenario
+
+    def with_spec(**kw):
+        return dataclasses.replace(
+            cfg, scenario=dataclasses.replace(spec, **kw))
+
+    if spec is not None and not spec.degenerate:
+        for ch in ("drop", "crash", "restart", "link_fail", "link_heal"):
+            if getattr(spec, f"{ch}_max") > 0:
+                out.append((f"scenario.{ch}", with_spec(**{f"{ch}_max": 0.0})))
+        if spec.partitions:
+            out.append(("scenario.partitions", with_spec(partitions=())))
+        if spec.delay_windows:
+            out.append(("scenario.delay_windows",
+                        with_spec(delay_windows=False)))
+    for ch in ("p_drop", "p_crash", "p_restart", "p_link_fail",
+               "p_link_heal"):
+        if getattr(cfg, ch) > 0:
+            out.append((ch, dataclasses.replace(cfg, **{ch: 0.0})))
+    return out
+
+
+def shrink_violation(cfg: RaftConfig, n_ticks: int, latch: dict,
+                     mutator_factory: Optional[Callable] = None) -> dict:
+    """Auto-shrink a latched violation to its minimal reproducer:
+    (1) HALVE the tick horizon while the latch persists (converging on
+    latch_tick + 1 — deterministic replays re-latch at the same tick as
+    long as the horizon covers it), then (2) zero fault channels one at a
+    time, keeping a channel zeroed whenever the latch persists without it
+    (the latch may MOVE — the shrunk coordinate is the shrunk config's
+    own first violation, re-verified by replay either way).
+
+    `mutator_factory(cfg) -> mutator` rebuilds the seeded mutation for
+    each candidate config (None for pure violations). Returns
+    {"config", "horizon", "latch", "steps"} — `steps` is the audit trail
+    [(kind, detail, kept_shrunk?)]."""
+    steps = []
+
+    def latch_of(c, h):
+        mut = mutator_factory(c) if mutator_factory is not None else None
+        return run_fuzz_batch(c, h, mutator=mut)["latch"]
+
+    horizon = n_ticks
+    # Phase 1: horizon halving (floor: the latch tick + 1).
+    while horizon > latch["tick"] + 1:
+        cand = max(latch["tick"] + 1, horizon // 2)
+        if cand == horizon:
+            break
+        got = latch_of(cfg, cand)
+        if got is not None:
+            horizon, latch = cand, got
+            steps.append(["horizon", cand, True])
+        else:
+            steps.append(["horizon", cand, False])
+            break
+    # Phase 2: channel zeroing, one at a time (re-enumerated after each
+    # kept shrink — zeroing one channel never changes another's bits, but
+    # the candidate list must reflect the current config).
+    changed = True
+    while changed:
+        changed = False
+        for name, cand_cfg in scenario_channels(cfg):
+            got = latch_of(cand_cfg, horizon)
+            if got is not None:
+                cfg, latch = cand_cfg, got
+                steps.append(["channel", name, True])
+                changed = True
+                break
+            steps.append(["channel", name, False])
+        # A kept shrink may have moved the latch earlier — re-tighten.
+        while horizon > latch["tick"] + 1:
+            cand = max(latch["tick"] + 1, horizon // 2)
+            if cand == horizon:
+                break
+            got = latch_of(cfg, cand)
+            if got is None:
+                break
+            horizon, latch = cand, got
+    return {"config": cfg, "horizon": horizon, "latch": latch,
+            "steps": steps}
+
+
+# -- corpus ------------------------------------------------------------------
+
+def universe_params(cfg: RaftConfig, group: int) -> dict:
+    """The host-readable bank row of one universe (the artifact's
+    `universe` field): {channel: int} for every sampled channel."""
+    if cfg.scenario is None:
+        return {}
+    from raft_kotlin_tpu.models.oracle import scenario_bank_np
+
+    bank = scenario_bank_np(cfg)
+    return {k: int(v[group]) for k, v in bank.items()}
+
+
+def violation_artifact(shrunk: dict, orig_cfg: RaftConfig,
+                       mutated: bool = False) -> dict:
+    """The minimal replayable corpus record for one shrunk violation."""
+    cfg, latch = shrunk["config"], shrunk["latch"]
+    spec = cfg.scenario
+    g = latch["group"]
+    return {
+        "schema": CORPUS_SCHEMA,
+        "farm_seed": spec.farm_seed if spec is not None else None,
+        "universe_id": (spec.universe_base + g) if spec is not None else g,
+        # The universe AS SAMPLED (the original batch config's bank row) —
+        # the shrunk config may have zeroed channels away entirely.
+        "universe": universe_params(orig_cfg, g),
+        "config": dataclasses.asdict(cfg),
+        "horizon": shrunk["horizon"],
+        "tick": latch["tick"],
+        "group": g,
+        "invariant": latch["invariant"],
+        "invariant_id": latch["invariant_id"],
+        "status": f"{latch['invariant']}@t{latch['tick']}/g{g}",
+        "shrink": shrunk["steps"],
+        "mutated": bool(mutated),
+        "orig_config": dataclasses.asdict(orig_cfg),
+    }
+
+
+def replay_artifact(artifact: dict,
+                    mutator_factory: Optional[Callable] = None) -> bool:
+    """Re-confirm a corpus record from scratch: rebuild the config, run
+    `horizon` monitored ticks, and require the latch at EXACTLY the
+    recorded (tick, group, invariant)."""
+    cfg = config_from_dict(artifact["config"])
+    mut = mutator_factory(cfg) if mutator_factory is not None else None
+    latch = run_fuzz_batch(cfg, artifact["horizon"], mutator=mut)["latch"]
+    return (latch is not None
+            and latch["tick"] == artifact["tick"]
+            and latch["group"] == artifact["group"]
+            and latch["invariant_id"] == artifact["invariant_id"])
+
+
+def corpus_lines(records) -> list:
+    """The corpus's canonical JSONL lines (sort_keys, no whitespace
+    variance) — byte-determinism is the contract corpus_hash pins."""
+    return [json.dumps(r, sort_keys=True, separators=(",", ":"))
+            for r in records]
+
+
+def corpus_hash(records, farm_seed, universes: int, n_ticks: int) -> str:
+    """A short content hash over the canonical corpus + farm shape: equal
+    inputs => equal corpus bytes => equal hash (tests/test_fuzz.py pins
+    this; bench publishes it as fuzz_corpus_hash)."""
+    payload = json.dumps(
+        {"schema": CORPUS_SCHEMA, "farm_seed": farm_seed,
+         "universes": universes, "ticks": n_ticks,
+         "records": corpus_lines(records)},
+        sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+
+# -- the farm ----------------------------------------------------------------
+
+def fuzz_farm(cfg: RaftConfig, n_ticks: int, universes: Optional[int] = None,
+              batch_groups: Optional[int] = None,
+              out_path: Optional[str] = None,
+              mutator_factory: Optional[Callable] = None,
+              triage_confirm: bool = True, verbose: bool = False) -> dict:
+    """Run the farm over `universes` universes (default: one batch of
+    cfg.n_groups) in batches of `batch_groups`, collecting latches,
+    shrinking each to a minimal artifact, replay-confirming, and writing
+    the JSONL corpus to `out_path`. Returns the summary dict (the bench
+    fuzz leg's record fields live here):
+
+    {"farm_seed", "universes", "ticks_per_universe", "universe_ticks",
+     "inv_status", "violations", "coverage", "corpus_hash", "records",
+     "telemetry"}.
+
+    Each batch latches at most its lexicographically FIRST violation (the
+    monitor's latch is scalar); the farm harvests one artifact per
+    violating batch per pass — a real campaign reruns with the offending
+    universe's channel zeroed or a different farm_seed to dig further.
+    """
+    spec = cfg.scenario
+    assert spec is not None, "fuzz_farm needs cfg.scenario (the bank spec)"
+    universes = universes if universes is not None else cfg.n_groups
+    batch_groups = batch_groups if batch_groups is not None else cfg.n_groups
+    records = []
+    status = "clean"
+    tel_total: dict = {}
+    cov_total = {"fault_universes": 0, "election_universes": 0,
+                 "taint_restart_universes": 0, "taint_unsafe_universes": 0,
+                 "violation_universes": 0}
+    done = 0
+    while done < universes:
+        gb = min(batch_groups, universes - done)
+        cfg_b = dataclasses.replace(
+            cfg, n_groups=gb,
+            scenario=dataclasses.replace(
+                spec, universe_base=spec.universe_base + done))
+        mut = mutator_factory(cfg_b) if mutator_factory is not None else None
+        res = run_fuzz_batch(cfg_b, n_ticks, mutator=mut)
+        for k, v in res["telemetry"].items():
+            tel_total[k] = tel_total.get(k, 0) + v
+        for k in cov_total:
+            cov_total[k] += res["coverage"][k]
+        if res["latch"] is not None:
+            if verbose:
+                print(f"LATCH: {res['summary']['inv_status']} in batch at "
+                      f"universe_base={spec.universe_base + done}")
+            shrunk = shrink_violation(cfg_b, n_ticks, res["latch"],
+                                      mutator_factory=mutator_factory)
+            art = violation_artifact(shrunk, cfg_b,
+                                     mutated=mutator_factory is not None)
+            art["replay_confirmed"] = replay_artifact(
+                art, mutator_factory=mutator_factory)
+            if triage_confirm and mutator_factory is None:
+                # Pure violations get the full triage treatment: device
+                # replay through ops/tick.make_run + explain() narrative.
+                from raft_kotlin_tpu.api.triage import triage_violation
+
+                rec = triage_violation(shrunk["config"], shrunk["latch"],
+                                       replay=True)
+                art["triage_confirmed"] = bool(rec.get("confirmed"))
+            records.append(art)
+            if status == "clean":
+                status = art["status"]
+        done += gb
+    result = {
+        "schema": CORPUS_SCHEMA,
+        "farm_seed": spec.farm_seed,
+        "universes": universes,
+        "ticks_per_universe": n_ticks,
+        "universe_ticks": universes * n_ticks,
+        "inv_status": status,
+        "violations": len(records),
+        "coverage": cov_total,
+        "telemetry": tel_total,
+        "corpus_hash": corpus_hash(records, spec.farm_seed, universes,
+                                   n_ticks),
+        "records": records,
+    }
+    if out_path is not None:
+        with open(out_path, "w") as f:
+            for line in corpus_lines(records):
+                f.write(line + "\n")
+    return result
+
+
+def smoke_spec(farm_seed: int = 12) -> ScenarioSpec:
+    """THE smoke-universe spec: mixed fault lattices + all three partition
+    program kinds — one copy shared by bench.py's gated fuzz leg and
+    scripts/probe_invariants.py's ranking probe, so the probe always ranks
+    the same universe family the bench gates on."""
+    return ScenarioSpec(
+        farm_seed=farm_seed, drop_max=0.25, crash_max=0.02, restart_max=0.2,
+        partitions=("split", "asym", "leader"),
+        part_period_lo=5, part_period_hi=40)
+
+
+def smoke_config(groups: int, farm_seed: int = 12,
+                 seed: int = 9) -> RaftConfig:
+    """The smoke-batch config over smoke_spec (see there)."""
+    return RaftConfig(n_groups=groups, n_nodes=3, log_capacity=32,
+                      cmd_period=5, seed=seed,
+                      scenario=smoke_spec(farm_seed)).stressed(10)
+
+
+# -- seeded mutations (the farm's own acceptance harness) --------------------
+
+def committed_rewrite_mutator(cfg: RaftConfig, tick: int, group: int,
+                              delta: int = 7777):
+    """A deliberately broken transition: at tick `tick`, rewrite the
+    stored content of node 1's log slot 0 in `group` — where slot 0 is
+    committed and the logs are pristine this is a Figure-8-style
+    committed rewrite, latched at exactly (tick, group) with the
+    lexicographically FIRST applicable invariant (leader_append_only when
+    node 1 is a continuing live leader, log_matching otherwise;
+    committed_prefix counts either way). Applied post-tick inside the
+    scan (make_batch_runner)."""
+    def mutate(state, t):
+        hit = (t == tick)
+        G = state.log_cmd.shape[-1]
+        C = state.log_cmd.shape[1]
+        g_hot = jnp.arange(G, dtype=_I32) == group
+        slot_hot = (jnp.arange(C, dtype=_I32) == 0)[None, :, None]
+        node_hot = (jnp.arange(state.log_cmd.shape[0], dtype=_I32)
+                    == 0)[:, None, None]
+        m = hit & (node_hot & slot_hot & g_hot[None, None, :])
+        lc = jnp.where(m, state.log_cmd + jnp.asarray(
+            delta, state.log_cmd.dtype), state.log_cmd)
+        return state.replace(log_cmd=lc)
+
+    return mutate
+
+
+def twin_leader_mutator(cfg: RaftConfig, tick: int, group: int):
+    """A deliberately broken transition: at tick `tick`, force nodes 1
+    AND 2 of `group` into LEADER at node 1's term — two live same-term
+    leaders, an election-safety violation (id 0) regardless of who the
+    group's natural leader was."""
+    from raft_kotlin_tpu.constants import LEADER
+
+    def mutate(state, t):
+        hit = (t == tick)
+        G = state.role.shape[-1]
+        g_hot = (jnp.arange(G, dtype=_I32) == group)[None, :]
+        n12 = (jnp.arange(state.role.shape[0], dtype=_I32) < 2)[:, None]
+        m = hit & (n12 & g_hot)
+        role = jnp.where(m, jnp.asarray(LEADER, state.role.dtype),
+                         state.role)
+        term = jnp.where(m, state.term[0][None], state.term)
+        up = state.up | m
+        return state.replace(role=role, term=term, up=up)
+
+    return mutate

@@ -271,6 +271,11 @@ class OracleGroup:
         self.schedule: dict[int, list[tuple[int, int]]] = {}
         # Driver fault commands: {tick: [(node_id, "crash"|"restart"), ...]}
         self.fault_schedule: dict[int, list[tuple[int, str]]] = {}
+        # Scenario bank rows for THIS group (SEMANTICS.md §12): partition
+        # programs are evaluated inside tick() (leader isolation reads the
+        # pre-phase-F roles); the fault/delay channels ride the mask fns.
+        self._scen = scenario_bank_np(cfg) if cfg.scenario is not None \
+            else None
 
     def inject(self, tick: int, node_id: int, cmd: int) -> None:
         self.schedule.setdefault(tick, []).append((node_id, cmd))
@@ -303,9 +308,27 @@ class OracleGroup:
             self.events.append({"tick": t, "phase": phase, "kind": kind, **kw})
             return True
 
+        # Scripted partition programs (SEMANTICS.md §12): the scheduled
+        # directed-link-down mask for this tick, evaluated from the
+        # PRE-phase-F roles (leader isolation isolates nodes that were live
+        # leaders at tick start) through THE shared evaluator — the same
+        # function the kernel's make_aux folds into edge_iid, so the bits
+        # agree by construction.
+        sched_down = None
+        if self._scen is not None and "part_kind" in self._scen:
+            lead = np.asarray(
+                [[n.role == LEADER and n.up for n in nodes]], dtype=bool)
+            row = {k: self._scen[k][self.g:self.g + 1]
+                   for k in self._scen if k.startswith("part_")}
+            sched_down = rngmod.scenario_link_down(
+                row, t, lead, cfg.n_nodes, xp=np)[0]
+
         def ok(s: int, r: int) -> bool:
-            # §9 effective edge health: iid survival ∧ link health ∧ both ends up.
+            # §9 effective edge health: iid survival ∧ link health ∧ both ends up
+            # ∧ not scheduled-down (§12 partition programs).
             if not (nodes[s - 1].up and nodes[r - 1].up and self.link_up[s - 1][r - 1]):
+                return False
+            if sched_down is not None and sched_down[s - 1][r - 1]:
                 return False
             if edge_ok is None:
                 return True
@@ -681,10 +704,7 @@ class OracleGroup:
         if cfg.delay_lo == cfg.delay_hi:
             lo = cfg.delay_lo
             return lambda a, b: lo
-        m = _delay_all_groups(
-            cfg.seed, tick, (cfg.n_groups, cfg.n_nodes, cfg.n_nodes),
-            cfg.delay_lo, cfg.delay_hi,
-        )[self.g]
+        m = _delay_all_groups(cfg, tick)[self.g]
         return lambda a, b: int(m[a - 1][b - 1])
 
     # -- introspection --------------------------------------------------------
@@ -743,48 +763,81 @@ def predraw(cfg: RaftConfig, groups=None, k: int | None = None):
     return out
 
 
+@functools.lru_cache(maxsize=8)
+def scenario_bank_np(cfg: RaftConfig) -> dict:
+    """The cfg's ScenarioBank (utils/rng.sample_scenario_bank) as host
+    numpy, memoized per config — the oracle-side copy of the exact arrays
+    the kernel's rng operand carries (same sampling, same bits)."""
+    import jax
+
+    bank = jax.device_get(rngmod.sample_scenario_bank(cfg))
+    return {k: np.asarray(v) for k, v in bank.items()}
+
+
+def _scen_thresh(cfg: RaftConfig, key: str):
+    """Per-group (G,) threshold channel of cfg's bank, or None."""
+    if cfg.scenario is None:
+        return None
+    return scenario_bank_np(cfg).get(key)
+
+
 @functools.lru_cache(maxsize=None)  # masks are small; groups are run sequentially
-def _edge_mask_all_groups(seed: int, tick: int, shape: tuple, p_drop: float):
-    base = rngmod.base_key(seed)
-    return np.asarray(rngmod.edge_ok_mask(base, tick, shape, p_drop))
+def _edge_mask_all_groups(cfg: RaftConfig, tick: int):
+    base = rngmod.base_key(cfg.seed)
+    shape = (cfg.n_groups, cfg.n_nodes, cfg.n_nodes)
+    return np.asarray(rngmod.edge_ok_mask(
+        base, tick, shape, cfg.p_drop, thresh=_scen_thresh(cfg, "drop_t")))
 
 
 @functools.lru_cache(maxsize=None)
-def _delay_all_groups(seed: int, tick: int, shape: tuple, lo: int, hi: int):
-    base = rngmod.base_key(seed)
-    return np.asarray(rngmod.delay_mask(base, tick, shape, lo, hi))
+def _delay_all_groups(cfg: RaftConfig, tick: int):
+    base = rngmod.base_key(cfg.seed)
+    shape = (cfg.n_groups, cfg.n_nodes, cfg.n_nodes)
+    lo_g = hi_g = None
+    if cfg.scenario is not None:
+        bank = scenario_bank_np(cfg)
+        if "delay_lo" in bank:
+            import jax.numpy as jnp
+
+            lo_g = jnp.asarray(bank["delay_lo"])
+            hi_g = jnp.asarray(bank["delay_hi"])
+    return np.asarray(rngmod.delay_mask(
+        base, tick, shape, cfg.delay_lo, cfg.delay_hi, lo_g=lo_g, hi_g=hi_g))
 
 
 @functools.lru_cache(maxsize=None)
-def _fault_masks_all_groups(seed: int, tick: int, G: int, N: int, p_crash: float,
-                            p_restart: float, p_link_fail: float, p_link_heal: float):
-    base = rngmod.base_key(seed)
+def _fault_masks_all_groups(cfg: RaftConfig, tick: int):
+    base = rngmod.base_key(cfg.seed)
+    G, N = cfg.n_groups, cfg.n_nodes
     return {
-        "crash": np.asarray(rngmod.event_mask(base, rngmod.KIND_CRASH, tick, (G, N), p_crash)),
-        "restart": np.asarray(
-            rngmod.event_mask(base, rngmod.KIND_RESTART, tick, (G, N), p_restart)
-        ),
-        "link_fail": np.asarray(
-            rngmod.event_mask(base, rngmod.KIND_LINK_FAIL, tick, (G, N, N), p_link_fail)
-        ),
-        "link_heal": np.asarray(
-            rngmod.event_mask(base, rngmod.KIND_LINK_HEAL, tick, (G, N, N), p_link_heal)
-        ),
+        "crash": np.asarray(rngmod.event_mask(
+            base, rngmod.KIND_CRASH, tick, (G, N), cfg.p_crash,
+            thresh=_scen_thresh(cfg, "crash_t"))),
+        "restart": np.asarray(rngmod.event_mask(
+            base, rngmod.KIND_RESTART, tick, (G, N), cfg.p_restart,
+            thresh=_scen_thresh(cfg, "restart_t"))),
+        "link_fail": np.asarray(rngmod.event_mask(
+            base, rngmod.KIND_LINK_FAIL, tick, (G, N, N), cfg.p_link_fail,
+            thresh=_scen_thresh(cfg, "link_fail_t"))),
+        "link_heal": np.asarray(rngmod.event_mask(
+            base, rngmod.KIND_LINK_HEAL, tick, (G, N, N), cfg.p_link_heal,
+            thresh=_scen_thresh(cfg, "link_heal_t"))),
     }
 
 
 def make_faults_fn(cfg: RaftConfig, group: int):
     """Per-tick §9 fault-event masks for one group, sliced from the canonical shaped
-    draws so they match the kernel's bit-for-bit (same pattern as make_edge_ok_fn)."""
+    draws so they match the kernel's bit-for-bit (same pattern as make_edge_ok_fn).
+    Scenario banks (§12) route their per-group threshold channels through the
+    same shared draw helpers."""
+    spec = cfg.scenario
     if not (cfg.p_crash > 0 or cfg.p_restart > 0
-            or cfg.p_link_fail > 0 or cfg.p_link_heal > 0):
+            or cfg.p_link_fail > 0 or cfg.p_link_heal > 0
+            or (spec is not None and (spec.has_faults or spec.has_links))):
         return None
 
     def fn(tick: int):
-        m = _fault_masks_all_groups(
-            cfg.seed, tick, cfg.n_groups, cfg.n_nodes,
-            cfg.p_crash, cfg.p_restart, cfg.p_link_fail, cfg.p_link_heal,
-        )
+        m = _fault_masks_all_groups(cfg, tick)
         return {k: v[group] for k, v in m.items()}
 
     return fn
@@ -794,11 +847,10 @@ def make_edge_ok_fn(cfg: RaftConfig, group: int):
     """Per-tick (N, N) edge mask for one group, sliced from the canonical shaped draw
     (SEMANTICS.md §4) so it matches the kernel's (G, N, N) mask exactly. The full-grid
     draw is memoized per tick, so running all G oracle groups computes it once."""
-    if cfg.p_drop <= 0.0:
+    if cfg.p_drop <= 0.0 and _scen_thresh(cfg, "drop_t") is None:
         return None
-    shape = (cfg.n_groups, cfg.n_nodes, cfg.n_nodes)
 
     def fn(tick: int):
-        return _edge_mask_all_groups(cfg.seed, tick, shape, cfg.p_drop)[group]
+        return _edge_mask_all_groups(cfg, tick)[group]
 
     return fn

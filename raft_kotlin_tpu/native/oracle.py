@@ -81,6 +81,11 @@ class _Inputs(ct.Structure):
         ("edge_ok", _U8P), ("crash_m", _U8P), ("restart_m", _U8P),
         ("link_fail", _U8P), ("link_heal", _U8P),
         ("inject", _I32P), ("fault_cmd", _U8P), ("delay", _I32P),
+        # §12 leader-isolation partition windows: [T][G] u8, 1 = every edge
+        # touching a node that was a live leader at tick start is down this
+        # tick (the one scenario channel that cannot be precomputed into
+        # edge_ok — it depends on per-tick state the engine itself holds).
+        ("leader_iso", _U8P),
     ]
 
 
@@ -141,7 +146,7 @@ def _lib() -> ct.CDLL:
             ct.POINTER(_Dims), ct.POINTER(_State), ct.POINTER(_Inputs),
             ct.POINTER(_Trace),
         ]
-        assert lib.raft_abi_version() == 2
+        assert lib.raft_abi_version() == 3
         _lib_handle = lib
     return _lib_handle
 
@@ -173,13 +178,24 @@ def _draw_tables(cfg: RaftConfig, kind: int, K: int, lo: int, hi: int) -> np.nda
 
 
 def _tick_masks(cfg: RaftConfig, t0: int, T: int) -> Dict[str, Optional[np.ndarray]]:
-    """Per-tick §4/§9 masks for ticks [t0, t0+T), shaped (T, ...); None when off."""
+    """Per-tick §4/§9/§12 masks for ticks [t0, t0+T), shaped (T, ...); None
+    when off. Scenario banks (cfg.scenario) route their per-group threshold
+    channels through the same shared rng helpers, and tick-scheduled
+    partition programs (split/asym — everything except leader isolation)
+    fold into edge_ok up front; leader-isolation windows ride the separate
+    (T, G) leader_iso channel the C++ engine evaluates against its own
+    pre-phase-F roles."""
     import jax
     import jax.numpy as jnp
 
     base = rngmod.base_key(cfg.seed)
     G, N = cfg.n_groups, cfg.n_nodes
     ticks = jnp.arange(t0, t0 + T, dtype=jnp.int32)
+    scen = {}
+    if cfg.scenario is not None:
+        from raft_kotlin_tpu.models.oracle import scenario_bank_np
+
+        scen = scenario_bank_np(cfg)
 
     def stack(fn):
         return np.ascontiguousarray(
@@ -189,29 +205,68 @@ def _tick_masks(cfg: RaftConfig, t0: int, T: int) -> Dict[str, Optional[np.ndarr
     out: Dict[str, Optional[np.ndarray]] = {
         "edge_ok": None, "crash_m": None, "restart_m": None,
         "link_fail": None, "link_heal": None, "delay": None,
+        "leader_iso": None,
     }
     if cfg.uses_mailbox and cfg.delay_lo < cfg.delay_hi:
+        lo_g = jnp.asarray(scen["delay_lo"]) if "delay_lo" in scen else None
+        hi_g = jnp.asarray(scen["delay_hi"]) if "delay_hi" in scen else None
         out["delay"] = np.ascontiguousarray(np.asarray(
             jax.jit(lambda: jax.lax.map(
                 lambda t: rngmod.delay_mask(base, t, (G, N, N),
-                                            cfg.delay_lo, cfg.delay_hi),
+                                            cfg.delay_lo, cfg.delay_hi,
+                                            lo_g=lo_g, hi_g=hi_g),
                 ticks))(), dtype=np.int32))
-    if cfg.p_drop > 0:
-        out["edge_ok"] = stack(
-            lambda t: rngmod.edge_ok_mask(base, t, (G, N, N), cfg.p_drop))
-    if cfg.p_crash > 0 or cfg.p_restart > 0:
+    has_parts = "part_kind" in scen
+    if cfg.p_drop > 0 or "drop_t" in scen or has_parts:
+        drop_t = jnp.asarray(scen["drop_t"]) if "drop_t" in scen else None
+
+        def edge_fn(t):
+            e = rngmod.edge_ok_mask(base, t, (G, N, N), cfg.p_drop,
+                                    thresh=drop_t)
+            if has_parts:
+                # Tick-scheduled programs fold here; leader-isolation
+                # groups contribute nothing (leader_gn=None) and route
+                # through the leader_iso channel below instead.
+                e = e & ~rngmod.scenario_link_down(scen, t, None, N)
+            return e
+
+        out["edge_ok"] = stack(edge_fn)
+    if has_parts:
+        from raft_kotlin_tpu.utils.config import PART_LEADER
+
+        if bool(np.any(scen["part_kind"] == PART_LEADER)):
+            # The SAME §12 flapping-window formula as scenario_link_down
+            # (rng.scenario_active), evaluated for all T ticks at once.
+            act = rngmod.scenario_active(
+                scen, np.arange(t0, t0 + T)[:, None])
+            out["leader_iso"] = np.ascontiguousarray(
+                (act & (scen["part_kind"][None] == PART_LEADER))
+                .astype(np.uint8))
+    if cfg.p_crash > 0 or cfg.p_restart > 0 or "crash_t" in scen \
+            or "restart_t" in scen:
+        crash_t = jnp.asarray(scen["crash_t"]) if "crash_t" in scen else None
+        restart_t = jnp.asarray(scen["restart_t"]) \
+            if "restart_t" in scen else None
         out["crash_m"] = stack(
-            lambda t: rngmod.event_mask(base, rngmod.KIND_CRASH, t, (G, N), cfg.p_crash))
+            lambda t: rngmod.event_mask(base, rngmod.KIND_CRASH, t, (G, N),
+                                        cfg.p_crash, thresh=crash_t))
         out["restart_m"] = stack(
             lambda t: rngmod.event_mask(base, rngmod.KIND_RESTART, t, (G, N),
-                                        cfg.p_restart))
-    if cfg.p_link_fail > 0 or cfg.p_link_heal > 0:
+                                        cfg.p_restart, thresh=restart_t))
+    if cfg.p_link_fail > 0 or cfg.p_link_heal > 0 or "link_fail_t" in scen \
+            or "link_heal_t" in scen:
+        lf_t = jnp.asarray(scen["link_fail_t"]) \
+            if "link_fail_t" in scen else None
+        lh_t = jnp.asarray(scen["link_heal_t"]) \
+            if "link_heal_t" in scen else None
         out["link_fail"] = stack(
-            lambda t: rngmod.event_mask(base, rngmod.KIND_LINK_FAIL, t, (G, N, N),
-                                        cfg.p_link_fail))
+            lambda t: rngmod.event_mask(base, rngmod.KIND_LINK_FAIL, t,
+                                        (G, N, N), cfg.p_link_fail,
+                                        thresh=lf_t))
         out["link_heal"] = stack(
-            lambda t: rngmod.event_mask(base, rngmod.KIND_LINK_HEAL, t, (G, N, N),
-                                        cfg.p_link_heal))
+            lambda t: rngmod.event_mask(base, rngmod.KIND_LINK_HEAL, t,
+                                        (G, N, N), cfg.p_link_heal,
+                                        thresh=lh_t))
     return out
 
 
@@ -294,6 +349,7 @@ class NativeOracle:
                 inject=_ptr(inject, _I32P),
                 fault_cmd=_ptr(fault_cmd, _U8P),
                 delay=_ptr(masks["delay"], _I32P),
+                leader_iso=_ptr(masks["leader_iso"], _U8P),
             )
             trace_s = _Trace(**({k: _ptr(tr[k], _I32P) for k in TRACE_FIELDS}
                                 if trace else {}))

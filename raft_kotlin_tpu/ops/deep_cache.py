@@ -212,9 +212,9 @@ def make_deep_scan(cfg, n_ticks: int, return_state: bool = False,
     N, G = cfg.n_nodes, cfg.n_groups
 
     def fc_tick(state, fc, rng):
-        base, tkeys, bkeys = rng
+        base, tkeys, bkeys, scen = tick_mod.split_rng(rng)
         aux, flags = tick_mod.make_aux(cfg, base, tkeys, bkeys, state,
-                                       None, None)
+                                       None, None, scen=scen)
         assert flags.batched, "make_deep_scan needs a batched-engine config"
         s = tick_mod.flatten_state(cfg, state)
         fc = dict(fc)
@@ -367,20 +367,16 @@ def _sharded_default_rng(cfg, mesh):
     placement (init_sharded's pattern — a host-side make_rng + device_put
     would raise on a multi-process mesh). Shared by every sharded runner
     here so the out_shardings contract lives in exactly one place."""
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
     from raft_kotlin_tpu.ops import tick as tick_mod
+    from raft_kotlin_tpu.parallel import mesh as mesh_mod
 
-    lanes = P(None, ("dcn", "ici"))
     memo: list = []
 
     def default_rng():
         if not memo:
             memo.append(jax.jit(
                 lambda: tick_mod.make_rng(cfg),
-                out_shardings=(NamedSharding(mesh, P()),
-                               NamedSharding(mesh, lanes),
-                               NamedSharding(mesh, lanes)))())
+                out_shardings=mesh_mod.rng_shardings(cfg, mesh))())
         return memo[0]
 
     return default_rng
@@ -558,9 +554,9 @@ def make_sharded_deep_scan(cfg, mesh, n_ticks: int,
         return dict(zip(FC, outs))
 
     def tick_fc(state, fc, rng):
-        base, tkeys, bkeys = rng
+        base, tkeys, bkeys, scen = tick_mod.split_rng(rng)
         aux, flags2 = tick_mod.make_aux(cfg, base, tkeys, bkeys, state,
-                                        None, None)
+                                        None, None, scen=scen)
         aux_names = tuple(k for k in tick_mod.AUX_FIELDS if k in aux)
         flat = tick_mod.flatten_state(cfg, state)
         n_s, n_a = len(sfields), len(aux_names)

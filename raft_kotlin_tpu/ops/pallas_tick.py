@@ -615,7 +615,8 @@ def _make_fused_core(cfg: RaftConfig, lanes: int, tile_g: int,
 
 
 def fused_launch_aux(cfg: RaftConfig, base, tkeys, bkeys, tick0, t_ctr,
-                     b_ctr, T: int, resets_bound: Optional[int] = None):
+                     b_ctr, T: int, resets_bound: Optional[int] = None,
+                     scen: Optional[dict] = None):
     """The XLA pre-pass of one fused launch: draw the T per-tick aux dicts
     (ops/tick.make_aux over a shim state — every draw is derivable from
     the pre-launch counters and the tick index) plus the counter-keyed
@@ -633,10 +634,13 @@ def fused_launch_aux(cfg: RaftConfig, base, tkeys, bkeys, tick0, t_ctr,
 
     per, flags = [], None
     for k in range(T):
+        # Stateless shim: a leader-isolation bank cannot run fused (the
+        # per-tick roles are unknown at launch) — resolve_fused_geometry
+        # gates that statically; make_aux raises if it slips through.
         shim = types.SimpleNamespace(tick=tick0 + k, t_ctr=t_ctr,
                                      b_ctr=b_ctr)
         aux_k, flags = tick_mod.make_aux(cfg, base, tkeys, bkeys, shim,
-                                         None, None)
+                                         None, None, scen=scen)
         per.append(aux_k)
     tabs = draw_tables(cfg, tkeys, bkeys, t_ctr, b_ctr, T,
                        resets_bound=resets_bound)
@@ -763,10 +767,10 @@ def make_pallas_tick(cfg: RaftConfig, tile_g: Optional[int] = None,
                     with jax.ensure_compile_time_eval():
                         default_rng.append(tick_mod.make_rng(cfg))
                 rng = default_rng[0]
-            base, tkeys, bkeys = rng
+            base, tkeys, bkeys, scen = tick_mod.split_rng(rng)
             per, flags, (el_tab, b_tab) = fused_launch_aux(
                 cfg, base, tkeys, bkeys, state.tick, state.t_ctr,
-                state.b_ctr, T_f)
+                state.b_ctr, T_f, scen=scen)
             call, sfields, aux_names, _snaps = build_call_f(flags)
             flat = tick_mod.flatten_state(cfg, state)
             outs = call(*(cast_flat_in(flat, {}, sfields, ())
@@ -807,9 +811,9 @@ def make_pallas_tick(cfg: RaftConfig, tile_g: Optional[int] = None,
                 with jax.ensure_compile_time_eval():
                     default_rng.append(tick_mod.make_rng(cfg))
             rng = default_rng[0]
-        base, tkeys, bkeys = rng
+        base, tkeys, bkeys, scen = tick_mod.split_rng(rng)
         aux, flags = tick_mod.make_aux(
-            cfg, base, tkeys, bkeys, state, inject, fault_cmd)
+            cfg, base, tkeys, bkeys, state, inject, fault_cmd, scen=scen)
         call, sfields, aux_names = build_call(flags)
         flat = tick_mod.flatten_state(cfg, state)
         outs = call(*cast_flat_in(flat, aux, sfields, aux_names))
@@ -1086,6 +1090,17 @@ def resolve_fused_geometry(cfg: RaftConfig,
         interpret = jax.default_backend() == "cpu"
     if platform is None:
         platform = "cpu" if interpret else None
+    if cfg.scenario is not None and cfg.scenario.needs_state:
+        # Leader-isolation partition programs (SEMANTICS.md §12) read the
+        # PRE-TICK roles per tick; the fused kernel precomputes all T aux
+        # dicts at launch, before those roles exist. Routed T falls back
+        # sticky to 1; a pinned T is a demand and raises.
+        if fused_ticks is not None and fused_ticks > 1:
+            raise ValueError(
+                "fused_ticks > 1 cannot run a leader-isolation scenario "
+                "bank (cfg.scenario.needs_state): per-tick aux depends on "
+                "pre-tick state the fused launch cannot see")
+        fused_ticks = 1
     if fused_ticks is None:
         try:
             base = tile_g if tile_g is not None else \
@@ -1212,6 +1227,14 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
     if K > 1:
+        if cfg.scenario is not None and cfg.scenario.needs_state:
+            # Same static gate as resolve_fused_geometry: the archival
+            # K-tick kernel precomputes aux from a stateless shim, which
+            # leader-isolation banks (§12) cannot feed.
+            raise ValueError(
+                "k_per_launch > 1 cannot run a leader-isolation scenario "
+                "bank (cfg.scenario.needs_state): per-tick aux depends on "
+                "pre-tick state the K-tick launch cannot see")
         T_f = 1
         tile_g, ilp_subtiles = resolve_scan_geometry(
             cfg, interpret, K, tile_g, ilp_subtiles)
@@ -1260,7 +1283,7 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
         n_launch, rem = 0, n_ticks
 
     def run(state: RaftState, rng):
-        base, tkeys, bkeys = rng
+        base, tkeys, bkeys, scen = tick_mod.split_rng(rng)
         flat = tick_mod.flatten_state(cfg, state)
         # One-time entry casts (the per-tick cost this runner removes): the
         # scan carries the i32 kernel form; storage dtypes return at exit.
@@ -1270,10 +1293,13 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
 
         def body(carry, _):
             s, t, tel, mon = carry
+            # The flat carry holds the real pre-tick rows, so the shim
+            # carries role/up too — leader-isolation banks work at T=1.
             shim = types.SimpleNamespace(
-                tick=t, t_ctr=s["t_ctr"], b_ctr=s["b_ctr"])
+                tick=t, t_ctr=s["t_ctr"], b_ctr=s["b_ctr"],
+                role=s["role"], up=s["up"])
             aux, flags = tick_mod.make_aux(
-                cfg, base, tkeys, bkeys, shim, None, None)
+                cfg, base, tkeys, bkeys, shim, None, None, scen=scen)
             call, sfields, aux_names = build_call(flags)
             with telemetry_mod.engine_scope("pallas"):
                 outs = call(*([s[k] for k in sfields]
@@ -1304,7 +1330,7 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
                 shim = types.SimpleNamespace(
                     tick=t + k, t_ctr=s["t_ctr"], b_ctr=s["b_ctr"])
                 aux_k, flags = tick_mod.make_aux(
-                    cfg, base, tkeys, bkeys, shim, None, None)
+                    cfg, base, tkeys, bkeys, shim, None, None, scen=scen)
                 per.append(aux_k)
             call, sfields_k, aux_names = build_call_k(flags)
             slabs = [jnp.concatenate(
@@ -1329,7 +1355,7 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
             s, t, tel, mon = carry
             per, flags, (el_tab, b_tab) = fused_launch_aux(
                 cfg, base, tkeys, bkeys, t, s["t_ctr"], s["b_ctr"], T_f,
-                resets_bound=_resets_bound)
+                resets_bound=_resets_bound, scen=scen)
             call, sfields_f, aux_names, snaps = build_call_f(flags)
             with telemetry_mod.engine_scope("pallas-fused"):
                 outs = call(*([s[k] for k in sfields_f]

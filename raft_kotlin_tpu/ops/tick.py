@@ -1854,15 +1854,33 @@ def _phase_lattice(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
     return aux_dirty["m"]
 
 
+def split_rng(rng):
+    """Normalize an rng operand to (base, tkeys, bkeys, scen): classical
+    3-tuples (every pre-scenario caller, and make_rng without a scenario)
+    carry an empty bank. THE one unpack idiom — every engine routes its
+    rng operand through here so the scenario bank reaches make_aux on all
+    of them."""
+    if len(rng) == 3:
+        base, tkeys, bkeys = rng
+        return base, tkeys, bkeys, {}
+    return rng
+
+
 def make_flags(cfg: RaftConfig, inject_present: bool = False,
                fault_present: bool = False, batched: Optional[bool] = None,
                sharded: bool = False) -> BodyFlags:
     """The BodyFlags a tick over `cfg` compiles with (shared by make_aux and
-    the multi-tick flat-carry runner, which needs the field set up front)."""
+    the multi-tick flat-carry runner, which needs the field set up front).
+    Scenario banks (cfg.scenario) compile the fault/link phases in when the
+    spec carries the corresponding channels — a static property of the
+    config, so every engine resolves the same flags."""
     dyn = cfg.uses_dyn_log
+    spec = cfg.scenario
     return BodyFlags(
-        faults=cfg.p_crash > 0 or cfg.p_restart > 0 or fault_present,
-        links=cfg.p_link_fail > 0 or cfg.p_link_heal > 0,
+        faults=cfg.p_crash > 0 or cfg.p_restart > 0 or fault_present
+        or (spec is not None and spec.has_faults),
+        links=cfg.p_link_fail > 0 or cfg.p_link_heal > 0
+        or (spec is not None and spec.has_links),
         periodic=cfg.cmd_period > 0,
         inject=inject_present,
         delay=cfg.uses_mailbox,
@@ -1881,7 +1899,7 @@ def make_flags(cfg: RaftConfig, inject_present: bool = False,
 
 def make_aux(cfg: RaftConfig, base, tkeys, bkeys, state: RaftState,
              inject, fault_cmd, batched: Optional[bool] = None,
-             sharded: bool = False):
+             sharded: bool = False, scen: Optional[dict] = None):
     """Draw/assemble the phase_body aux inputs from pre-tick state (XLA ops).
 
     Randomness is drawn in the canonical (G, ...) §4 shapes and transposed, so no
@@ -1890,25 +1908,55 @@ def make_aux(cfg: RaftConfig, base, tkeys, bkeys, state: RaftState,
     BodyFlags.batched); None = automatic (batched whenever dyn and no mailbox).
     `sharded=True` marks an actually-sharded run (parallel/mesh): the per-pair
     dyn engine then uses the flat log layout (BodyFlags.sharded).
-    """
+
+    `scen` is the per-group ScenarioBank (SEMANTICS.md §12; rides the rng
+    operand — split_rng): per-group fault thresholds replace the scalar
+    probabilities channel-by-channel, per-group delay windows replace the
+    scalar window, and scripted partition programs fold into edge_iid as
+    time-windowed directed-link masks — all HERE, so phase_body and the
+    Mosaic kernel never see a scenario at all. Leader-isolation programs
+    read the PRE-TICK roles from `state` (engines that feed a stateless
+    shim cannot run them and must fall back — cfg.scenario.needs_state)."""
     G, N = cfg.n_groups, cfg.n_nodes
     t = state.tick
+    scen = scen or {}
     aux = {}
     flags = make_flags(cfg, inject_present=inject is not None,
                        fault_present=fault_cmd is not None,
                        batched=batched, sharded=sharded)
     if flags.delay and cfg.delay_lo < cfg.delay_hi:
         aux["delay"] = rngmod.delay_mask(
-            base, t, (G, N, N), cfg.delay_lo, cfg.delay_hi
+            base, t, (G, N, N), cfg.delay_lo, cfg.delay_hi,
+            lo_g=scen.get("delay_lo"), hi_g=scen.get("delay_hi")
         ).transpose(1, 2, 0).reshape(N * N, G).astype(jnp.int16)
-    aux["edge_iid"] = rngmod.edge_ok_mask(
-        base, t, (G, N, N), cfg.p_drop
-    ).transpose(1, 2, 0).reshape(N * N, G).astype(jnp.int16)
+    edge = rngmod.edge_ok_mask(
+        base, t, (G, N, N), cfg.p_drop, thresh=scen.get("drop_t"))
+    if "part_kind" in scen:
+        # Scripted partitions (§12): evaluated on the canonical (G, N, N)
+        # orientation BEFORE the kernel transpose, from pre-tick state.
+        role = getattr(state, "role", None)
+        up = getattr(state, "up", None)
+        if cfg.scenario is not None and cfg.scenario.needs_state \
+                and role is None:
+            raise RuntimeError(
+                "leader-isolation partition programs need the pre-tick "
+                "state (cfg.scenario.needs_state) — this engine feeds a "
+                "stateless aux shim and must fall back")
+        lead = None
+        if role is not None:
+            # (N, G) state rows -> canonical (G, N); up may be an int
+            # stand-in on the flat carry.
+            lead = ((role == LEADER) & (up != 0)).T
+        edge = edge & ~rngmod.scenario_link_down(scen, t, lead, N)
+    aux["edge_iid"] = edge.transpose(1, 2, 0).reshape(N * N, G) \
+        .astype(jnp.int16)
     if flags.faults:
         crash_m = rngmod.event_mask(
-            base, rngmod.KIND_CRASH, t, (G, N), cfg.p_crash).T
+            base, rngmod.KIND_CRASH, t, (G, N), cfg.p_crash,
+            thresh=scen.get("crash_t")).T
         restart_m = rngmod.event_mask(
-            base, rngmod.KIND_RESTART, t, (G, N), cfg.p_restart).T
+            base, rngmod.KIND_RESTART, t, (G, N), cfg.p_restart,
+            thresh=scen.get("restart_t")).T
         if fault_cmd is not None:
             crash_m = crash_m | (fault_cmd.T == 1)
             restart_m = restart_m | (fault_cmd.T == 2)
@@ -1917,10 +1965,12 @@ def make_aux(cfg: RaftConfig, base, tkeys, bkeys, state: RaftState,
             tkeys, state.t_ctr, cfg.el_lo, cfg.el_hi).astype(jnp.int16)
     if flags.links:
         aux["link_fail"] = rngmod.event_mask(
-            base, rngmod.KIND_LINK_FAIL, t, (G, N, N), cfg.p_link_fail
+            base, rngmod.KIND_LINK_FAIL, t, (G, N, N), cfg.p_link_fail,
+            thresh=scen.get("link_fail_t")
         ).transpose(1, 2, 0).reshape(N * N, G).astype(jnp.int16)
         aux["link_heal"] = rngmod.event_mask(
-            base, rngmod.KIND_LINK_HEAL, t, (G, N, N), cfg.p_link_heal
+            base, rngmod.KIND_LINK_HEAL, t, (G, N, N), cfg.p_link_heal,
+            thresh=scen.get("link_heal_t")
         ).transpose(1, 2, 0).reshape(N * N, G).astype(jnp.int16)
     aux["bdraw"] = rngmod.draw_uniform_keyed(
         bkeys, state.b_ctr, cfg.bo_lo, cfg.bo_hi).astype(jnp.int16)
@@ -1984,7 +2034,15 @@ def finish_tick(cfg: RaftConfig, tkeys, s: dict, el_dirty, t):
 
 def make_rng(cfg: RaftConfig):
     """The per-simulation RNG operands: (base key, timeout key grid, backoff key
-    grid). Static key prefixes are computed once per simulation (rng.grid_keys):
+    grid[, scenario bank]). When cfg.scenario is set, the per-group
+    ScenarioBank (utils/rng.sample_scenario_bank — keyed by the spec's
+    farm_seed/universe_base, NOT cfg.seed) rides the tuple as a 4th
+    element, reaching every engine's make_aux through the existing rng
+    operand plumbing: bank VALUES are runtime operands, so same-spec-shape
+    configs share one compilation. Classical configs keep the 3-tuple
+    (split_rng normalizes).
+
+    Static key prefixes are computed once per simulation (rng.grid_keys):
     the per-draw cost inside the tick drops to fold_in(counter) + randint.
     grid_keys is (G, N) canonical; transposed here so keyed draws line up with
     (N, G) counter grids (the derivation is per-element, so the draw bits are
@@ -1999,6 +2057,8 @@ def make_rng(cfg: RaftConfig):
     N = cfg.n_nodes
     tkeys = rngmod.grid_keys(base, rngmod.KIND_TIMEOUT, cfg.n_groups, N).T
     bkeys = rngmod.grid_keys(base, rngmod.KIND_BACKOFF, cfg.n_groups, N).T
+    if cfg.scenario is not None:
+        return base, tkeys, bkeys, rngmod.sample_scenario_bank(cfg)
     return base, tkeys, bkeys
 
 
@@ -2042,9 +2102,9 @@ def make_tick(cfg: RaftConfig, batched: Optional[bool] = None,
                 with jax.ensure_compile_time_eval():
                     default_rng.append(make_rng(cfg))
             rng = default_rng[0]
-        base, tkeys, bkeys = rng
+        base, tkeys, bkeys, scen = split_rng(rng)
         aux, flags = make_aux(cfg, base, tkeys, bkeys, state, inject, fault_cmd,
-                              batched=batched, sharded=sharded)
+                              batched=batched, sharded=sharded, scen=scen)
         s = flatten_state(cfg, state)
         el_dirty = phase_body(cfg, s, aux, flags)
         return finish_tick(cfg, tkeys, unflatten_state(cfg, s), el_dirty, state.tick)
@@ -2067,7 +2127,9 @@ def make_run(cfg: RaftConfig, n_ticks: int, trace: bool = True, impl: str = "xla
     telemetry=True additionally threads the scan-carry flight recorder
     (utils/telemetry.py — scalar counters, read back once);
     monitor=True threads the scan-carry safety-invariant monitor (Figure-3
-    checks + first-violation latch + history ring, finalized form). The
+    checks + first-violation latch + history ring, finalized form; the
+    fuzzing farm's per-GROUP stress channel needs the RAW carry and runs
+    its own scan — api/fuzz.make_batch_runner). The
     return grows accordingly: (state, trace[, telemetry][, monitor]) —
     protocol bits are unchanged either way (both only read the states the
     scan already carries).

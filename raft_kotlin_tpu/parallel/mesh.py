@@ -132,6 +132,23 @@ def route_deep_engine(C: int, g_shard: int,
     return best[3]
 
 
+def rng_shardings(cfg: RaftConfig, mesh: Mesh):
+    """NamedShardings for the make_rng(cfg) operand tuple, derived from its
+    own eval_shape so the scenario bank (per-group (G,) arrays, present
+    when cfg.scenario is set) shards over groups exactly like the key
+    grids: rank-0 leaves replicate, (G,) leaves shard on the flat mesh,
+    (N, G) leaves shard on their last axis. THE one copy of the rng
+    placement contract (make_sharded_run and the deep sharded runners)."""
+    from raft_kotlin_tpu.ops.tick import make_rng
+
+    rep = NamedSharding(mesh, P())
+    lanes1 = NamedSharding(mesh, P(("dcn", "ici")))
+    lanes2 = NamedSharding(mesh, P(None, ("dcn", "ici")))
+    shapes = jax.eval_shape(lambda: make_rng(cfg))
+    return jax.tree_util.tree_map(
+        lambda s: {0: rep, 1: lanes1, 2: lanes2}[len(s.shape)], shapes)
+
+
 def make_mesh(devices: Optional[Sequence[jax.Device]] = None,
               dcn: Optional[int] = None) -> Mesh:
     """Build the canonical ("dcn", "ici") mesh over `devices` (default: all).
@@ -292,13 +309,13 @@ def _make_shardmap_pallas_tick(cfg: RaftConfig, mesh: Mesh,
                                         tick_states=snap_fields)
 
         def tick_fused(state: RaftState, rng):
-            base, tkeys, bkeys = rng
+            base, tkeys, bkeys, scen = tick_mod.split_rng(rng)
             # The aux/draw-table pre-pass is THE shared fused assembly
             # (fused_launch_aux/fused_aux_slabs — one copy of the
             # outside-the-kernel half of the bit-compat contract).
             per, flags, (el_tab, b_tab) = fused_launch_aux(
                 cfg, base, tkeys, bkeys, state.tick, state.t_ctr,
-                state.b_ctr, T_f)
+                state.b_ctr, T_f, scen=scen)
             call, sfields, aux_names, snaps = build_call_f(flags)
             flat = tick_mod.flatten_state(cfg, state)
             ins = cast_flat_in(flat, {}, sfields, ()) \
@@ -328,8 +345,9 @@ def _make_shardmap_pallas_tick(cfg: RaftConfig, mesh: Mesh,
                                   subtiles=sub_k)
 
     def tick(state: RaftState, rng) -> RaftState:
-        base, tkeys, bkeys = rng
-        aux, flags = tick_mod.make_aux(cfg, base, tkeys, bkeys, state, None, None)
+        base, tkeys, bkeys, scen = tick_mod.split_rng(rng)
+        aux, flags = tick_mod.make_aux(cfg, base, tkeys, bkeys, state,
+                                       None, None, scen=scen)
         call, sfields, aux_names = build_call(flags)
         flat = tick_mod.flatten_state(cfg, state)
         ins = cast_flat_in(flat, aux, sfields, aux_names)
@@ -400,10 +418,10 @@ def _make_shardmap_xla_tick(cfg: RaftConfig, mesh: Mesh,
     batched_arg: Optional[bool] = None if batched else False
 
     def tick(state: RaftState, rng) -> RaftState:
-        base, tkeys, bkeys = rng
+        base, tkeys, bkeys, scen = tick_mod.split_rng(rng)
         aux, flags = tick_mod.make_aux(cfg, base, tkeys, bkeys, state,
                                        None, None, batched=batched_arg,
-                                       sharded=not batched)
+                                       sharded=not batched, scen=scen)
         sfields = tick_mod.state_fields(flags)
         aux_names = tuple(k for k in tick_mod.AUX_FIELDS if k in aux)
         flat = tick_mod.flatten_state(cfg, state)
@@ -505,10 +523,10 @@ def make_sharded_run(cfg: RaftConfig, mesh: Mesh, n_ticks: int,
         tick_fn = lambda st, rng: xla_tick(st, rng=rng)
     sh = state_sharding(mesh, cfg)
     rep = NamedSharding(mesh, P())
-    # rng operand shardings: base key replicated; (N, G) key grids sharded on
-    # the groups axis like every state array.
-    keys_sh = NamedSharding(mesh, P(None, ("dcn", "ici")))
-    rng_sh = (rep, keys_sh, keys_sh)
+    # rng operand shardings: base key replicated; (N, G) key grids sharded
+    # on the groups axis like every state array; scenario-bank (G,) arrays
+    # (when cfg.scenario) sharded over groups (rng_shardings).
+    rng_sh = rng_shardings(cfg, mesh)
     # rng computed straight into its mesh placement (init_sharded's pattern):
     # a host-side make_rng + device_put to these shardings would raise on a
     # multi-process mesh, where the shardings span non-addressable devices

@@ -11,6 +11,110 @@ backoff 2_000..3_000 ms (RaftServer.kt:221).
 from __future__ import annotations
 
 import dataclasses
+from typing import Optional
+
+
+# Canonical partition-program kind codes (utils/rng.scenario_link_down —
+# shared verbatim by kernel aux assembly, Python oracle and native engine).
+PART_NONE, PART_SPLIT, PART_ASYM, PART_LEADER = 0, 1, 2, 3
+PART_KINDS = ("split", "asym", "leader")
+
+
+@dataclasses.dataclass(frozen=True)
+class ScenarioSpec:
+    """Per-group scenario heterogeneity (the fuzzing-farm bank, SEMANTICS.md
+    §12). When `RaftConfig.scenario` is set, `ops/tick.make_rng` samples a
+    ScenarioBank — per-group fault thresholds, delay windows and partition
+    programs — from a counted threefry stream keyed by
+    (farm_seed, universe_id = universe_base + group), so every group is a
+    distinct, reproducible universe and the bank rides the rng operand
+    (seed- and universe-independent compilation). The spec itself is static
+    and hashable: it is part of the config, so a replay artifact is just
+    the config dict.
+
+    Channels (each sampled per group, uniform over its integer domain):
+    - drop/crash/restart/link_fail/link_heal: per-group 23-bit uint32
+      probability thresholds on [0, p_threshold(<ch>_max)] (utils/rng —
+      integer-exact across oracle and kernels; <ch>_max = 0 disables).
+    - delay_windows: per-group [lo, hi] delay windows sampled WITHIN the
+      run's mailbox window [delay_lo, delay_hi] (requires delay_lo <
+      delay_hi; the run's regime — known-delivery etc. — is preserved).
+    - partitions: the enabled scripted partition-program kinds, a subset
+      of PART_KINDS; each group draws one program (or none) with
+      flapping window (period, duty, phase) — see utils/rng.
+      "leader" programs read the PRE-TICK roles, so they are unavailable
+      to engines whose aux is precomputed ahead of state (the fused-T
+      Pallas kernel falls back to T=1; everything else works).
+
+    `degenerate=True` is the provable degenerate case: the bank is built
+    from the config's own SCALAR fault fields (all groups identical), and
+    every engine must be bit-identical to the scalar path — the farm's
+    correctness anchor (tests/test_fuzz.py)."""
+
+    farm_seed: int = 0
+    universe_base: int = 0
+    degenerate: bool = False
+    drop_max: float = 0.0
+    crash_max: float = 0.0
+    restart_max: float = 0.0
+    link_fail_max: float = 0.0
+    link_heal_max: float = 0.0
+    delay_windows: bool = False
+    partitions: tuple = ()
+    part_period_lo: int = 8
+    part_period_hi: int = 64
+
+    def __post_init__(self):
+        # Coerce to tuple so a list argument cannot build an unhashable
+        # "frozen" spec (lru_cache keys on the whole config downstream).
+        object.__setattr__(self, "partitions", tuple(self.partitions))
+        for ch in ("drop", "crash", "restart", "link_fail", "link_heal"):
+            p = getattr(self, f"{ch}_max")
+            if not (0.0 <= p <= 1.0):
+                raise ValueError(f"{ch}_max must be in [0, 1], got {p}")
+        bad = [k for k in self.partitions if k not in PART_KINDS]
+        if bad:
+            raise ValueError(f"unknown partition kinds {bad}; "
+                             f"valid: {PART_KINDS}")
+        if not (1 <= self.part_period_lo <= self.part_period_hi):
+            raise ValueError(
+                f"need 1 <= part_period_lo <= part_period_hi, got "
+                f"{self.part_period_lo}/{self.part_period_hi}")
+
+    @property
+    def has_faults(self) -> bool:
+        """Whether the sampled bank carries crash/restart channels (the
+        phase-F faults flag must compile in)."""
+        return not self.degenerate and (
+            self.crash_max > 0 or self.restart_max > 0)
+
+    @property
+    def has_links(self) -> bool:
+        """Whether the sampled bank carries link fail/heal channels (the
+        phase-F link-transition flag must compile in)."""
+        return not self.degenerate and (
+            self.link_fail_max > 0 or self.link_heal_max > 0)
+
+    @property
+    def needs_state(self) -> bool:
+        """Whether per-tick aux assembly must read pre-tick STATE (leader
+        isolation) — engines that precompute aux ahead of state (the fused
+        Pallas kernel) cannot run such banks and fall back."""
+        return (not self.degenerate) and ("leader" in self.partitions)
+
+
+def config_from_dict(d: dict) -> "RaftConfig":
+    """Rebuild a RaftConfig from dataclasses.asdict output (the triage /
+    fuzz-corpus replay path): the nested scenario dict becomes a
+    ScenarioSpec again and JSON-roundtripped lists re-tuple."""
+    d = dict(d)
+    scen = d.get("scenario")
+    if isinstance(scen, dict):
+        scen = dict(scen)
+        if "partitions" in scen:
+            scen["partitions"] = tuple(scen["partitions"])
+        d["scenario"] = ScenarioSpec(**scen)
+    return RaftConfig(**d)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -74,12 +178,27 @@ class RaftConfig:
 
     seed: int = 0
 
+    # Per-group scenario heterogeneity (the fuzzing-farm bank, SEMANTICS.md
+    # §12): None = the classical single-universe run. When set, make_rng
+    # samples the per-group ScenarioBank and threads it through every
+    # engine's rng operand; the scalar fault fields above still apply as
+    # baselines for any channel the spec does not sample.
+    scenario: Optional[ScenarioSpec] = None
+
     def __post_init__(self):
         if not (0 <= self.delay_lo <= self.delay_hi):
             raise ValueError(
                 f"need 0 <= delay_lo <= delay_hi, got {self.delay_lo}/{self.delay_hi}")
         if self.log_dtype not in ("int32", "int16"):
             raise ValueError(f"log_dtype must be int32 or int16, got {self.log_dtype}")
+        s = self.scenario
+        if s is not None and not s.degenerate:
+            if s.delay_windows and not self.delay_lo < self.delay_hi:
+                raise ValueError(
+                    "scenario.delay_windows needs a real run window "
+                    f"(delay_lo < delay_hi), got {self.delay_lo}/{self.delay_hi}")
+            if s.partitions and self.n_nodes < 2:
+                raise ValueError("partition programs need n_nodes >= 2")
 
     @property
     def uses_mailbox(self) -> bool:

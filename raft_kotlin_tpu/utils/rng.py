@@ -9,8 +9,11 @@ see bit-identical values. See SEMANTICS.md §4.
 
 from __future__ import annotations
 
+import math
+
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 jax.config.update("jax_threefry_partitionable", True)
 
@@ -22,6 +25,45 @@ KIND_RESTART = 4
 KIND_LINK_FAIL = 5
 KIND_LINK_HEAL = 6
 KIND_DELAY = 7
+
+# Scenario-bank sampling kinds (SEMANTICS.md §12): one counted-threefry
+# stream per channel, keyed by (farm_seed, channel kind, universe_id) — a
+# universe's parameters depend on its id alone, never on the batch shape,
+# so any batch containing universe u reproduces exactly u's lattice.
+# Disjoint from the per-tick kinds above (different base key anyway — the
+# farm_seed, not the run seed — but kept disjoint for greppability).
+SCEN_KIND_DROP = 32
+SCEN_KIND_CRASH = 33
+SCEN_KIND_RESTART = 34
+SCEN_KIND_LINK_FAIL = 35
+SCEN_KIND_LINK_HEAL = 36
+SCEN_KIND_DELAY_LO = 37
+SCEN_KIND_DELAY_HI = 38
+SCEN_KIND_PART_KIND = 39
+SCEN_KIND_PART_CUT = 40
+SCEN_KIND_PART_SRC = 41
+SCEN_KIND_PART_DST = 42
+SCEN_KIND_PART_PERIOD = 43
+SCEN_KIND_PART_DUTY = 44
+SCEN_KIND_PART_PHASE = 45
+
+# Event probabilities live in a 23-bit integer domain: jax's f32 uniform is
+# exactly (bits >> 9) * 2^-23, so `bernoulli(key, p) == (bits(key) >> 9) <
+# p_threshold(p)` bit-for-bit — the one integer-exact event path shared by
+# scalar configs and per-group scenario banks (tests/test_fuzz.py pins the
+# equivalence against jax.random.bernoulli itself, so a jax upgrade that
+# changes the uniform bit derivation fails loudly).
+P_BITS = 23
+P_SHIFT = 32 - P_BITS
+
+
+def p_threshold(p: float) -> int:
+    """The 23-bit threshold t with `uniform(key) < f32(p)  <=>
+    (bits(key) >> 9) < t`, exact: f32(p) * 2^23 is exact in double
+    (24-bit significand times a power of two), and ceil counts the
+    uniform lattice points strictly below p."""
+    p32 = float(np.float32(p)) if p == p else 0.0  # NaN -> 0
+    return max(0, min(math.ceil(p32 * (1 << P_BITS)), 1 << P_BITS))
 
 
 def base_key(seed: int) -> jax.Array:
@@ -93,32 +135,215 @@ def draw_uniform_counters(
     return jax.vmap(lambda c: draw_uniform(base, kind, g, n, c, lo, hi))(ctrs)
 
 
-def edge_ok_mask(base: jax.Array, tick, shape: tuple, p_drop: float) -> jax.Array:
+def _event_bits(base: jax.Array, kind: int, tick, shape: tuple) -> jax.Array:
+    """The 23-bit uniform lattice draw behind every shaped event mask —
+    identical bits to what jax's bernoulli/uniform consumes at this key."""
+    k = jax.random.fold_in(jax.random.fold_in(base, kind), tick)
+    return jax.random.bits(k, shape, dtype=jnp.uint32) >> P_SHIFT
+
+
+def _thresh_bcast(thresh, shape: tuple) -> jax.Array:
+    """A scalar or per-group (G,) threshold broadcast against a (G, ...)
+    event shape, as uint32."""
+    t = jnp.asarray(thresh).astype(jnp.uint32)
+    if t.ndim == 1:
+        t = t.reshape(t.shape + (1,) * (len(shape) - 1))
+    return t
+
+
+def edge_ok_mask(base: jax.Array, tick, shape: tuple, p_drop: float,
+                 thresh=None) -> jax.Array:
     """(G, N, N) boolean mask for tick `tick`: element [g, s-1, r-1] is True iff the
     directed message s -> r in group g survives this tick. One shaped draw per tick,
-    shared verbatim by oracle and kernel (SEMANTICS.md §4)."""
-    if p_drop <= 0.0:
-        return jnp.ones(shape, dtype=bool)
-    k = jax.random.fold_in(jax.random.fold_in(base, KIND_FAULT), tick)
-    return ~jax.random.bernoulli(k, p_drop, shape)
+    shared verbatim by oracle and kernel (SEMANTICS.md §4).
+
+    `thresh` (per-group (G,) int32 23-bit thresholds — the scenario bank's
+    drop channel, SEMANTICS.md §12) overrides the scalar probability; the
+    scalar path routes through p_threshold onto the SAME integer compare,
+    bit-identical to the historical bernoulli form (see p_threshold)."""
+    if thresh is None:
+        if p_drop <= 0.0:
+            return jnp.ones(shape, dtype=bool)
+        thresh = p_threshold(p_drop)
+    bits = _event_bits(base, KIND_FAULT, tick, shape)
+    return bits >= _thresh_bcast(thresh, shape)
 
 
-def delay_mask(base: jax.Array, tick, shape: tuple, lo: int, hi: int) -> jax.Array:
+def delay_mask(base: jax.Array, tick, shape: tuple, lo: int, hi: int,
+               lo_g=None, hi_g=None) -> jax.Array:
     """(G, N, N) int32 of per-directed-pair message delays for sends at tick `tick`,
     uniform on [lo, hi] inclusive (SEMANTICS.md §10). Element [g, s-1, r-1] is the
     delay of the exchange s sends to r this tick. One shaped draw per tick, shared
-    verbatim by oracle, kernel, and native engine — same pattern as edge_ok_mask."""
-    if lo == hi:
+    verbatim by oracle, kernel, and native engine — same pattern as edge_ok_mask.
+
+    `lo_g`/`hi_g` (per-group (G,) int32 — the scenario bank's delay
+    windows) override the scalar bounds per group; jax's randint broadcasts
+    array bounds elementwise over the same drawn bits, so equal per-group
+    bounds are bit-identical to the scalar call (tests/test_fuzz.py)."""
+    if lo_g is None and lo == hi:
         return jnp.full(shape, lo, dtype=jnp.int32)
     k = jax.random.fold_in(jax.random.fold_in(base, KIND_DELAY), tick)
+    if lo_g is not None:
+        ext = (1,) * (len(shape) - 1)
+        return jax.random.randint(
+            k, shape, lo_g.reshape(lo_g.shape + ext),
+            hi_g.reshape(hi_g.shape + ext) + 1, dtype=jnp.int32)
     return jax.random.randint(k, shape, lo, hi + 1, dtype=jnp.int32)
 
 
-def event_mask(base: jax.Array, kind: int, tick, shape: tuple, p: float) -> jax.Array:
+def event_mask(base: jax.Array, kind: int, tick, shape: tuple, p: float,
+               thresh=None) -> jax.Array:
     """Shaped boolean event draw for tick `tick` (True = event fires). One draw per
     (kind, tick), shared verbatim by oracle and kernel — the fault-event analogue of
-    `edge_ok_mask` (SEMANTICS.md §9: crash/restart/link-fail/link-heal events)."""
-    if p <= 0.0:
-        return jnp.zeros(shape, dtype=bool)
-    k = jax.random.fold_in(jax.random.fold_in(base, kind), tick)
-    return jax.random.bernoulli(k, p, shape)
+    `edge_ok_mask` (SEMANTICS.md §9: crash/restart/link-fail/link-heal events).
+    `thresh` selects the per-group scenario-bank channel (see edge_ok_mask)."""
+    if thresh is None:
+        if p <= 0.0:
+            return jnp.zeros(shape, dtype=bool)
+        thresh = p_threshold(p)
+    bits = _event_bits(base, kind, tick, shape)
+    return bits < _thresh_bcast(thresh, shape)
+
+
+# ---------------------------------------------------------------------------
+# Scenario bank (SEMANTICS.md §12): per-group fault lattices, delay windows
+# and scripted partition programs, sampled from a counted threefry stream
+# keyed by (farm_seed, channel, universe_id).
+
+from raft_kotlin_tpu.utils.config import (  # noqa: E402  (no import cycle:
+    PART_ASYM, PART_LEADER, PART_NONE, PART_SPLIT)  # config imports nothing)
+
+# Bank key -> aux consumer, for reference. All values are (G,) int32:
+#   drop_t/crash_t/restart_t/link_fail_t/link_heal_t  23-bit thresholds
+#   delay_lo/delay_hi                                 per-group §10 windows
+#   part_kind (PART_* code) / part_cut (split block size) / part_src,
+#   part_dst (asym directed edge) / part_period, part_duty, part_phase
+#   (the flapping window: active iff (tick + phase) % period < duty)
+THRESHOLD_CHANNELS = {
+    "drop_t": ("drop_max", "p_drop", SCEN_KIND_DROP),
+    "crash_t": ("crash_max", "p_crash", SCEN_KIND_CRASH),
+    "restart_t": ("restart_max", "p_restart", SCEN_KIND_RESTART),
+    "link_fail_t": ("link_fail_max", "p_link_fail", SCEN_KIND_LINK_FAIL),
+    "link_heal_t": ("link_heal_max", "p_link_heal", SCEN_KIND_LINK_HEAL),
+}
+PARTITION_KEYS = ("part_kind", "part_cut", "part_src", "part_dst",
+                  "part_period", "part_duty", "part_phase")
+
+
+def _scen_draw(fkey, kind: int, uids, lo, hi):
+    """(G,) int32, element u = the counted inclusive-uniform draw for
+    universe uids[u] on [lo[u], hi[u]] (bounds scalars or (G,) arrays) —
+    keyed by (farm_seed, kind, universe_id) only, never by batch shape."""
+    kk = jax.random.fold_in(fkey, kind)
+    lo = jnp.broadcast_to(jnp.asarray(lo, jnp.int32), uids.shape)
+    hi = jnp.broadcast_to(jnp.asarray(hi, jnp.int32), uids.shape)
+    f = lambda u, a, b: jax.random.randint(
+        jax.random.fold_in(kk, u), (), a, b + 1, dtype=jnp.int32)
+    return jax.vmap(f)(uids, lo, hi)
+
+
+def sample_scenario_bank(cfg) -> dict:
+    """The ScenarioBank for `cfg` (cfg.scenario must be set): a dict of
+    (n_groups,) int32 arrays — see the key table above. Pure jnp (traceable;
+    ops/tick.make_rng computes it into the rng operand). Channel keys are
+    PRESENT iff the channel is active, and that presence is what compiles
+    the corresponding engine paths in (ops/tick.make_flags reads the spec).
+
+    degenerate=True builds the bank from the config's own scalar fault
+    fields instead of sampling — all groups identical, every active scalar
+    channel routed through the bank code path — the provable
+    bit-identical-to-scalar case (tests/test_fuzz.py)."""
+    spec = cfg.scenario
+    assert spec is not None, "sample_scenario_bank needs cfg.scenario"
+    G, N = cfg.n_groups, cfg.n_nodes
+    bank: dict = {}
+    if spec.degenerate:
+        for key, (_mx, scalar, _kind) in THRESHOLD_CHANNELS.items():
+            p = getattr(cfg, scalar)
+            if p > 0:
+                bank[key] = jnp.full((G,), p_threshold(p), jnp.int32)
+        if cfg.delay_lo < cfg.delay_hi:
+            bank["delay_lo"] = jnp.full((G,), cfg.delay_lo, jnp.int32)
+            bank["delay_hi"] = jnp.full((G,), cfg.delay_hi, jnp.int32)
+        return bank
+    fkey = jax.random.key(spec.farm_seed)
+    uids = spec.universe_base + jnp.arange(G, dtype=jnp.int32)
+    for key, (mx_name, _scalar, kind) in THRESHOLD_CHANNELS.items():
+        mx = getattr(spec, mx_name)
+        if mx > 0:
+            bank[key] = _scen_draw(fkey, kind, uids, 0, p_threshold(mx))
+    if spec.delay_windows:
+        lo = _scen_draw(fkey, SCEN_KIND_DELAY_LO, uids,
+                        cfg.delay_lo, cfg.delay_hi)
+        bank["delay_lo"] = lo
+        bank["delay_hi"] = _scen_draw(fkey, SCEN_KIND_DELAY_HI, uids,
+                                      lo, cfg.delay_hi)
+    if spec.partitions:
+        codes = {"split": PART_SPLIT, "asym": PART_ASYM,
+                 "leader": PART_LEADER}
+        table = jnp.asarray(
+            (PART_NONE,) + tuple(codes[k] for k in spec.partitions),
+            jnp.int32)
+        idx = _scen_draw(fkey, SCEN_KIND_PART_KIND, uids,
+                         0, len(spec.partitions))
+        bank["part_kind"] = jnp.take(table, idx)
+        bank["part_cut"] = _scen_draw(fkey, SCEN_KIND_PART_CUT, uids,
+                                      1, max(1, N - 1))
+        src = _scen_draw(fkey, SCEN_KIND_PART_SRC, uids, 1, N)
+        dst0 = _scen_draw(fkey, SCEN_KIND_PART_DST, uids, 1, max(1, N - 1))
+        bank["part_src"] = src
+        # dst uniform over [1, N] \ {src} (spec validation pins N >= 2).
+        bank["part_dst"] = dst0 + (dst0 >= src).astype(jnp.int32)
+        period = _scen_draw(fkey, SCEN_KIND_PART_PERIOD, uids,
+                            spec.part_period_lo, spec.part_period_hi)
+        bank["part_period"] = period
+        bank["part_duty"] = _scen_draw(fkey, SCEN_KIND_PART_DUTY, uids,
+                                       1, period)
+        bank["part_phase"] = _scen_draw(fkey, SCEN_KIND_PART_PHASE, uids,
+                                        0, period - 1)
+    return bank
+
+
+def scenario_active(scen: dict, tick):
+    """The §12 flapping window: True where a group's partition program is
+    ACTIVE at `tick` — (tick + phase) % period < duty. THE one copy of the
+    window formula (scenario_link_down and the native engine's host-side
+    leader_iso channel both evaluate exactly this); `tick` may be a scalar
+    or a broadcastable array of ticks."""
+    return ((tick + scen["part_phase"]) % scen["part_period"]) \
+        < scen["part_duty"]
+
+
+def scenario_link_down(scen: dict, tick, leader_gn, N: int, xp=jnp):
+    """The per-tick scheduled-partition mask: (G, N, N) bool, True where
+    the directed edge s -> r is DOWN this tick under the group's partition
+    program (SEMANTICS.md §12). Pure integer/boolean arithmetic — `xp` is
+    jnp for the kernels and np for the scalar oracles, so every
+    implementation evaluates the SAME function.
+
+    Programs (scen["part_kind"], PART_* codes), gated by the flapping
+    window active = (tick + phase) % period < duty:
+    - PART_SPLIT:  clean split {1..cut} vs {cut+1..N}; cross edges down
+      both ways.
+    - PART_ASYM:   the single directed edge src -> dst down.
+    - PART_LEADER: every edge touching a node that was a LIVE LEADER at
+      tick start (`leader_gn`: (G, N) bool; pre-phase-F state) down.
+    Self-edges are never partitioned (a node always reaches itself)."""
+    kind = scen["part_kind"]
+    G = kind.shape[0]
+    active = scenario_active(scen, tick)
+    ids = xp.arange(1, N + 1, dtype=kind.dtype)
+    s_id, r_id = ids[None, :, None], ids[None, None, :]
+    k = kind[:, None, None]
+    cut = scen["part_cut"][:, None, None]
+    split = (s_id <= cut) != (r_id <= cut)
+    asym = (s_id == scen["part_src"][:, None, None]) \
+        & (r_id == scen["part_dst"][:, None, None])
+    if leader_gn is None:
+        ldr = xp.zeros((G, N, N), dtype=bool)
+    else:
+        lg = leader_gn != 0
+        ldr = lg[:, :, None] | lg[:, None, :]
+    down = ((k == PART_SPLIT) & split) | ((k == PART_ASYM) & asym) \
+        | ((k == PART_LEADER) & ldr)
+    return down & active[:, None, None] & (s_id != r_id)

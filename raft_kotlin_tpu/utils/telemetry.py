@@ -333,23 +333,38 @@ def monitor_ring_stride(n_ticks: int, windows: int = MONITOR_WINDOWS) -> int:
     return max(1, -(-int(n_ticks) // int(windows)))
 
 
-def monitor_init(n_groups: int, n_ticks: int,
-                 enabled: bool = True) -> Optional[Dict[str, jax.Array]]:
+def monitor_init(n_groups: int, n_ticks: int, enabled: bool = True,
+                 per_group: bool = False) -> Optional[Dict[str, jax.Array]]:
     """THE runner-side monitor-carry constructor: a fresh carry with the
     ring stride tiling an n_ticks run, or None when the runner's monitor
     flag is off — one copy of the idiom every engine's scan builder uses,
-    so the carry's construction can never drift between engines."""
+    so the carry's construction can never drift between engines.
+    `per_group=True` adds the PER_GROUP_KEYS stress counters (the fuzzing
+    farm's universe-ranking channel — reduced in the carry alongside the
+    history ring, zero per-tick host traffic)."""
     if not enabled:
         return None
-    return monitor_zeros(n_groups, monitor_ring_stride(n_ticks))
+    return monitor_zeros(n_groups, monitor_ring_stride(n_ticks),
+                         per_group=per_group)
+
+
+# Per-group (universe) stress counters, carried when monitor_zeros(
+# per_group=True): elections started (rounds delta), §9 liveness
+# transitions, and per-group violation counts — the fuzzing farm ranks
+# universes by these without any host readback (api/fuzz.py). grp_elections
+# needs `rounds` in the step views; monitor_view/monitor_flat_view supply
+# it opportunistically and monitor_step_arrays raises if a per-group carry
+# meets a view without it (a fused-snapshot path misconfiguration).
+PER_GROUP_KEYS = ("grp_elections", "grp_fault_events", "grp_violations")
 
 
 def monitor_zeros(n_groups: int, ring_stride: int = 1,
-                  windows: int = MONITOR_WINDOWS) -> Dict[str, jax.Array]:
+                  windows: int = MONITOR_WINDOWS,
+                  per_group: bool = False) -> Dict[str, jax.Array]:
     """A fresh monitor carry. `ring_stride` is baked in as a () int32 so
     summarize_monitor can decode the ring without out-of-band metadata."""
     neg1 = jnp.full((), -1, _I32)
-    return {
+    out = {
         "tick": jnp.zeros((), _I32),
         "latch_tick": neg1, "latch_group": neg1, "latch_inv": neg1,
         "viol_total": jnp.zeros((), _I32),
@@ -363,6 +378,10 @@ def monitor_zeros(n_groups: int, ring_stride: int = 1,
         "ring_violations": jnp.zeros((windows,), _I32),
         "ring_stride": jnp.full((), int(ring_stride), _I32),
     }
+    if per_group:
+        for k in PER_GROUP_KEYS:
+            out[k] = jnp.zeros((n_groups,), _I32)
+    return out
 
 
 def invariant_matrix(prev: dict, cur: dict, taint_restart: jax.Array,
@@ -529,6 +548,25 @@ def monitor_step_arrays(prev: dict, cur: dict, mon: Dict[str, jax.Array]
     out["viol_by_inv"] = mon["viol_by_inv"] + per_inv
     out["viol_total"] = mon["viol_total"] + vc
 
+    if "grp_violations" in mon:
+        # Per-group (universe) stress counters (PER_GROUP_KEYS): the same
+        # transition reductions as the latch/flight-recorder, kept (G,)-
+        # wide in the carry so the farm ranks universes with zero per-tick
+        # host traffic.
+        out["grp_violations"] = mon["grp_violations"] + jnp.sum(
+            V.astype(_I32), axis=0)
+        out["grp_fault_events"] = mon["grp_fault_events"] + jnp.sum(
+            ((prev["up"] != 0) != (cur["up"] != 0)).astype(_I32), axis=0)
+        r_p, r_c = prev.get("rounds"), cur.get("rounds")
+        if r_p is None or r_c is None:
+            raise ValueError(
+                "per-group monitor counters need `rounds` in the step "
+                "views (monitor_view/monitor_flat_view supply it; a fused "
+                "snapshot set does not — run the farm on a full-state "
+                "engine)")
+        out["grp_elections"] = mon["grp_elections"] + jnp.sum(
+            r_c.astype(_I32) - r_p.astype(_I32), axis=0)
+
     # First-violation latch: within the tick, lexicographic (group, inv)
     # via one masked min over key = group * N_INVARIANTS + inv; across
     # ticks the scan order makes the first latching tick earliest.
@@ -569,8 +607,11 @@ def monitor_step_arrays(prev: dict, cur: dict, mon: Dict[str, jax.Array]
 
 
 def monitor_view(state) -> dict:
-    """The monitor view of a RaftState (every RaftState-carrying runner)."""
+    """The monitor view of a RaftState (every RaftState-carrying runner).
+    `rounds` rides opportunistically — only the per-group stress counters
+    (PER_GROUP_KEYS) read it."""
     v = {k: getattr(state, k) for k in MONITOR_STATE_FIELDS}
+    v["rounds"] = getattr(state, "rounds", None)
     for k in TELEMETRY_MAILBOX_FIELDS:
         v[k] = getattr(state, k, None)
     return v
@@ -585,6 +626,7 @@ def monitor_flat_view(flat: dict, n_nodes: int) -> dict:
         a = flat[k]
         v[k] = a.reshape(N, -1, a.shape[-1]) if k in ("log_term", "log_cmd") \
             else a
+    v["rounds"] = flat.get("rounds")  # per-group counters only (see monitor_view)
     for k in TELEMETRY_MAILBOX_FIELDS:
         a = flat.get(k)
         v[k] = a.reshape(N, N, -1) if a is not None else None
@@ -609,6 +651,19 @@ def monitor_finalize(mon: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
     out["taint_restart_groups"] = _s(mon["taint_restart"])
     out["taint_unsafe_groups"] = _s(mon["taint_unsafe"])
     return out
+
+
+def universe_stats(mon: Dict[str, jax.Array]) -> dict:
+    """Host materialization of the per-group (universe) channels of a RAW
+    (un-finalized) per-group monitor carry: the PER_GROUP_KEYS counters
+    plus the per-group taint masks — the farm's ranking/coverage input
+    (api/fuzz.py). One batched device_get; arrays come back as numpy."""
+    import numpy as np
+
+    keys = [k for k in PER_GROUP_KEYS if k in mon]
+    host = jax.device_get({k: mon[k] for k in keys + [
+        k for k in ("taint_restart", "taint_unsafe") if k in mon]})
+    return {k: np.asarray(v) for k, v in host.items()}
 
 
 def monitor_scalars(mon: Dict[str, jax.Array]) -> Dict[str, jax.Array]:

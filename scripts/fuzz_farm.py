@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Nightly-scale deterministic simulation-fuzzing farm CLI (ISSUE 9).
+
+Samples per-universe fault lattices, delay windows and scripted partition
+programs from (farm_seed, universe_id), runs monitored batches on device,
+auto-shrinks any latched Figure-3 violation to a minimal replayable
+artifact, and writes the JSONL corpus. The corpus bytes are a pure
+function of the farm inputs (api/fuzz.corpus_hash), so two runs with the
+same arguments produce byte-identical corpora — the determinism the
+whole design exists to buy.
+
+Examples:
+  # 100k universe-ticks, sync fault soup, corpus to ./fuzz_corpus.jsonl
+  python scripts/fuzz_farm.py --universes 512 --ticks 200 \
+      --out fuzz_corpus.jsonl
+
+  # mailbox regime with per-universe delay windows
+  python scripts/fuzz_farm.py --universes 256 --ticks 300 --delay 1 4 \
+      --farm-seed 3
+
+Exit status: 0 clean, 1 any violation latched (the corpus holds the
+artifacts), 2 usage/infrastructure error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    # Defaults derive from THE shared smoke-universe family
+    # (api/fuzz.smoke_spec / smoke_config — the same one bench.py's gated
+    # leg and probe_invariants' ranking run), so tuning it there retunes
+    # the nightly CLI too.
+    from raft_kotlin_tpu.api.fuzz import smoke_config
+
+    sm = smoke_config(512)
+    sp = sm.scenario
+
+    ap = argparse.ArgumentParser(
+        description="deterministic simulation-fuzzing farm")
+    ap.add_argument("--universes", type=int, default=512,
+                    help="total universes to explore")
+    ap.add_argument("--ticks", type=int, default=200,
+                    help="ticks per universe")
+    ap.add_argument("--batch", type=int, default=0,
+                    help="universes per device batch (0 = all at once)")
+    ap.add_argument("--farm-seed", type=int, default=sp.farm_seed,
+                    help="the bank's counted-threefry seed")
+    ap.add_argument("--universe-base", type=int, default=0,
+                    help="first universe id (resume/partition campaigns)")
+    ap.add_argument("--seed", type=int, default=sm.seed,
+                    help="run seed (per-tick draws; boot timers)")
+    ap.add_argument("--nodes", type=int, default=sm.n_nodes)
+    ap.add_argument("--log-capacity", type=int, default=sm.log_capacity)
+    ap.add_argument("--cmd-period", type=int, default=sm.cmd_period)
+    ap.add_argument("--drop-max", type=float, default=sp.drop_max)
+    ap.add_argument("--crash-max", type=float, default=sp.crash_max)
+    ap.add_argument("--restart-max", type=float, default=sp.restart_max)
+    ap.add_argument("--link-fail-max", type=float, default=sp.link_fail_max)
+    ap.add_argument("--link-heal-max", type=float, default=sp.link_heal_max)
+    ap.add_argument("--partitions", default=",".join(sp.partitions),
+                    help="comma list of split/asym/leader ('' = none)")
+    ap.add_argument("--delay", type=int, nargs=2, default=None,
+                    metavar=("LO", "HI"),
+                    help="mailbox window; enables per-universe delay "
+                    "windows when LO < HI")
+    ap.add_argument("--stress", type=int, default=10,
+                    help="pacing compression factor (RaftConfig.stressed)")
+    ap.add_argument("--out", default=None, help="JSONL corpus path")
+    ap.add_argument("--json", action="store_true",
+                    help="print the full summary as JSON")
+    args = ap.parse_args()
+
+    import dataclasses
+
+    from raft_kotlin_tpu.api import fuzz
+    from raft_kotlin_tpu.utils.config import RaftConfig
+
+    parts = tuple(p for p in args.partitions.split(",") if p)
+    delay_lo, delay_hi = args.delay if args.delay else (0, 0)
+    # Unspecified spec fields (flapping period bounds etc.) stay at the
+    # shared smoke family's values.
+    spec = dataclasses.replace(
+        sp,
+        farm_seed=args.farm_seed, universe_base=args.universe_base,
+        drop_max=args.drop_max, crash_max=args.crash_max,
+        restart_max=args.restart_max, link_fail_max=args.link_fail_max,
+        link_heal_max=args.link_heal_max,
+        delay_windows=delay_lo < delay_hi, partitions=parts)
+    batch = args.batch or args.universes
+    cfg = RaftConfig(
+        n_groups=batch, n_nodes=args.nodes,
+        log_capacity=args.log_capacity, cmd_period=args.cmd_period,
+        delay_lo=delay_lo, delay_hi=delay_hi, seed=args.seed,
+        scenario=spec).stressed(args.stress)
+
+    res = fuzz.fuzz_farm(cfg, args.ticks, universes=args.universes,
+                         batch_groups=batch, out_path=args.out,
+                         verbose=True)
+    if args.json:
+        print(json.dumps(res, sort_keys=True))
+    else:
+        print(f"universes={res['universes']} x ticks="
+              f"{res['ticks_per_universe']} -> "
+              f"{res['universe_ticks']} universe-ticks")
+        print(f"inv_status={res['inv_status']} "
+              f"violations={res['violations']} "
+              f"corpus_hash={res['corpus_hash']}")
+        print("coverage:", json.dumps(res["coverage"], sort_keys=True))
+        print("telemetry:", json.dumps(res["telemetry"], sort_keys=True))
+        for r in res["records"]:
+            print(f"  artifact: {r['status']} universe={r['universe_id']} "
+                  f"horizon={r['horizon']} "
+                  f"replay_confirmed={r['replay_confirmed']}")
+    return 0 if res["inv_status"] == "clean" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

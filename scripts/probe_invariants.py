@@ -48,6 +48,13 @@ def main() -> int:
                     help="overhead_frac acceptance threshold")
     ap.add_argument("--enforce", action="store_true",
                     help="exit 2 when overhead_frac >= --gate")
+    ap.add_argument("--fuzz", type=int, default=0, metavar="UNIVERSES",
+                    help="also run a scenario-bank batch of this many "
+                    "universes and print the top-K stressed universes "
+                    "(per-universe monitor counters — ISSUE 9)")
+    ap.add_argument("--fuzz-ticks", type=int, default=120)
+    ap.add_argument("--fuzz-top", type=int, default=10)
+    ap.add_argument("--farm-seed", type=int, default=12)
     args = ap.parse_args()
 
     import bench
@@ -117,6 +124,43 @@ def main() -> int:
         "monitor": {k: int(v) for k, v in med.items()
                     if k.startswith("inv_")},
     }))
+    if args.fuzz:
+        # Per-universe stress ranking (ISSUE 9 satellite): one monitored
+        # scenario-bank batch through the farm runner; the grp_* counters
+        # are reduced in the scan carry alongside the history ring, so
+        # ranking costs zero extra host traffic.
+        import numpy as np
+
+        from raft_kotlin_tpu.api import fuzz as fuzz_mod
+
+        # The SAME smoke universe family bench.py's gated leg runs
+        # (fuzz.smoke_config) — the ranking describes the gated batch.
+        fcfg = fuzz_mod.smoke_config(args.fuzz, farm_seed=args.farm_seed)
+        spec = fcfg.scenario
+        res = fuzz_mod.run_fuzz_batch(fcfg, args.fuzz_ticks)
+        uni = res["universe"]
+        # int64: the weighted score can wrap int32 on long violating runs,
+        # which would garble the ranking.
+        stress = (uni["grp_violations"].astype(np.int64) * 1_000_000
+                  + uni["grp_fault_events"].astype(np.int64) * 1_000
+                  + uni["grp_elections"].astype(np.int64))
+        order = np.argsort(-stress)[: args.fuzz_top]
+        print(json.dumps({
+            "fuzz_universes": args.fuzz,
+            "fuzz_ticks": args.fuzz_ticks,
+            "fuzz_inv_status": res["summary"]["inv_status"],
+            "fuzz_coverage": res["coverage"],
+            "top_universes": [{
+                "universe_id": int(spec.universe_base + g),
+                "elections": int(uni["grp_elections"][g]),
+                "fault_events": int(uni["grp_fault_events"][g]),
+                "violations": int(uni["grp_violations"][g]),
+                "taint_restart": bool(uni["taint_restart"][g]),
+                "taint_unsafe": bool(uni["taint_unsafe"][g]),
+                "params": fuzz_mod.universe_params(fcfg, int(g)),
+            } for g in order],
+        }))
+
     if args.enforce and not gate_ok:
         print(f"GATE FAIL: monitor overhead {overhead:.2%} >= "
               f"{args.gate:.0%}", file=sys.stderr)

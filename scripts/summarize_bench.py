@@ -70,6 +70,10 @@ INV_LEGS = (
     ("churn_inv_status", "churn inv", "suspect"),
     ("mailbox_inv_status", "mailbox inv", "suspect"),
     ("deeplog_inv_status", "deep-log inv", "deeplog_suspect"),
+    # r12 (ISSUE 9): the deterministic fuzz smoke batch — a latched
+    # violation in ANY sampled universe gates exactly like the classical
+    # legs (the replayable artifact is in that run's stderr + corpus).
+    ("fuzz_inv_status", "fuzz inv", "suspect"),
 )
 
 

@@ -42,6 +42,9 @@ def _fake_record():
         "fused_ticks": 4,
         "fused_vs_t1": 1.31,
         "latency_frac_amortized": 0.81,
+        "fuzz_universes": 512,
+        "fuzz_inv_status": "clean",
+        "fuzz_corpus_hash": "865df34419d7102f",
         "suspect": False,
         # plus the long tail of fields that overflowed the driver window
         **{f"filler_{i}": [0.1234] * 8 for i in range(80)},
@@ -91,6 +94,13 @@ def test_compact_headline_is_last_line_and_complete():
     # summarize_bench's fused-leg regression row read them from the
     # artifact.
     for k in ("fused_ticks", "fused_vs_t1", "latency_frac_amortized"):
+        assert k in bench.COMPACT_EXTRA_FIELDS, k
+    # The r12 additions (ISSUE 9): the fuzz smoke leg's verdict, universe
+    # count and deterministic corpus hash ride the authoritative tail by
+    # NAME — summarize_bench's fuzz gate and the round's acceptance
+    # criteria ("clean at >=100k universe-ticks, reproducible corpus")
+    # read them from the artifact.
+    for k in ("fuzz_universes", "fuzz_inv_status", "fuzz_corpus_hash"):
         assert k in bench.COMPACT_EXTRA_FIELDS, k
     for k in bench.COMPACT_EXTRA_FIELDS:
         assert k in last, k

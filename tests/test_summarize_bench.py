@@ -111,3 +111,26 @@ def test_safety_violation_gate(tmp_path):
         json.dumps(art(1, "election_safety@t3/g0")))
     (tmp_path / "BENCH_r02.json").write_text(json.dumps(art(2, "clean")))
     assert sb.main([str(tmp_path / "BENCH_r*.json")]) == 0
+
+
+def test_fuzz_violation_gate(tmp_path):
+    # ISSUE 9 satellite: a non-clean fuzz-farm verdict on the latest
+    # vetted round gates exit-1 exactly like the classical inv legs.
+    sb = _mod()
+    assert ("fuzz_inv_status", "fuzz inv", "suspect") in sb.INV_LEGS
+
+    def art(n, fuzz_status):
+        tail = json.dumps({"ticks_per_sec": 400.0, "suspect": False,
+                           "inv_status": "clean",
+                           "fuzz_inv_status": fuzz_status}) + "\n"
+        return {"n": n, "rc": 0, "tail": tail, "parsed": None}
+
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(art(1, "clean")))
+    (tmp_path / "BENCH_r02.json").write_text(
+        json.dumps(art(2, "log_matching@t17/g203")))
+    recs = sb.load_all(str(tmp_path / "BENCH_r*.json"))
+    assert sb.check_violations(recs) == [
+        ("fuzz inv", "log_matching@t17/g203")]
+    assert sb.main([str(tmp_path / "BENCH_r*.json")]) == 1
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(art(2, "clean")))
+    assert sb.main([str(tmp_path / "BENCH_r*.json")]) == 0
