@@ -41,6 +41,7 @@ zero fault channels, and replay at exactly the injected coordinate
 from __future__ import annotations
 
 import dataclasses
+import functools
 import hashlib
 import json
 from typing import Callable, Optional
@@ -65,8 +66,32 @@ def _cpu_batched_guard(cfg: RaftConfig) -> Optional[bool]:
                      and jax.default_backend() == "cpu") else None
 
 
+def _monitor_shardings(mesh, n_groups: int, n_ticks: int):
+    """NamedShardings for the RAW per-group monitor carry under `mesh`:
+    the (G,)-BY-CONTRACT keys (PER_GROUP_KEYS stress counters + the taint
+    masks) place on the groups axis like the state arrays; scalars, the
+    history ring and the latch replicate. Keyed by NAME, not by shape —
+    a shape rule would mis-shard the (W,) ring whenever n_groups happened
+    to equal the window count. (The rng operand's placement stays in
+    mesh.rng_shardings, where shape IS the contract: bank channels are
+    (G,) by construction. These were the two single-device assumptions
+    the r13 pod work removed.)"""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    rep = NamedSharding(mesh, P())
+    lanes = NamedSharding(mesh, P(("dcn", "ici")))
+    per_group = set(telemetry_mod.PER_GROUP_KEYS) | {
+        "taint_restart", "taint_unsafe"}
+    mon0 = jax.eval_shape(
+        lambda: telemetry_mod.monitor_init(n_groups, n_ticks,
+                                           per_group=True))
+    for k in per_group:
+        assert mon0[k].shape == (n_groups,), k  # the (G,) contract itself
+    return {k: (lanes if k in per_group else rep) for k in mon0}
+
+
 def make_batch_runner(cfg: RaftConfig, n_ticks: int,
-                      mutator: Optional[Callable] = None):
+                      mutator: Optional[Callable] = None, mesh=None):
     """run(state0?) -> (end_state, telemetry, RAW per-group monitor carry)
     for one monitored+recorded batch — the farm's engine. One jit, one
     scan, per-universe counters in the carry (monitor_groups), monitor
@@ -75,18 +100,54 @@ def make_batch_runner(cfg: RaftConfig, n_ticks: int,
 
     `mutator(state, tick_scalar) -> state` is the seeded-mutation hook:
     applied to the POST-tick state inside the scan, BEFORE the monitor
-    step — a deliberately broken transition the monitor must catch."""
+    step — a deliberately broken transition the monitor must catch.
+
+    `mesh` (ISSUE 10): shard the batch's UNIVERSES over a device mesh —
+    the scenario bank rides the rng operand placed by mesh.rng_shardings
+    (groups axis), the per-universe stress counters stay (G,)-wide and
+    sharded in the carry (_monitor_shardings), and the tick is the same
+    embarrassingly parallel program every sharded runner compiles, so
+    scenario throughput multiplies with the pod while the bits (and the
+    corpus hash) stay EXACTLY the single-device ones
+    (tests/test_pod.py)."""
     from raft_kotlin_tpu.models.state import init_state
     from raft_kotlin_tpu.ops.tick import make_rng, make_tick
 
-    tick_fn = make_tick(cfg, batched=_cpu_batched_guard(cfg))
-    rng = make_rng(cfg)
+    if mesh is None:
+        tick = make_tick(cfg, batched=_cpu_batched_guard(cfg))
+        tick_fn = lambda s, rng: tick(s, rng=rng)
+        jit_kw = {}
+        rng = make_rng(cfg)
+        mk_state = lambda: init_state(cfg)
+    else:
+        import math as _math
 
-    @jax.jit
+        from raft_kotlin_tpu.parallel import mesh as mesh_mod
+
+        n_dev = _math.prod(mesh.devices.shape)
+        assert cfg.n_groups % n_dev == 0, "pad_groups first"
+        if cfg.uses_dyn_log:
+            smt = mesh_mod._make_shardmap_xla_tick(cfg, mesh)
+            tick_fn = lambda s, rng: smt(s, rng)
+        else:
+            tick = make_tick(cfg)
+            tick_fn = lambda s, rng: tick(s, rng=rng)
+        sh = mesh_mod.state_sharding(mesh, cfg)
+        rng_sh = mesh_mod.rng_shardings(cfg, mesh)
+        rep = jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec())
+        mon_sh = _monitor_shardings(mesh, cfg.n_groups, n_ticks)
+        jit_kw = {"in_shardings": (sh, rng_sh),
+                  "out_shardings": (sh, rep, mon_sh)}
+        # Computed straight into placement (init_sharded's pattern).
+        rng = jax.jit(lambda: make_rng(cfg), out_shardings=rng_sh)()
+        mk_state = lambda: mesh_mod.init_sharded(cfg, mesh)
+
+    @functools.partial(jax.jit, **jit_kw)
     def run(st, rng):
         def body(carry, _):
             s, tel, mon = carry
-            s2 = tick_fn(s, rng=rng)
+            s2 = tick_fn(s, rng)
             if mutator is not None:
                 s2 = mutator(s2, s.tick)
             tel = telemetry_mod.telemetry_step(s, s2, tel)
@@ -101,14 +162,14 @@ def make_batch_runner(cfg: RaftConfig, n_ticks: int,
         return end, tel, mon
 
     def call(state0=None):
-        st = state0 if state0 is not None else init_state(cfg)
+        st = state0 if state0 is not None else mk_state()
         return run(st, rng)
 
     return call
 
 
 def run_fuzz_batch(cfg: RaftConfig, n_ticks: int,
-                   mutator: Optional[Callable] = None) -> dict:
+                   mutator: Optional[Callable] = None, mesh=None) -> dict:
     """One monitored farm batch -> a host-side result dict:
     - "summary": telemetry.summarize_monitor (inv_status, latch, ring...),
     - "latch": the first-violation coordinate or None,
@@ -116,8 +177,11 @@ def run_fuzz_batch(cfg: RaftConfig, n_ticks: int,
     - "universe": per-group numpy arrays (grp_elections/grp_fault_events/
       grp_violations + taint masks — the stress-ranking channel),
     - "coverage": scalar coverage figures (universes with any fault
-      event / election / taint — the "bank actually bit" evidence)."""
-    end, tel, mon = make_batch_runner(cfg, n_ticks, mutator=mutator)()
+      event / election / taint — the "bank actually bit" evidence).
+    `mesh` shards the batch's universes across devices (bit-identical —
+    see make_batch_runner)."""
+    end, tel, mon = make_batch_runner(cfg, n_ticks, mutator=mutator,
+                                      mesh=mesh)()
     summary = telemetry_mod.summarize_monitor(mon)
     uni = telemetry_mod.universe_stats(mon)
     cov = {
@@ -303,7 +367,8 @@ def fuzz_farm(cfg: RaftConfig, n_ticks: int, universes: Optional[int] = None,
               batch_groups: Optional[int] = None,
               out_path: Optional[str] = None,
               mutator_factory: Optional[Callable] = None,
-              triage_confirm: bool = True, verbose: bool = False) -> dict:
+              triage_confirm: bool = True, verbose: bool = False,
+              mesh=None) -> dict:
     """Run the farm over `universes` universes (default: one batch of
     cfg.n_groups) in batches of `batch_groups`, collecting latches,
     shrinking each to a minimal artifact, replay-confirming, and writing
@@ -318,11 +383,26 @@ def fuzz_farm(cfg: RaftConfig, n_ticks: int, universes: Optional[int] = None,
     monitor's latch is scalar); the farm harvests one artifact per
     violating batch per pass — a real campaign reruns with the offending
     universe's channel zeroed or a different farm_seed to dig further.
+
+    `mesh` (ISSUE 10) shards each batch's universes across the device
+    mesh — scenario throughput multiplies with the pod; bits, latches and
+    the corpus hash are EXACTLY the single-device ones (the bank is keyed
+    by universe_id, never by batch shape or placement; pinned by
+    tests/test_pod.py). Shrink and replay confirmation stay single-device
+    (shrunk reproducers are tiny). Batch sizes must tile the mesh.
     """
     spec = cfg.scenario
     assert spec is not None, "fuzz_farm needs cfg.scenario (the bank spec)"
     universes = universes if universes is not None else cfg.n_groups
     batch_groups = batch_groups if batch_groups is not None else cfg.n_groups
+    if mesh is not None:
+        import math as _math
+
+        n_dev = _math.prod(mesh.devices.shape)
+        assert batch_groups % n_dev == 0 and universes % batch_groups == 0, (
+            "sharded farm batches must tile the mesh: need batch_groups % "
+            f"n_devices == 0 and universes % batch_groups == 0, got "
+            f"{universes}/{batch_groups}/{n_dev}")
     records = []
     status = "clean"
     tel_total: dict = {}
@@ -337,7 +417,8 @@ def fuzz_farm(cfg: RaftConfig, n_ticks: int, universes: Optional[int] = None,
             scenario=dataclasses.replace(
                 spec, universe_base=spec.universe_base + done))
         mut = mutator_factory(cfg_b) if mutator_factory is not None else None
-        res = run_fuzz_batch(cfg_b, n_ticks, mutator=mut)
+        res = run_fuzz_batch(cfg_b, n_ticks, mutator=mut,
+                             mesh=mesh if gb == batch_groups else None)
         for k, v in res["telemetry"].items():
             tel_total[k] = tel_total.get(k, 0) + v
         for k in cov_total:

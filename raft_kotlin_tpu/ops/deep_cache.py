@@ -503,16 +503,13 @@ def make_sharded_deep_scan(cfg, mesh, n_ticks: int,
     n_dev = math.prod(mesh.devices.shape)
     assert G % n_dev == 0, "pad_groups first"
     if engine == "auto":
-        if cfg.uses_mailbox and not cfg.known_delivery:
-            # τ=0 mailbox: a slot can be filled AND delivered within one
-            # tick, so no pre-computable read set exists — per-pair flat
-            # only (route_deep_engine's contract leaves this to callers).
-            engine = "flat"
-        else:
-            engine = mesh_mod.route_deep_engine(
-                cfg.log_capacity, G // n_dev,
-                mesh.devices.flatten()[0].platform,
-                mailbox=cfg.uses_mailbox)
+        # The unified plan layer (parallel/autotune.plan_for, r13): one
+        # resolution composes the τ=0-mailbox flat guard, the per-shard
+        # lane width, and the measured crossover table — this runner no
+        # longer consults a table of its own.
+        from raft_kotlin_tpu.parallel.autotune import plan_for
+
+        engine = plan_for(cfg, mesh)["engine"]
     assert engine in ("fc", "batched", "flat"), engine
     assert not (cfg.uses_mailbox and not cfg.known_delivery
                 and engine != "flat"), \

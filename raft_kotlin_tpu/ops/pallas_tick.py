@@ -68,93 +68,48 @@ def pick_tile(G: int, total_rows: int = 0) -> Optional[int]:
 
 
 # ---------------------------------------------------------------------------
-# Sub-tile ILP routing (ISSUE 4; same measured-crossover pattern as
-# parallel.mesh.DEEP_ROUTING_TABLE). The phase lattice is a ~240-op serial
-# dependency chain per lane (opcount.phase_body_chain_depth) and the headline
-# kernel sits ~5x under both the HBM and VPU rooflines (BENCH_r05
-# hbm_bw_frac 0.164 / vpu_frac 0.178) — issue latency, not bandwidth or
-# slots, is the binding resource. Splitting each tile into K independent
-# lane slabs overlaps K chains inside one kernel body; the win saturates
-# when K chains cover the per-op latency or the slab hits the 128-lane vreg
-# floor. Every entry is (tile_g, K, source): provisional pins chosen at the
-# vreg floor, re-measured by scripts/probe_chain_ilp.py's K-sweep and
-# published as `ilp_subtiles` in the bench record every round (the same
-# re-pin discipline as the deep-engine table). K=1 keeps the pre-ILP kernel
-# byte-identical.
-ILP_SUBTILE_TABLE = (
-    (1024, 4, "provisional: 256-lane slabs (2 vregs) x4 chains; re-pinned"
-     " by BENCH_r08 ilp_subtiles + probe_chain_ilp sweep"),
-    (512, 4, "provisional: the 128-lane vreg floor x4 chains — the headline"
-     " tile (probe_stage1_tiles); re-pinned by BENCH_r08"),
-    (256, 2, "provisional: vreg floor allows only 2 slabs"),
-    (128, 1, "single vreg: no split possible below the 128-lane floor"),
-)
+# Sub-tile ILP + fused-tick routing (ISSUE 4 / ISSUE 7). The phase lattice
+# is a ~240-op serial dependency chain per lane and the headline kernel
+# sits ~5x under both the HBM and VPU rooflines (BENCH_r05) — issue+launch
+# latency, not bandwidth or slots, is the binding resource. K independent
+# lane slabs per tile overlap K chains inside one kernel body (the win
+# saturates at the 128-lane vreg floor); T full phase lattices per launch
+# amortize the launch and keep state VMEM-resident between ticks. K=1/T=1
+# keep the pre-ILP/pre-fusion kernel byte-identical and are the sticky
+# CPU/interpret guards.
+#
+# Since round 13 the measured crossover data lives in the UNIFIED tuning
+# table (parallel/autotune.py — one plan layer for engine + ILP + fused +
+# sharding, measure-on-first-use, pinnable via scripts/autotune.py).
+# ILP_SUBTILE_TABLE / FUSED_TICK_TABLE remain as DERIVED VIEWS (the same
+# (tile, K|T, source) tuples the historical tests and probes read) and
+# the route_* functions delegate; tests/test_autotune.py pins the views
+# equal to the unified layer over the full tile lattice.
+from raft_kotlin_tpu.parallel import autotune as autotune_mod
+
+ILP_SUBTILE_TABLE = autotune_mod.derived_ilp_table()
+FUSED_TICK_TABLE = autotune_mod.derived_fused_table()
 
 
 def route_ilp_subtiles(tile_g: int, platform: Optional[str] = None) -> int:
     """Sub-tile count K for a megakernel tile of `tile_g` lanes, from the
-    measured table. CPU guard: the interpreter executes ops serially — no
-    issue latency to hide — and K multiplies trace size, so interpret/CPU
-    runs stay at K=1 (tests pin K explicitly when they want the sub-tiled
-    program on CPU). Unknown tiles (interpreter-only shapes) fall back to
-    K=1; hardware tiles are exactly the _TILES ladder, all tabulated."""
-    if platform is None:
-        platform = jax.default_backend()
-    if platform == "cpu":
-        return 1
-    for t, k, _src in ILP_SUBTILE_TABLE:
-        if t == tile_g and tile_g % k == 0:
-            return k
-    return 1
-
-
-# ---------------------------------------------------------------------------
-# Fused-tick routing (ISSUE 7; same measured-crossover pattern as the ILP
-# table above). At ~372 ticks/s the headline kernel uses <20% of BOTH
-# rooflines (BENCH_r05) — the binding floor is one kernel launch plus one
-# serial chain ISSUE per tick. Running T full phase lattices per launch
-# (make_pallas_core(fused_ticks=T)) amortizes the launch across T ticks and
-# keeps state VMEM-resident between them (HBM load once, store once per
-# T-block). The round-5 K-tick kernel measured SLOWER and was archived
-# (make_pallas_core_k below, kept as the negative result); the fused-T
-# engine differs in exactly what that experiment lacked: it composes with
-# the sub-tile ILP (K slabs x T ticks of overlapped chains per launch —
-# round 5 ran one serial T-chain and simply made it T times longer) and it
-# exposes per-tick snapshot outputs so the recorder/monitor harness
-# (PR 5/6) pins bit-neutrality at every fused depth. Entries are
-# (tile_g, T, source); provisional pins are re-measured by
-# scripts/probe_fused_ticks.py's TxK sweep (--pin rewrites this block) and
-# published as `fused_ticks` in the bench record every round. T=1 keeps the
-# pre-fusion kernel byte-identical and is the sticky fallback for
-# CPU/interpret, trace-mode per-tick runners, and any shape whose fused
-# VMEM model does not fit.
-# FUSED_TICK_TABLE[begin] (scripts/probe_fused_ticks.py --pin rewrites)
-FUSED_TICK_TABLE = (
-    (1024, 2, "provisional: widest tile - VMEM bounds the T aux slabs +"
-     " draw tables; re-pinned by BENCH_r06 fused_ticks +"
-     " probe_fused_ticks sweep"),
-    (512, 4, "provisional: the headline tile - 4x launch amortization at"
-     " ~60% of the fused VMEM model; re-pinned by BENCH_r06"),
-    (256, 4, "provisional: same amortization, half the slab VMEM"),
-    (128, 4, "provisional: smallest tile, most launches to amortize"),
-)
-# FUSED_TICK_TABLE[end]
+    unified tuning table. CPU guard: the interpreter executes ops serially
+    — no issue latency to hide — and K multiplies trace size, so
+    interpret/CPU runs stay at K=1 (tests pin K explicitly when they want
+    the sub-tiled program on CPU). Unknown tiles (interpreter-only shapes)
+    fall back to K=1; hardware tiles are exactly the _TILES ladder, all
+    tabulated."""
+    return autotune_mod.ilp_subtiles(tile_g, platform=platform)
 
 
 def route_fused_ticks(tile_g: int, platform: Optional[str] = None) -> int:
     """Fused tick count T for a megakernel tile of `tile_g` lanes, from the
-    measured table. CPU guard: the interpreter pays no launch/issue latency
-    to amortize, and T multiplies trace size, so interpret/CPU runs stay at
-    T=1 (tests pin T explicitly when they want the fused program on CPU).
-    Unknown tiles fall back to T=1 — the byte-identical pre-fusion path."""
-    if platform is None:
-        platform = jax.default_backend()
-    if platform == "cpu":
-        return 1
-    for t, T, _src in FUSED_TICK_TABLE:
-        if t == tile_g:
-            return T
-    return 1
+    unified tuning table. CPU guard: the interpreter pays no launch/issue
+    latency to amortize, and T multiplies trace size, so interpret/CPU runs
+    stay at T=1 (tests pin T explicitly when they want the fused program on
+    CPU). Unknown tiles fall back to T=1 — the byte-identical pre-fusion
+    path."""
+    return autotune_mod.fused_ticks(tile_g, platform=platform)
 
 
 # Per-tick observables the fused kernel can snapshot (post-tick, one output

@@ -2120,7 +2120,9 @@ def make_run(cfg: RaftConfig, n_ticks: int, trace: bool = True, impl: str = "xla
     trace is a dict of (T, N, G) arrays (role/term/commit/last_index/voted_for/rounds/
     up per tick, post-tick) — the differential-test observable. With trace=False
     returns per-tick (G,) leader counts only (cheap bench/metrics mode).
-    impl: "xla" (default) or "pallas" (the ops/pallas_tick.py megakernel).
+    impl: "xla" (default), "pallas" (the ops/pallas_tick.py megakernel), or
+    "auto" — resolve engine + fused depth through the unified plan layer
+    (parallel/autotune.plan_for, r13).
     batched=False forces the per-pair deep-log engine (BodyFlags.batched) —
     XLA:CPU compiles of the batched engine blow up on int16 deep configs, so
     CPU-bound tests of such configs pass this.
@@ -2149,6 +2151,18 @@ def make_run(cfg: RaftConfig, n_ticks: int, trace: bool = True, impl: str = "xla
     (block-end) counts of shape (n_ticks // T, G). Telemetry/monitor
     accumulate per tick inside the loop, bit-equal to T=1.
     """
+    if impl == "auto":
+        # The unified plan layer (parallel/autotune.plan_for, r13): one
+        # resolution decides engine + fused depth; this runner no longer
+        # needs per-caller impl knowledge ("pallas" stays a pallas-tick
+        # advancer here, so only the engine name and T are consumed).
+        from raft_kotlin_tpu.parallel.autotune import plan_for
+
+        plan = plan_for(cfg, telemetry=telemetry, monitor=monitor,
+                        trace=trace)
+        impl = "pallas" if plan["engine"] == "pallas" else "xla"
+        if fused_ticks == 1:
+            fused_ticks = plan["fused_ticks"]
     T_f = max(1, fused_ticks)
     if trace:
         T_f = 1  # sticky fallback: per-tick traces need per-tick emission

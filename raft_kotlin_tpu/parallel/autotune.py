@@ -1,0 +1,653 @@
+"""ONE routing layer: the autotuned execution-plan table (ROADMAP item 2).
+
+Until round 13 the repo carried FOUR hand-maintained routing tables, each
+re-measured by hand every PR: `DEEP_ROUTING_TABLE` + `route_deep_engine`
+(parallel/mesh.py — the deep-band engine crossover), `ILP_SUBTILE_TABLE`
+(ops/pallas_tick.py — sub-tile ILP K per megakernel tile) and
+`FUSED_TICK_TABLE` (ops/pallas_tick.py — fused tick count T per tile).
+This module replaces all of them with ONE declarative plan space: a
+resolution key (regime, capacity, lanes, dtype, mailbox, platform) maps to
+a full execution plan
+
+    {engine, ilp_subtiles, fused_ticks, sharding, tile}
+
+through, in order:
+
+1. the pinned in-repo `TUNING_TABLE` (the marker-bounded block below —
+   rows are canonical JSON, so `scripts/autotune.py --pin` rewrites are
+   BYTE-STABLE: the same measurements always produce the same bytes);
+2. the runtime measurement cache (`.autotune_cache.json`, gitignored) —
+   measure-on-first-use results persisted per machine;
+3. measure-on-first-use itself, when explicitly enabled (`measure=True`,
+   the `--measure` CLI, or `RAFT_AUTOTUNE=measure`): candidate plans are
+   benchmarked through `bench.measure`'s program shapes (the SAME
+   timing-trap-hardened harness the headline uses) and the winner is
+   written to the cache;
+4. nearest pinned shape in log-space within the same (regime, mailbox)
+   class — exactly the crossover interpolation `route_deep_engine` used;
+5. static defaults (the always-correct conservative plan).
+
+HARD GUARDS apply after every path and can never be tuned away: CPU/
+interpret runs pin {engine: flat|xla, K: 1, T: 1} (compile-feasibility
+and no-issue-latency-to-hide, not perf classes), and the 128-lane vreg
+floor bounds K. Plan choice is SEMANTICS-FREE by the repo's differential
+contract: every plan the resolver can emit is bit-identical to every
+other (SEMANTICS.md §13) — a routing decision can only ever cost time,
+never bits.
+
+The legacy tables still exist as DERIVED VIEWS (`derived_deep_table` /
+`derived_ilp_table` / `derived_fused_table` feed the old names in
+parallel/mesh.py and ops/pallas_tick.py) so every historical pin, test
+and bench audit keeps working; tests/test_autotune.py pins the equality
+of the old lookups and the unified layer over the full shape lattice.
+
+`plan_for(cfg, mesh)` is the composed resolution for a whole config and
+`make_planned_run` the single make_run-style entry that dispatches the
+resolved plan onto the right engine builder — the "one entry, one
+routing layer" ROADMAP item 2 names.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+from typing import Callable, Optional
+
+import jax
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+CACHE_PATH = os.path.join(REPO_ROOT, ".autotune_cache.json")
+
+PLAN_FIELDS = ("engine", "ilp_subtiles", "fused_ticks", "sharding", "tile")
+REGIMES = ("shallow", "deep")
+DEEP_ENGINES = ("fc", "batched", "flat")
+
+# The 128-lane vreg floor (ops/pallas_tick.make_pallas_core's hardware
+# assertion): a routed K must keep tile // K a multiple of 128.
+VREG_LANES = 128
+
+
+# ---------------------------------------------------------------------------
+# The pinned table. Each row is ONE canonical-JSON line:
+#   {"key": {regime, capacity, lanes, dtype, mailbox, platform},
+#    "plan": {engine, ilp_subtiles, fused_ticks, sharding, tile},
+#    "provenance": {source[, measured: {...}]}}
+# Shallow rows are keyed by the megakernel TILE (lanes == tile, capacity 0
+# = any static-log capacity) — the same key the legacy ILP/FUSED tables
+# used. Deep rows are keyed by (capacity, per-shard lane width, mailbox).
+# The block is rewritten in place by scripts/autotune.py --pin (and by
+# scripts/probe_fused_ticks.py --pin for the shallow T entries);
+# format_rows() renders entries canonically (sorted, minimal separators),
+# so a rewrite from identical measurements is byte-identical — table
+# byte-stability is pinned by tests/test_autotune.py.
+# TUNING_TABLE[begin] (scripts/autotune.py --pin rewrites this block)
+_TUNING_ROWS = (
+    '{"key":{"capacity":0,"dtype":"int32","lanes":128,"mailbox":false,"platform":"tpu","regime":"shallow"},"plan":{"engine":"pallas","fused_ticks":4,"ilp_subtiles":1,"sharding":"shard_map","tile":128},"provenance":{"source":"migrated r13 from ILP_SUBTILE_TABLE (single vreg: no split possible below the 128-lane floor) + FUSED_TICK_TABLE (provisional: smallest tile, most launches to amortize; re-pinned by BENCH_r06)"}}',  # noqa: E501
+    '{"key":{"capacity":0,"dtype":"int32","lanes":256,"mailbox":false,"platform":"tpu","regime":"shallow"},"plan":{"engine":"pallas","fused_ticks":4,"ilp_subtiles":2,"sharding":"shard_map","tile":256},"provenance":{"source":"migrated r13 from ILP_SUBTILE_TABLE (provisional: vreg floor allows only 2 slabs) + FUSED_TICK_TABLE (provisional: same amortization, half the slab VMEM)"}}',  # noqa: E501
+    '{"key":{"capacity":0,"dtype":"int32","lanes":512,"mailbox":false,"platform":"tpu","regime":"shallow"},"plan":{"engine":"pallas","fused_ticks":4,"ilp_subtiles":4,"sharding":"shard_map","tile":512},"provenance":{"source":"migrated r13 from ILP_SUBTILE_TABLE (provisional: the 128-lane vreg floor x4 chains - the headline tile; re-pinned by BENCH_r08) + FUSED_TICK_TABLE (provisional: the headline tile - 4x launch amortization at ~60% of the fused VMEM model; re-pinned by BENCH_r06)"}}',  # noqa: E501
+    '{"key":{"capacity":0,"dtype":"int32","lanes":1024,"mailbox":false,"platform":"tpu","regime":"shallow"},"plan":{"engine":"pallas","fused_ticks":2,"ilp_subtiles":4,"sharding":"shard_map","tile":1024},"provenance":{"source":"migrated r13 from ILP_SUBTILE_TABLE (provisional: 256-lane slabs (2 vregs) x4 chains; re-pinned by BENCH_r08) + FUSED_TICK_TABLE (provisional: widest tile - VMEM bounds the T aux slabs + draw tables; re-pinned by BENCH_r06)"}}',  # noqa: E501
+    '{"key":{"capacity":1024,"dtype":"int16","lanes":2048,"mailbox":false,"platform":"tpu","regime":"deep"},"plan":{"engine":"batched","fused_ticks":1,"ilp_subtiles":1,"sharding":"shard_map","tile":null},"provenance":{"source":"BENCH_r05 corner: batched 71.1k vs fc 54.2k vs flat 48.1k gsps"}}',  # noqa: E501
+    '{"key":{"capacity":1024,"dtype":"int16","lanes":2048,"mailbox":true,"platform":"tpu","regime":"deep"},"plan":{"engine":"batched","fused_ticks":1,"ilp_subtiles":1,"sharding":"shard_map","tile":null},"provenance":{"source":"mailbox corner: provisional from BENCH_r05 mbdeep_sliced 60.6k vs cornerdeep_batched 76.7k gsps (the per-pair-vs-batched gap the r7 engines close); re-pinned by BENCH_r07 mbdeep_* + routing_match"}}',  # noqa: E501
+    '{"key":{"capacity":10000,"dtype":"int16","lanes":3328,"mailbox":false,"platform":"tpu","regime":"deep"},"plan":{"engine":"fc","fused_ticks":1,"ilp_subtiles":1,"sharding":"shard_map","tile":null},"provenance":{"source":"config5_pershard leg (r6): the true v4-32 config-5 per-chip shard; provisional winner = nearest measured neighbor until BENCH_r06 config5_pershard_* fields land"}}',  # noqa: E501
+    '{"key":{"capacity":10000,"dtype":"int16","lanes":3328,"mailbox":true,"platform":"tpu","regime":"deep"},"plan":{"engine":"fc","fused_ticks":1,"ilp_subtiles":1,"sharding":"shard_map","tile":null},"provenance":{"source":"mailbox config-5 per-chip shard: provisional (see the sync entry at this shape)"}}',  # noqa: E501
+    '{"key":{"capacity":10000,"dtype":"int16","lanes":13312,"mailbox":false,"platform":"tpu","regime":"deep"},"plan":{"engine":"fc","fused_ticks":1,"ilp_subtiles":1,"sharding":"shard_map","tile":null},"provenance":{"source":"BENCH_r05 deeplog: fc 258.0k gsps (3.6x batched per ROUND5.md stage table)"}}',  # noqa: E501
+    '{"key":{"capacity":10000,"dtype":"int16","lanes":13312,"mailbox":true,"platform":"tpu","regime":"deep"},"plan":{"engine":"fc","fused_ticks":1,"ilp_subtiles":1,"sharding":"shard_map","tile":null},"provenance":{"source":"mailbox production shape: provisional winner = the synchronous measured winner at the same shape until BENCH_r07 mbdeep_* fields land"}}',  # noqa: E501
+)
+# TUNING_TABLE[end]
+
+TUNING_TABLE = tuple(json.loads(r) for r in _TUNING_ROWS)
+
+
+def canonical_key(key: dict) -> str:
+    """The byte-stable identity of a resolution key (cache dict key, row
+    sort key)."""
+    return json.dumps(key, sort_keys=True, separators=(",", ":"))
+
+
+def _key_order(key: dict) -> tuple:
+    """Deterministic structural ordering (shallow rows first, then by
+    numeric shape) — NOT the json-string order, which would sort
+    lanes=1024 before lanes=128."""
+    return (0 if key["regime"] == "shallow" else 1, key["capacity"],
+            key["lanes"], bool(key["mailbox"]), key["dtype"],
+            key["platform"])
+
+
+def format_rows(entries) -> tuple:
+    """Entries -> the canonical row strings the marker block holds, sorted
+    by key: same entries (any order, any dict insertion history) => same
+    tuple of strings => same bytes on disk. THE byte-stability contract."""
+    rows = []
+    for e in entries:
+        e = {"key": dict(e["key"]), "plan": dict(e["plan"]),
+             "provenance": dict(e.get("provenance") or {})}
+        rows.append(json.dumps(e, sort_keys=True, separators=(",", ":")))
+    return tuple(sorted(rows, key=lambda r: _key_order(
+        json.loads(r)["key"])))
+
+
+def render_table_block(entries) -> str:
+    """The full text between the TUNING_TABLE markers for `entries` —
+    what --pin writes (byte-stable via format_rows)."""
+    lines = ["_TUNING_ROWS = ("]
+    for r in format_rows(entries):
+        lines.append("    '" + r + "',  # noqa: E501")
+    lines.append(")")
+    return "\n".join(lines)
+
+
+def pin_entries(entries, path: Optional[str] = None) -> None:
+    """Rewrite the marker-bounded TUNING_TABLE block in this module's
+    source with `entries` (the generalization of the old
+    probe_fused_ticks.py --pin regex rewrite). Byte-stable: pinning the
+    same entries twice writes identical bytes."""
+    import re
+
+    path = path or os.path.abspath(__file__)
+    with open(path) as f:
+        text = f.read()
+    m = re.search(
+        r"(# TUNING_TABLE\[begin\][^\n]*\n)(.*?)(\n# TUNING_TABLE\[end\])",
+        text, re.DOTALL)
+    if not m:
+        raise RuntimeError("TUNING_TABLE markers not found")
+    new = m.group(1) + render_table_block(entries) + m.group(3)
+    with open(path, "w") as f:
+        f.write(text[:m.start()] + new + text[m.end():])
+
+
+# ---------------------------------------------------------------------------
+# Keys and plans.
+
+def platform_class(platform: Optional[str]) -> str:
+    """Collapse a backend name onto the table's platform classes: "cpu"
+    stays "cpu" (the guard class), every accelerator resolves through the
+    "tpu" rows (the measured class — the same collapse route_deep_engine
+    historically applied)."""
+    if platform is None:
+        platform = jax.default_backend()
+    return "cpu" if platform == "cpu" else "tpu"
+
+
+def deep_key(capacity: int, lanes: int, mailbox: bool = False,
+             dtype: str = "int16", platform: Optional[str] = None) -> dict:
+    return {"regime": "deep", "capacity": int(capacity), "lanes": int(lanes),
+            "dtype": dtype, "mailbox": bool(mailbox),
+            "platform": platform_class(platform)}
+
+
+def shallow_key(tile: int, platform: Optional[str] = None,
+                dtype: str = "int32", mailbox: bool = False) -> dict:
+    return {"regime": "shallow", "capacity": 0, "lanes": int(tile),
+            "dtype": dtype, "mailbox": bool(mailbox),
+            "platform": platform_class(platform)}
+
+
+def default_plan(key: dict) -> dict:
+    """The conservative always-correct plan (resolution path 5)."""
+    if key["regime"] == "deep":
+        return {"engine": "flat", "ilp_subtiles": 1, "fused_ticks": 1,
+                "sharding": "shard_map", "tile": None}
+    return {"engine": "pallas", "ilp_subtiles": 1, "fused_ticks": 1,
+            "sharding": "shard_map", "tile": key["lanes"]}
+
+
+def apply_guards(key: dict, plan: dict) -> dict:
+    """The NON-tunable constraints, applied after every resolution path:
+
+    - CPU deep: the per-pair flat engine regardless of shape — XLA:CPU's
+      compile of the batched gather/scatter program blows up at real deep
+      widths (a compile-feasibility guard, not a perf class);
+    - CPU shallow: K=1 (the interpreter executes serially — no issue
+      latency to hide) and T=1 (no launch latency to amortize), the
+      byte-identity guarantee for the whole CPU differential suite;
+    - the 128-lane vreg floor: K must divide the tile into >=128-lane
+      slabs (Mosaic's hardware assertion can never fire on a routed K).
+    """
+    plan = dict(plan)
+    if key["platform"] == "cpu":
+        if key["regime"] == "deep":
+            plan["engine"] = "flat"
+        plan["ilp_subtiles"] = 1
+        plan["fused_ticks"] = 1
+        return plan
+    tile = plan.get("tile")
+    k = int(plan.get("ilp_subtiles") or 1)
+    if key["regime"] == "shallow" and tile:
+        if tile % k or (tile // k) % VREG_LANES:
+            plan["ilp_subtiles"] = 1
+    return plan
+
+
+def _load_cache(cache_path: Optional[str] = None) -> dict:
+    path = cache_path or CACHE_PATH
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except Exception:
+        return {}
+
+
+def _save_cache(cache: dict, cache_path: Optional[str] = None) -> None:
+    path = cache_path or CACHE_PATH
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(cache, f, sort_keys=True, indent=1)
+    os.replace(tmp, path)
+
+
+def cache_entry(key: dict, plan: dict, provenance: dict,
+                cache_path: Optional[str] = None) -> None:
+    """Persist one measured plan into the runtime cache (measure-on-first-
+    use path 3 writes through here; --pin promotes cache rows into the
+    in-repo table)."""
+    cache = _load_cache(cache_path)
+    cache[canonical_key(key)] = {"plan": dict(plan),
+                                 "provenance": dict(provenance)}
+    _save_cache(cache, cache_path)
+
+
+def _nearest(key: dict, entries) -> Optional[dict]:
+    """Nearest pinned entry in log-space on (capacity, lanes) within the
+    same (regime, mailbox, platform) class — the crossover interpolation
+    route_deep_engine used. Shallow keys interpolate on lanes only when no
+    exact tile row exists (the legacy tables' fallback was K=1/T=1, which
+    apply_guards' vreg floor and default_plan preserve via exact=None)."""
+    cands = [e for e in entries
+             if e["key"]["regime"] == key["regime"]
+             and e["key"]["mailbox"] == key["mailbox"]
+             and e["key"]["platform"] == key["platform"]]
+    if not cands:
+        return None
+    if key["regime"] == "shallow":
+        # Exact-tile semantics (legacy): unknown tiles do NOT inherit a
+        # neighbor's K/T — they fall through to the default plan.
+        exact = [e for e in cands if e["key"]["lanes"] == key["lanes"]]
+        return exact[0] if exact else None
+    lc, lg = math.log(max(key["capacity"], 1)), math.log(max(key["lanes"], 1))
+    return min(cands, key=lambda e: (
+        (math.log(max(e["key"]["capacity"], 1)) - lc) ** 2
+        + (math.log(max(e["key"]["lanes"], 1)) - lg) ** 2))
+
+
+def measure_enabled() -> bool:
+    return os.environ.get("RAFT_AUTOTUNE", "") == "measure"
+
+
+def resolve_plan(key: dict, measure: Optional[bool] = None,
+                 cache_path: Optional[str] = None,
+                 measure_fn: Optional[Callable] = None,
+                 with_source: bool = False):
+    """THE resolution: key -> plan (see the module docstring for the
+    order). `measure_fn(key) -> (plan, provenance)` injects a measurement
+    backend (tests; default = measure_key below). `with_source=True`
+    additionally returns where the plan came from: "pinned" | "cache" |
+    "measured" | "nearest" | "default"."""
+    key = dict(key)
+    key["platform"] = platform_class(key.get("platform"))
+
+    def out(plan, source):
+        plan = apply_guards(key, plan)
+        return (plan, source) if with_source else plan
+
+    ck = canonical_key(key)
+    for e in TUNING_TABLE:
+        if canonical_key(e["key"]) == ck:
+            return out(e["plan"], "pinned")
+    cached = _load_cache(cache_path).get(ck)
+    if cached is not None:
+        return out(cached["plan"], "cache")
+    if measure is None:
+        measure = measure_enabled()
+    # CPU keys never measure: the guards pin their whole plan anyway.
+    if measure and key["platform"] != "cpu":
+        fn = measure_fn or measure_key
+        plan, prov = fn(key)
+        cache_entry(key, plan, prov, cache_path)
+        return out(plan, "measured")
+    near = _nearest(key, TUNING_TABLE)
+    if near is not None:
+        return out(near["plan"], "nearest")
+    return out(default_plan(key), "default")
+
+
+# ---------------------------------------------------------------------------
+# Legacy-table derived views + the old lookup signatures (the adapters
+# parallel/mesh.py and ops/pallas_tick.py re-export).
+
+def derived_deep_table() -> tuple:
+    """DEEP_ROUTING_TABLE's (C, g_shard, mailbox, winner, source) rows,
+    derived from the unified table's deep entries."""
+    rows = []
+    for e in TUNING_TABLE:
+        k = e["key"]
+        if k["regime"] != "deep" or k["platform"] != "tpu":
+            continue
+        rows.append((k["capacity"], k["lanes"], k["mailbox"],
+                     e["plan"]["engine"], e["provenance"].get("source", "")))
+    return tuple(sorted(rows, key=lambda r: (r[0], r[1], r[2])))
+
+
+def _shallow_rows():
+    return sorted(
+        (e for e in TUNING_TABLE
+         if e["key"]["regime"] == "shallow"
+         and e["key"]["platform"] == "tpu"),
+        key=lambda e: -e["key"]["lanes"])
+
+
+def derived_ilp_table() -> tuple:
+    """ILP_SUBTILE_TABLE's (tile, K, source) rows, derived view."""
+    return tuple((e["key"]["lanes"], e["plan"]["ilp_subtiles"],
+                  e["provenance"].get("source", ""))
+                 for e in _shallow_rows())
+
+
+def derived_fused_table() -> tuple:
+    """FUSED_TICK_TABLE's (tile, T, source) rows, derived view."""
+    return tuple((e["key"]["lanes"], e["plan"]["fused_ticks"],
+                  e["provenance"].get("source", ""))
+                 for e in _shallow_rows())
+
+
+def deep_engine(C: int, g_shard: int, platform: Optional[str] = None,
+                mailbox: bool = False) -> str:
+    """The deep-band per-shard engine for a shape — the unified-layer form
+    of the old route_deep_engine (parallel/mesh.py re-exports it under
+    that name; semantics pinned equal by tests/test_autotune.py)."""
+    return resolve_plan(deep_key(C, g_shard, mailbox=mailbox,
+                                 platform=platform))["engine"]
+
+
+def ilp_subtiles(tile_g: int, platform: Optional[str] = None) -> int:
+    """Sub-tile ILP K for a megakernel tile — the unified-layer form of
+    the old route_ilp_subtiles (ops/pallas_tick.py re-exports)."""
+    plan = resolve_plan(shallow_key(tile_g, platform=platform))
+    k = int(plan["ilp_subtiles"])
+    return k if tile_g % k == 0 else 1
+
+
+def fused_ticks(tile_g: int, platform: Optional[str] = None) -> int:
+    """Fused tick count T for a megakernel tile — the unified-layer form
+    of the old route_fused_ticks (ops/pallas_tick.py re-exports)."""
+    return int(resolve_plan(shallow_key(tile_g,
+                                        platform=platform))["fused_ticks"])
+
+
+# ---------------------------------------------------------------------------
+# Whole-config resolution (the composed entry).
+
+def plan_for(cfg, mesh=None, platform: Optional[str] = None,
+             telemetry: bool = False, monitor: bool = False,
+             trace: bool = False, with_source: bool = False):
+    """Resolve the FULL execution plan for a config (optionally sharded
+    over `mesh`): the one place that composes regime classification
+    (cfg.uses_dyn_log), per-shard lane width, the τ=0-mailbox flat guard,
+    the Pallas tile/VMEM model and the tuning table into
+    {engine, ilp_subtiles, fused_ticks, sharding, tile}.
+
+    Shallow plans resolve their geometry through ops/pallas_tick.
+    resolve_fused_geometry (the VMEM model must see the observers'
+    snapshot rows), which itself routes T and K through this module — the
+    table consultation happens exactly once, here."""
+    n_dev = 1
+    if mesh is not None:
+        n_dev = math.prod(mesh.devices.shape)
+        platform = platform or mesh.devices.flatten()[0].platform
+    pclass = platform_class(platform)
+    lanes = cfg.n_groups // max(n_dev, 1)
+    if cfg.uses_dyn_log:
+        if cfg.uses_mailbox and not cfg.known_delivery:
+            # τ=0 mailbox: no pre-computable read set — per-pair flat is
+            # the only valid engine (the caller-level rule every deep
+            # router applies; a table entry can never override it).
+            plan, source = ({"engine": "flat", "ilp_subtiles": 1,
+                             "fused_ticks": 1, "sharding": "shard_map",
+                             "tile": None}, "guard")
+        else:
+            plan, source = resolve_plan(
+                deep_key(cfg.log_capacity, lanes, mailbox=cfg.uses_mailbox,
+                         dtype=cfg.log_dtype, platform=pclass),
+                with_source=True)
+        plan = dict(plan)
+        plan["sharding"] = "shard_map" if mesh is not None else "single"
+        return (plan, source) if with_source else plan
+    # Shallow: pallas when the tile model fits on an accelerator, else xla.
+    interpret = pclass == "cpu"
+    engine = "xla"
+    tile = None
+    k, T = 1, 1
+    if not interpret:
+        from raft_kotlin_tpu.ops.pallas_tick import (
+            _snapshot_rows, fused_snapshot_fields, resolve_fused_geometry)
+
+        try:
+            snaps = (fused_snapshot_fields(cfg, telemetry=telemetry,
+                                           monitor=monitor, trace=trace)
+                     if (telemetry or monitor or trace) else ())
+            tile, k, T = resolve_fused_geometry(
+                cfg, interpret=False,
+                snap_rows=_snapshot_rows(cfg, snaps),
+                lanes=lanes if mesh is not None else None,
+                platform=None if mesh is None else pclass)
+            engine = "pallas"
+        except ValueError:
+            engine, tile, k, T = "xla", None, 1, 1
+    source = "pinned" if engine == "pallas" else "guard"
+    if engine == "pallas" and tile is not None:
+        _, source = resolve_plan(shallow_key(tile, platform=pclass),
+                                 with_source=True)
+    plan = {"engine": engine, "ilp_subtiles": int(k), "fused_ticks": int(T),
+            "sharding": ("shard_map" if engine == "pallas" else "spmd")
+            if mesh is not None else "single", "tile": tile}
+    return (plan, source) if with_source else plan
+
+
+def make_planned_run(cfg, n_ticks: int, mesh=None, telemetry: bool = False,
+                     monitor: bool = False, metrics_every: int = 0,
+                     plan: Optional[dict] = None):
+    """The single composed entry (ROADMAP item 2): resolve the plan and
+    dispatch it onto the right engine builder. Returns (run, plan):
+
+    - deep + mesh      -> ops/deep_cache.make_sharded_deep_scan (the
+                          plan's engine; run(state[, rng, summarize]) ->
+                          reduction dict, self_timed)
+    - deep, 1 device   -> ops/deep_cache.make_deep_scan (fc) or
+                          ops/tick.make_run-style scan (batched/flat)
+    - shallow + mesh   -> parallel/mesh.make_sharded_run (impl + fused_
+                          ticks from the plan)
+    - shallow, 1 device-> ops/pallas_tick.make_pallas_scan (pallas) or
+                          ops/tick.make_run (xla)
+
+    Every dispatch target consumes the RESOLVED plan; none consults a
+    table of its own. Plan choice is bit-neutral (SEMANTICS.md §13), so
+    this entry only ever decides speed."""
+    plan = dict(plan) if plan is not None else plan_for(
+        cfg, mesh, telemetry=telemetry, monitor=monitor)
+    if cfg.uses_dyn_log:
+        from raft_kotlin_tpu.ops.deep_cache import (
+            make_deep_scan, make_sharded_deep_scan)
+
+        if mesh is not None:
+            run = make_sharded_deep_scan(cfg, mesh, n_ticks,
+                                         engine=plan["engine"],
+                                         telemetry=telemetry,
+                                         monitor=monitor)
+            return run, plan
+        if plan["engine"] == "fc":
+            return make_deep_scan(cfg, n_ticks, telemetry=telemetry,
+                                  monitor=monitor), plan
+        from raft_kotlin_tpu.ops.tick import make_run
+
+        run = make_run(cfg, n_ticks, trace=False,
+                       batched=None if plan["engine"] == "batched" else False,
+                       telemetry=telemetry, monitor=monitor)
+        return run, plan
+    if mesh is not None:
+        from raft_kotlin_tpu.parallel.mesh import make_sharded_run
+
+        impl = "pallas" if plan["engine"] == "pallas" else "xla"
+        run = make_sharded_run(cfg, mesh, n_ticks,
+                               metrics_every=metrics_every, impl=impl,
+                               telemetry=telemetry, monitor=monitor,
+                               fused_ticks=plan["fused_ticks"]
+                               if impl == "pallas" else None)
+        return run, plan
+    if plan["engine"] == "pallas":
+        from raft_kotlin_tpu.ops.pallas_tick import make_pallas_scan
+
+        run = make_pallas_scan(cfg, n_ticks, tile_g=plan["tile"],
+                               ilp_subtiles=plan["ilp_subtiles"],
+                               fused_ticks=plan["fused_ticks"],
+                               telemetry=telemetry, monitor=monitor)
+        return run, plan
+    from raft_kotlin_tpu.ops.tick import make_run
+
+    run = make_run(cfg, n_ticks, trace=False, telemetry=telemetry,
+                   monitor=monitor, fused_ticks=plan["fused_ticks"])
+    return run, plan
+
+
+# ---------------------------------------------------------------------------
+# Measurement (resolution path 3 + the --measure/--audit CLI backend).
+# Everything routes through bench.measure — the timing-trap-hardened
+# harness (per-rep distinct rng operands, in-region host materialization,
+# medians) — so a tuned entry is a production-shape measurement, not a
+# microbenchmark.
+
+def _bench():
+    import sys
+
+    if REPO_ROOT not in sys.path:
+        sys.path.insert(0, REPO_ROOT)
+    import bench
+
+    return bench
+
+
+def measure_deep_key(key: dict, n_ticks: int = 10, reps: int = 2) -> tuple:
+    """Benchmark fc/batched/flat at the key's shape through the sharded
+    harness (1-device mesh — shard_map dispatch cost cancels out of the
+    crossover, the same argument as bench's routing-audit legs). Returns
+    (plan, provenance)."""
+    import dataclasses as dc
+
+    from raft_kotlin_tpu.ops.deep_cache import make_sharded_deep_scan
+    from raft_kotlin_tpu.parallel.mesh import make_mesh
+    from raft_kotlin_tpu.utils.config import RaftConfig
+
+    bench = _bench()
+    cfg = RaftConfig(
+        n_groups=key["lanes"], n_nodes=7, log_capacity=key["capacity"],
+        log_dtype=key["dtype"], cmd_period=2, p_drop=0.05, seed=3,
+    ).stressed(10)
+    if key["mailbox"]:
+        cfg = dc.replace(cfg, delay_lo=1, delay_hi=3)
+    mesh = make_mesh(jax.devices()[:1])
+    timings = {}
+    for engine in DEEP_ENGINES:
+        def gen(cfg_c, engine=engine):
+            yield (lambda n: make_sharded_deep_scan(
+                cfg_c, mesh, n, engine=engine)), f"shardmap-{engine}"
+        try:
+            ts, _, _ = bench.measure(cfg, n_ticks, reps, gen)
+            timings[engine] = round(
+                cfg.n_groups * n_ticks / bench.median(ts), 1)
+        except Exception as e:
+            timings[engine] = None
+            print(f"autotune measure {engine} failed: {str(e)[:160]}")
+    valid = {k: v for k, v in timings.items() if v}
+    if not valid:
+        raise RuntimeError(f"no deep engine measurable at {key}")
+    winner = max(valid, key=valid.get)
+    plan = {"engine": winner, "ilp_subtiles": 1, "fused_ticks": 1,
+            "sharding": "shard_map", "tile": None}
+    prov = {"source": f"autotune measure-on-first-use "
+                      f"({jax.devices()[0].platform})",
+            "measured": {"gsps": timings, "ticks": n_ticks, "reps": reps}}
+    return plan, prov
+
+
+def measure_shallow_key(key: dict, n_ticks: int = 20,
+                        reps: int = 2) -> tuple:
+    """Benchmark the (T, K) grid at the key's tile through the headline
+    builder shape (recorder+monitor on, flat carry — probe_fused_ticks'
+    production-program discipline). Returns (plan, provenance)."""
+    from raft_kotlin_tpu.ops.pallas_tick import make_pallas_scan
+    from raft_kotlin_tpu.utils.config import RaftConfig
+
+    bench = _bench()
+    tile = key["lanes"]
+    cfg = RaftConfig(
+        n_groups=max(tile * 8, 4096), n_nodes=5, log_capacity=32,
+        cmd_period=10, p_drop=0.25, p_crash=0.01, p_restart=0.08,
+        p_link_fail=0.02, p_link_heal=0.08, seed=0,
+    ).stressed(10)
+    timings = {}
+    for T in (1, 2, 4, 8):
+        for K in (1, 2, 4):
+            if tile % K or (tile // K) % VREG_LANES:
+                continue
+
+            def gen(cfg_c, T=T, K=K):
+                yield (lambda n: make_pallas_scan(
+                    cfg_c, n, tile_g=tile, interpret=False, jitted=False,
+                    telemetry=True, monitor=True, fused_ticks=T,
+                    ilp_subtiles=K)), f"pallas-T{T}K{K}"
+            try:
+                ts, stats, _ = bench.measure(cfg, n_ticks, reps, gen)
+                best = bench.median(ts)
+                if int(stats[ts.index(best)].get(
+                        "tel_fused_draw_overflow") or 0):
+                    continue  # clamped draws: invalid point
+                timings[f"T{T}K{K}"] = round(n_ticks / best, 2)
+            except Exception as e:
+                print(f"autotune measure T{T}K{K} failed: {str(e)[:160]}")
+    if not timings:
+        raise RuntimeError(f"no shallow point measurable at {key}")
+    winner = max(timings, key=timings.get)
+    T, K = (int(x) for x in winner[1:].split("K"))
+    plan = {"engine": "pallas", "ilp_subtiles": K, "fused_ticks": T,
+            "sharding": "shard_map", "tile": tile}
+    prov = {"source": f"autotune measure-on-first-use "
+                      f"({jax.devices()[0].platform})",
+            "measured": {"ticks_per_sec": timings, "ticks": n_ticks,
+                         "reps": reps}}
+    return plan, prov
+
+
+def measure_key(key: dict, **kw) -> tuple:
+    """(plan, provenance) for one key — the default measure_fn."""
+    if key["regime"] == "deep":
+        return measure_deep_key(key, **kw)
+    return measure_shallow_key(key, **kw)
+
+
+def audit_entries(entries=None, measure_fn: Optional[Callable] = None,
+                  **kw) -> list:
+    """Re-measure pinned entries on the CURRENT platform and report drift
+    (the --audit CLI): [{key, pinned, measured, match}]. Only entries of
+    this platform's class are auditable (a CPU host cannot audit tpu
+    pins)."""
+    entries = TUNING_TABLE if entries is None else entries
+    pclass = platform_class(None)
+    fn = measure_fn or measure_key
+    out = []
+    for e in entries:
+        if e["key"]["platform"] != pclass:
+            continue
+        try:
+            plan, prov = fn(dict(e["key"]), **kw)
+        except Exception as err:
+            out.append({"key": e["key"], "pinned": e["plan"],
+                        "measured": None, "match": None,
+                        "error": str(err)[:200]})
+            continue
+        match = all(plan.get(f) == e["plan"].get(f)
+                    for f in ("engine", "ilp_subtiles", "fused_ticks"))
+        out.append({"key": e["key"], "pinned": e["plan"], "measured": plan,
+                    "provenance": prov, "match": match})
+    return out

@@ -64,89 +64,175 @@ def shard_map_compat(f, mesh, in_specs, out_specs, check_vma=False):
 # avoid log takes), "batched" (plain batched engine — pays the take/scatter op
 # floors every tick), and "flat" (per-pair flat engine — the round-2 sharded
 # program; no batching, ~7 log ops per pair). Which one wins is a function of
-# the SHAPE (log capacity C x per-shard lane width G), not of the platform:
-# BENCH_r05's own corner data shows fc LOSING at small C/G (54.2k vs 71.1k
-# gsps at C=1024/G=2048) while winning 3.6x at the production shape
-# (C=10k/G=13312). Routing therefore consults this measured crossover table —
-# nearest benched shape in log-space — instead of a platform class. Every
-# entry cites its bench artifact; bench.py re-measures all three engines at
-# each tabulated shape every round and publishes *_routing_match fields so a
-# stale entry is a visible artifact, not a silent misroute.
+# the SHAPE (log capacity C x per-shard lane width G), not of the platform.
 #
-# Round 7 adds a MAILBOX dimension: for delay_lo >= 1 (the known-delivery
-# regime) the batched/fc engines run under the §10 mailbox too (ops/tick.py
-# r7), with their own crossover — the mailbox pays extra per-pair slot
-# algebra AND a wider read batch (6N+1 vs 4N+1 term rows), so the mailbox
-# entries are pinned separately. τ=0 (delay_lo == 0) never reaches the
-# table: callers route it to "flat"/per-pair (no pre-computable read set).
-DEEP_ROUTING_TABLE = (
-    # (C, per-shard G, mailbox, winner, source artifact)
-    (10_000, 13_312, False, "fc",
-     "BENCH_r05 deeplog: fc 258.0k gsps (3.6x batched per ROUND5.md stage"
-     " table)"),
-    (10_000, 3_328, False, "fc",
-     "config5_pershard leg (r6): the true v4-32 config-5 per-chip shard;"
-     " provisional winner = nearest measured neighbor until BENCH_r06's"
-     " config5_pershard_* fields land"),
-    (1_024, 2_048, False, "batched",
-     "BENCH_r05 corner: batched 71.1k vs fc 54.2k vs flat 48.1k gsps"),
-    (10_000, 13_312, True, "fc",
-     "mailbox production shape: provisional winner = the synchronous"
-     " measured winner at the same shape until BENCH_r07's mbdeep_* fields"
-     " land"),
-    (10_000, 3_328, True, "fc",
-     "mailbox config-5 per-chip shard: provisional (see above)"),
-    (1_024, 2_048, True, "batched",
-     "mailbox corner: provisional from BENCH_r05 mbdeep_sliced 60.6k vs"
-     " cornerdeep_batched 76.7k gsps (the per-pair-vs-batched gap the r7"
-     " engines close); re-pinned by BENCH_r07 mbdeep_* + routing_match"),
-)
+# Since round 13 the crossover data lives in the UNIFIED tuning table
+# (parallel/autotune.py — one plan layer for engine + ILP + fused-tick +
+# sharding routing, measure-on-first-use + pinnable). DEEP_ROUTING_TABLE
+# remains as a DERIVED VIEW of that table's deep rows (same
+# (C, g_shard, mailbox, winner, source) tuples — bench's routing audits
+# and the historical tests keep reading it) and route_deep_engine
+# delegates to the unified resolution; tests/test_autotune.py pins the
+# two equal over the full shape lattice.
+from raft_kotlin_tpu.parallel import autotune as autotune_mod
+
+DEEP_ROUTING_TABLE = autotune_mod.derived_deep_table()
 
 
 def route_deep_engine(C: int, g_shard: int,
                       platform: Optional[str] = None,
                       mailbox: bool = False) -> str:
     """Pick the deep-log per-shard engine ("fc" | "batched" | "flat") for a
-    (log capacity, per-shard lane width[, mailbox]) shape from
-    DEEP_ROUTING_TABLE — the measured winner at the nearest benched shape
-    in log-space within the config's mailbox class.
+    (log capacity, per-shard lane width[, mailbox]) shape — since round 13
+    a view of the unified tuning layer (parallel/autotune.resolve_plan):
+    the measured winner at the exact pinned shape, else the nearest pinned
+    shape in log-space within the config's mailbox class.
 
     `platform` (default: jax.default_backend()) carries the one surviving
     NON-perf constraint: XLA:CPU's compile of the batched gather/scatter
     program blows up at real deep widths (the round-2 observation
     _make_shardmap_xla_tick documents), so CPU meshes stay on the per-pair
     flat engine regardless of shape — a compile-feasibility guard, not a
-    perf class. `mailbox=True` selects the mailbox crossover entries and is
-    only meaningful for delay_lo >= 1 (known-delivery): τ=0 mailbox configs
-    are handled by the CALLER (a slot can be filled and delivered within
-    one tick, so only "flat"/per-pair is valid there).
+    perf class (autotune.apply_guards). `mailbox=True` selects the mailbox
+    crossover entries and is only meaningful for delay_lo >= 1
+    (known-delivery): τ=0 mailbox configs are handled by the CALLER (a
+    slot can be filled and delivered within one tick, so only
+    "flat"/per-pair is valid there).
     """
-    if platform is None:
-        platform = jax.default_backend()
-    if platform == "cpu":
-        return "flat"
-    lc, lg = math.log(max(C, 1)), math.log(max(g_shard, 1))
-    best = min((e for e in DEEP_ROUTING_TABLE if e[2] == mailbox),
-               key=lambda e: (math.log(e[0]) - lc) ** 2
-               + (math.log(e[1]) - lg) ** 2)
-    return best[3]
+    return autotune_mod.deep_engine(C, g_shard, platform=platform,
+                                    mailbox=mailbox)
 
 
 def rng_shardings(cfg: RaftConfig, mesh: Mesh):
     """NamedShardings for the make_rng(cfg) operand tuple, derived from its
-    own eval_shape so the scenario bank (per-group (G,) arrays, present
-    when cfg.scenario is set) shards over groups exactly like the key
-    grids: rank-0 leaves replicate, (G,) leaves shard on the flat mesh,
-    (N, G) leaves shard on their last axis. THE one copy of the rng
-    placement contract (make_sharded_run and the deep sharded runners)."""
+    own eval_shape: any leaf whose LAST axis is group-sized shards on the
+    flat mesh over that axis (the key grids, the scenario bank's (G,)
+    channels); everything else replicates. THE one copy of the rng
+    placement contract (make_sharded_run, the deep sharded runners, and
+    the sharded fuzz farm).
+
+    Placement is decided by SHAPE, not rank: the old rank-based mapping
+    ({0: replicate, 1: shard, 2: shard-last}) was a single-device
+    assumption — any rank-1 leaf that is not group-sized (a future bank
+    table row, a raw-key pair) would have been sharded over an axis it
+    cannot tile on a real mesh."""
     from raft_kotlin_tpu.ops.tick import make_rng
 
     rep = NamedSharding(mesh, P())
-    lanes1 = NamedSharding(mesh, P(("dcn", "ici")))
-    lanes2 = NamedSharding(mesh, P(None, ("dcn", "ici")))
+    G = cfg.n_groups
+
+    def pick(s):
+        if s.ndim and s.shape[-1] == G:
+            return NamedSharding(
+                mesh, P(*([None] * (s.ndim - 1)), ("dcn", "ici")))
+        return rep
+
     shapes = jax.eval_shape(lambda: make_rng(cfg))
-    return jax.tree_util.tree_map(
-        lambda s: {0: rep, 1: lanes1, 2: lanes2}[len(s.shape)], shapes)
+    return jax.tree_util.tree_map(pick, shapes)
+
+
+# ---------------------------------------------------------------------------
+# Collective-freedom (ISSUE 10): groups never communicate, so the sharded
+# TICK must be collective-free — telemetry/monitor/window reductions and
+# checkpoint I/O are the ONLY cross-device traffic, and they live OUTSIDE
+# shard_map by construction. These checkers make that claim auditable.
+
+# Explicit cross-shard communication primitives a shard_map body could
+# contain (jaxpr names; psum2 is the newer psum binding).
+COLLECTIVE_PRIMITIVES = frozenset({
+    "psum", "psum2", "pmax", "pmin", "pmean", "ppermute", "pbroadcast",
+    "all_gather", "all_gather_invariant", "all_to_all", "reduce_scatter",
+    "pgather", "axis_index_groups",
+})
+
+# HLO instruction names XLA emits for cross-device traffic (compiled-module
+# scan — catches what the SPMD partitioner inserts, which never appears in
+# a jaxpr).
+HLO_COLLECTIVE_OPS = ("all-reduce", "all-gather", "all-to-all",
+                      "collective-permute", "reduce-scatter",
+                      "collective-broadcast")
+
+
+def jaxpr_collectives(fn, *args) -> list:
+    """Names of every collective primitive reachable from fn's jaxpr
+    (recursing through scan/cond/pjit/shard_map sub-jaxprs). Inside
+    shard_map, ANY cross-device op must be an explicit collective
+    primitive — so an empty list proves the traced program is shard-local
+    end to end."""
+    jaxpr = jax.make_jaxpr(fn)(*args)
+    found = []
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            if eqn.primitive.name in COLLECTIVE_PRIMITIVES:
+                found.append(eqn.primitive.name)
+            for v in eqn.params.values():
+                for sub in jax.tree_util.tree_leaves(
+                        v, is_leaf=lambda x: hasattr(x, "jaxpr")):
+                    if hasattr(sub, "jaxpr"):
+                        walk(sub.jaxpr)
+                    elif hasattr(sub, "eqns"):
+                        walk(sub)
+
+    walk(jaxpr.jaxpr)
+    return found
+
+
+def compiled_collectives(lowered_or_fn, *args) -> list:
+    """HLO collective instruction names in the COMPILED module of a jitted
+    callable (pass a jax.jit result plus its args, or an already-lowered
+    object). This is the check that covers the SPMD ("xla" impl) path,
+    where collectives are inserted at partitioning time and never appear
+    in the jaxpr."""
+    import re
+
+    if hasattr(lowered_or_fn, "compile"):
+        compiled = lowered_or_fn.compile()
+    else:
+        compiled = jax.jit(lowered_or_fn).lower(*args).compile()
+    text = compiled.as_text()
+    out = []
+    # HLO spells ops as `%name = type op-name(...)`; on TPU/GPU backends
+    # collectives routinely lower to ASYNC pairs (`all-reduce-start` /
+    # `all-reduce-done`) — match those too and report the canonical name
+    # (a matcher that only saw the sync form would false-pass a module
+    # full of cross-device traffic). Anchored on `(` so instruction
+    # spellings match, not metadata substrings.
+    pats = [(op, re.compile(rf"(?:^|[\s=]){re.escape(op)}"
+                            rf"(?:-start|-done)?\("))
+            for op in HLO_COLLECTIVE_OPS]
+    for line in text.splitlines():
+        s = line.strip()
+        for op, pat in pats:
+            if pat.search(s):
+                out.append(op)
+    return out
+
+
+def assert_tick_collective_free(cfg: RaftConfig, mesh: Mesh,
+                                impl: str = "xla") -> int:
+    """Trace ONE bare sharded tick (no observers — their reductions are
+    the sanctioned cross-device traffic) and assert its jaxpr contains no
+    collective primitive; returns the number of shard_map-visible
+    collectives found (always 0 on success). The bench pod legs and
+    tests/test_pod.py publish/pin this."""
+    from raft_kotlin_tpu.ops.tick import make_rng
+
+    if impl == "pallas":
+        tick = _make_shardmap_pallas_tick(cfg, mesh)
+    elif cfg.uses_dyn_log:
+        tick = _make_shardmap_xla_tick(cfg, mesh)
+    else:
+        xla_tick = make_tick(cfg)
+        tick = lambda st, rng: xla_tick(st, rng=rng)
+    st = init_sharded(cfg, mesh)
+    rng = jax.jit(lambda: make_rng(cfg),
+                  out_shardings=rng_shardings(cfg, mesh))()
+    found = jaxpr_collectives(tick, st, rng)
+    assert not found, (
+        f"sharded tick is NOT collective-free: {sorted(set(found))} — "
+        "cross-device traffic outside the telemetry/checkpoint envelope")
+    return len(found)
 
 
 def make_mesh(devices: Optional[Sequence[jax.Device]] = None,

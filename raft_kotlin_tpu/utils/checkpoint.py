@@ -27,7 +27,7 @@ import jax
 import numpy as np
 
 from raft_kotlin_tpu.models.state import RaftState
-from raft_kotlin_tpu.utils.config import RaftConfig
+from raft_kotlin_tpu.utils.config import RaftConfig, config_from_dict
 
 _HEADER_KEY = "__raft_config_json__"
 _EXTRA_KEY = "__raft_extra_json__"
@@ -208,7 +208,11 @@ def load_sharded(
         raise ValueError(
             f"sharded checkpoint version {version} not supported "
             f"(this build reads 4-{_VERSION})")
-    cfg = RaftConfig(**manifest["cfg"])
+    # config_from_dict, not RaftConfig(**...): a scenario config's nested
+    # ScenarioSpec json-roundtrips as a plain dict and must be rebuilt —
+    # the PR-8 fuzz-farm bank made scenario configs checkpointable state
+    # holders, and a sharded farm resume must roundtrip them (r13).
+    cfg = config_from_dict(manifest["cfg"])
     if expect_cfg is not None and expect_cfg != cfg:
         raise ValueError(
             f"checkpoint config mismatch:\n saved   {cfg}\n expected {expect_cfg}")
@@ -333,7 +337,7 @@ def _load_impl(path, expect_cfg, sharding):
     if version < 5 and "last_term" not in arrays:
         arrays["last_term"] = _derive_last_term(
             arrays["log_term"], arrays["last_index"])
-    cfg = RaftConfig(**cfg_dict)
+    cfg = config_from_dict(cfg_dict)  # rebuilds a nested ScenarioSpec too
     arrays = _canon_dtypes(arrays, cfg)
     from raft_kotlin_tpu.models.state import MAILBOX_FIELDS
 

@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""The unified tuning-table CLI (ISSUE 10 satellite — generalizes
+scripts/probe_fused_ticks.py --pin to the WHOLE plan space).
+
+The one routing layer (raft_kotlin_tpu/parallel/autotune.py) resolves the
+full execution plan {engine, ilp_subtiles, fused_ticks, sharding, tile}
+per (regime, shape, dtype, mailbox, platform) key from the pinned
+TUNING_TABLE, the runtime measurement cache, or measure-on-first-use.
+This CLI drives the measured side of that contract:
+
+  python scripts/autotune.py --measure [key...]
+      Benchmark candidate plans for each key on the CURRENT platform
+      (through bench.measure — the timing-trap-hardened harness) and
+      populate the runtime cache (.autotune_cache.json). Default key set:
+      every pinned key of this platform's class, so a fresh machine tunes
+      the shapes the repo actually routes.
+
+  python scripts/autotune.py --pin
+      Promote the runtime cache (plus any pinned rows the cache does not
+      override) into the in-repo TUNING_TABLE — the marker-bounded block
+      in parallel/autotune.py is rewritten BYTE-STABLY (same measurements
+      => same bytes; canonical JSON rows, sorted by key). Refused on CPU:
+      interpreter/host timings cannot pin a hardware table.
+
+  python scripts/autotune.py --audit
+      Re-measure every pinned entry of this platform's class and report
+      drift (pinned plan vs freshly measured winner). Exit 2 when any
+      entry drifted — the per-round re-pin discipline as one command
+      instead of three probe scripts.
+
+Keys are given as JSON objects (see autotune.deep_key/shallow_key) or the
+shorthand  deep:C,LANES[,mailbox]  /  shallow:TILE .
+
+Plan choice is semantics-free (SEMANTICS.md §13): every plan is
+bit-identical to every other, so this tool can only ever change speed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_compilation_cache_dir", os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+from raft_kotlin_tpu.parallel import autotune  # noqa: E402
+
+
+def parse_key(arg: str) -> dict:
+    if arg.startswith("{"):
+        return json.loads(arg)
+    kind, _, rest = arg.partition(":")
+    parts = [p for p in rest.split(",") if p]
+    if kind == "deep":
+        C, lanes = int(parts[0]), int(parts[1])
+        mailbox = len(parts) > 2 and parts[2] in ("1", "true", "mailbox")
+        return autotune.deep_key(C, lanes, mailbox=mailbox)
+    if kind == "shallow":
+        return autotune.shallow_key(int(parts[0]))
+    raise SystemExit(f"unparseable key {arg!r} (deep:C,LANES[,mailbox] | "
+                     f"shallow:TILE | JSON)")
+
+
+def default_keys() -> list:
+    pclass = autotune.platform_class(None)
+    return [dict(e["key"]) for e in autotune.TUNING_TABLE
+            if e["key"]["platform"] == pclass]
+
+
+def main() -> int:
+    args = sys.argv[1:]
+    mode = next((a for a in ("--measure", "--pin", "--audit")
+                 if a in args), None)
+    keys = [parse_key(a) for a in args if not a.startswith("--")]
+    on_accel = jax.default_backend() != "cpu"
+
+    if mode == "--measure" or mode is None:
+        keys = keys or default_keys()
+        if not keys:
+            print("no measurable keys for this platform class "
+                  f"({autotune.platform_class(None)})", file=sys.stderr)
+            return 2
+        results = []
+        for key in keys:
+            try:
+                plan, prov = autotune.measure_key(key)
+                autotune.cache_entry(key, plan, prov)
+                results.append({"key": key, "plan": plan,
+                                "provenance": prov})
+            except Exception as e:
+                results.append({"key": key, "error": str(e)[:300]})
+        print(json.dumps({"mode": "measure",
+                          "platform": jax.devices()[0].platform,
+                          "cache": autotune.CACHE_PATH,
+                          "results": results}), flush=True)
+        return 0
+
+    if mode == "--pin":
+        if not on_accel:
+            print("--pin refused: CPU interpreter/host timings cannot pin "
+                  "a hardware table", file=sys.stderr)
+            return 2
+        cache = autotune._load_cache()
+        if not cache:
+            print(f"--pin: empty cache at {autotune.CACHE_PATH} — run "
+                  "--measure first", file=sys.stderr)
+            return 2
+        by_key = {autotune.canonical_key(e["key"]): dict(e)
+                  for e in autotune.TUNING_TABLE}
+        for ck, row in cache.items():
+            by_key[ck] = {"key": json.loads(ck), "plan": row["plan"],
+                          "provenance": row["provenance"]}
+        entries = list(by_key.values())
+        autotune.pin_entries(entries)
+        print(json.dumps({"mode": "pin", "entries": len(entries),
+                          "from_cache": len(cache),
+                          "path": autotune.__file__}), flush=True)
+        return 0
+
+    # --audit
+    report = autotune.audit_entries()
+    drifted = [r for r in report if r.get("match") is False]
+    print(json.dumps({"mode": "audit",
+                      "platform": jax.devices()[0].platform,
+                      "audited": len(report),
+                      "drifted": len(drifted),
+                      "report": report}), flush=True)
+    for r in drifted:
+        print(f"DRIFT: {r['key']} pinned {r['pinned']} but measured "
+              f"{r['measured']}", file=sys.stderr)
+    return 2 if drifted else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

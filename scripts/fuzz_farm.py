@@ -75,6 +75,11 @@ def main() -> int:
     ap.add_argument("--out", default=None, help="JSONL corpus path")
     ap.add_argument("--json", action="store_true",
                     help="print the full summary as JSON")
+    ap.add_argument("--shard", action="store_true",
+                    help="shard each batch's universes over ALL visible "
+                    "devices (ISSUE 10: scenario throughput multiplies "
+                    "with the pod; bits and corpus hash are identical to "
+                    "the single-device run — batch must tile the mesh)")
     args = ap.parse_args()
 
     import dataclasses
@@ -100,9 +105,15 @@ def main() -> int:
         delay_lo=delay_lo, delay_hi=delay_hi, seed=args.seed,
         scenario=spec).stressed(args.stress)
 
+    mesh = None
+    if args.shard:
+        from raft_kotlin_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh()
+
     res = fuzz.fuzz_farm(cfg, args.ticks, universes=args.universes,
                          batch_groups=batch, out_path=args.out,
-                         verbose=True)
+                         verbose=True, mesh=mesh)
     if args.json:
         print(json.dumps(res, sort_keys=True))
     else:

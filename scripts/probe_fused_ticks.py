@@ -27,11 +27,13 @@ a Perfetto trace of any (T, K) point groups ops under exactly these
 columns; the probe's chain model says which phase to fuse next (p5 holds
 151 of 238 ops at the headline config).
 
---pin rewrites the FUSED_TICK_TABLE block in ops/pallas_tick.py in place
-with this sweep's measured winner for the probed tile (the
-`# FUSED_TICK_TABLE[begin]/[end]` markers bound the rewrite) — the first
-step of the ROADMAP-2 measure-on-first-use autotune refactor: the table
-stops being a hand-maintained artifact and becomes this probe's output.
+--pin rewrites the probed tile's SHALLOW entry of the unified
+TUNING_TABLE (parallel/autotune.py — the marker-bounded block
+scripts/autotune.py owns; since r13 FUSED_TICK_TABLE is a derived view of
+it, so the old name keeps reading the new pin). The ROADMAP-2
+measure-on-first-use autotune refactor landed in r13; this probe remains
+as the T x K deep-dive (full sweep + chain attribution), while
+scripts/autotune.py is the whole-table measure/pin/audit CLI.
 
   python scripts/probe_fused_ticks.py [groups] [ticks] [--pin]
 
@@ -46,7 +48,6 @@ from __future__ import annotations
 
 import json
 import os
-import re
 import sys
 import time
 
@@ -59,11 +60,6 @@ jax.config.update("jax_compilation_cache_dir", os.path.join(
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
-PALLAS_TICK_PY = os.path.join(
-    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-    "raft_kotlin_tpu", "ops", "pallas_tick.py")
-
-
 def feasible_ks(tile_g: int, interpret: bool):
     ks = []
     for k in (1, 2, 4):
@@ -75,34 +71,26 @@ def feasible_ks(tile_g: int, interpret: bool):
     return ks
 
 
-def pin_table(tile_g: int, best_t: int, source: str) -> None:
-    """Rewrite the probed tile's FUSED_TICK_TABLE entry in place (the
-    marker-bounded block in ops/pallas_tick.py). Other tiles' entries are
-    preserved; the probed tile's line is replaced with the measured pin."""
-    with open(PALLAS_TICK_PY) as f:
-        text = f.read()
-    m = re.search(
-        r"(# FUSED_TICK_TABLE\[begin\][^\n]*\nFUSED_TICK_TABLE = \()"
-        r"(.*?)(\n\)\n# FUSED_TICK_TABLE\[end\])", text, re.DOTALL)
-    if not m:
-        raise RuntimeError("FUSED_TICK_TABLE markers not found")
-    body = m.group(2)
-    entries = re.findall(r"\(\s*(\d+),\s*(\d+),\s*((?:\"[^\"]*\"\s*)+)\)",
-                         body)
-    lines = []
-    seen = False
-    for t, T, src in entries:
-        if int(t) == tile_g:
-            lines.append(f'    ({tile_g}, {best_t}, "{source}"),')
-            seen = True
-        else:
-            src_clean = " ".join(s.strip() for s in src.split("\n"))
-            lines.append(f"    ({t}, {T}, {src_clean.rstrip()}),")
-    if not seen:
-        lines.insert(0, f'    ({tile_g}, {best_t}, "{source}"),')
-    new = m.group(1) + "\n" + "\n".join(lines) + m.group(3)
-    with open(PALLAS_TICK_PY, "w") as f:
-        f.write(text[:m.start()] + new + text[m.end():])
+def pin_table(tile_g: int, best_t: int, source: str,
+              best_k: int = None) -> None:
+    """Rewrite the probed tile's SHALLOW entry of the unified TUNING_TABLE
+    (parallel/autotune.pin_entries — byte-stable canonical rows). Other
+    keys' entries are preserved; FUSED_TICK_TABLE / ILP_SUBTILE_TABLE are
+    derived views, so every legacy reader sees the new pin."""
+    from raft_kotlin_tpu.parallel import autotune
+
+    key = autotune.shallow_key(tile_g, platform="tpu")
+    ck = autotune.canonical_key(key)
+    by_key = {autotune.canonical_key(e["key"]): dict(e)
+              for e in autotune.TUNING_TABLE}
+    old = by_key.get(ck)
+    plan = dict(old["plan"]) if old else autotune.default_plan(key)
+    plan["fused_ticks"] = int(best_t)
+    if best_k is not None:
+        plan["ilp_subtiles"] = int(best_k)
+    by_key[ck] = {"key": key, "plan": plan,
+                  "provenance": {"source": source}}
+    autotune.pin_entries(list(by_key.values()))
 
 
 def main():
@@ -208,7 +196,7 @@ def main():
             src = (f"probe_fused_ticks {time.strftime('%Y-%m-%d')}: "
                    f"{winner['ticks_per_sec']} ticks/s at T={winner['t']} "
                    f"K={winner['k']} (G={groups})")
-            pin_table(tile, winner["t"], src)
+            pin_table(tile, winner["t"], src, best_k=winner["k"])
             record["pinned"] = True
     print(json.dumps(record), flush=True)
 

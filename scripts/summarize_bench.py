@@ -57,7 +57,19 @@ LEGS = (
     # fused-vs-T=1 speedup drops >10% below the best prior vetted round
     # (e.g. the kernel silently degrading to the nofuse ladder rung).
     ("fused_vs_t1", "fused-vs-T1 speedup", "suspect"),
+    # r13 (ISSUE 10): the pod scale-out leg — per-pod group-steps/s over
+    # the full device mesh (raft_group_steps_per_sec_per_pod in the full
+    # record; the scaling_efficiency 0.9 floor is a separate absolute
+    # check below, real pods only).
+    ("pod_gsps", "pod gsps", "suspect"),
 )
+
+# Absolute floor for per-chip scaling efficiency on a REAL pod
+# (n_devices > 1, pod_dryrun false): groups never communicate, so
+# anything below 0.9 means the scale-out layer itself is leaking time.
+# The 8-virtual-CPU-device dryrun publishes the figure honestly but does
+# not gate (virtual devices share the host's cores).
+SCALING_FLOOR = 0.9
 
 # (field, label, suspect-gate field) — the per-leg safety-invariant
 # verdicts (ISSUE 6). A vetted leg whose latest-round verdict is anything
@@ -74,7 +86,17 @@ INV_LEGS = (
     # violation in ANY sampled universe gates exactly like the classical
     # legs (the replayable artifact is in that run's stderr + corpus).
     ("fuzz_inv_status", "fuzz inv", "suspect"),
+    # r13 (ISSUE 10): the monitored pod run's Figure-3 verdict.
+    ("pod_inv_status", "pod inv", "suspect"),
 )
+
+# Boolean audit fields (r13): pod_dryrun marks the virtual-device
+# fallback; the *_routing_match / plan_routing_match audits compare the
+# unified tuning table (parallel/autotune.py) against the round's own
+# measurements — a False is TABLE DRIFT and warns (re-pin with
+# scripts/autotune.py), it does not gate.
+AUDIT_BOOLS = ("pod_dryrun", "plan_routing_match", "corner_routing_match",
+               "mbdeep_routing_match", "config5_pershard_routing_match")
 
 
 def _extract_field(tail: str, field: str) -> Optional[float]:
@@ -137,12 +159,36 @@ def load_record(path: str) -> Optional[dict]:
         vetted[field] = gate_value(gate)
     if not legs and not inv:
         return None
+    aux_num: Dict[str, float] = {}
+    for field in ("scaling_efficiency", "pod_n_devices"):
+        v = parsed.get(field)
+        if not isinstance(v, (int, float)):
+            v = _extract_field(tail, field)
+        if v is not None:
+            aux_num[field] = float(v)
+    aux_bool: Dict[str, bool] = {}
+    for field in AUDIT_BOOLS:
+        v = parsed.get(field)
+        if not isinstance(v, bool):
+            m = re.findall(rf'"{re.escape(field)}": (true|false)', tail)
+            v = (m[-1] == "true") if m else None
+        if v is not None:
+            aux_bool[field] = v
+    # The dryrun's pod_gsps is an 8-virtual-CPU-device figure, not a
+    # hardware number: it must neither ENTER the cross-round pod baseline
+    # nor be COMPARED against a real pod's prior round (a hardware-
+    # availability difference is not a regression). Drop the leg unless
+    # the record affirmatively says pod_dryrun=false.
+    if "pod_gsps" in legs and aux_bool.get("pod_dryrun") is not False:
+        del legs["pod_gsps"]
+        vetted.pop("pod_gsps", None)
     rnd = art.get("n")
     if rnd is None:
         m = re.search(r"BENCH_r(\d+)", os.path.basename(path))
         rnd = int(m.group(1)) if m else -1
     return {"round": int(rnd), "path": os.path.basename(path),
-            "legs": legs, "inv": inv, "vetted": vetted}
+            "legs": legs, "inv": inv, "vetted": vetted,
+            "aux_num": aux_num, "aux_bool": aux_bool}
 
 
 def load_all(pattern: Optional[str] = None) -> List[dict]:
@@ -174,6 +220,38 @@ def check_regressions(recs: List[dict],
         if cur < (1.0 - tol) * best:
             out.append((label, cur, best, best_round))
     return out
+
+
+def check_pod_scaling(recs: List[dict]) -> List[Tuple[str, float, float]]:
+    """[(label, value, floor)] when the LATEST round ran a REAL pod
+    (pod_n_devices > 1, pod_dryrun false) whose vetted per-chip
+    scaling_efficiency fell below the absolute SCALING_FLOOR (ISSUE 10) —
+    gating, like a regression."""
+    if not recs:
+        return []
+    latest = recs[-1]
+    eff = latest.get("aux_num", {}).get("scaling_efficiency")
+    n_dev = latest.get("aux_num", {}).get("pod_n_devices")
+    dryrun = latest.get("aux_bool", {}).get("pod_dryrun")
+    if eff is None or not n_dev or n_dev <= 1 or dryrun is not False:
+        return []
+    if not latest["vetted"].get("pod_gsps", latest["vetted"].get("value")):
+        return []
+    if eff < SCALING_FLOOR:
+        return [("pod scaling efficiency", eff, SCALING_FLOOR)]
+    return []
+
+
+def check_tuning_drift(recs: List[dict]) -> List[Tuple[str, bool]]:
+    """[(field, value)] for every False routing/plan audit of the LATEST
+    round — the unified tuning table disagreed with the round's own
+    measurements. WARNING-only (a stale pin costs time, never bits —
+    SEMANTICS.md §13); re-pin with scripts/autotune.py --measure/--pin."""
+    if not recs:
+        return []
+    latest = recs[-1]
+    return [(f, v) for f, v in latest.get("aux_bool", {}).items()
+            if f != "pod_dryrun" and v is False]
 
 
 def check_violations(recs: List[dict]) -> List[Tuple[str, str]]:
@@ -227,6 +305,18 @@ def main(argv=None) -> int:
               f"'{verdict}' — the on-device Figure-3 monitor caught a "
               "safety-invariant break on a vetted leg (replay tuple on "
               "that bench run's stderr)", file=sys.stderr)
+    pod_fails = check_pod_scaling(recs)
+    for label, eff, floor in pod_fails:
+        print(f"POD SCALING: {label} r{latest:02d} = {eff:.3f} below the "
+              f"{floor} floor on a REAL pod — the collective-free "
+              "scale-out layer is leaking time", file=sys.stderr)
+    for field, _v in check_tuning_drift(recs):
+        print(f"WARNING: tuning-table drift — r{latest:02d} {field} is "
+              "false (the unified TUNING_TABLE disagrees with this "
+              "round's own measurements; re-pin with scripts/autotune.py "
+              "--measure then --pin). Not gating: plan choice is "
+              "semantics-free, a stale pin only costs time",
+              file=sys.stderr)
     # Non-clean verdicts on UNVETTED legs don't gate (an untrustworthy
     # measurement's verdict is not evidence either way) but must never be
     # reported as clean — surface them as warnings.
@@ -236,7 +326,7 @@ def main(argv=None) -> int:
     for f, v in unvetted_bad:
         print(f"WARNING: {f} latched '{v}' on an UNVETTED (suspect) leg — "
               "not gating, but not clean either", file=sys.stderr)
-    if regs or viols:
+    if regs or viols or pod_fails:
         return 1
     clean_legs = sum(1 for f, v in latest_rec.get("inv", {}).items()
                      if v == "clean" and latest_rec["vetted"].get(f))

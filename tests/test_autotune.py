@@ -1,0 +1,258 @@
+"""The unified tuning layer (parallel/autotune.py — ISSUE 10 tentpole).
+
+Three contracts:
+1. MIGRATION EQUALITY — every lookup the four legacy tables answered
+   (DEEP_ROUTING_TABLE / route_deep_engine, ILP_SUBTILE_TABLE,
+   FUSED_TICK_TABLE) answers identically through the unified layer, over
+   the full shape lattice including the CPU guards; the literal pre-r13
+   winners are hardcoded here so a table edit that silently changes a
+   migrated pin is a visible diff, not an accident.
+2. BYTE-STABILITY — the pinned table's rendering is a pure function of
+   its entries (same measurements => same bytes), which is what makes
+   `scripts/autotune.py --pin` an auditable artifact rewrite.
+3. RESOLUTION — pinned/cache/measured/nearest/default resolution order,
+   measure-on-first-use writing through the cache, and plan_for/
+   make_planned_run dispatching plans that are bit-identical to the
+   direct builders.
+"""
+
+import json
+import shutil
+
+import numpy as np
+import pytest
+
+from raft_kotlin_tpu.parallel import autotune
+from raft_kotlin_tpu.utils.config import RaftConfig
+
+
+@pytest.fixture
+def no_cache(tmp_path, monkeypatch):
+    # Resolution tests must not see a developer's runtime cache.
+    monkeypatch.setattr(autotune, "CACHE_PATH",
+                        str(tmp_path / "nocache.json"))
+
+
+# -- 1. migration equality ---------------------------------------------------
+
+# The literal pre-r13 tables (the hand-maintained artifacts ISSUE 10
+# retired). The unified layer must answer every lookup identically.
+LEGACY_DEEP = (
+    (10_000, 13_312, False, "fc"),
+    (10_000, 3_328, False, "fc"),
+    (1_024, 2_048, False, "batched"),
+    (10_000, 13_312, True, "fc"),
+    (10_000, 3_328, True, "fc"),
+    (1_024, 2_048, True, "batched"),
+)
+LEGACY_ILP = ((1024, 4), (512, 4), (256, 2), (128, 1))
+LEGACY_FUSED = ((1024, 2), (512, 4), (256, 4), (128, 4))
+
+
+def test_deep_lattice_equals_legacy(no_cache):
+    from raft_kotlin_tpu.parallel.mesh import (
+        DEEP_ROUTING_TABLE, route_deep_engine)
+
+    for C, g, mb, winner in LEGACY_DEEP:
+        assert route_deep_engine(C, g, "tpu", mailbox=mb) == winner
+        assert autotune.deep_engine(C, g, "tpu", mailbox=mb) == winner
+        # CPU compile-feasibility guard survives the migration.
+        assert route_deep_engine(C, g, "cpu", mailbox=mb) == "flat"
+    # The derived view carries exactly the legacy rows (winner per shape).
+    derived = {(c, g, mb): w for c, g, mb, w, _s in DEEP_ROUTING_TABLE}
+    assert derived == {(c, g, mb): w for c, g, mb, w in LEGACY_DEEP}
+    # Off-lattice shapes: nearest-in-log-space within the mailbox class —
+    # the crossover interpolation the legacy router applied.
+    assert route_deep_engine(8_000, 10_000, "tpu") == "fc"
+    assert route_deep_engine(1_000, 1_500, "tpu") == "batched"
+    assert route_deep_engine(64, 16, "tpu") in ("fc", "batched", "flat")
+
+
+def test_shallow_lattice_equals_legacy(no_cache):
+    from raft_kotlin_tpu.ops.pallas_tick import (
+        _TILES, FUSED_TICK_TABLE, ILP_SUBTILE_TABLE, route_fused_ticks,
+        route_ilp_subtiles)
+
+    for tile, k in LEGACY_ILP:
+        assert route_ilp_subtiles(tile, "tpu") == k
+        assert autotune.ilp_subtiles(tile, "tpu") == k
+        assert route_ilp_subtiles(tile, "cpu") == 1  # CPU guard
+    for tile, T in LEGACY_FUSED:
+        assert route_fused_ticks(tile, "tpu") == T
+        assert autotune.fused_ticks(tile, "tpu") == T
+        assert route_fused_ticks(tile, "cpu") == 1  # CPU guard
+    # Derived views expose the legacy row format, every hardware tile
+    # tabulated (test_routing.py's invariants keep holding through them).
+    assert {(t, k) for t, k, _s in ILP_SUBTILE_TABLE} == set(LEGACY_ILP)
+    assert {(t, T) for t, T, _s in FUSED_TICK_TABLE} == set(LEGACY_FUSED)
+    assert set(_TILES) <= {t for t, _k, _s in ILP_SUBTILE_TABLE}
+    # Unknown (interpreter-only) tiles fall through to the K=1/T=1 default.
+    assert route_ilp_subtiles(520, "tpu") == 1
+    assert route_fused_ticks(520, "tpu") == 1
+
+
+def test_vreg_floor_guard(no_cache):
+    # A (hypothetically mis-pinned) K that breaks the 128-lane vreg floor
+    # is clamped by apply_guards — the hardware assertion in
+    # make_pallas_core can never fire on a routed plan.
+    key = autotune.shallow_key(256, platform="tpu")
+    bad = {"engine": "pallas", "ilp_subtiles": 4, "fused_ticks": 2,
+           "sharding": "shard_map", "tile": 256}
+    assert autotune.apply_guards(key, bad)["ilp_subtiles"] == 1
+    ok = dict(bad, ilp_subtiles=2)
+    assert autotune.apply_guards(key, ok)["ilp_subtiles"] == 2
+
+
+# -- 2. byte-stability -------------------------------------------------------
+
+def test_table_byte_stability(tmp_path):
+    entries = [json.loads(r) for r in autotune._TUNING_ROWS]
+    a = autotune.render_table_block(entries)
+    # Same entries, reversed order and re-built dicts: identical bytes.
+    shuffled = [{"provenance": dict(e["provenance"]), "plan": dict(e["plan"]),
+                 "key": dict(e["key"])} for e in reversed(entries)]
+    b = autotune.render_table_block(shuffled)
+    assert a == b
+    # The checked-in block IS the canonical rendering (a hand edit that
+    # breaks canonicality would make the next --pin a noisy diff).
+    assert tuple(json.loads(r) for r in autotune.format_rows(entries)) \
+        == autotune.TUNING_TABLE
+    # pin_entries on a copy of the module: twice from the same entries =>
+    # byte-identical files, markers preserved, table parseable.
+    mod_copy = tmp_path / "autotune_copy.py"
+    shutil.copy(autotune.__file__.replace(".pyc", ".py"), mod_copy)
+    autotune.pin_entries(entries, path=str(mod_copy))
+    first = mod_copy.read_bytes()
+    autotune.pin_entries(shuffled, path=str(mod_copy))
+    assert mod_copy.read_bytes() == first
+    ns: dict = {"__file__": str(mod_copy)}
+    exec(compile(mod_copy.read_text(), str(mod_copy), "exec"), ns)
+    assert ns["TUNING_TABLE"] == autotune.TUNING_TABLE
+
+
+# -- 3. resolution -----------------------------------------------------------
+
+def test_resolution_order_and_sources(no_cache):
+    # Pinned shape -> "pinned". (platform pinned explicitly: on a CPU
+    # test host a defaulted key lands in the cpu GUARD class, exactly
+    # like the legacy router.)
+    plan, src = autotune.resolve_plan(
+        autotune.deep_key(10_000, 13_312, platform="tpu"), with_source=True)
+    assert (src, plan["engine"]) == ("pinned", "fc")
+    # Unknown deep shape -> "nearest" (log-space interpolation).
+    plan, src = autotune.resolve_plan(
+        autotune.deep_key(9_000, 10_000, platform="tpu"), with_source=True)
+    assert (src, plan["engine"]) == ("nearest", "fc")
+    # Unknown shallow tile -> "default" (exact-tile semantics: no
+    # neighbor inheritance, matching the legacy K=1/T=1 fallthrough).
+    plan, src = autotune.resolve_plan(
+        autotune.shallow_key(520, platform="tpu"), with_source=True)
+    assert src == "default"
+    assert plan["ilp_subtiles"] == 1 and plan["fused_ticks"] == 1
+    # CPU keys: the guards dominate whatever the table says.
+    plan = autotune.resolve_plan(
+        autotune.deep_key(10_000, 13_312, platform="cpu"))
+    assert plan["engine"] == "flat"
+    plan = autotune.resolve_plan(
+        autotune.shallow_key(512, platform="cpu"))
+    assert plan["ilp_subtiles"] == 1 and plan["fused_ticks"] == 1
+
+
+def test_measure_on_first_use_cache(tmp_path):
+    cache = str(tmp_path / "cache.json")
+    key = autotune.deep_key(2_048, 4_096, platform="tpu")  # not pinned
+    calls = []
+
+    def fake_measure(k):
+        calls.append(dict(k))
+        return ({"engine": "batched", "ilp_subtiles": 1, "fused_ticks": 1,
+                 "sharding": "shard_map", "tile": None},
+                {"source": "fake", "measured": {"gsps": {"batched": 1.0}}})
+
+    plan, src = autotune.resolve_plan(key, measure=True, cache_path=cache,
+                                      measure_fn=fake_measure,
+                                      with_source=True)
+    assert (src, plan["engine"], len(calls)) == ("measured", "batched", 1)
+    # Second resolution: served from the cache, measure_fn NOT re-invoked.
+    plan, src = autotune.resolve_plan(key, measure=True, cache_path=cache,
+                                      measure_fn=fake_measure,
+                                      with_source=True)
+    assert (src, plan["engine"], len(calls)) == ("cache", "batched", 1)
+    # Without measure and without cache the same key interpolates.
+    plan, src = autotune.resolve_plan(
+        key, measure=False, cache_path=str(tmp_path / "other.json"),
+        with_source=True)
+    assert src == "nearest"
+
+
+def test_plan_for_composition(no_cache):
+    # Deep on CPU: flat engine (guard), single-device sharding label.
+    dcfg = RaftConfig(n_groups=8, n_nodes=3, log_capacity=512, seed=1)
+    plan = autotune.plan_for(dcfg)
+    assert plan == {"engine": "flat", "ilp_subtiles": 1, "fused_ticks": 1,
+                    "sharding": "single", "tile": None}
+    # τ=0 mailbox deep: flat is the ONLY valid engine — the caller-level
+    # rule overrides any table entry (plan_for composes it in).
+    mcfg = RaftConfig(n_groups=8, n_nodes=3, log_capacity=512, mailbox=True,
+                      seed=1)
+    plan, src = autotune.plan_for(mcfg, with_source=True)
+    assert plan["engine"] == "flat" and src == "guard"
+    # Shallow on CPU: xla engine, K=1/T=1 (the whole differential suite's
+    # byte-identity guarantee).
+    scfg = RaftConfig(n_groups=512, n_nodes=3, log_capacity=8, seed=1)
+    plan = autotune.plan_for(scfg)
+    assert plan["engine"] == "xla"
+    assert plan["ilp_subtiles"] == 1 and plan["fused_ticks"] == 1
+
+
+def test_make_planned_run_bit_identity(no_cache):
+    # The composed entry dispatches a plan whose bits equal the direct
+    # builder's — plan choice is semantics-free (SEMANTICS.md §13).
+    from raft_kotlin_tpu.models.state import init_state
+    from raft_kotlin_tpu.ops.tick import make_run
+
+    cfg = RaftConfig(n_groups=32, n_nodes=3, log_capacity=8, cmd_period=5,
+                     p_drop=0.1, seed=7).stressed(10)
+    run, plan = autotune.make_planned_run(cfg, 12)
+    end, _ = run(init_state(cfg))
+    ref, _ = make_run(cfg, 12, trace=False)(init_state(cfg))
+    assert plan["engine"] == "xla"
+    for f in ("term", "commit", "last_index", "role"):
+        assert np.array_equal(np.asarray(getattr(end, f)),
+                              np.asarray(getattr(ref, f))), f
+
+
+def test_make_planned_run_sharded_deep(no_cache):
+    # Deep + mesh: the sharded router consumes the resolved plan (flat on
+    # the CPU mesh) and the reduction contract holds.
+    from raft_kotlin_tpu.ops.tick import make_rng
+    from raft_kotlin_tpu.parallel.mesh import (
+        init_sharded, make_mesh, pad_groups)
+
+    mesh = make_mesh()
+    cfg = pad_groups(RaftConfig(n_groups=16, n_nodes=3, log_capacity=256,
+                                cmd_period=3, p_drop=0.1,
+                                seed=3).stressed(10), mesh)
+    run, plan = autotune.make_planned_run(cfg, 4, mesh=mesh)
+    assert plan["engine"] == "flat" and plan["sharding"] == "shard_map"
+    vals = run(init_sharded(cfg, mesh), make_rng(cfg))
+    assert vals["rounds"] >= 0 and "livepin" in vals
+
+
+def test_audit_reports_drift(no_cache):
+    # audit_entries re-measures pinned entries of the CURRENT platform
+    # class; with an injected measure_fn it must flag exactly the entries
+    # whose fresh winner disagrees with the pin.
+    entries = [e for e in autotune.TUNING_TABLE
+               if e["key"]["regime"] == "deep"][:2]
+    # Pretend this host is the pinned platform class.
+    fake = [dict(e, key=dict(e["key"],
+                             platform=autotune.platform_class(None)))
+            for e in entries]
+
+    def disagree(key):
+        return ({"engine": "flat", "ilp_subtiles": 1, "fused_ticks": 1,
+                 "sharding": "shard_map", "tile": None}, {"source": "x"})
+
+    rep = autotune.audit_entries(fake, measure_fn=disagree)
+    assert len(rep) == 2 and all(r["match"] is False for r in rep)

@@ -45,6 +45,12 @@ def _fake_record():
         "fuzz_universes": 512,
         "fuzz_inv_status": "clean",
         "fuzz_corpus_hash": "865df34419d7102f",
+        "pod_gsps": 283_000_000.0,
+        "scaling_efficiency": 0.97,
+        "pod_parity": 1.0,
+        "pod_inv_status": "clean",
+        "plan_engine": "pallas",
+        "plan_source": "pinned",
         "suspect": False,
         # plus the long tail of fields that overflowed the driver window
         **{f"filler_{i}": [0.1234] * 8 for i in range(80)},
@@ -102,14 +108,21 @@ def test_compact_headline_is_last_line_and_complete():
     # read them from the artifact.
     for k in ("fuzz_universes", "fuzz_inv_status", "fuzz_corpus_hash"):
         assert k in bench.COMPACT_EXTRA_FIELDS, k
+    # The r13 additions (ISSUE 10): the pod scale-out leg's per-pod gsps,
+    # scaling efficiency, sharded parity and Figure-3 verdict, plus the
+    # unified-plan audit — summarize_bench's pod rows / scaling floor and
+    # the round's acceptance criteria read them from the artifact.
+    for k in ("pod_gsps", "scaling_efficiency", "pod_parity",
+              "pod_inv_status", "plan_engine", "plan_source"):
+        assert k in bench.COMPACT_EXTRA_FIELDS, k
     for k in bench.COMPACT_EXTRA_FIELDS:
         assert k in last, k
         assert last[k] == record[k], k
     # Small enough that the driver's tail window always captures it whole
-    # (the r10 verdict fields grew the line; a violation status is ~30
+    # (the r13 pod/plan fields grew the line; a violation status is ~30
     # chars longer per leg than "clean", so keep generous headroom under
     # the multi-KB driver window).
-    assert len(lines[-1]) < 1000, lines[-1]
+    assert len(lines[-1]) < 1200, lines[-1]
 
 
 def test_compact_headline_handles_missing_fields():

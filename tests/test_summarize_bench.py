@@ -134,3 +134,61 @@ def test_fuzz_violation_gate(tmp_path):
     assert sb.main([str(tmp_path / "BENCH_r*.json")]) == 1
     (tmp_path / "BENCH_r02.json").write_text(json.dumps(art(2, "clean")))
     assert sb.main([str(tmp_path / "BENCH_r*.json")]) == 0
+
+
+def test_pod_scaling_gate_and_drift_warning(tmp_path):
+    # ISSUE 10 satellites: (a) a REAL pod (pod_dryrun false, n_devices>1)
+    # whose scaling_efficiency falls below the 0.9 floor gates exit-1;
+    # the virtual-device dryrun publishes the figure but never gates.
+    # (b) a False routing/plan audit field is a tuning-table-drift
+    # WARNING, not a gate.
+    sb = _mod()
+    assert ("pod_gsps", "pod gsps", "suspect") in sb.LEGS
+    assert ("pod_inv_status", "pod inv", "suspect") in sb.INV_LEGS
+
+    def art(n, eff, dryrun, match="true"):
+        tail = json.dumps({
+            "ticks_per_sec": 400.0, "suspect": False,
+            "inv_status": "clean", "pod_inv_status": "clean",
+            "pod_gsps": 3200.0, "pod_n_devices": 8,
+            "scaling_efficiency": eff}) + "\n"
+        tail = tail[:-2] + (f', "pod_dryrun": {dryrun}, '
+                            f'"plan_routing_match": {match}}}\n')
+        return {"n": n, "rc": 0, "tail": tail, "parsed": None}
+
+    (tmp_path / "BENCH_r01.json").write_text(
+        json.dumps(art(1, 0.95, "false")))
+    assert sb.main([str(tmp_path / "BENCH_r*.json")]) == 0
+    # Real pod below the floor -> gate.
+    (tmp_path / "BENCH_r02.json").write_text(
+        json.dumps(art(2, 0.5, "false")))
+    recs = sb.load_all(str(tmp_path / "BENCH_r*.json"))
+    assert sb.check_pod_scaling(recs) == [
+        ("pod scaling efficiency", 0.5, sb.SCALING_FLOOR)]
+    assert sb.main([str(tmp_path / "BENCH_r*.json")]) == 1
+    # The SAME efficiency on the virtual-device dryrun does not gate.
+    (tmp_path / "BENCH_r02.json").write_text(
+        json.dumps(art(2, 0.5, "true")))
+    assert sb.check_pod_scaling(
+        sb.load_all(str(tmp_path / "BENCH_r*.json"))) == []
+    assert sb.main([str(tmp_path / "BENCH_r*.json")]) == 0
+    # Tuning drift: plan_routing_match false -> reported, never gating.
+    (tmp_path / "BENCH_r02.json").write_text(
+        json.dumps(art(2, 0.95, "false", match="false")))
+    recs = sb.load_all(str(tmp_path / "BENCH_r*.json"))
+    assert sb.check_tuning_drift(recs) == [("plan_routing_match", False)]
+    assert sb.main([str(tmp_path / "BENCH_r*.json")]) == 0
+    # A dryrun round's pod_gsps is not a hardware number: it must not be
+    # compared against a real pod's prior round (hardware availability is
+    # not a regression) nor enter the baseline itself.
+    real = art(1, 0.95, "false")
+    real["tail"] = real["tail"].replace('"pod_gsps": 3200.0',
+                                        '"pod_gsps": 320000.0')
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(real))
+    (tmp_path / "BENCH_r02.json").write_text(
+        json.dumps(art(2, 0.5, "true")))  # dryrun, 100x lower pod_gsps
+    recs = sb.load_all(str(tmp_path / "BENCH_r*.json"))
+    assert "pod_gsps" in recs[0]["legs"]
+    assert "pod_gsps" not in recs[-1]["legs"]
+    assert sb.check_regressions(recs) == []
+    assert sb.main([str(tmp_path / "BENCH_r*.json")]) == 0
