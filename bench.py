@@ -269,7 +269,16 @@ COMPACT_EXTRA_FIELDS = ("deeplog_parity_rate", "deeplog_ov_fallback",
                         # round's acceptance gate read these from the
                         # authoritative tail.
                         "pod_gsps", "scaling_efficiency", "pod_parity",
-                        "pod_inv_status", "plan_engine", "plan_source")
+                        "pod_inv_status", "plan_engine", "plan_source",
+                        # r14 (ISSUE 11): the routed state layout, the
+                        # routed + packed concrete-pytree bytes/tick, and
+                        # the packed-vs-wide byte ratio — the round's
+                        # acceptance gate (>= 2x at the headline config)
+                        # and summarize_bench's bytes trajectory +
+                        # regression rows read them from the
+                        # authoritative tail.
+                        "layout", "bytes_per_tick",
+                        "bytes_per_tick_packed", "packed_vs_wide")
 
 # Flight-recorder counters published verbatim from the headline run's
 # median rep (stats tel_* keys — utils/telemetry.TELEMETRY_FIELDS).
@@ -297,7 +306,8 @@ def emit_lines(record: dict) -> list:
     return [json.dumps(record), compact_headline(record)]
 
 
-def scan_runner(tick_fn, telemetry: bool = False, monitor: bool = False):
+def scan_runner(tick_fn, telemetry: bool = False, monitor: bool = False,
+                layout: str = "wide", cfg=None):
     """builder(n_ticks) -> UNJITTED run(st, rng) -> (end_state, livepin[,
     telemetry]) for a per-tick function (measure() jits exactly once, with
     the reductions inside — see measure's docstring for why the state must
@@ -318,26 +328,47 @@ def scan_runner(tick_fn, telemetry: bool = False, monitor: bool = False):
     recorder cost and stats surface its counters; monitor=True threads the
     scan-carry safety-invariant monitor the same way (the <3% overhead
     gate of scripts/probe_invariants.py measures exactly this timed
-    configuration)."""
+    configuration).
+
+    layout="packed" (ISSUE 11; needs cfg + telemetry) carries the packed
+    state layout through the scan (models/state.pack_state, unpack at
+    read) — the width-overflow latch surfaces as the recorder key
+    packed_width_overflow, gated by main() like the fused overflow."""
     from raft_kotlin_tpu.utils import telemetry as telemetry_mod
+
+    packed = layout == "packed"
+    if packed:
+        assert cfg is not None and telemetry, \
+            "scan_runner layout='packed' needs cfg and telemetry=True"
+    from raft_kotlin_tpu.models.state import pack_state, unpack_state
 
     def build(n_ticks):
         def run(st, rng):
+            if packed:
+                st = pack_state(cfg, st)
+
             def body(carry, _):
                 s, acc, tel, mon = carry
-                s2 = tick_fn(s, rng=rng)
+                w = unpack_state(cfg, s) if packed else s
+                s2 = tick_fn(w, rng=rng)
                 acc = acc + jnp.sum(s2.log_cmd[:, 0, :].astype(jnp.int32))
                 if tel is not None:
-                    tel = telemetry_mod.telemetry_step(s, s2, tel)
+                    tel = telemetry_mod.telemetry_step(w, s2, tel)
                 if mon is not None:
-                    mon = telemetry_mod.monitor_step(s, s2, mon)
-                return (s2, acc, tel, mon), None
+                    mon = telemetry_mod.monitor_step(w, s2, mon)
+                nxt = pack_state(cfg, s2, ov=s.ov) if packed else s2
+                return (nxt, acc, tel, mon), None
             tel0 = telemetry_mod.telemetry_zeros() if telemetry else None
             mon0 = telemetry_mod.monitor_init(
                 st.term.shape[-1], n_ticks, monitor)
             (end, acc, tel, mon), _ = jax.lax.scan(
                 body, (st, jnp.zeros((), jnp.int32), tel0, mon0), None,
                 length=n_ticks)
+            if packed:
+                tel = dict(tel)
+                tel["packed_width_overflow"] = jnp.any(
+                    end.ov != 0).astype(jnp.int32)
+                end = unpack_state(cfg, end)
             out = (end, acc)
             if telemetry:
                 out = out + (tel,)
@@ -348,12 +379,32 @@ def scan_runner(tick_fn, telemetry: bool = False, monitor: bool = False):
     return build
 
 
+def _headline_layout(cfg):
+    """The plan-routed state layout for a config's timed headline
+    (parallel/autotune.plan_for's `layout` dimension, ISSUE 11); "wide"
+    on any resolution failure — the conservative legacy default."""
+    try:
+        from raft_kotlin_tpu.parallel.autotune import plan_for
+
+        return plan_for(cfg, telemetry=True, monitor=True).get(
+            "layout", "wide")
+    except Exception as e:
+        print(f"layout resolution failed: {str(e)[:120]}", file=sys.stderr)
+        return "wide"
+
+
 def tick_candidates(cfg):
     from raft_kotlin_tpu.ops.pallas_tick import (
         choose_impl, make_pallas_scan, resolve_fused_geometry)
     from raft_kotlin_tpu.ops.tick import make_tick
 
     if choose_impl(cfg) == "pallas":
+        # Routed state layout (ISSUE 11): the Pallas rungs carry the
+        # plan's layout (the packed width latch surfaces through the
+        # recorder as tel_packed_width_overflow — gated below like the
+        # fused draw overflow); the XLA fallback rung stays wide, matching
+        # plan_for's own engine=xla resolution.
+        layout = _headline_layout(cfg)
         # Flat-carry multi-tick runner: state<->kernel-form conversions once
         # per call, not once per tick (~0.3 ms/tick on the headline config).
         # The flight recorder (ISSUE 5) AND the safety-invariant monitor
@@ -369,7 +420,8 @@ def tick_candidates(cfg):
         yield (lambda n: make_pallas_scan(cfg, n, interpret=False,
                                           jitted=False,
                                           telemetry=True,
-                                          monitor=True)), "pallas"
+                                          monitor=True,
+                                          layout=layout)), "pallas"
         try:
             # Resolve with the SAME snapshot rows the headline builder
             # carries (recorder+monitor on): the bare model can route a T
@@ -390,19 +442,22 @@ def tick_candidates(cfg):
                                               jitted=False,
                                               telemetry=True,
                                               monitor=True,
-                                              fused_ticks=1)), "pallas-nofuse"
+                                              fused_ticks=1,
+                                              layout=layout)), "pallas-nofuse"
     yield scan_runner(make_tick(cfg), telemetry=True, monitor=True), "xla"
 
 
 def pallas_t1_only(cfg):
     """The fused-vs-T=1 A/B comparator: the headline builder with
     fused_ticks PINNED to 1, everything else identical (recorder +
-    monitor on, flat carry, jitted=False)."""
+    monitor on, flat carry, routed layout, jitted=False)."""
     from raft_kotlin_tpu.ops.pallas_tick import make_pallas_scan
 
+    layout = _headline_layout(cfg)
     yield (lambda n: make_pallas_scan(cfg, n, interpret=False, jitted=False,
                                       telemetry=True, monitor=True,
-                                      fused_ticks=1)), "pallas-t1"
+                                      fused_ticks=1,
+                                      layout=layout)), "pallas-t1"
 
 
 def xla_only(cfg):
@@ -685,25 +740,42 @@ def _pod_dryrun_subprocess(n_devices: int = 8) -> dict:
     return pod
 
 
-def state_aux_bytes_per_tick(cfg) -> int:
-    """HBM bytes the tick must move at minimum: every state array read once and
-    written once (the Pallas megakernel achieves exactly this; XLA re-reads
-    across fusion islands), plus the per-tick aux masks read once."""
-    from raft_kotlin_tpu.models.state import init_state
+def _tree_nbytes(shapes) -> int:
+    return sum(int(np.prod(leaf.shape)) * leaf.dtype.itemsize
+               for leaf in jax.tree_util.tree_leaves(shapes))
 
-    shapes = jax.eval_shape(lambda: init_state(cfg))
-    state = sum(
-        int(np.prod(getattr(shapes, f.name).shape)) * getattr(shapes, f.name).dtype.itemsize
-        for f in dataclasses.fields(shapes)
-        if getattr(shapes, f.name) is not None
-    )
-    G, N = cfg.n_groups, cfg.n_nodes
-    aux = G * N * N * 2  # edge_iid as i16 lanes (make_aux narrowing)
-    if cfg.p_crash > 0 or cfg.p_restart > 0:
-        aux += G * N * (1 + 1 + 2)  # crash/restart bool + el_draw_f i16
-    if cfg.p_link_fail > 0 or cfg.p_link_heal > 0:
-        aux += G * N * N * 2 * 2
-    aux += G * N * 2  # bdraw i16
+
+def state_aux_bytes_per_tick(cfg, layout: str = "wide") -> int:
+    """HBM bytes the tick must move at minimum under `layout`: every state
+    array read once and written once (the Pallas megakernel achieves
+    exactly this; XLA re-reads across fusion islands), plus the per-tick
+    aux set read once.
+
+    Both terms are CONCRETE-pytree accounting (ISSUE 11 satellite): the
+    state term is the summed leaf nbytes of the routed layout's actual
+    pytree (init_state, packed through models/state.pack_state when
+    layout="packed") and the aux term the summed leaf nbytes of the dict
+    make_aux actually assembles — eval_shape on the real builders, so a
+    new field or dtype change can never silently drift out of the model
+    (the r5-r13 hand-maintained formula undercounted the periodic-command
+    row and had to mirror every narrowing by hand)."""
+    from raft_kotlin_tpu.models.state import init_state, pack_state
+    from raft_kotlin_tpu.ops import tick as tick_mod
+
+    def build_state():
+        st = init_state(cfg)
+        return pack_state(cfg, st) if layout == "packed" else st
+
+    state = _tree_nbytes(jax.eval_shape(build_state))
+
+    def build_aux():
+        st = init_state(cfg)
+        base, tkeys, bkeys, scen = tick_mod.split_rng(tick_mod.make_rng(cfg))
+        aux, _ = tick_mod.make_aux(cfg, base, tkeys, bkeys, st, None, None,
+                                   scen=scen)
+        return aux
+
+    aux = _tree_nbytes(jax.eval_shape(build_aux))
     return 2 * state + aux
 
 
@@ -882,13 +954,28 @@ def main() -> None:
     # if still inconsistent, published with "suspect": true rather than as a
     # clean number. Init-state rounds are all zero, so an end-state sum IS the
     # elections count for the run.
-    bytes_per_tick = state_aux_bytes_per_tick(cfg)
+    # Routed state layout (ISSUE 11): the plan layer picks wide|packed
+    # exactly like engine/T/K; the timed headline candidates run it
+    # (tick_candidates threads it into the Pallas builders) and the
+    # roofline accounting below must describe the layout actually run.
+    # The packed/wide A/B is concrete-pytree accounting either way.
+    headline_layout = _headline_layout(cfg)
+    bytes_per_tick_wide = state_aux_bytes_per_tick(cfg, layout="wide")
+    bytes_per_tick_packed = state_aux_bytes_per_tick(cfg, layout="packed")
+    packed_vs_wide = round(bytes_per_tick_wide / bytes_per_tick_packed, 2)
     peak = _peak_hbm_bytes_per_sec()
     suspect_reasons = []
     for attempt in range(2):
         times1, stats1, impl = measure(cfg, ticks, reps, tick_candidates)
         best = median(times1)
         med_stats = stats1[times1.index(best)]
+        # The layout the WINNING rung actually carried (the ladder's XLA
+        # fallback runs wide regardless of the plan) — the roofline must
+        # describe the measured program, not the routed intent.
+        layout_run = (headline_layout if impl.startswith("pallas")
+                      else "wide")
+        bytes_per_tick = (bytes_per_tick_packed if layout_run == "packed"
+                          else bytes_per_tick_wide)
         achieved_bw = bytes_per_tick * (ticks / best)
         hbm_bw_frac = round(achieved_bw / peak, 3) if peak else None
         spread = max(times1) / min(times1)
@@ -1514,6 +1601,23 @@ def main() -> None:
             f"{churn_fused_overflow} / mailbox {mailbox_fused_overflow}): "
             "clamped draws, fused bits invalid"]
 
+    # Packed-layout integrity (ISSUE 11): the jitted=False embedding
+    # surfaces the width-overflow latch through the recorder
+    # (tel_packed_width_overflow); ANY nonzero latch on ANY rep of a
+    # packed timed leg means wrapped (wrong) values — poison the round
+    # exactly like a fused draw overflow.
+    def _packed_overflow(stats):
+        return max((int(s.get("tel_packed_width_overflow") or 0)
+                    for s in stats), default=0)
+
+    packed_overflow = max(_packed_overflow(stats1),
+                          _packed_overflow(cstats),
+                          _packed_overflow(mstats))
+    if packed_overflow:
+        suspect_reasons = list(suspect_reasons) + [
+            f"packed-layout width overflow ({packed_overflow}): wrapped "
+            "values, packed bits invalid — re-pin layout wide"]
+
     baseline_group_steps_per_sec = 10.0
     record = dict({
         "metric": "raft_group_steps_per_sec_per_chip",
@@ -1539,8 +1643,15 @@ def main() -> None:
         "suspect_reason": "; ".join(suspect_reasons) or None,
         "rep_times_s": [round(t, 4) for t in times1],
         "churn_rep_times_s": [round(t, 4) for t in ctimes],
-        # Perf model (roofline anchor).
+        # Perf model (roofline anchor). bytes_per_tick is CONCRETE-pytree
+        # accounting for the layout the headline actually ran (ISSUE 11);
+        # the packed/wide pair and their ratio are the layout A/B.
         "bytes_per_tick": bytes_per_tick,
+        "layout": layout_run,
+        "bytes_per_tick_wide": bytes_per_tick_wide,
+        "bytes_per_tick_packed": bytes_per_tick_packed,
+        "packed_vs_wide": packed_vs_wide,
+        "packed_width_overflow": packed_overflow,
         "achieved_hbm_gbps": round(achieved_bw / 1e9, 1),
         "hbm_bw_frac": hbm_bw_frac,
         # Two-sided roofline: the compute half (exact element-op count of

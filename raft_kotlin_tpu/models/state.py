@@ -12,6 +12,7 @@ are transposed at the boundary, so the layout change never touches a single draw
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Optional
 
 import jax
@@ -233,3 +234,302 @@ MAILBOX_FIELDS = (
     "aq_due", "aq_term", "aq_pli", "aq_plt", "aq_hase",
     "aq_ent_t", "aq_ent_c", "aq_commit",
 )
+
+
+# ---------------------------------------------------------------------------
+# Packed state layout (SEMANTICS.md §14): the bit/byte-minimal STORAGE
+# representation of a RaftState, selected by the plan layer exactly like
+# `engine`/`fused_ticks` (parallel/autotune: plan["layout"] ∈ {wide,
+# packed}). The round-4 int16 pattern taken to sub-byte granularity:
+# handler arithmetic always runs on the WIDE dtypes — engines unpack the
+# packed carry at read and re-pack at write, so phase_body, the oracle and
+# the monitor see bit-identical values under either layout (the layout-
+# invariance contract, pinned by tests/test_layout.py).
+#
+# Encodings (all groups-minor, like the wide layout):
+#   - ctrl_bits (3, G) u32 — the hot phase-lattice head fused into three
+#     contiguous words per group (struct-of-arrays reordering: one
+#     cacheline/vreg stream instead of five):
+#       word 0: role, 2 bits per node        (FOLLOWER/CANDIDATE/LEADER)
+#       word 1: round_state, 2 bits per node (IDLE/BACKOFF/ACTIVE)
+#       word 2: el_armed | hb_armed << N | up << 2N (3 bool planes)
+#   - peer bitmasks: responded/link_up/aq_hase (N, N, G) planes become
+#     (N, G) N-bit masks (bit b-1 of row a-1 = pair (a, b)), u8 when
+#     N <= 8 else u16.
+#   - int8/int16 narrowing wherever assert_narrow_bounds-style bounds
+#     already hold (log positions when C + 1 <= 127, countdowns when the
+#     config's window fits int8 — packed_field_dtype is the gate).
+#   - term-valued / monotone-counter fields (term, last_term, t_ctr,
+#     b_ctr, rounds, the §10 term/round/cmd slots) narrow to int16, and
+#     log_term to int8 / log_cmd to int16, under the WIDTH-OVERFLOW LATCH:
+#     no config bound caps a term, so pack_fields compares every narrowed
+#     value against its packed range and latches `ov` on any mismatch.
+#     A latched pack produced WRAPPED (invalid) bits — runners host-check
+#     the latch and fail loudly (the fused draw-table overflow contract);
+#     re-run with layout="wide", which has no latch and no bound.
+#
+# pack_fields/unpack_fields operate on dicts of canonical-shape arrays
+# ((N, G) / (N, N, G) / (N, C, G)) so the Pallas flat-carry runner can
+# reuse them; pack_state/unpack_state are the RaftState-level wrappers.
+
+# Wide fields fused into the (3, G) ctrl_bits word stack.
+CTRL_FIELDS = ("role", "round_state", "el_armed", "hb_armed", "up")
+# Wide (N, N, G) bool/flag planes that become (N, G) N-bit masks.
+PEER_BIT_FIELDS = {"responded": "responded_bits", "link_up": "link_bits",
+                   "aq_hase": "aq_hase_bits"}
+# Term-valued / monotone-counter fields: int16 under the overflow latch.
+LATCH16 = (
+    "term", "last_term", "t_ctr", "b_ctr", "rounds",
+    "vq_term", "vq_llt", "vq_round", "aq_term", "aq_plt",
+    "aq_ent_t", "aq_ent_c",
+)
+
+
+@struct.dataclass
+class PackedRaftState:
+    """RaftState in the packed storage layout (see the block comment
+    above). Same pytree discipline as RaftState: groups-minor arrays, §10
+    mailbox fields present iff cfg.uses_mailbox, a () tick scalar — plus
+    the (G,) int8 per-group `ov` width-overflow latch (0 = every narrowed
+    value of that group fit;
+    nonzero = some pack wrapped and the bits are INVALID)."""
+
+    ctrl_bits: jax.Array       # (3, G) u32 — role / round_state / flags
+    term: jax.Array            # (N, G) i16 (latched)
+    last_term: jax.Array       # (N, G) i16 (latched)
+    voted_for: jax.Array       # (N, G) i8
+    commit: jax.Array          # (N, G) i8|i16
+    last_index: jax.Array      # (N, G) i8|i16
+    phys_len: jax.Array        # (N, G) i8|i16
+    log_term: jax.Array        # (N, C, G) i8 (latched)
+    log_cmd: jax.Array         # (N, C, G) i16 (latched)
+    el_left: jax.Array         # (N, G) i8|i16
+    round_left: jax.Array      # (N, G) i8|i16
+    round_age: jax.Array       # (N, G) i8|i16
+    votes: jax.Array           # (N, G) i8
+    responses: jax.Array       # (N, G) i8
+    responded_bits: jax.Array  # (N, G) u8|u16 peer mask
+    bo_left: jax.Array         # (N, G) i8|i16
+    next_index: jax.Array      # (N, N, G) i8|i16
+    match_index: jax.Array     # (N, N, G) i8|i16
+    hb_left: jax.Array         # (N, G) i8|i16
+    link_bits: jax.Array       # (N, G) u8|u16 peer mask
+    t_ctr: jax.Array           # (N, G) i16 (latched)
+    b_ctr: jax.Array           # (N, G) i16 (latched)
+    rounds: jax.Array          # (N, G) i16 (latched)
+    tick: jax.Array            # () i32
+    ov: jax.Array              # (G,) i8 per-group width-overflow latch
+
+    # §10 mailbox (present only when cfg.uses_mailbox, like RaftState).
+    vq_due: Optional[jax.Array] = None     # (N, N, G) i8|i16
+    vq_term: Optional[jax.Array] = None    # (N, N, G) i16 (latched)
+    vq_lli: Optional[jax.Array] = None     # (N, N, G) i8|i16
+    vq_llt: Optional[jax.Array] = None     # (N, N, G) i16 (latched)
+    vq_round: Optional[jax.Array] = None   # (N, N, G) i16 (latched)
+    aq_due: Optional[jax.Array] = None     # (N, N, G) i8|i16
+    aq_term: Optional[jax.Array] = None    # (N, N, G) i16 (latched)
+    aq_pli: Optional[jax.Array] = None     # (N, N, G) i8|i16
+    aq_plt: Optional[jax.Array] = None     # (N, N, G) i16 (latched)
+    aq_hase_bits: Optional[jax.Array] = None  # (N, G) u8|u16 peer mask
+    aq_ent_t: Optional[jax.Array] = None   # (N, N, G) i16 (latched)
+    aq_ent_c: Optional[jax.Array] = None   # (N, N, G) i16 (latched)
+    aq_commit: Optional[jax.Array] = None  # (N, N, G) i8|i16
+
+
+def assert_packed_bounds(cfg: RaftConfig) -> None:
+    """Structural guards of the packed encodings: the ctrl word stack
+    needs 3N flag bits and 2N role bits per u32 word (N <= 10 — the
+    reference's ids are <= 9), on top of the NARROW16 config guards."""
+    assert_narrow_bounds(cfg)
+    assert cfg.n_nodes <= 10, (
+        "packed layout needs n_nodes <= 10 (3N flag bits per u32 ctrl "
+        "word)")
+
+
+def packed_field_dtype(name: str, cfg: RaftConfig):
+    """Canonical PACKED storage dtype of a PackedRaftState field under
+    `cfg` — the packed-layout sibling of field_dtype. Config-gated int8
+    narrowing applies wherever the config bounds the value range into
+    int8 (with a unit of slack for the -1/0 sentinel and transient
+    decrement states); everything term-valued is int16 under the width-
+    overflow latch (see the module block comment)."""
+    if name == "ctrl_bits":
+        return jnp.uint32
+    if name in ("responded_bits", "link_bits", "aq_hase_bits"):
+        return jnp.uint8 if cfg.n_nodes <= 8 else jnp.uint16
+    if name in LATCH16:
+        return jnp.int16
+    if name == "log_term":
+        return jnp.int8
+    if name == "log_cmd":
+        return jnp.int16
+    if name in ("voted_for", "votes", "responses"):
+        return jnp.int8  # node ids / tallies <= N <= 10
+    i8 = lambda fits: jnp.int8 if fits else jnp.int16
+    if name in ("commit", "last_index", "phys_len", "next_index",
+                "match_index", "vq_lli", "aq_pli", "aq_commit"):
+        return i8(cfg.log_capacity + 1 <= 127)  # next_index reaches C + 1
+    if name == "el_left":
+        return i8(cfg.el_hi <= 126)
+    if name == "bo_left":
+        return i8(cfg.bo_hi <= 126)
+    if name in ("round_left", "round_age"):
+        return i8(cfg.round_ticks <= 126)
+    if name == "hb_left":
+        return i8(cfg.hb_ticks <= 126)
+    if name in ("vq_due", "aq_due"):
+        return i8(cfg.delay_hi <= 126)
+    if name == "ov":
+        return jnp.int8  # (G,) per-group latch
+    return jnp.int32  # the tick scalar
+
+
+def _peer_shifts(N: int):
+    return (jnp.arange(N, dtype=jnp.uint32))[None, :, None]
+
+
+def pack_fields(cfg: RaftConfig, s: dict):
+    """Pack a dict of canonical-shape wide arrays ((N, G) / (N, N, G) /
+    (N, C, G); any integer or bool dtype — the Pallas flat carry feeds
+    int32) into the packed field dict. Returns (packed dict, ov) where
+    `ov` is the (G,) bool PER-GROUP width-overflow latch: True for every
+    group where some narrowed value fell outside its packed range (the
+    pack then wrapped and that group's packed bits are invalid — every
+    range assumption is self-checking). The latch is lane-shaped ON
+    PURPOSE: scan carries accumulate it elementwise (sharded runs stay
+    shard-local per tick — no per-tick collective) and runners reduce it
+    to a scalar exactly once, at scan exit."""
+    assert_packed_bounds(cfg)
+    N = cfg.n_nodes
+    out = {}
+    ov = jnp.zeros(s["term"].shape[-1:], bool)
+
+    def lanes_any(bad):  # reduce a bad-value mask onto the groups axis
+        return jnp.any(bad, axis=tuple(range(bad.ndim - 1)))
+
+    def narrow(name, v):
+        nonlocal ov
+        dt = packed_field_dtype(name, cfg)
+        w = v.astype(jnp.int32)
+        info = jnp.iinfo(dt)
+        ov = ov | lanes_any((w < info.min) | (w > info.max))
+        return w.astype(dt)
+
+    def word2(v):  # 2-bit lanes (role / round_state): values must fit 2 bits
+        nonlocal ov
+        w = v.astype(jnp.int32)
+        ov = ov | lanes_any((w < 0) | (w > 3))
+        sh = (2 * jnp.arange(N, dtype=jnp.uint32))[:, None]
+        return jnp.sum(w.astype(jnp.uint32) << sh, axis=0,
+                       dtype=jnp.uint32)
+
+    def bits1(v):  # bool plane -> N-bit word over the node axis
+        sh = (jnp.arange(N, dtype=jnp.uint32))[:, None]
+        return jnp.sum((v != 0).astype(jnp.uint32) << sh, axis=0,
+                       dtype=jnp.uint32)
+
+    flags = (bits1(s["el_armed"]) | (bits1(s["hb_armed"]) << N)
+             | (bits1(s["up"]) << (2 * N)))
+    out["ctrl_bits"] = jnp.stack(
+        [word2(s["role"]), word2(s["round_state"]), flags]).astype(jnp.uint32)
+    for name, packed_name in PEER_BIT_FIELDS.items():
+        if name not in s:
+            continue
+        v = (s[name] != 0).astype(jnp.uint32)
+        word = jnp.sum(v << _peer_shifts(N), axis=1, dtype=jnp.uint32)
+        out[packed_name] = word.astype(packed_field_dtype(packed_name, cfg))
+    for name, v in s.items():
+        if name in CTRL_FIELDS or name in PEER_BIT_FIELDS:
+            continue
+        out[name] = narrow(name, v)
+    return out, ov
+
+
+def unpack_fields(cfg: RaftConfig, p: dict, kernel_form: bool = False):
+    """Inverse of pack_fields: packed field dict -> wide canonical-shape
+    dict. `kernel_form=False` restores the canonical STORAGE dtypes
+    (field_dtype — bools as bools); `kernel_form=True` emits the Pallas
+    flat-carry dtypes instead (int32 everywhere except the logs, which
+    keep their storage dtype — the entry-cast discipline of
+    make_pallas_scan)."""
+    N = cfg.n_nodes
+    out = {}
+
+    def wide_dt(name):
+        if kernel_form:
+            return (field_dtype(name, cfg) if name in ("log_term", "log_cmd")
+                    else jnp.int32)
+        return field_dtype(name, cfg)
+
+    def from2(word):
+        sh = (2 * jnp.arange(N, dtype=jnp.uint32))[:, None]
+        return ((word[None, :] >> sh) & 3).astype(jnp.int32)
+
+    def from1(word, shift):
+        sh = (jnp.arange(N, dtype=jnp.uint32) + shift)[:, None]
+        return ((word[None, :] >> sh) & 1).astype(jnp.int32)
+
+    ctrl = p["ctrl_bits"].astype(jnp.uint32)
+    for name, v in (("role", from2(ctrl[0])),
+                    ("round_state", from2(ctrl[1])),
+                    ("el_armed", from1(ctrl[2], 0)),
+                    ("hb_armed", from1(ctrl[2], N)),
+                    ("up", from1(ctrl[2], 2 * N))):
+        out[name] = v.astype(wide_dt(name)) if kernel_form else (
+            v != 0 if field_dtype(name, cfg) == jnp.bool_
+            else v.astype(field_dtype(name, cfg)))
+    for name, packed_name in PEER_BIT_FIELDS.items():
+        if packed_name not in p or p[packed_name] is None:
+            continue
+        word = p[packed_name].astype(jnp.uint32)
+        v = (word[:, None, :] >> _peer_shifts(N)) & 1
+        out[name] = (v.astype(jnp.int32).astype(wide_dt(name))
+                     if kernel_form or field_dtype(name, cfg) != jnp.bool_
+                     else v != 0)
+    for name, v in p.items():
+        if (name in ("ctrl_bits", "tick", "ov") or v is None
+                or name in PEER_BIT_FIELDS.values()):
+            continue
+        out[name] = v.astype(jnp.int32).astype(wide_dt(name))
+    return out
+
+
+def pack_state(cfg: RaftConfig, state: RaftState, ov=None) -> PackedRaftState:
+    """RaftState -> PackedRaftState. `ov` chains a previous latch value
+    (a packed scan carry accumulates it across ticks — every engine's
+    repack passes the pre-tick state's own `ov`, the carry-chaining
+    contract); the result's (G,) `ov` is nonzero for every group where
+    some pack so far wrapped a value."""
+    s = {f.name: getattr(state, f.name) for f in dataclasses.fields(state)
+         if f.name != "tick" and getattr(state, f.name) is not None}
+    p, ov_now = pack_fields(cfg, s)
+    ov_now = ov_now.astype(jnp.int8)
+    if ov is not None:
+        ov_now = ov_now | ov.astype(jnp.int8)
+    return PackedRaftState(**p, tick=state.tick, ov=ov_now)
+
+
+def unpack_state(cfg: RaftConfig, packed: PackedRaftState) -> RaftState:
+    """PackedRaftState -> RaftState (canonical storage dtypes). Valid only
+    when packed.ov == 0 — a latched pack wrapped values (check_packed_ov
+    is the host-side guard runners apply)."""
+    p = {f.name: getattr(packed, f.name) for f in dataclasses.fields(packed)
+         if f.name not in ("tick", "ov")
+         and getattr(packed, f.name) is not None}
+    return RaftState(**unpack_fields(cfg, p), tick=packed.tick)
+
+
+def check_packed_ov(ov) -> None:
+    """Host-side loud-fail guard on the width-overflow latch (the fused
+    draw-table overflow contract): a nonzero latch means some narrowed
+    value exceeded its packed width — the packed bits are INVALID and the
+    run must be discarded and re-executed with layout="wide". Accepts the
+    scalar reduction or the raw (G,) per-group latch."""
+    import numpy as np
+
+    if np.any(np.asarray(jax.device_get(ov))):
+        raise RuntimeError(
+            "packed-layout width overflow: a term/counter/log value "
+            "exceeded its packed storage width (models/state.py LATCH16 "
+            "latch) — the packed bits are invalid; re-run with "
+            'layout="wide"')

@@ -182,7 +182,8 @@ def refill_all(cfg, state) -> dict:
 
 
 def make_deep_scan(cfg, n_ticks: int, return_state: bool = False,
-                   telemetry: bool = False, monitor: bool = False):
+                   telemetry: bool = False, monitor: bool = False,
+                   layout: str = "wide"):
     """Multi-tick runner for the frontier-cached deep engine.
 
     run(state, rng[, summarize]) executes n_ticks through the fcache tick
@@ -204,12 +205,22 @@ def make_deep_scan(cfg, n_ticks: int, return_state: bool = False,
     return_state=True the call returns (end, ov, monitor-finalized)
     instead of (end, ov)). On an OV fallback the published monitor verdict
     is the PLAIN rerun's — the verdict of the bits actually published.
-    Bits are untouched either way (both only read the carried states)."""
-    from raft_kotlin_tpu.models.state import RaftState
+    Bits are untouched either way (both only read the carried states).
+
+    layout="packed" (ISSUE 11) carries the packed state layout through the
+    scan (models/state.pack_state; the frontier cache itself stays wide —
+    it is derived working state, not state at rest): external contract
+    unchanged, width-overflow latch host-checked per call (RuntimeError —
+    re-run with layout="wide")."""
+    from raft_kotlin_tpu.models.state import (
+        RaftState, check_packed_ov, pack_state, unpack_state)
     from raft_kotlin_tpu.ops import tick as tick_mod
 
     tick_plain = tick_mod.make_tick(cfg)
     N, G = cfg.n_nodes, cfg.n_groups
+    packed = layout == "packed"
+    if layout not in ("wide", "packed"):
+        raise ValueError(f"unknown layout {layout!r}")
 
     def fc_tick(state, fc, rng):
         base, tkeys, bkeys, scen = tick_mod.split_rng(rng)
@@ -228,38 +239,46 @@ def make_deep_scan(cfg, n_ticks: int, return_state: bool = False,
     def scan_of(tick_fn, with_fc):
         def run(st, fc, rng):
             def body(carry, _):
+                s, f, acc, ova, tel, mon = carry
+                w = unpack_state(cfg, s) if packed else s
                 if with_fc:
-                    s, f, acc, ova, tel, mon = carry
-                    s2, f2, ov = tick_fn(s, f, rng)
+                    s2, f2, ov = tick_fn(w, f, rng)
                     ov_t = jnp.any(ov)
                     ova = ova | ov_t
                 else:
-                    s, f, acc, ova, tel, mon = carry
-                    s2, f2 = tick_fn(s, rng=rng), f
+                    s2, f2 = tick_fn(w, rng=rng), f
                     ov_t = None
                 if tel is not None:
-                    tel = telemetry_mod.telemetry_step(s, s2, tel, ov=ov_t)
+                    tel = telemetry_mod.telemetry_step(w, s2, tel, ov=ov_t)
                 if mon is not None:
-                    mon = telemetry_mod.monitor_step(s, s2, mon)
+                    mon = telemetry_mod.monitor_step(w, s2, mon)
                 acc = acc + jnp.sum(s2.log_cmd[:, 0, :].astype(_I32))
-                return (s2, f2, acc, ova, tel, mon), None
+                nxt = pack_state(cfg, s2, ov=s.ov) if packed else s2
+                return (nxt, f2, acc, ova, tel, mon), None
 
             tel0 = telemetry_mod.telemetry_zeros() if telemetry else None
             mon0 = telemetry_mod.monitor_init(cfg.n_groups, n_ticks,
                                               monitor)
-            carry0 = (st, fc, jnp.zeros((), _I32), jnp.zeros((), bool),
+            st0 = pack_state(cfg, st) if packed else st
+            carry0 = (st0, fc, jnp.zeros((), _I32), jnp.zeros((), bool),
                       tel0, mon0)
             (end, _, acc, ova, tel, mon), _ = jax.lax.scan(
                 body, carry0, None, length=n_ticks)
-            return end, acc, ova, tel, mon
+            pov = jnp.any(end.ov != 0) if packed else jnp.zeros((), _I32)
+            if packed:
+                end = unpack_state(cfg, end)
+            return end, acc, ova, tel, mon, pov
         return run
 
     fc_scan = scan_of(fc_tick, True)
     plain_scan = scan_of(lambda s, rng: tick_plain(s, rng=rng), False)
 
-    def reductions(end, acc, ova, tel, mon, summarize):
-        return _reduction(end, acc, ova.astype(_I32), summarize, tel=tel,
-                          mon=mon)
+    def reductions(end, acc, ova, tel, mon, pov, summarize):
+        out = _reduction(end, acc, ova.astype(_I32), summarize, tel=tel,
+                         mon=mon)
+        if packed:
+            out["packed_ov"] = pov.astype(_I32)
+        return out
 
     refill_jit = jax.jit(lambda s: refill_all(cfg, s))
 
@@ -270,10 +289,12 @@ def make_deep_scan(cfg, n_ticks: int, return_state: bool = False,
         jplain_s = jax.jit(lambda s, r: plain_scan(s, None, r))
 
         def run_state(st, rng):
-            end, _, ova, _tel, mon = jfc_s(st, rng, refill_jit(st))
+            end, _, ova, _tel, mon, pov = jfc_s(st, rng, refill_jit(st))
             ov = bool(jax.device_get(ova))
             if ov:
-                end, _, _, _tel, mon = jplain_s(st, rng)
+                end, _, _, _tel, mon, pov = jplain_s(st, rng)
+            if packed:
+                check_packed_ov(pov)
             if monitor:
                 return end, ov, telemetry_mod.monitor_finalize(mon)
             return end, ov
@@ -296,6 +317,8 @@ def make_deep_scan(cfg, n_ticks: int, return_state: bool = False,
         jfc, jplain = jitted[summarize]
         fc = refill_jit(st)
         vals = {k: v for k, v in jfc(st, rng, fc).items()}
+        if packed:
+            check_packed_ov(vals["packed_ov"])
         if int(jax.device_get(vals["ov"])):
             # The plain rerun carries no cache, so its recorder never sees
             # OV events — publish the fc attempt's per-tick OV count (the
@@ -305,6 +328,8 @@ def make_deep_scan(cfg, n_ticks: int, return_state: bool = False,
             # verdict is the verdict of the published bits.
             fc_ov_ticks = vals.get("tel_ov_fallbacks")
             vals = {k: v for k, v in jplain(st, rng).items()}
+            if packed:
+                check_packed_ov(vals["packed_ov"])
             vals["ov"] = jnp.ones((), _I32)
             if fc_ov_ticks is not None:
                 vals["tel_ov_fallbacks"] = fc_ov_ticks
@@ -330,7 +355,8 @@ def _reduction(end, acc, ov, summarize, tel=None, mon=None):
 
 
 def _livepin_scan(tick, n_ticks, telemetry: bool = False,
-                  monitor: bool = False, n_groups: int = 0):
+                  monitor: bool = False, n_groups: int = 0,
+                  cfg=None, layout: str = "wide"):
     """lax.scan of a per-tick sharded engine under the bench livepin
     discipline (one log_cmd row observed through the carry every tick so
     XLA cannot dead-carry-eliminate the payload chain — bench.measure's
@@ -338,26 +364,41 @@ def _livepin_scan(tick, n_ticks, telemetry: bool = False,
     flight-recorder accumulation, and optional safety-invariant monitor
     accumulation (monitor=True needs n_groups for the taint masks). The
     single copy of the plain-scan body shared by the non-fc sharded
-    runners and the fc runner's OV fallback;
-    scan(st, rng[, with_trace]) -> (end, livepin, tel, mon, trace_ys)."""
+    runners and the fc runner's OV fallback. layout="packed" (needs cfg)
+    carries the packed state layout between ticks (unpack-at-read,
+    SEMANTICS.md §14) — the trailing `pov` is its width-overflow latch
+    (always 0 under "wide");
+    scan(st, rng[, with_trace]) -> (end, livepin, tel, mon, trace_ys,
+    pov)."""
+    from raft_kotlin_tpu.models.state import pack_state, unpack_state
+
+    packed = layout == "packed"
+    assert not packed or cfg is not None, "layout='packed' needs cfg"
+
     def scan(st, rng, with_trace=False):
         def body(carry, _):
             s, acc, tel, mon = carry
-            s2 = tick(s, rng)
+            w = unpack_state(cfg, s) if packed else s
+            s2 = tick(w, rng)
             acc = acc + jnp.sum(s2.log_cmd[:, 0, :].astype(_I32))
             if tel is not None:
-                tel = telemetry_mod.telemetry_step(s, s2, tel)
+                tel = telemetry_mod.telemetry_step(w, s2, tel)
             if mon is not None:
-                mon = telemetry_mod.monitor_step(s, s2, mon)
+                mon = telemetry_mod.monitor_step(w, s2, mon)
             y = _trace_row(s2) if with_trace else None
-            return (s2, acc, tel, mon), y
+            nxt = pack_state(cfg, s2, ov=s.ov) if packed else s2
+            return (nxt, acc, tel, mon), y
 
         tel0 = telemetry_mod.telemetry_zeros() if telemetry else None
         mon0 = telemetry_mod.monitor_init(n_groups, n_ticks, monitor)
+        st0 = pack_state(cfg, st) if packed else st
         (end, acc, tel, mon), ys = jax.lax.scan(
-            body, (st, jnp.zeros((), _I32), tel0, mon0), None,
+            body, (st0, jnp.zeros((), _I32), tel0, mon0), None,
             length=n_ticks)
-        return end, acc, tel, mon, ys
+        pov = jnp.any(end.ov != 0) if packed else jnp.zeros((), _I32)
+        if packed:
+            end = unpack_state(cfg, end)
+        return end, acc, tel, mon, ys, pov
 
     return scan
 
@@ -385,20 +426,25 @@ def _sharded_default_rng(cfg, mesh):
 def _make_sharded_plain_scan(cfg, mesh, n_ticks: int, engine: str,
                              return_state: bool = False,
                              telemetry: bool = False,
-                             monitor: bool = False):
+                             monitor: bool = False,
+                             layout: str = "wide"):
     """The non-fc sharded deep runners behind make_sharded_deep_scan's
     routing: the per-shard BATCHED or per-pair FLAT shard_map engine
     (parallel.mesh._make_shardmap_xla_tick) scanned for n_ticks under the
     SAME run contract as the fc runner (self_timed reduction dict /
     (state, ov)) — ov is always False here, these engines carry no cache
-    to overflow."""
+    to overflow. layout="packed" packs the scan carry (outside shard_map,
+    elementwise — the per-shard engine program is untouched and stays
+    collective-free; the width latch is host-checked per call)."""
+    from raft_kotlin_tpu.models.state import check_packed_ov
     from raft_kotlin_tpu.parallel import mesh as mesh_mod
 
+    packed = layout == "packed"
     tick = mesh_mod._make_shardmap_xla_tick(
         cfg, mesh, batched=(engine == "batched"))
     scan = _livepin_scan(lambda s, rng: tick(s, rng), n_ticks,
                          telemetry=telemetry, monitor=monitor,
-                         n_groups=cfg.n_groups)
+                         n_groups=cfg.n_groups, cfg=cfg, layout=layout)
     default_rng = _sharded_default_rng(cfg, mesh)
 
     if return_state:
@@ -406,7 +452,9 @@ def _make_sharded_plain_scan(cfg, mesh, n_ticks: int, engine: str,
 
         def run_state(st, rng=None):
             rng = rng if rng is not None else default_rng()
-            end, _, _tel, _mon, _ys = jscan(st, rng)
+            end, _, _tel, _mon, _ys, pov = jscan(st, rng)
+            if packed:
+                check_packed_ov(pov)
             return end, False
 
         return run_state
@@ -417,12 +465,18 @@ def _make_sharded_plain_scan(cfg, mesh, n_ticks: int, engine: str,
         rng = rng if rng is not None else default_rng()
         if summarize not in jitted:
             def reduced(s, r):
-                end, acc, tel, mon, _ys = scan(s, r)
-                return _reduction(end, acc, jnp.zeros((), _I32), summarize,
-                                  tel=tel, mon=mon)
+                end, acc, tel, mon, _ys, pov = scan(s, r)
+                out = _reduction(end, acc, jnp.zeros((), _I32), summarize,
+                                 tel=tel, mon=mon)
+                if packed:
+                    out["packed_ov"] = pov.astype(_I32)
+                return out
 
             jitted[summarize] = jax.jit(reduced)
-        return dict(jitted[summarize](st, rng).items())
+        vals = dict(jitted[summarize](st, rng).items())
+        if packed:
+            check_packed_ov(vals["packed_ov"])
+        return vals
 
     run.self_timed = True
     return run
@@ -441,7 +495,8 @@ def make_sharded_deep_scan(cfg, mesh, n_ticks: int,
                            engine: str = "auto",
                            trace: bool = False,
                            telemetry: bool = False,
-                           monitor: bool = False):
+                           monitor: bool = False,
+                           layout: Optional[str] = None):
     """The sharded deep-log runner — and, since round 6, the deep band's
     engine ROUTER: `engine="auto"` (the default every production caller
     uses) picks the per-shard engine ("fc" | "batched" | "flat") from
@@ -491,11 +546,23 @@ def make_sharded_deep_scan(cfg, mesh, n_ticks: int,
     are GLOBAL for the same reason).
 
     run(state, rng=None[, summarize]) -> dict of host scalars (self_timed,
-    bench.measure contract); with return_state=True -> (state, ov)."""
+    bench.measure contract); with return_state=True -> (state, ov).
+
+    `layout`="packed" (ISSUE 11) carries the packed state layout through
+    every scan here — packing runs OUTSIDE shard_map on the globally
+    sharded state (elementwise INCLUDING the (G,) per-group width latch,
+    so the per-tick program stays shard-local and collective-free; the
+    latch's scalar reduction happens once at scan exit, the observers'
+    collective class), and the per-shard engine program is untouched.
+    The default None adopts the plan's layout under engine="auto" and
+    means "wide" otherwise; an EXPLICIT "wide" always wins over the
+    routed plan (the documented overflow remedy)."""
     import math
 
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from raft_kotlin_tpu.models.state import (
+        check_packed_ov, pack_state, unpack_state)
     from raft_kotlin_tpu.ops import tick as tick_mod
     from raft_kotlin_tpu.parallel import mesh as mesh_mod
 
@@ -509,7 +576,14 @@ def make_sharded_deep_scan(cfg, mesh, n_ticks: int,
         # longer consults a table of its own.
         from raft_kotlin_tpu.parallel.autotune import plan_for
 
-        engine = plan_for(cfg, mesh)["engine"]
+        plan = plan_for(cfg, mesh)
+        engine = plan["engine"]
+        if layout is None:
+            layout = plan.get("layout", "wide")
+    layout = layout or "wide"
+    packed = layout == "packed"
+    if layout not in ("wide", "packed"):
+        raise ValueError(f"unknown layout {layout!r}")
     assert engine in ("fc", "batched", "flat"), engine
     assert not (cfg.uses_mailbox and not cfg.known_delivery
                 and engine != "flat"), \
@@ -518,7 +592,7 @@ def make_sharded_deep_scan(cfg, mesh, n_ticks: int,
         assert not trace, "trace mode is the fc parity leg's observable"
         return _make_sharded_plain_scan(cfg, mesh, n_ticks, engine,
                                         return_state, telemetry=telemetry,
-                                        monitor=monitor)
+                                        monitor=monitor, layout=layout)
     flags = tick_mod.make_flags(cfg)
     assert flags.batched, "make_sharded_deep_scan needs a batched config"
     sfields = tick_mod.state_fields(flags)
@@ -589,23 +663,29 @@ def make_sharded_deep_scan(cfg, mesh, n_ticks: int,
 
         def body(carry, _):
             s, f, acc, ova, tel, mon = carry
-            s2, f2, ov = tick_fc(s, f, rng)
+            w = unpack_state(cfg, s) if packed else s
+            s2, f2, ov = tick_fc(w, f, rng)
             acc = acc + jnp.sum(s2.log_cmd[:, 0, :].astype(_I32))
             ov_t = jnp.any(ov)
             if tel is not None:
-                tel = telemetry_mod.telemetry_step(s, s2, tel, ov=ov_t)
+                tel = telemetry_mod.telemetry_step(w, s2, tel, ov=ov_t)
             if mon is not None:
-                mon = telemetry_mod.monitor_step(s, s2, mon)
+                mon = telemetry_mod.monitor_step(w, s2, mon)
             y = _trace_row(s2) if with_trace else None
-            return (s2, f2, acc, ova | ov_t, tel, mon), y
+            nxt = pack_state(cfg, s2, ov=s.ov) if packed else s2
+            return (nxt, f2, acc, ova | ov_t, tel, mon), y
 
         tel0 = telemetry_mod.telemetry_zeros() if telemetry else None
         mon0 = telemetry_mod.monitor_init(cfg.n_groups, n_ticks, monitor)
-        carry0 = (st, fc0, jnp.zeros((), _I32), jnp.zeros((), bool),
+        st0 = pack_state(cfg, st) if packed else st
+        carry0 = (st0, fc0, jnp.zeros((), _I32), jnp.zeros((), bool),
                   tel0, mon0)
         (end, _, acc, ova, tel, mon), ys = jax.lax.scan(
             body, carry0, None, length=n_ticks)
-        return end, acc, ova, tel, mon, ys
+        pov = jnp.any(end.ov != 0) if packed else jnp.zeros((), _I32)
+        if packed:
+            end = unpack_state(cfg, end)
+        return end, acc, ova, tel, mon, ys, pov
 
     # Plain sharded fallback: the per-tick shard_map BATCHED engine
     # (parallel/mesh's deep route), scanned with the SAME rng operand the
@@ -615,7 +695,8 @@ def make_sharded_deep_scan(cfg, mesh, n_ticks: int,
     plain_tick = mesh_mod._make_shardmap_xla_tick(cfg, mesh)
     scan_plain = _livepin_scan(lambda s, rng: plain_tick(s, rng), n_ticks,
                                telemetry=telemetry, monitor=monitor,
-                               n_groups=cfg.n_groups)
+                               n_groups=cfg.n_groups, cfg=cfg,
+                               layout=layout)
 
     default_rng = _sharded_default_rng(cfg, mesh)
 
@@ -629,10 +710,12 @@ def make_sharded_deep_scan(cfg, mesh, n_ticks: int,
 
         def run_trace(st, rng=None):
             rng = rng if rng is not None else default_rng()
-            _, _, ova, _tel, _mon, ys = jfc_t(st, rng)
+            _, _, ova, _tel, _mon, ys, pov = jfc_t(st, rng)
             ov = bool(jax.device_get(ova))
             if ov:
-                _, _, _tel, _mon, ys = jplain_t(st, rng)
+                _, _, _tel, _mon, ys, pov = jplain_t(st, rng)
+            if packed:
+                check_packed_ov(pov)
             return jax.device_get(ys), ov
 
         return run_trace
@@ -643,10 +726,12 @@ def make_sharded_deep_scan(cfg, mesh, n_ticks: int,
 
         def run_state(st, rng=None):
             rng = rng if rng is not None else default_rng()
-            end, _, ova, _tel, _mon, _ys = jfc_s(st, rng)
+            end, _, ova, _tel, _mon, _ys, pov = jfc_s(st, rng)
             ov = bool(jax.device_get(ova))
             if ov:
-                end, _, _tel, _mon, _ys = jplain_s(st, rng)
+                end, _, _tel, _mon, _ys, pov = jplain_s(st, rng)
+            if packed:
+                check_packed_ov(pov)
             return end, ov
 
         return run_state
@@ -660,23 +745,33 @@ def make_sharded_deep_scan(cfg, mesh, n_ticks: int,
         rng = rng if rng is not None else default_rng()
         if summarize not in jitted:
             def reduced(s, r):
-                end, acc, ova, tel, mon, _ys = scan_fc(s, r)
-                return _reduction(end, acc, ova.astype(_I32), summarize,
-                                  tel=tel, mon=mon)
+                end, acc, ova, tel, mon, _ys, pov = scan_fc(s, r)
+                out = _reduction(end, acc, ova.astype(_I32), summarize,
+                                 tel=tel, mon=mon)
+                if packed:
+                    out["packed_ov"] = pov.astype(_I32)
+                return out
 
             def reduced_plain(s, r):
-                end, acc, tel, mon, _ys = scan_plain(s, r)
-                return _reduction(end, acc, jnp.ones((), _I32), summarize,
-                                  tel=tel, mon=mon)
+                end, acc, tel, mon, _ys, pov = scan_plain(s, r)
+                out = _reduction(end, acc, jnp.ones((), _I32), summarize,
+                                 tel=tel, mon=mon)
+                if packed:
+                    out["packed_ov"] = pov.astype(_I32)
+                return out
 
             jitted[summarize] = (jax.jit(reduced), jax.jit(reduced_plain))
         jfc, jplain = jitted[summarize]
         vals = dict(jfc(st, rng).items())
+        if packed:
+            check_packed_ov(vals["packed_ov"])
         if int(jax.device_get(vals["ov"])):
             # As in make_deep_scan: the plain rerun's recorder sees no OV
             # events, so keep the fc attempt's per-tick fallback count.
             fc_ov_ticks = vals.get("tel_ov_fallbacks")
             vals = dict(jplain(st, rng).items())
+            if packed:
+                check_packed_ov(vals["packed_ov"])
             if fc_ov_ticks is not None:
                 vals["tel_ov_fallbacks"] = fc_ov_ticks
         return vals

@@ -1104,7 +1104,8 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
                      telemetry: bool = False,
                      monitor: bool = False,
                      fused_ticks: Optional[int] = None,
-                     trace: bool = False):
+                     trace: bool = False,
+                     layout: str = "wide"):
     """Multi-tick Pallas runner with a FLAT int32 scan carry.
 
     Scanning make_pallas_tick converts RaftState <-> the kernel's flat int32
@@ -1162,14 +1163,41 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
     across T by construction (the fused legs read it from the snapshots) —
     the test surface tests/test_fused_ticks.py pins.
 
+    `layout` = "packed" (ISSUE 11) packs the FLAT SCAN CARRY between
+    kernel launches into the bit/byte-minimal layout (models/state.
+    pack_fields — SEMANTICS.md §14): the body unpacks to the i32 kernel
+    form at read and re-packs at write, so the HBM-resident state between
+    launches is the packed representation while the Mosaic kernel (and
+    its bits) stay untouched. This deliberately reverses the runner's
+    entry-cast amortization for the carry — bytes at rest traded for
+    elementwise repack ALU; in-kernel unpack is the hardware follow-up.
+    The width-overflow latch is host-checked per call when jitted=True
+    (RuntimeError, the fused overflow contract); jitted=False requires
+    telemetry=True and surfaces the latch as the recorder key
+    `packed_width_overflow`. The archival K-tick path rejects packed.
+
     Returns run(state, rng) -> state (jitted; rng rides as an operand so the
     compilation is seed-independent, as everywhere else)."""
     import types
 
+    from raft_kotlin_tpu.models import state as state_mod
     from raft_kotlin_tpu.utils import telemetry as telemetry_mod
 
     N, G = cfg.n_nodes, cfg.n_groups
     K = max(1, k_per_launch)
+    packed = layout == "packed"
+    if layout not in ("wide", "packed"):
+        raise ValueError(f"unknown layout {layout!r}")
+    if packed and K > 1:
+        raise ValueError(
+            "layout='packed' needs k_per_launch == 1 (the archival K-tick "
+            "kernel exposes no per-tick state to repack between launches)")
+    if packed and not jitted and not telemetry:
+        raise ValueError(
+            "layout='packed' with jitted=False needs telemetry=True: the "
+            "runner embeds in the caller's jit, so the width-overflow "
+            "latch's only surfaced channel is the flight recorder "
+            "(packed_width_overflow)")
     if (telemetry or monitor or trace) and K > 1:
         raise ValueError(
             "telemetry/monitor/trace need k_per_launch == 1: the K-tick "
@@ -1236,6 +1264,44 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
         n_launch, rem = divmod(n_ticks, T_f)
     else:
         n_launch, rem = 0, n_ticks
+    C_log = cfg.log_capacity
+
+    # Packed-carry adapters (ISSUE 11): the flat i32 kernel form <-> the
+    # packed rest layout, applied once per scan step around the launch
+    # (pair/log reshapes are free; pack_fields/unpack_fields are the one
+    # shared encoding — models/state.py).
+    def _pack_flat(s):
+        canon = {}
+        for k in sfields:
+            v = s[k]
+            if k in tick_mod._PAIR_FIELDS:
+                v = v.reshape(N, N, G)
+            elif k in tick_mod._LOG_FIELDS:
+                v = v.reshape(N, C_log, G)
+            canon[k] = v
+        return state_mod.pack_fields(cfg, canon)
+
+    def _unpack_flat(p):
+        s = state_mod.unpack_fields(cfg, p, kernel_form=True)
+        for k in sfields:
+            if k in tick_mod._PAIR_FIELDS:
+                s[k] = s[k].reshape(N * N, G)
+            elif k in tick_mod._LOG_FIELDS:
+                s[k] = s[k].reshape(N * C_log, G)
+        return s
+
+    def _carry_in(s, ovc, t, tel, mon):
+        if not packed:
+            return (s, t, tel, mon)
+        p, ov2 = _pack_flat(s)
+        return (p, ovc | ov2, t, tel, mon)
+
+    def _carry_out(carry):
+        if not packed:
+            s, t, tel, mon = carry
+            return s, jnp.zeros((), bool), t, tel, mon
+        p, ovc, t, tel, mon = carry
+        return _unpack_flat(p), ovc, t, tel, mon
 
     def run(state: RaftState, rng):
         base, tkeys, bkeys, scen = tick_mod.split_rng(rng)
@@ -1247,7 +1313,7 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
                 flat[k] = flat[k].astype(_I32)
 
         def body(carry, _):
-            s, t, tel, mon = carry
+            s, ovc, t, tel, mon = _carry_out(carry)
             # The flat carry holds the real pre-tick rows, so the shim
             # carries role/up too — leader-isolation banks work at T=1.
             shim = types.SimpleNamespace(
@@ -1276,7 +1342,7 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
                     telemetry_mod.monitor_flat_view(s, N),
                     telemetry_mod.monitor_flat_view(s2, N), mon)
             ys = ({f: s2[f] for f in FUSED_TRACE_FIELDS} if trace else None)
-            return (s2, t + 1, tel, mon), ys
+            return _carry_in(s2, ovc, t + 1, tel, mon), ys
 
         def body_k(carry, _):
             s, t, tel, mon = carry  # tel/mon None here (K > 1 rejected)
@@ -1307,7 +1373,7 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
             # replay the T per-tick transitions from the kernel's snapshot
             # outputs — same step functions as the 1-tick body, so their
             # carries are bit-equal to the unfused run.
-            s, t, tel, mon = carry
+            s, ovc, t, tel, mon = _carry_out(carry)
             per, flags, (el_tab, b_tab) = fused_launch_aux(
                 cfg, base, tkeys, bkeys, t, s["t_ctr"], s["b_ctr"], T_f,
                 resets_bound=_resets_bound, scen=scen)
@@ -1323,11 +1389,12 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
             if trace:
                 ys["trace"] = {f: jnp.stack([p[f] for p in ticks_f])
                                for f in FUSED_TRACE_FIELDS}
-            return (s2, t + T_f, tel, mon), ys
+            return _carry_in(s2, ovc, t + T_f, tel, mon), ys
 
         tel0 = telemetry_mod.telemetry_zeros() if telemetry else None
         mon0 = telemetry_mod.monitor_init(G, n_ticks, monitor)
-        flat_t = (flat, state.tick, tel0, mon0)
+        flat_t = _carry_in(flat, jnp.zeros((G,), bool), state.tick, tel0,
+                           mon0)
         ov_total = jnp.zeros((), _I32)
         traces = []
         if K > 1 and n_launch:
@@ -1343,7 +1410,9 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
             flat_t, ys = jax.lax.scan(body, flat_t, None, length=rem)
             if trace:
                 traces.append(ys)
-        flat, t, tel, mon = flat_t
+        flat, pov_lanes, t, tel, mon = _carry_out(flat_t)
+        # One scalar reduction of the (G,) per-group latch, at scan exit.
+        pov = jnp.any(pov_lanes) if packed else pov_lanes
         s, _ = cast_flat_out(cfg, [flat[k] for k in sfields], sfields,
                              with_dirty=False)
         end = RaftState(**tick_mod.unflatten_state(cfg, s), tick=t)
@@ -1353,6 +1422,10 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
             # The jitted=False embedding's overflow channel (see docstring).
             tel = dict(tel)
             tel["fused_draw_overflow"] = ov_total
+        if packed and not jitted:
+            # Same embedding argument for the packed width latch.
+            tel = dict(tel)
+            tel["packed_width_overflow"] = pov.astype(_I32)
         out = (end,)
         if trace:
             out = out + ({f: jnp.concatenate([tr[f] for tr in traces])
@@ -1362,7 +1435,11 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
         if monitor:
             out = out + (telemetry_mod.monitor_finalize(mon),)
         if T_f > 1 and jitted:
-            return out + (ov_total,)  # stripped by the checked() wrapper
+            out = out + (ov_total,)  # stripped by the checked() wrapper
+        if packed and jitted:
+            out = out + (pov.astype(_I32),)  # stripped + host-checked
+        if (T_f > 1 or packed) and jitted:
+            return out
         return out if len(out) > 1 else end
 
     # jitted=False hands the traceable fn to callers that embed it in a
@@ -1384,19 +1461,25 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
             return end
 
         return checked
-    if T_f > 1 and jitted:
+    if (T_f > 1 or packed) and jitted:
         inner_f = jax.jit(run)
 
         def checked_f(state, rng):
             res = inner_f(state, rng)
-            res, ov = res[:-1], res[-1]
-            if int(jax.device_get(ov)):
-                raise RuntimeError(
-                    f"fused-tick kernel draw-table overflow: a node "
-                    f"consumed more election-timer resets within one "
-                    f"{T_f}-tick launch than the structural bound covers "
-                    f"(resets_per_tick_bound) — the launch's draws were "
-                    f"clamped and its bits are INVALID; results discarded")
+            if packed:
+                res, pov = res[:-1], res[-1]
+            if T_f > 1:
+                res, ov = res[:-1], res[-1]
+                if int(jax.device_get(ov)):
+                    raise RuntimeError(
+                        f"fused-tick kernel draw-table overflow: a node "
+                        f"consumed more election-timer resets within one "
+                        f"{T_f}-tick launch than the structural bound "
+                        f"covers (resets_per_tick_bound) — the launch's "
+                        f"draws were clamped and its bits are INVALID; "
+                        f"results discarded")
+            if packed:
+                state_mod.check_packed_ov(pov)
             return res if len(res) > 1 else res[0]
 
         return checked_f

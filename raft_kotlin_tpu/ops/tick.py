@@ -2114,7 +2114,8 @@ def make_tick(cfg: RaftConfig, batched: Optional[bool] = None,
 
 def make_run(cfg: RaftConfig, n_ticks: int, trace: bool = True, impl: str = "xla",
              batched: Optional[bool] = None, telemetry: bool = False,
-             monitor: bool = False, rng=None, fused_ticks: int = 1):
+             monitor: bool = False, rng=None, fused_ticks: int = 1,
+             layout: Optional[str] = None):
     """jitted runner: state -> (state, trace) stepping n_ticks via lax.scan.
 
     trace is a dict of (T, N, G) arrays (role/term/commit/last_index/voted_for/rounds/
@@ -2150,7 +2151,22 @@ def make_run(cfg: RaftConfig, n_ticks: int, trace: bool = True, impl: str = "xla
     routing); with trace=False the per-tick leader counts become per-BLOCK
     (block-end) counts of shape (n_ticks // T, G). Telemetry/monitor
     accumulate per tick inside the loop, bit-equal to T=1.
+
+    `layout` = "packed" (ISSUE 11) carries the PACKED state layout
+    (models/state.pack_state — SEMANTICS.md §14) through the scan: the
+    body unpacks at read, ticks on the wide dtypes (identical bits by
+    construction) and re-packs at write, so the state at rest between
+    ticks is the bit/byte-minimal representation. External contract is
+    unchanged (wide state in, wide state out); the width-overflow latch
+    is host-checked after the run and raises RuntimeError on a wrapped
+    value (re-run with layout="wide"). The default None adopts the
+    plan's layout under impl="auto" and means "wide" otherwise — an
+    EXPLICIT "wide" always wins over the routed plan (it is the
+    documented overflow remedy and must never be re-packed).
     """
+    from raft_kotlin_tpu.models.state import (
+        check_packed_ov, pack_state, unpack_state)
+
     if impl == "auto":
         # The unified plan layer (parallel/autotune.plan_for, r13): one
         # resolution decides engine + fused depth; this runner no longer
@@ -2163,6 +2179,12 @@ def make_run(cfg: RaftConfig, n_ticks: int, trace: bool = True, impl: str = "xla
         impl = "pallas" if plan["engine"] == "pallas" else "xla"
         if fused_ticks == 1:
             fused_ticks = plan["fused_ticks"]
+        if layout is None:
+            layout = plan.get("layout", "wide")
+    layout = layout or "wide"
+    packed = layout == "packed"
+    if layout not in ("wide", "packed"):
+        raise ValueError(f"unknown layout {layout!r}")
     T_f = max(1, fused_ticks)
     if trace:
         T_f = 1  # sticky fallback: per-tick traces need per-tick emission
@@ -2177,18 +2199,23 @@ def make_run(cfg: RaftConfig, n_ticks: int, trace: bool = True, impl: str = "xla
 
     @jax.jit
     def run(st, rng):
+        if packed:
+            st = pack_state(cfg, st)
+
         def one(carry):
             st, tel, mon = carry
+            wide = unpack_state(cfg, st) if packed else st
             with telemetry_mod.engine_scope(impl):
-                st2 = tick_fn(st, rng=rng)
+                st2 = tick_fn(wide, rng=rng)
             if telemetry:
-                tel = telemetry_mod.telemetry_step(st, st2, tel)
+                tel = telemetry_mod.telemetry_step(wide, st2, tel)
             if monitor:
-                mon = telemetry_mod.monitor_step(st, st2, mon)
-            return (st2, tel, mon)
+                mon = telemetry_mod.monitor_step(wide, st2, mon)
+            nxt = pack_state(cfg, st2, ov=st.ov) if packed else st2
+            return (nxt, tel, mon), st2
 
         def body(carry, _):
-            st2, tel, mon = one(carry)
+            carry, st2 = one(carry)
             if trace:
                 out = {
                     "role": st2.role,
@@ -2201,13 +2228,16 @@ def make_run(cfg: RaftConfig, n_ticks: int, trace: bool = True, impl: str = "xla
                 }
             else:
                 out = jnp.sum((st2.role == LEADER).astype(_I32), axis=0)
-            return (st2, tel, mon), out
+            return carry, out
 
         def block(carry, _):
             # One T-block: the fori-loop-over-T body that mirrors a fused
-            # kernel launch's program shape (ISSUE 7).
-            carry = lax.fori_loop(0, T_f, lambda _i, c: one(c), carry)
-            out = jnp.sum((carry[0].role == LEADER).astype(_I32), axis=0)
+            # kernel launch's program shape (ISSUE 7). The block output
+            # reads the block-END state (unpacked again under the packed
+            # layout — per-tick wide states cannot ride a fori_loop out).
+            carry = lax.fori_loop(0, T_f, lambda _i, c: one(c)[0], carry)
+            end = unpack_state(cfg, carry[0]) if packed else carry[0]
+            out = jnp.sum((end.role == LEADER).astype(_I32), axis=0)
             return carry, out
 
         tel0 = telemetry_mod.telemetry_zeros() if telemetry else None
@@ -2221,12 +2251,26 @@ def make_run(cfg: RaftConfig, n_ticks: int, trace: bool = True, impl: str = "xla
         else:
             carry, ys = lax.scan(body, carry, None, length=n_ticks)
         end, tel, mon = carry
+        # One scalar reduction of the (G,) per-group latch, at scan exit
+        # (never per tick — the sharded runs' collective-freedom hinges
+        # on the carry staying lane-shaped).
+        pov = jnp.any(end.ov != 0) if packed else None
+        if packed:
+            end = unpack_state(cfg, end)
         out = (end, ys)
         if telemetry:
             out = out + (tel,)
         if monitor:
             out = out + (telemetry_mod.monitor_finalize(mon),)
-        return out
+        return out + (pov,) if packed else out
 
     # rng rides the jit boundary as an operand (seed-independent program).
+    if packed:
+        def call(st):
+            res = run(st, rng)
+            res, pov = res[:-1], res[-1]
+            check_packed_ov(pov)  # loud-fail: wrapped bits are invalid
+            return res
+
+        return call
     return lambda st: run(st, rng)

@@ -9,9 +9,10 @@ This module replaces all of them with ONE declarative plan space: a
 resolution key (regime, capacity, lanes, dtype, mailbox, platform) maps to
 a full execution plan
 
-    {engine, ilp_subtiles, fused_ticks, sharding, tile}
+    {engine, ilp_subtiles, fused_ticks, layout, sharding, tile}
 
-through, in order:
+(`layout` ∈ {wide, packed} — the r14 state-layout dimension,
+models/state.py packed encodings, SEMANTICS.md §14) through, in order:
 
 1. the pinned in-repo `TUNING_TABLE` (the marker-bounded block below —
    rows are canonical JSON, so `scripts/autotune.py --pin` rewrites are
@@ -61,9 +62,11 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 CACHE_PATH = os.path.join(REPO_ROOT, ".autotune_cache.json")
 
-PLAN_FIELDS = ("engine", "ilp_subtiles", "fused_ticks", "sharding", "tile")
+PLAN_FIELDS = ("engine", "ilp_subtiles", "fused_ticks", "layout",
+               "sharding", "tile")
 REGIMES = ("shallow", "deep")
 DEEP_ENGINES = ("fc", "batched", "flat")
+LAYOUTS = ("wide", "packed")
 
 # The 128-lane vreg floor (ops/pallas_tick.make_pallas_core's hardware
 # assertion): a routed K must keep tile // K a multiple of 128.
@@ -73,8 +76,11 @@ VREG_LANES = 128
 # ---------------------------------------------------------------------------
 # The pinned table. Each row is ONE canonical-JSON line:
 #   {"key": {regime, capacity, lanes, dtype, mailbox, platform},
-#    "plan": {engine, ilp_subtiles, fused_ticks, sharding, tile},
+#    "plan": {engine, ilp_subtiles, fused_ticks, layout, sharding, tile},
 #    "provenance": {source[, measured: {...}]}}
+# Rows predating a plan dimension simply omit it and resolve to its
+# legacy default (layout -> "wide"; apply_guards normalizes) — the
+# migration contract that lets --pin rewrites and old caches coexist.
 # Shallow rows are keyed by the megakernel TILE (lanes == tile, capacity 0
 # = any static-log capacity) — the same key the legacy ILP/FUSED tables
 # used. Deep rows are keyed by (capacity, per-shard lane width, mailbox).
@@ -85,16 +91,16 @@ VREG_LANES = 128
 # byte-stability is pinned by tests/test_autotune.py.
 # TUNING_TABLE[begin] (scripts/autotune.py --pin rewrites this block)
 _TUNING_ROWS = (
-    '{"key":{"capacity":0,"dtype":"int32","lanes":128,"mailbox":false,"platform":"tpu","regime":"shallow"},"plan":{"engine":"pallas","fused_ticks":4,"ilp_subtiles":1,"sharding":"shard_map","tile":128},"provenance":{"source":"migrated r13 from ILP_SUBTILE_TABLE (single vreg: no split possible below the 128-lane floor) + FUSED_TICK_TABLE (provisional: smallest tile, most launches to amortize; re-pinned by BENCH_r06)"}}',  # noqa: E501
-    '{"key":{"capacity":0,"dtype":"int32","lanes":256,"mailbox":false,"platform":"tpu","regime":"shallow"},"plan":{"engine":"pallas","fused_ticks":4,"ilp_subtiles":2,"sharding":"shard_map","tile":256},"provenance":{"source":"migrated r13 from ILP_SUBTILE_TABLE (provisional: vreg floor allows only 2 slabs) + FUSED_TICK_TABLE (provisional: same amortization, half the slab VMEM)"}}',  # noqa: E501
-    '{"key":{"capacity":0,"dtype":"int32","lanes":512,"mailbox":false,"platform":"tpu","regime":"shallow"},"plan":{"engine":"pallas","fused_ticks":4,"ilp_subtiles":4,"sharding":"shard_map","tile":512},"provenance":{"source":"migrated r13 from ILP_SUBTILE_TABLE (provisional: the 128-lane vreg floor x4 chains - the headline tile; re-pinned by BENCH_r08) + FUSED_TICK_TABLE (provisional: the headline tile - 4x launch amortization at ~60% of the fused VMEM model; re-pinned by BENCH_r06)"}}',  # noqa: E501
-    '{"key":{"capacity":0,"dtype":"int32","lanes":1024,"mailbox":false,"platform":"tpu","regime":"shallow"},"plan":{"engine":"pallas","fused_ticks":2,"ilp_subtiles":4,"sharding":"shard_map","tile":1024},"provenance":{"source":"migrated r13 from ILP_SUBTILE_TABLE (provisional: 256-lane slabs (2 vregs) x4 chains; re-pinned by BENCH_r08) + FUSED_TICK_TABLE (provisional: widest tile - VMEM bounds the T aux slabs + draw tables; re-pinned by BENCH_r06)"}}',  # noqa: E501
-    '{"key":{"capacity":1024,"dtype":"int16","lanes":2048,"mailbox":false,"platform":"tpu","regime":"deep"},"plan":{"engine":"batched","fused_ticks":1,"ilp_subtiles":1,"sharding":"shard_map","tile":null},"provenance":{"source":"BENCH_r05 corner: batched 71.1k vs fc 54.2k vs flat 48.1k gsps"}}',  # noqa: E501
-    '{"key":{"capacity":1024,"dtype":"int16","lanes":2048,"mailbox":true,"platform":"tpu","regime":"deep"},"plan":{"engine":"batched","fused_ticks":1,"ilp_subtiles":1,"sharding":"shard_map","tile":null},"provenance":{"source":"mailbox corner: provisional from BENCH_r05 mbdeep_sliced 60.6k vs cornerdeep_batched 76.7k gsps (the per-pair-vs-batched gap the r7 engines close); re-pinned by BENCH_r07 mbdeep_* + routing_match"}}',  # noqa: E501
-    '{"key":{"capacity":10000,"dtype":"int16","lanes":3328,"mailbox":false,"platform":"tpu","regime":"deep"},"plan":{"engine":"fc","fused_ticks":1,"ilp_subtiles":1,"sharding":"shard_map","tile":null},"provenance":{"source":"config5_pershard leg (r6): the true v4-32 config-5 per-chip shard; provisional winner = nearest measured neighbor until BENCH_r06 config5_pershard_* fields land"}}',  # noqa: E501
-    '{"key":{"capacity":10000,"dtype":"int16","lanes":3328,"mailbox":true,"platform":"tpu","regime":"deep"},"plan":{"engine":"fc","fused_ticks":1,"ilp_subtiles":1,"sharding":"shard_map","tile":null},"provenance":{"source":"mailbox config-5 per-chip shard: provisional (see the sync entry at this shape)"}}',  # noqa: E501
-    '{"key":{"capacity":10000,"dtype":"int16","lanes":13312,"mailbox":false,"platform":"tpu","regime":"deep"},"plan":{"engine":"fc","fused_ticks":1,"ilp_subtiles":1,"sharding":"shard_map","tile":null},"provenance":{"source":"BENCH_r05 deeplog: fc 258.0k gsps (3.6x batched per ROUND5.md stage table)"}}',  # noqa: E501
-    '{"key":{"capacity":10000,"dtype":"int16","lanes":13312,"mailbox":true,"platform":"tpu","regime":"deep"},"plan":{"engine":"fc","fused_ticks":1,"ilp_subtiles":1,"sharding":"shard_map","tile":null},"provenance":{"source":"mailbox production shape: provisional winner = the synchronous measured winner at the same shape until BENCH_r07 mbdeep_* fields land"}}',  # noqa: E501
+    '{"key":{"capacity":0,"dtype":"int32","lanes":128,"mailbox":false,"platform":"tpu","regime":"shallow"},"plan":{"engine":"pallas","fused_ticks":4,"ilp_subtiles":1,"layout":"packed","sharding":"shard_map","tile":128},"provenance":{"source":"migrated r13 from ILP_SUBTILE_TABLE (single vreg: no split possible below the 128-lane floor) + FUSED_TICK_TABLE (provisional: smallest tile, most launches to amortize; re-pinned by BENCH_r06); layout packed r14: 2.4x fewer concrete-pytree bytes/tick at the headline shape under the width latch (provisional \u2014 re-pinned by BENCH_r06 packed_vs_wide)"}}',  # noqa: E501
+    '{"key":{"capacity":0,"dtype":"int32","lanes":256,"mailbox":false,"platform":"tpu","regime":"shallow"},"plan":{"engine":"pallas","fused_ticks":4,"ilp_subtiles":2,"layout":"packed","sharding":"shard_map","tile":256},"provenance":{"source":"migrated r13 from ILP_SUBTILE_TABLE (provisional: vreg floor allows only 2 slabs) + FUSED_TICK_TABLE (provisional: same amortization, half the slab VMEM); layout packed r14: 2.4x fewer concrete-pytree bytes/tick at the headline shape under the width latch (provisional \u2014 re-pinned by BENCH_r06 packed_vs_wide)"}}',  # noqa: E501
+    '{"key":{"capacity":0,"dtype":"int32","lanes":512,"mailbox":false,"platform":"tpu","regime":"shallow"},"plan":{"engine":"pallas","fused_ticks":4,"ilp_subtiles":4,"layout":"packed","sharding":"shard_map","tile":512},"provenance":{"source":"migrated r13 from ILP_SUBTILE_TABLE (provisional: the 128-lane vreg floor x4 chains - the headline tile; re-pinned by BENCH_r08) + FUSED_TICK_TABLE (provisional: the headline tile - 4x launch amortization at ~60% of the fused VMEM model; re-pinned by BENCH_r06); layout packed r14: 2.4x fewer concrete-pytree bytes/tick at the headline shape under the width latch (provisional \u2014 re-pinned by BENCH_r06 packed_vs_wide)"}}',  # noqa: E501
+    '{"key":{"capacity":0,"dtype":"int32","lanes":1024,"mailbox":false,"platform":"tpu","regime":"shallow"},"plan":{"engine":"pallas","fused_ticks":2,"ilp_subtiles":4,"layout":"packed","sharding":"shard_map","tile":1024},"provenance":{"source":"migrated r13 from ILP_SUBTILE_TABLE (provisional: 256-lane slabs (2 vregs) x4 chains; re-pinned by BENCH_r08) + FUSED_TICK_TABLE (provisional: widest tile - VMEM bounds the T aux slabs + draw tables; re-pinned by BENCH_r06); layout packed r14: 2.4x fewer concrete-pytree bytes/tick at the headline shape under the width latch (provisional \u2014 re-pinned by BENCH_r06 packed_vs_wide)"}}',  # noqa: E501
+    '{"key":{"capacity":1024,"dtype":"int16","lanes":2048,"mailbox":false,"platform":"tpu","regime":"deep"},"plan":{"engine":"batched","fused_ticks":1,"ilp_subtiles":1,"layout":"wide","sharding":"shard_map","tile":null},"provenance":{"source":"BENCH_r05 corner: batched 71.1k vs fc 54.2k vs flat 48.1k gsps; layout wide r14: the int16 log already dominates deep bytes (packed win ~1.3x, repack tax unmeasured \u2014 scripts/probe_layout.py re-measures)"}}',  # noqa: E501
+    '{"key":{"capacity":1024,"dtype":"int16","lanes":2048,"mailbox":true,"platform":"tpu","regime":"deep"},"plan":{"engine":"batched","fused_ticks":1,"ilp_subtiles":1,"layout":"wide","sharding":"shard_map","tile":null},"provenance":{"source":"mailbox corner: provisional from BENCH_r05 mbdeep_sliced 60.6k vs cornerdeep_batched 76.7k gsps (the per-pair-vs-batched gap the r7 engines close); re-pinned by BENCH_r07 mbdeep_* + routing_match; layout wide r14: the int16 log already dominates deep bytes (packed win ~1.3x, repack tax unmeasured \u2014 scripts/probe_layout.py re-measures)"}}',  # noqa: E501
+    '{"key":{"capacity":10000,"dtype":"int16","lanes":3328,"mailbox":false,"platform":"tpu","regime":"deep"},"plan":{"engine":"fc","fused_ticks":1,"ilp_subtiles":1,"layout":"wide","sharding":"shard_map","tile":null},"provenance":{"source":"config5_pershard leg (r6): the true v4-32 config-5 per-chip shard; provisional winner = nearest measured neighbor until BENCH_r06 config5_pershard_* fields land; layout wide r14: the int16 log already dominates deep bytes (packed win ~1.3x, repack tax unmeasured \u2014 scripts/probe_layout.py re-measures)"}}',  # noqa: E501
+    '{"key":{"capacity":10000,"dtype":"int16","lanes":3328,"mailbox":true,"platform":"tpu","regime":"deep"},"plan":{"engine":"fc","fused_ticks":1,"ilp_subtiles":1,"layout":"wide","sharding":"shard_map","tile":null},"provenance":{"source":"mailbox config-5 per-chip shard: provisional (see the sync entry at this shape); layout wide r14: the int16 log already dominates deep bytes (packed win ~1.3x, repack tax unmeasured \u2014 scripts/probe_layout.py re-measures)"}}',  # noqa: E501
+    '{"key":{"capacity":10000,"dtype":"int16","lanes":13312,"mailbox":false,"platform":"tpu","regime":"deep"},"plan":{"engine":"fc","fused_ticks":1,"ilp_subtiles":1,"layout":"wide","sharding":"shard_map","tile":null},"provenance":{"source":"BENCH_r05 deeplog: fc 258.0k gsps (3.6x batched per ROUND5.md stage table); layout wide r14: the int16 log already dominates deep bytes (packed win ~1.3x, repack tax unmeasured \u2014 scripts/probe_layout.py re-measures)"}}',  # noqa: E501
+    '{"key":{"capacity":10000,"dtype":"int16","lanes":13312,"mailbox":true,"platform":"tpu","regime":"deep"},"plan":{"engine":"fc","fused_ticks":1,"ilp_subtiles":1,"layout":"wide","sharding":"shard_map","tile":null},"provenance":{"source":"mailbox production shape: provisional winner = the synchronous measured winner at the same shape until BENCH_r07 mbdeep_* fields land; layout wide r14: the int16 log already dominates deep bytes (packed win ~1.3x, repack tax unmeasured \u2014 scripts/probe_layout.py re-measures)"}}',  # noqa: E501
 )
 # TUNING_TABLE[end]
 
@@ -190,9 +196,10 @@ def default_plan(key: dict) -> dict:
     """The conservative always-correct plan (resolution path 5)."""
     if key["regime"] == "deep":
         return {"engine": "flat", "ilp_subtiles": 1, "fused_ticks": 1,
-                "sharding": "shard_map", "tile": None}
+                "layout": "wide", "sharding": "shard_map", "tile": None}
     return {"engine": "pallas", "ilp_subtiles": 1, "fused_ticks": 1,
-            "sharding": "shard_map", "tile": key["lanes"]}
+            "layout": "wide", "sharding": "shard_map",
+            "tile": key["lanes"]}
 
 
 def apply_guards(key: dict, plan: dict) -> dict:
@@ -204,15 +211,24 @@ def apply_guards(key: dict, plan: dict) -> dict:
     - CPU shallow: K=1 (the interpreter executes serially — no issue
       latency to hide) and T=1 (no launch latency to amortize), the
       byte-identity guarantee for the whole CPU differential suite;
+    - CPU any regime: layout "wide" — the packed layout trades repack ALU
+      for HBM bytes at rest, a wall the CPU interpreter doesn't have
+      (same class as K=1/T=1: nothing to amortize, only slowdown);
     - the 128-lane vreg floor: K must divide the tile into >=128-lane
       slabs (Mosaic's hardware assertion can never fire on a routed K).
+
+    A plan with no `layout` entry (pre-r14 pinned rows, stale caches)
+    normalizes to the legacy "wide" — the layout-dimension migration
+    contract, pinned by tests/test_autotune.py.
     """
     plan = dict(plan)
+    plan.setdefault("layout", "wide")
     if key["platform"] == "cpu":
         if key["regime"] == "deep":
             plan["engine"] = "flat"
         plan["ilp_subtiles"] = 1
         plan["fused_ticks"] = 1
+        plan["layout"] = "wide"
         return plan
     tile = plan.get("tile")
     k = int(plan.get("ilp_subtiles") or 1)
@@ -405,8 +421,9 @@ def plan_for(cfg, mesh=None, platform: Optional[str] = None,
             # the only valid engine (the caller-level rule every deep
             # router applies; a table entry can never override it).
             plan, source = ({"engine": "flat", "ilp_subtiles": 1,
-                             "fused_ticks": 1, "sharding": "shard_map",
-                             "tile": None}, "guard")
+                             "fused_ticks": 1, "layout": "wide",
+                             "sharding": "shard_map", "tile": None},
+                            "guard")
         else:
             plan, source = resolve_plan(
                 deep_key(cfg.log_capacity, lanes, mailbox=cfg.uses_mailbox,
@@ -437,10 +454,13 @@ def plan_for(cfg, mesh=None, platform: Optional[str] = None,
         except ValueError:
             engine, tile, k, T = "xla", None, 1, 1
     source = "pinned" if engine == "pallas" else "guard"
+    layout = "wide"
     if engine == "pallas" and tile is not None:
-        _, source = resolve_plan(shallow_key(tile, platform=pclass),
-                                 with_source=True)
+        row_plan, source = resolve_plan(shallow_key(tile, platform=pclass),
+                                        with_source=True)
+        layout = row_plan.get("layout", "wide")
     plan = {"engine": engine, "ilp_subtiles": int(k), "fused_ticks": int(T),
+            "layout": layout,
             "sharding": ("shard_map" if engine == "pallas" else "spmd")
             if mesh is not None else "single", "tile": tile}
     return (plan, source) if with_source else plan
@@ -467,6 +487,8 @@ def make_planned_run(cfg, n_ticks: int, mesh=None, telemetry: bool = False,
     this entry only ever decides speed."""
     plan = dict(plan) if plan is not None else plan_for(
         cfg, mesh, telemetry=telemetry, monitor=monitor)
+    plan.setdefault("layout", "wide")
+    layout = plan["layout"]
     if cfg.uses_dyn_log:
         from raft_kotlin_tpu.ops.deep_cache import (
             make_deep_scan, make_sharded_deep_scan)
@@ -475,16 +497,16 @@ def make_planned_run(cfg, n_ticks: int, mesh=None, telemetry: bool = False,
             run = make_sharded_deep_scan(cfg, mesh, n_ticks,
                                          engine=plan["engine"],
                                          telemetry=telemetry,
-                                         monitor=monitor)
+                                         monitor=monitor, layout=layout)
             return run, plan
         if plan["engine"] == "fc":
             return make_deep_scan(cfg, n_ticks, telemetry=telemetry,
-                                  monitor=monitor), plan
+                                  monitor=monitor, layout=layout), plan
         from raft_kotlin_tpu.ops.tick import make_run
 
         run = make_run(cfg, n_ticks, trace=False,
                        batched=None if plan["engine"] == "batched" else False,
-                       telemetry=telemetry, monitor=monitor)
+                       telemetry=telemetry, monitor=monitor, layout=layout)
         return run, plan
     if mesh is not None:
         from raft_kotlin_tpu.parallel.mesh import make_sharded_run
@@ -494,7 +516,8 @@ def make_planned_run(cfg, n_ticks: int, mesh=None, telemetry: bool = False,
                                metrics_every=metrics_every, impl=impl,
                                telemetry=telemetry, monitor=monitor,
                                fused_ticks=plan["fused_ticks"]
-                               if impl == "pallas" else None)
+                               if impl == "pallas" else None,
+                               layout=layout)
         return run, plan
     if plan["engine"] == "pallas":
         from raft_kotlin_tpu.ops.pallas_tick import make_pallas_scan
@@ -502,12 +525,14 @@ def make_planned_run(cfg, n_ticks: int, mesh=None, telemetry: bool = False,
         run = make_pallas_scan(cfg, n_ticks, tile_g=plan["tile"],
                                ilp_subtiles=plan["ilp_subtiles"],
                                fused_ticks=plan["fused_ticks"],
-                               telemetry=telemetry, monitor=monitor)
+                               telemetry=telemetry, monitor=monitor,
+                               layout=layout)
         return run, plan
     from raft_kotlin_tpu.ops.tick import make_run
 
     run = make_run(cfg, n_ticks, trace=False, telemetry=telemetry,
-                   monitor=monitor, fused_ticks=plan["fused_ticks"])
+                   monitor=monitor, fused_ticks=plan["fused_ticks"],
+                   layout=layout)
     return run, plan
 
 
@@ -564,7 +589,7 @@ def measure_deep_key(key: dict, n_ticks: int = 10, reps: int = 2) -> tuple:
         raise RuntimeError(f"no deep engine measurable at {key}")
     winner = max(valid, key=valid.get)
     plan = {"engine": winner, "ilp_subtiles": 1, "fused_ticks": 1,
-            "sharding": "shard_map", "tile": None}
+            "layout": "wide", "sharding": "shard_map", "tile": None}
     prov = {"source": f"autotune measure-on-first-use "
                       f"({jax.devices()[0].platform})",
             "measured": {"gsps": timings, "ticks": n_ticks, "reps": reps}}
@@ -591,27 +616,33 @@ def measure_shallow_key(key: dict, n_ticks: int = 20,
         for K in (1, 2, 4):
             if tile % K or (tile // K) % VREG_LANES:
                 continue
+            for L in LAYOUTS:
 
-            def gen(cfg_c, T=T, K=K):
-                yield (lambda n: make_pallas_scan(
-                    cfg_c, n, tile_g=tile, interpret=False, jitted=False,
-                    telemetry=True, monitor=True, fused_ticks=T,
-                    ilp_subtiles=K)), f"pallas-T{T}K{K}"
-            try:
-                ts, stats, _ = bench.measure(cfg, n_ticks, reps, gen)
-                best = bench.median(ts)
-                if int(stats[ts.index(best)].get(
-                        "tel_fused_draw_overflow") or 0):
-                    continue  # clamped draws: invalid point
-                timings[f"T{T}K{K}"] = round(n_ticks / best, 2)
-            except Exception as e:
-                print(f"autotune measure T{T}K{K} failed: {str(e)[:160]}")
+                def gen(cfg_c, T=T, K=K, L=L):
+                    yield (lambda n: make_pallas_scan(
+                        cfg_c, n, tile_g=tile, interpret=False,
+                        jitted=False, telemetry=True, monitor=True,
+                        fused_ticks=T, ilp_subtiles=K, layout=L)), \
+                        f"pallas-T{T}K{K}-{L}"
+                try:
+                    ts, stats, _ = bench.measure(cfg, n_ticks, reps, gen)
+                    best = bench.median(ts)
+                    med = stats[ts.index(best)]
+                    if int(med.get("tel_fused_draw_overflow") or 0):
+                        continue  # clamped draws: invalid point
+                    if int(med.get("tel_packed_width_overflow") or 0):
+                        continue  # wrapped packs: invalid point
+                    timings[f"T{T}K{K}-{L}"] = round(n_ticks / best, 2)
+                except Exception as e:
+                    print(f"autotune measure T{T}K{K}-{L} failed: "
+                          f"{str(e)[:160]}")
     if not timings:
         raise RuntimeError(f"no shallow point measurable at {key}")
     winner = max(timings, key=timings.get)
-    T, K = (int(x) for x in winner[1:].split("K"))
+    tk, L = winner.split("-")
+    T, K = (int(x) for x in tk[1:].split("K"))
     plan = {"engine": "pallas", "ilp_subtiles": K, "fused_ticks": T,
-            "sharding": "shard_map", "tile": tile}
+            "layout": L, "sharding": "shard_map", "tile": tile}
     prov = {"source": f"autotune measure-on-first-use "
                       f"({jax.devices()[0].platform})",
             "measured": {"ticks_per_sec": timings, "ticks": n_ticks,
@@ -647,7 +678,9 @@ def audit_entries(entries=None, measure_fn: Optional[Callable] = None,
                         "error": str(err)[:200]})
             continue
         match = all(plan.get(f) == e["plan"].get(f)
-                    for f in ("engine", "ilp_subtiles", "fused_ticks"))
+                    for f in ("engine", "ilp_subtiles", "fused_ticks")) \
+            and (plan.get("layout") or "wide") == (
+                e["plan"].get("layout") or "wide")
         out.append({"key": e["key"], "pinned": e["plan"], "measured": plan,
                     "provenance": prov, "match": match})
     return out

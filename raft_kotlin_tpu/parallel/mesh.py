@@ -536,7 +536,8 @@ def _make_shardmap_xla_tick(cfg: RaftConfig, mesh: Mesh,
 def make_sharded_run(cfg: RaftConfig, mesh: Mesh, n_ticks: int,
                      metrics_every: int = 0, impl: str = "xla",
                      telemetry: bool = False, monitor: bool = False,
-                     fused_ticks: Optional[int] = None):
+                     fused_ticks: Optional[int] = None,
+                     layout: str = "wide"):
     """Compile run(state [, inject]) -> (state, metrics) sharded over `mesh`.
 
     metrics: dict of cross-group reductions emitted every `metrics_every` ticks
@@ -574,8 +575,22 @@ def make_sharded_run(cfg: RaftConfig, mesh: Mesh, n_ticks: int,
     draw-table overflow flag is summed across the run and host-checked
     after each call (RuntimeError on violation, the loud-failure
     contract).
+
+    `layout`="packed" (ISSUE 11) carries the packed state layout
+    (models/state.pack_state — SEMANTICS.md §14) through the sharded scan:
+    pack/unpack run OUTSIDE shard_map on the globally sharded state
+    (elementwise, shard-local under the partitioner — the per-shard tick
+    program is untouched and stays collective-free; only the width-latch
+    reduction joins the observers' collective class). External contract
+    unchanged (wide in, wide out); the latch is host-checked per call.
     """
+    from raft_kotlin_tpu.models.state import (
+        check_packed_ov, pack_state, unpack_state)
     from raft_kotlin_tpu.ops.tick import flatten_state, make_rng
+
+    packed = layout == "packed"
+    if layout not in ("wide", "packed"):
+        raise ValueError(f"unknown layout {layout!r}")
 
     fused_block, T_f = None, 1
     if impl == "pallas":
@@ -642,23 +657,38 @@ def make_sharded_run(cfg: RaftConfig, mesh: Mesh, n_ticks: int,
                 jnp.int64 if jax.config.jax_enable_x64 else jnp.int32)),
         }
 
+    def _wide(st):
+        return unpack_state(cfg, st) if packed else st
+
     def _pack(st, ms, tel, mon):
+        # One scalar reduction of the (G,) per-group latch, at scan exit
+        # only — the per-tick carry stays lane-shaped/shard-local, so the
+        # packed sharded tick adds NO per-tick collective.
+        pov = jnp.any(st.ov != 0) if packed else None
+        st = _wide(st)
         out = (st, ms)
         if telemetry:
             out = out + (tel,)
         if monitor:
             out = out + (telemetry_mod.monitor_finalize(mon),)
+        if packed:
+            out = out + (pov,)
         return out
 
     def run(st, rng):
+        if packed:
+            st = pack_state(cfg, st)
+
         def one(carry, _):
             s, tel, mon = carry
-            s2 = tick_fn(s, rng)
+            w = _wide(s)
+            s2 = tick_fn(w, rng)
             if tel is not None:
-                tel = telemetry_mod.telemetry_step(s, s2, tel)
+                tel = telemetry_mod.telemetry_step(w, s2, tel)
             if mon is not None:
-                mon = telemetry_mod.monitor_step(s, s2, mon)
-            return (s2, tel, mon), None
+                mon = telemetry_mod.monitor_step(w, s2, mon)
+            nxt = pack_state(cfg, s2, ov=s.ov) if packed else s2
+            return (nxt, tel, mon), None
 
         tel0 = telemetry_mod.telemetry_zeros() if telemetry else None
         mon0 = telemetry_mod.monitor_init(cfg.n_groups, n_ticks, monitor)
@@ -669,10 +699,10 @@ def make_sharded_run(cfg: RaftConfig, mesh: Mesh, n_ticks: int,
 
         def win(carry, _):
             st, tel, mon = carry
-            rounds0 = _rounds_sum(st)
+            rounds0 = _rounds_sum(_wide(st))
             (st, tel, mon), _ = jax.lax.scan(one, (st, tel, mon), None,
                                              length=metrics_every)
-            return (st, tel, mon), window_metrics(st, rounds0)
+            return (st, tel, mon), window_metrics(_wide(st), rounds0)
 
         (st, tel, mon), ms = jax.lax.scan(win, (st, tel0, mon0), None,
                                           length=n_ticks // metrics_every)
@@ -697,20 +727,24 @@ def make_sharded_run(cfg: RaftConfig, mesh: Mesh, n_ticks: int,
 
         def one(carry, _):
             s, tel, mon = carry
-            s2 = tick_fn(s, rng)
+            w = _wide(s)
+            s2 = tick_fn(w, rng)
             if tel is not None:
-                tel = telemetry_mod.telemetry_step(s, s2, tel)
+                tel = telemetry_mod.telemetry_step(w, s2, tel)
             if mon is not None:
-                mon = telemetry_mod.monitor_step(s, s2, mon)
-            return (s2, tel, mon), None
+                mon = telemetry_mod.monitor_step(w, s2, mon)
+            nxt = pack_state(cfg, s2, ov=s.ov) if packed else s2
+            return (nxt, tel, mon), None
 
         def oneblock(carry, _):
             s, tel, mon = carry
-            s2, ov, ticks_f = fused_block(s, rng)
+            w = _wide(s)
+            s2, ov, ticks_f = fused_block(w, rng)
             if tel is not None or mon is not None:
-                tel, mon = fused_observe(cfg, flatten_state(cfg, s),
+                tel, mon = fused_observe(cfg, flatten_state(cfg, w),
                                          ticks_f, tel, mon)
-            return (s2, tel, mon), ov
+            nxt = pack_state(cfg, s2, ov=s.ov) if packed else s2
+            return (nxt, tel, mon), ov
 
         def steps(carry, k):
             ov = jnp.zeros((), jnp.int32)
@@ -724,15 +758,17 @@ def make_sharded_run(cfg: RaftConfig, mesh: Mesh, n_ticks: int,
 
         tel0 = telemetry_mod.telemetry_zeros() if telemetry else None
         mon0 = telemetry_mod.monitor_init(cfg.n_groups, n_ticks, monitor)
+        if packed:
+            st = pack_state(cfg, st)
         if not metrics_every:
             (st, tel, mon), ov = steps((st, tel0, mon0), n_ticks)
             return _pack(st, None, tel, mon) + (ov,)
 
         def win(carry, _):
             s, tel, mon = carry
-            rounds0 = _rounds_sum(s)
+            rounds0 = _rounds_sum(_wide(s))
             carry, ov = steps(carry, metrics_every)
-            return carry, (window_metrics(carry[0], rounds0), ov)
+            return carry, (window_metrics(_wide(carry[0]), rounds0), ov)
 
         carry, (ms, ovs) = jax.lax.scan(win, (st, tel0, mon0), None,
                                         length=n_ticks // metrics_every)
@@ -745,7 +781,8 @@ def make_sharded_run(cfg: RaftConfig, mesh: Mesh, n_ticks: int,
 
     out_sh = ((sh, rep if metrics_every else None)
               + ((rep,) if telemetry else ())
-              + ((rep,) if monitor else ()))
+              + ((rep,) if monitor else ())
+              + ((rep,) if packed else ()))
     if T_f > 1:
         jitted_f = jax.jit(run_fused, in_shardings=(sh, rng_sh),
                            out_shardings=out_sh + (rep,))
@@ -758,8 +795,19 @@ def make_sharded_run(cfg: RaftConfig, mesh: Mesh, n_ticks: int,
                     f"fused-tick kernel draw-table overflow inside the "
                     f"sharded run (T={T_f}): the launch's draws were "
                     f"clamped and its bits are INVALID; results discarded")
+            if packed:
+                res, pov = res[:-1], res[-1]
+                check_packed_ov(pov)
             return res
 
         return call
     jitted = jax.jit(run, in_shardings=(sh, rng_sh), out_shardings=out_sh)
+    if packed:
+        def call_packed(st):
+            res = jitted(st, rng_placed)
+            res, pov = res[:-1], res[-1]
+            check_packed_ov(pov)
+            return res
+
+        return call_packed
     return lambda st: jitted(st, rng_placed)

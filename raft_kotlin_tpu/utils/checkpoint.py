@@ -13,6 +13,14 @@ RaftConfig (the config is part of the semantics — el_lo/el_hi etc. feed the co
 draws — so restoring under a different config is refused unless forced). Orbax is
 available in the image but adds nothing here: the state is a flat dict of dense arrays
 and .npz keeps the artifact a single portable file.
+
+Layout normalization (ISSUE 11): checkpoints always STORE the wide
+representation, whatever layout the run carried — save()/save_sharded()
+accept a PackedRaftState and unpack it (after checking its width-overflow
+latch), and load()/load_sharded() re-pack on request (`layout="packed"`).
+A packed run can therefore resume any unpacked checkpoint and vice versa;
+the on-disk format is layout-independent and needed no version bump
+(pack/unpack is lossless by the SEMANTICS.md §14 roundtrip contract).
 """
 
 from __future__ import annotations
@@ -63,13 +71,43 @@ def _derive_last_term(log_term, last_index):
     return np.where(li >= 1, vals, 0).astype(np.int32)
 
 
+def _normalize_wide(state, cfg: RaftConfig):
+    """Accept either layout; return the wide RaftState (the only stored
+    form). A packed state's width-overflow latch is checked first — a
+    latched state holds wrapped values and must never become a
+    checkpoint."""
+    from raft_kotlin_tpu.models.state import (
+        PackedRaftState, check_packed_ov, unpack_state)
+
+    if isinstance(state, PackedRaftState):
+        check_packed_ov(state.ov)
+        return unpack_state(cfg, state)
+    return state
+
+
+def _apply_layout(state: RaftState, cfg: RaftConfig, layout: str):
+    """Re-pack a loaded wide state when the resuming run carries
+    layout="packed" (models/state.pack_state; loaded checkpoints are
+    valid wide states, so the pack cannot latch — asserted anyway by the
+    runner's own host check on first use)."""
+    if layout == "wide":
+        return state
+    if layout != "packed":
+        raise ValueError(f"unknown layout {layout!r}")
+    from raft_kotlin_tpu.models.state import pack_state
+
+    return pack_state(cfg, state)
+
+
 def save(path: str, state: RaftState, cfg: RaftConfig, extra: Optional[dict] = None) -> None:
     """Atomically write `state` (+ config header) to `path` (.npz).
+    Accepts either layout; always stores wide (_normalize_wide).
 
     Sharded arrays are gathered to host first (np.asarray on a fully-addressable
     array concatenates its shards); multi-host checkpointing of non-addressable
     arrays should gather via jax.device_get on a replicated view first.
     """
+    state = _normalize_wide(state, cfg)
     arrays = {
         f.name: np.asarray(jax.device_get(getattr(state, f.name)))
         for f in dataclasses.fields(state)
@@ -98,6 +136,7 @@ def load(
     path: str,
     expect_cfg: Optional[RaftConfig] = None,
     sharding=None,
+    layout: str = "wide",
 ) -> Tuple[RaftState, RaftConfig]:
     """Load a checkpoint. Returns (state, cfg-as-saved).
 
@@ -105,19 +144,22 @@ def load(
     counted RNG makes config part of the trace). If `sharding` is given (a
     RaftState-shaped pytree of shardings, e.g. from parallel.mesh.state_sharding),
     each array is placed with that sharding; otherwise arrays land on the default
-    device.
+    device. `layout="packed"` returns the state re-packed for a packed run
+    (checkpoints store wide regardless — see the module docstring).
     """
     state, cfg, _ = _load_impl(path, expect_cfg, sharding)
-    return state, cfg
+    return _apply_layout(state, cfg, layout), cfg
 
 
 def load_with_extra(
     path: str,
     expect_cfg: Optional[RaftConfig] = None,
     sharding=None,
+    layout: str = "wide",
 ) -> Tuple[RaftState, RaftConfig, dict]:
     """As load(), but also returns the extra dict passed to save()."""
-    return _load_impl(path, expect_cfg, sharding)
+    state, cfg, extra = _load_impl(path, expect_cfg, sharding)
+    return _apply_layout(state, cfg, layout), cfg, extra
 
 
 def save_sharded(dirpath: str, state: RaftState, cfg: RaftConfig,
@@ -132,7 +174,11 @@ def save_sharded(dirpath: str, state: RaftState, cfg: RaftConfig,
     groups-axis slabs in ascending global offset. Restore with `load_sharded`
     under a mesh of ANY device count whose shard boundaries align (the common
     case: same total groups, any divisor count), or assemble unsharded.
+    Accepts either state layout; always stores wide (_normalize_wide — the
+    unpack is elementwise, so a sharded packed state unpacks shard-locally
+    without gathering).
     """
+    state = _normalize_wide(state, cfg)
     fields = [
         f.name for f in dataclasses.fields(state)
         if getattr(state, f.name) is not None
@@ -193,12 +239,15 @@ def load_sharded(
     dirpath: str,
     mesh=None,
     expect_cfg: Optional[RaftConfig] = None,
+    layout: str = "wide",
 ) -> Tuple[RaftState, RaftConfig]:
     """Restore a `save_sharded` checkpoint. With `mesh` (a jax.sharding.Mesh),
     each PROCESS opens only the shard files covering its own addressable
     devices' slices and device_puts only to those devices — on a multi-host
     mesh no host ever materializes (or even reads) the full groups axis.
-    Without `mesh`, assembles unsharded arrays on the default device."""
+    Without `mesh`, assembles unsharded arrays on the default device.
+    `layout="packed"` re-packs for a packed run (elementwise — sharding
+    is preserved shard-locally)."""
     with open(os.path.join(dirpath, "manifest.json")) as f:
         manifest = json.load(f)
     version = int(manifest.get("version", 0))
@@ -243,7 +292,7 @@ def load_sharded(
             parts = [shard_file(k)[name] for k in range(len(spans))]
             fields[name] = jax.device_put(
                 parts[0] if parts[0].ndim == 0 else np.concatenate(parts, axis=-1))
-        return RaftState(**fields), cfg
+        return _apply_layout(RaftState(**fields), cfg, layout), cfg
 
     from raft_kotlin_tpu.parallel.mesh import state_sharding
 
@@ -301,7 +350,7 @@ def load_sharded(
             singles.append(jax.device_put(device_slice(name, lo, hi), dev))
         fields[name] = jax.make_array_from_single_device_arrays(
             full_shape, target, singles)
-    return RaftState(**fields), cfg
+    return _apply_layout(RaftState(**fields), cfg, layout), cfg
 
 
 def _load_impl(path, expect_cfg, sharding):

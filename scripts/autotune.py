@@ -3,10 +3,13 @@
 scripts/probe_fused_ticks.py --pin to the WHOLE plan space).
 
 The one routing layer (raft_kotlin_tpu/parallel/autotune.py) resolves the
-full execution plan {engine, ilp_subtiles, fused_ticks, sharding, tile}
-per (regime, shape, dtype, mailbox, platform) key from the pinned
+full execution plan {engine, ilp_subtiles, fused_ticks, layout, sharding,
+tile} per (regime, shape, dtype, mailbox, platform) key from the pinned
 TUNING_TABLE, the runtime measurement cache, or measure-on-first-use.
-This CLI drives the measured side of that contract:
+Since r14 the shallow measurement grid sweeps the state-layout dimension
+too (wide|packed, ISSUE 11 — measure_shallow_key A/Bs every (T, K) point
+under both layouts) and --audit flags layout drift like any other plan
+field. This CLI drives the measured side of that contract:
 
   python scripts/autotune.py --measure [key...]
       Benchmark candidate plans for each key on the CURRENT platform

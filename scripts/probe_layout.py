@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""Packed-vs-wide state-layout A/B probe (ISSUE 11 satellite).
+
+Two questions, answered on the CURRENT backend:
+
+1. **What do the layouts cost/save in TIME?** A/B the same runner with
+   layout="wide" vs layout="packed", through `bench.measure` itself — the
+   timing-trap-hardened harness (distinct rng per rep, in-region host
+   materialization, median-of-reps) and the SAME builders the timed
+   headline uses (`make_pallas_scan(jitted=False)` / `bench.scan_runner`),
+   so the probe measures the production program shape. Both legs pin
+   fused_ticks=1 by default (an A/B across different fused depths would
+   charge fusion's win to the layout); --fused measures at the routed
+   depth instead. The packed leg's width-overflow latch is read from the
+   recorder (packed_width_overflow) and reported — a nonzero latch means
+   the packed numbers are INVALID (wrapped values).
+
+2. **What do the layouts cost/save in BYTES?** The concrete-pytree
+   accounting (bench.state_aux_bytes_per_tick for both layouts) and the
+   wide/packed ratio — the same numbers the BENCH record publishes as
+   bytes_per_tick_wide / bytes_per_tick_packed / packed_vs_wide.
+
+The authoritative numbers are the BENCH record's (the timed headline runs
+the plan-routed layout); this probe is the standalone sweep that feeds a
+layout re-pin (scripts/autotune.py --measure sweeps the same dimension).
+
+Usage:
+    python scripts/probe_layout.py [--groups 4096] [--ticks 50]
+        [--reps 3] [--impl auto|xla|pallas] [--mailbox] [--fused]
+        [--capacity 32] [--log-dtype int32|int16]
+
+Prints one JSON line: ticks/s per layout, packed_speedup (>1 = packed
+faster), the byte accounting, and the overflow latch.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--groups", type=int, default=4096)
+    ap.add_argument("--ticks", type=int, default=50)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--impl", default="auto",
+                    choices=("auto", "xla", "pallas"))
+    ap.add_argument("--mailbox", action="store_true",
+                    help="add §10 [1,3] delays")
+    ap.add_argument("--fused", action="store_true",
+                    help="measure at the routed fused depth instead of "
+                         "pinning T=1")
+    ap.add_argument("--capacity", type=int, default=32,
+                    help="log capacity (>=256 probes the deep band)")
+    ap.add_argument("--log-dtype", default="int32",
+                    choices=("int32", "int16"))
+    args = ap.parse_args()
+
+    import jax
+
+    import bench
+    from raft_kotlin_tpu.models.state import init_state
+    from raft_kotlin_tpu.ops.pallas_tick import choose_impl, make_pallas_scan
+    from raft_kotlin_tpu.ops.tick import make_rng, make_tick
+    from raft_kotlin_tpu.utils.config import RaftConfig
+
+    cfg = RaftConfig(
+        n_groups=args.groups, n_nodes=5, log_capacity=args.capacity,
+        log_dtype=args.log_dtype, cmd_period=10,
+        p_drop=0.25, p_crash=0.01, p_restart=0.08,
+        p_link_fail=0.02, p_link_heal=0.08, seed=0,
+    ).stressed(10)
+    if args.mailbox:
+        cfg = dataclasses.replace(cfg, delay_lo=1, delay_hi=3)
+    impl = choose_impl(cfg) if args.impl == "auto" else args.impl
+    on_cpu = jax.default_backend() == "cpu"
+
+    def candidates(layout):
+        """The headline builders with the layout switchable — both legs
+        pay identical harness costs (measure() jits once with the
+        reductions inside)."""
+        if impl == "pallas":
+            yield (lambda n: make_pallas_scan(
+                cfg, n, interpret=False, jitted=False, telemetry=True,
+                fused_ticks=None if args.fused else 1,
+                layout=layout)), f"pallas-{layout}"
+        else:
+            # CPU deep configs need the per-pair engine (the XLA:CPU
+            # batched-compile guard every CPU test applies).
+            tick = make_tick(cfg, batched=False if (
+                on_cpu and cfg.uses_dyn_log) else None)
+            yield bench.scan_runner(tick, telemetry=True, layout=layout,
+                                    cfg=cfg), f"xla-{layout}"
+
+    out = {"groups": cfg.n_groups, "ticks": args.ticks, "reps": args.reps,
+           "impl": impl, "platform": jax.devices()[0].platform,
+           "capacity": cfg.log_capacity, "log_dtype": cfg.log_dtype,
+           "mailbox": cfg.uses_mailbox}
+    overflow = 0
+    for layout in ("wide", "packed"):
+        ts, stats, used = bench.measure(
+            cfg, args.ticks, args.reps, lambda c: candidates(layout))
+        best = bench.median(ts)
+        out[f"{layout}_ticks_per_sec"] = round(args.ticks / best, 2)
+        out[f"{layout}_impl"] = used
+        if layout == "packed":
+            overflow = max(int(s.get("tel_packed_width_overflow") or 0)
+                           for s in stats)
+    out["packed_speedup"] = round(
+        out["packed_ticks_per_sec"] / out["wide_ticks_per_sec"], 3)
+    out["packed_width_overflow"] = overflow
+    if overflow:
+        out["suspect"] = ("packed width latch fired: wrapped values, "
+                          "packed timings invalid")
+    out["bytes_per_tick_wide"] = bench.state_aux_bytes_per_tick(cfg, "wide")
+    out["bytes_per_tick_packed"] = bench.state_aux_bytes_per_tick(
+        cfg, "packed")
+    out["packed_vs_wide"] = round(
+        out["bytes_per_tick_wide"] / out["bytes_per_tick_packed"], 2)
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

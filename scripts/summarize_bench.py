@@ -160,12 +160,21 @@ def load_record(path: str) -> Optional[dict]:
     if not legs and not inv:
         return None
     aux_num: Dict[str, float] = {}
-    for field in ("scaling_efficiency", "pod_n_devices"):
+    for field in ("scaling_efficiency", "pod_n_devices",
+                  # r14 (ISSUE 11): the concrete-pytree byte accounting —
+                  # the bytes/tick trajectory rows + the packed-encoding
+                  # regression gate (check_bytes).
+                  "bytes_per_tick", "bytes_per_tick_packed",
+                  "packed_vs_wide"):
         v = parsed.get(field)
         if not isinstance(v, (int, float)):
             v = _extract_field(tail, field)
         if v is not None:
             aux_num[field] = float(v)
+    if "bytes_per_tick_packed" in aux_num:
+        # The bytes gate vets on the headline suspect flag (accounting
+        # rides the same record as the measurements it describes).
+        vetted["bytes_per_tick_packed"] = gate_value("suspect")
     aux_bool: Dict[str, bool] = {}
     for field in AUDIT_BOOLS:
         v = parsed.get(field)
@@ -254,6 +263,33 @@ def check_tuning_drift(recs: List[dict]) -> List[Tuple[str, bool]]:
             if f != "pod_dryrun" and v is False]
 
 
+def check_bytes(recs: List[dict],
+                tol: float = REGRESSION_TOL) -> List[Tuple[str, float,
+                                                           float]]:
+    """[(label, latest, best prior)] when the LATEST round's packed
+    concrete-pytree bytes/tick GREW more than `tol` above the best
+    (lowest) prior VETTED round that published the figure (ISSUE 11):
+    bytes/tick is deterministic accounting of the packed encodings, so
+    growth means an encoding was silently widened — a layout regression.
+    The gate arms itself only once a vetted packed round exists; rounds
+    predating the field are skipped, never guessed."""
+    if len(recs) < 2:
+        return []
+    latest = recs[-1]
+    cur = latest.get("aux_num", {}).get("bytes_per_tick_packed")
+    if cur is None:
+        return []
+    prior = [(r["aux_num"]["bytes_per_tick_packed"], r["round"])
+             for r in recs[:-1]
+             if r["vetted"].get("bytes_per_tick_packed")]
+    if not prior:
+        return []
+    best, best_round = min(prior)
+    if cur > (1.0 + tol) * best:
+        return [("bytes/tick packed", cur, best)]
+    return []
+
+
 def check_violations(recs: List[dict]) -> List[Tuple[str, str]]:
     """[(leg label, verdict)] for every vetted invariant leg of the LATEST
     round whose verdict is not "clean" — the safety gate (ISSUE 6)."""
@@ -289,6 +325,20 @@ def main(argv=None) -> int:
             row.append(("-" if v is None
                         else f"{v:,.1f}{mark}").rjust(14))
         print("".join(row))
+    # r14 (ISSUE 11): bytes/tick trajectory rows (lower is better —
+    # concrete-pytree accounting of the routed and packed layouts).
+    for field, label in (("bytes_per_tick", "bytes/tick"),
+                         ("bytes_per_tick_packed", "bytes/tick packed")):
+        if not any(field in r.get("aux_num", {}) for r in recs):
+            continue
+        row = [label.ljust(18)]
+        for r in recs:
+            v = r.get("aux_num", {}).get(field)
+            mark = "" if r["vetted"].get(
+                "bytes_per_tick_packed", r["vetted"].get("value")) else "?"
+            row.append(("-" if v is None
+                        else f"{v:,.0f}{mark}").rjust(14))
+        print("".join(row))
     print("('?' = unvetted: no suspect:false gate in that round's record;"
           " excluded from the regression baseline)")
 
@@ -310,6 +360,12 @@ def main(argv=None) -> int:
         print(f"POD SCALING: {label} r{latest:02d} = {eff:.3f} below the "
               f"{floor} floor on a REAL pod — the collective-free "
               "scale-out layer is leaking time", file=sys.stderr)
+    byte_fails = check_bytes(recs)
+    for label, cur, best in byte_fails:
+        print(f"LAYOUT REGRESSION: {label} r{latest:02d} = {cur:,.0f} is "
+              f"{100 * (cur / best - 1):.1f}% above the best prior vetted "
+              f"round ({best:,.0f}) — a packed encoding was widened "
+              "(models/state.py packed_field_dtype)", file=sys.stderr)
     for field, _v in check_tuning_drift(recs):
         print(f"WARNING: tuning-table drift — r{latest:02d} {field} is "
               "false (the unified TUNING_TABLE disagrees with this "
@@ -326,7 +382,7 @@ def main(argv=None) -> int:
     for f, v in unvetted_bad:
         print(f"WARNING: {f} latched '{v}' on an UNVETTED (suspect) leg — "
               "not gating, but not clean either", file=sys.stderr)
-    if regs or viols or pod_fails:
+    if regs or viols or pod_fails or byte_fails:
         return 1
     clean_legs = sum(1 for f, v in latest_rec.get("inv", {}).items()
                      if v == "clean" and latest_rec["vetted"].get(f))

@@ -190,7 +190,7 @@ def test_plan_for_composition(no_cache):
     dcfg = RaftConfig(n_groups=8, n_nodes=3, log_capacity=512, seed=1)
     plan = autotune.plan_for(dcfg)
     assert plan == {"engine": "flat", "ilp_subtiles": 1, "fused_ticks": 1,
-                    "sharding": "single", "tile": None}
+                    "layout": "wide", "sharding": "single", "tile": None}
     # τ=0 mailbox deep: flat is the ONLY valid engine — the caller-level
     # rule overrides any table entry (plan_for composes it in).
     mcfg = RaftConfig(n_groups=8, n_nodes=3, log_capacity=512, mailbox=True,
@@ -237,6 +237,64 @@ def test_make_planned_run_sharded_deep(no_cache):
     assert plan["engine"] == "flat" and plan["sharding"] == "shard_map"
     vals = run(init_sharded(cfg, mesh), make_rng(cfg))
     assert vals["rounds"] >= 0 and "livepin" in vals
+
+
+def test_layout_dimension_migration(no_cache):
+    # r14 (ISSUE 11): plans carry a `layout` dimension routed exactly
+    # like engine/T/K. Three contracts, mirroring the r13 migration pins:
+    # 1. The pinned routing: every shallow tpu row routes "packed" (the
+    #    2.4x concrete-bytes win), every deep row "wide" (the int16 log
+    #    already dominates deep bytes).
+    for tile, _k in LEGACY_ILP:
+        plan = autotune.resolve_plan(
+            autotune.shallow_key(tile, platform="tpu"))
+        assert plan["layout"] == "packed", tile
+    for C, g, mb, _w in LEGACY_DEEP:
+        plan = autotune.resolve_plan(
+            autotune.deep_key(C, g, mailbox=mb, platform="tpu"))
+        assert plan["layout"] == "wide", (C, g, mb)
+    # 2. LEGACY-DEFAULT MIGRATION: a plan with no layout entry (pre-r14
+    #    pinned rows, stale runtime caches) normalizes to the legacy
+    #    "wide" — and the layout dimension changes NO other field of the
+    #    r13 lookups (the migration-equality tests above keep passing
+    #    against the same literal winners).
+    key = autotune.shallow_key(512, platform="tpu")
+    legacy = {"engine": "pallas", "ilp_subtiles": 4, "fused_ticks": 4,
+              "sharding": "shard_map", "tile": 512}
+    assert autotune.apply_guards(key, legacy)["layout"] == "wide"
+    assert autotune.default_plan(key)["layout"] == "wide"
+    # 3. CPU guard: layout pins wide regardless of the row (packed trades
+    #    repack ALU for an HBM wall the interpreter doesn't have) — the
+    #    same class as the K=1/T=1 guards.
+    cpu = autotune.apply_guards(autotune.shallow_key(512, platform="cpu"),
+                                dict(legacy, layout="packed"))
+    assert cpu["layout"] == "wide"
+    dcpu = autotune.resolve_plan(
+        autotune.deep_key(10_000, 13_312, platform="cpu"))
+    assert dcpu["layout"] == "wide"
+    # plan_for composes it: CPU hosts resolve wide end to end.
+    scfg = RaftConfig(n_groups=512, n_nodes=3, log_capacity=8, seed=1)
+    assert autotune.plan_for(scfg)["layout"] == "wide"
+
+
+def test_planned_run_layout_bit_identity(no_cache):
+    # Layout is bit-neutral through the planned dispatch too: the same
+    # plan with layout overridden to "packed" produces identical bits
+    # (SEMANTICS.md §13 extended by §14's layout-invariance contract).
+    from raft_kotlin_tpu.models.state import init_state
+
+    cfg = RaftConfig(n_groups=32, n_nodes=3, log_capacity=8, cmd_period=5,
+                     p_drop=0.1, seed=7).stressed(10)
+    run_w, plan = autotune.make_planned_run(cfg, 12)
+    assert plan["layout"] == "wide"  # CPU guard
+    run_p, plan_p = autotune.make_planned_run(
+        cfg, 12, plan=dict(plan, layout="packed"))
+    assert plan_p["layout"] == "packed"
+    end_w, _ = run_w(init_state(cfg))
+    end_p, _ = run_p(init_state(cfg))
+    for f in ("term", "commit", "last_index", "role", "voted_for"):
+        assert np.array_equal(np.asarray(getattr(end_w, f)),
+                              np.asarray(getattr(end_p, f))), f
 
 
 def test_audit_reports_drift(no_cache):

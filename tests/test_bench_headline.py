@@ -51,6 +51,10 @@ def _fake_record():
         "pod_inv_status": "clean",
         "plan_engine": "pallas",
         "plan_source": "pinned",
+        "layout": "packed",
+        "bytes_per_tick": 153_395_216,
+        "bytes_per_tick_packed": 153_395_216,
+        "packed_vs_wide": 2.36,
         "suspect": False,
         # plus the long tail of fields that overflowed the driver window
         **{f"filler_{i}": [0.1234] * 8 for i in range(80)},
@@ -114,6 +118,14 @@ def test_compact_headline_is_last_line_and_complete():
     # the round's acceptance criteria read them from the artifact.
     for k in ("pod_gsps", "scaling_efficiency", "pod_parity",
               "pod_inv_status", "plan_engine", "plan_source"):
+        assert k in bench.COMPACT_EXTRA_FIELDS, k
+    # The r14 additions (ISSUE 11): the routed state layout, the packed
+    # concrete-pytree bytes/tick and the packed-vs-wide ratio — the
+    # round's acceptance gate (>= 2x at the headline config) and
+    # summarize_bench's bytes trajectory/regression rows read them from
+    # the authoritative tail.
+    for k in ("layout", "bytes_per_tick", "bytes_per_tick_packed",
+              "packed_vs_wide"):
         assert k in bench.COMPACT_EXTRA_FIELDS, k
     for k in bench.COMPACT_EXTRA_FIELDS:
         assert k in last, k

@@ -113,6 +113,49 @@ def test_safety_violation_gate(tmp_path):
     assert sb.main([str(tmp_path / "BENCH_r*.json")]) == 0
 
 
+def test_bytes_regression_gate(tmp_path):
+    # ISSUE 11 satellite: once a vetted round publishes the packed
+    # concrete-pytree accounting, a later round whose packed bytes/tick
+    # GREW >10% gates exit-1 (an encoding was silently widened); the gate
+    # stays unarmed while no vetted packed round exists.
+    sb = _mod()
+
+    def art(n, packed=None, suspect="false"):
+        rec = {"ticks_per_sec": 400.0, "suspect": False}
+        if packed is not None:
+            rec["bytes_per_tick_packed"] = packed
+            rec["packed_vs_wide"] = 2.36
+        tail = json.dumps(rec) + "\n"
+        tail = tail.replace('"suspect": false', f'"suspect": {suspect}')
+        return {"n": n, "rc": 0, "tail": tail, "parsed": None}
+
+    # No prior packed round -> unarmed, clean exit.
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(art(1)))
+    (tmp_path / "BENCH_r02.json").write_text(
+        json.dumps(art(2, packed=153_000_000)))
+    assert sb.check_bytes(sb.load_all(str(tmp_path / "BENCH_r*.json"))) \
+        == []
+    assert sb.main([str(tmp_path / "BENCH_r*.json")]) == 0
+    # Latest round's packed bytes grew 30% above the vetted prior -> gate.
+    (tmp_path / "BENCH_r03.json").write_text(
+        json.dumps(art(3, packed=199_000_000)))
+    recs = sb.load_all(str(tmp_path / "BENCH_r*.json"))
+    fails = sb.check_bytes(recs)
+    assert len(fails) == 1 and fails[0][1] == 199_000_000
+    assert sb.main([str(tmp_path / "BENCH_r*.json")]) == 1
+    # Shrinking (or equal) bytes never gate — lower is better.
+    (tmp_path / "BENCH_r03.json").write_text(
+        json.dumps(art(3, packed=150_000_000)))
+    assert sb.main([str(tmp_path / "BENCH_r*.json")]) == 0
+    # A SUSPECT prior packed round must not arm the baseline.
+    (tmp_path / "BENCH_r02.json").write_text(
+        json.dumps(art(2, packed=100_000_000, suspect="true")))
+    (tmp_path / "BENCH_r03.json").write_text(
+        json.dumps(art(3, packed=199_000_000)))
+    assert sb.check_bytes(
+        sb.load_all(str(tmp_path / "BENCH_r*.json"))) == []
+
+
 def test_fuzz_violation_gate(tmp_path):
     # ISSUE 9 satellite: a non-clean fuzz-farm verdict on the latest
     # vetted round gates exit-1 exactly like the classical inv legs.
