@@ -278,7 +278,16 @@ COMPACT_EXTRA_FIELDS = ("deeplog_parity_rate", "deeplog_ov_fallback",
                         # regression rows read them from the
                         # authoritative tail.
                         "layout", "bytes_per_tick",
-                        "bytes_per_tick_packed", "packed_vs_wide")
+                        "bytes_per_tick_packed", "packed_vs_wide",
+                        # r15 (ISSUE 12): the §15 compaction leg — the
+                        # bounded-window run's Figure-3 verdict, the
+                        # snapshot counters and the HBM-bound figure —
+                        # summarize_bench's compaction safety row and
+                        # HBM-bound trajectory row read these from the
+                        # authoritative tail.
+                        "compaction_inv_status", "snapshots_taken",
+                        "installsnap_deliveries",
+                        "compaction_deeplog_hbm_gb")
 
 # Flight-recorder counters published verbatim from the headline run's
 # median rep (stats tel_* keys — utils/telemetry.TELEMETRY_FIELDS).
@@ -1537,6 +1546,79 @@ def main() -> None:
     except Exception as e:
         print(f"fuzz smoke leg failed: {str(e)[:300]}", file=sys.stderr)
 
+    # Compaction leg (ISSUE 12): the §15 bounded-window proof — a
+    # monitored + recorded run of 4x log_capacity ticks at a
+    # bounded-window config (positions MUST outgrow the ring), publishing
+    # the snapshot counters, the live-window high-water (flat memory:
+    # window_hw <= C), the capacity-latch census, and the Figure-3
+    # verdict across the truncation boundary (gated by
+    # scripts/summarize_bench.py INV_LEGS like every safety leg). The
+    # HBM-bound figure next to it is deterministic accounting: the
+    # config-5 deep shape with its log bounded to the compaction window —
+    # the trajectory row that turns "7.49 GB and dies at C" into
+    # "bounded GB, unbounded lifetime".
+    compaction_inv_status = None
+    compaction_stats = {}
+    compaction_hbm_gb = None
+    cmp_cfg = None
+    try:
+        from raft_kotlin_tpu.models.state import init_state
+        from raft_kotlin_tpu.ops.tick import make_run
+        from raft_kotlin_tpu.utils.config import ScenarioSpec
+        from raft_kotlin_tpu.utils.telemetry import (
+            monitor_scalars, status_from_scalars, trace_span)
+
+        cmp_c = int(os.environ.get("RAFT_BENCH_COMPACTION_CAPACITY", 64))
+        cmp_g = int(os.environ.get("RAFT_BENCH_COMPACTION_GROUPS",
+                                   256 if on_accel else 64))
+        cmp_ticks = 4 * cmp_c
+        # §15 warmup-down (SEMANTICS.md §15): quirk k routes every client
+        # command to cmd_node, so only universes where cmd_node leads
+        # every group keep the committed prefix — and therefore the fold
+        # — moving; warmup makes that true at ANY group count instead of
+        # a per-group election lottery, which is what lets the
+        # capacity-latch census stay 0 while positions outgrow the ring.
+        cmp_cfg = RaftConfig(
+            n_groups=cmp_g, n_nodes=3, log_capacity=cmp_c, cmd_period=2,
+            p_drop=0.05, seed=cfg.seed, compact_watermark=8,
+            compact_chunk=8,
+            scenario=ScenarioSpec(warmup_down=40)).stressed(10)
+        with trace_span("bench/compaction"):
+            cend, _, ctel, cmon = make_run(
+                cmp_cfg, cmp_ticks, trace=False, telemetry=True,
+                monitor=True,
+                batched=None if on_accel else False)(init_state(cmp_cfg))
+        csc = {k: int(v) for k, v in monitor_scalars(cmon).items()}
+        compaction_inv_status = _auto_inv_triage(
+            cmp_cfg, status_from_scalars(csc), csc)
+        chost = jax.device_get(
+            {"si": cend.snap_index, "pl": cend.phys_len,
+             "cap": cend.cap_ov, "li": cend.last_index})
+        si = np.asarray(chost["si"]).astype(np.int64)
+        compaction_stats = {
+            "compaction_groups": cmp_g,
+            "compaction_capacity": cmp_c,
+            "compaction_ticks": cmp_ticks,
+            "snapshots_taken": int(ctel["snapshots_taken"]),
+            "installsnap_deliveries": int(
+                ctel["installsnap_deliveries"]),
+            # Flat-memory evidence: the live window's high-water vs C,
+            # positions beyond the ring, and the capacity-latch census
+            # (must be 0 — compaction IS the remedy).
+            "compaction_window_hw": int(
+                (np.asarray(chost["pl"]).astype(np.int64) - si).max()),
+            "compaction_positions_hw": int(
+                np.asarray(chost["li"]).astype(np.int64).max()),
+            "compaction_cap_groups": int(np.sum(np.any(
+                np.asarray(chost["cap"]) != 0, axis=0))),
+        }
+        cmp_window = int(os.environ.get(
+            "RAFT_BENCH_COMPACTION_DEEP_WINDOW", 1024))
+        compaction_hbm_gb = round(dataclasses.replace(
+            deep_cfg, log_capacity=cmp_window).hbm_bytes() / 1e9, 2)
+    except Exception as e:
+        print(f"compaction leg failed: {str(e)[:300]}", file=sys.stderr)
+
     # Pod scale-out leg (ISSUE 10): shard the headline config over ALL
     # visible devices and publish per-pod numbers next to per-chip (pod_*
     # fields + raft_group_steps_per_sec_per_pod). On a 1-device host the
@@ -1730,6 +1812,16 @@ def main() -> None:
             "taint_restart_universes"),
         "fuzz_taint_unsafe_universes": fuzz_coverage.get(
             "taint_unsafe_universes"),
+        # Compaction leg (ISSUE 12): the §15 bounded-window run's
+        # Figure-3 verdict across the truncation boundary, the snapshot
+        # counters, flat-memory evidence (window high-water vs the ring,
+        # positions beyond it, capacity-latch census), and the
+        # HBM-bound accounting figure — the config-5 deep shape with
+        # its log bounded to the compaction window (vs the unbounded
+        # deeplog_hbm_gb): lifetime no longer buys bytes.
+        "compaction_inv_status": compaction_inv_status,
+        **compaction_stats,
+        "compaction_deeplog_hbm_gb": compaction_hbm_gb,
         # Pod scale-out leg (ISSUE 10): per-pod throughput next to the
         # per-chip headline, the per-chip scaling efficiency vs an
         # identically-measured 1-device mesh, sharded parity (pod run ≡

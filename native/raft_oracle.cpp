@@ -35,6 +35,8 @@ struct Dims {
   int32_t Kt, Kb;              // timeout / backoff draw-table depths
   int32_t delay_lo, delay_hi;  // SEMANTICS.md §10 send-delay range; 0/0 = sync
   int32_t mailbox;             // nonzero: route exchanges through the §10 mailbox
+  int32_t compact_watermark;   // §15 log compaction: 0 = off (abi v4)
+  int32_t compact_chunk;       // §15 max entries folded per node per tick
 };
 
 // All per-(group,node) state, flattened C-order. Caller-owned, mutated in place.
@@ -58,6 +60,11 @@ struct State {
   int32_t *vq_due, *vq_term, *vq_lli, *vq_llt, *vq_round;
   int32_t *aq_due, *aq_term, *aq_pli, *aq_plt, *aq_hase, *aq_ent_t, *aq_ent_c,
           *aq_commit;
+  // §15 (abi v4): snapshot state (null unless Dims.compact_watermark > 0;
+  // snap_index doubles as the ring base) + the always-present capacity-
+  // exhaustion latch.
+  int32_t *snap_index, *snap_term, *snap_digest;      // [G][N]
+  int32_t *cap_ov;                                    // [G][N] latch bits
 };
 
 // Host-supplied randomness + schedules. Any pointer may be null (= feature off).
@@ -101,29 +108,51 @@ struct Group {
     return base + ((g * d.N + (n - 1)) * d.C + i);
   }
 
-  // -- Log semantics (SEMANTICS.md §3; Commons.kt:47-74) --------------------
+  // -- Log semantics (SEMANTICS.md §3 + §15 ring window) -------------------
+  bool compact() const { return d.compact_watermark > 0; }
+  int32_t base(int n) const { return compact() ? *f(s.snap_index, n) : 0; }
+  int32_t rslot(int32_t p) const { return compact() ? (p % d.C) : p; }
   bool log_valid(int n, int32_t i) const {
-    return 0 <= i && i < *f(s.last_index, n);
+    return base(n) <= i && i < *f(s.last_index, n);
   }
-  int32_t log_get_term(int n, int32_t i) const { return *slot(s.log_term, n, i); }
-  int32_t log_get_cmd(int n, int32_t i) const { return *slot(s.log_cmd, n, i); }
+  int32_t log_get_term(int n, int32_t i) const {
+    return *slot(s.log_term, n, rslot(i));
+  }
+  int32_t log_get_cmd(int n, int32_t i) const {
+    return *slot(s.log_cmd, n, rslot(i));
+  }
+  // §15 boundary read: term at position i, serving base-1 from snap_term.
+  int32_t term_at(int n, int32_t i) const {
+    if (compact() && i == base(n) - 1) return *f(s.snap_term, n);
+    return log_get_term(n, i);
+  }
   void log_add(int n, int32_t i, int32_t term_v, int32_t cmd_v) {
     int32_t li = *f(s.last_index, n), pl = *f(s.phys_len, n);
+    int32_t b = base(n);
+    if (compact() && 0 <= i && i < b) return;  // §15 absorb (folded)
     if (i == li) {                    // physical append at slot phys_len
-      if (pl >= d.C) return;          // capacity clip [canon]
-      *slot(s.log_term, n, pl) = term_v;
-      *slot(s.log_cmd, n, pl) = cmd_v;
+      if (pl - b >= d.C) {            // capacity clip [canon] on the window
+        *f(s.cap_ov, n) |= 1;         // §15 capacity-exhaustion latch
+        return;
+      }
+      *slot(s.log_term, n, rslot(pl)) = term_v;
+      *slot(s.log_cmd, n, rslot(pl)) = cmd_v;
       *f(s.phys_len, n) = pl + 1;
       *f(s.last_index, n) = li + 1;
     } else if (i < li && i >= 0) {    // overwrite + logical truncation (quirk j)
-      *slot(s.log_term, n, i) = term_v;
-      *slot(s.log_cmd, n, i) = cmd_v;
+      *slot(s.log_term, n, rslot(i)) = term_v;
+      *slot(s.log_cmd, n, rslot(i)) = cmd_v;
       *f(s.last_index, n) = i + 1;
     }                                 // i > li: reject
   }
   int32_t last_log_term(int n) const {
     int32_t li = *f(s.last_index, n);
-    return li == 0 ? 0 : log_get_term(n, li - 1);
+    if (li == 0) return 0;
+    if (compact() && li == base(n)) return *f(s.snap_term, n);
+    // §15 quirk-a: a fold can push base past li — the kernel's masked
+    // gather reads 0 there (_win_ok), never the stale ring bits.
+    if (compact() && li < base(n)) return 0;
+    return log_get_term(n, li - 1);
   }
 
   // -- Counted draws (tables injected by host; SEMANTICS.md §4/§7) ----------
@@ -155,6 +184,12 @@ struct Group {
       *nn(s.match_index, n, p) = 0;
     }
     *f(s.hb_armed, n) = 0; *f(s.hb_left, n) = 0;
+    if (compact()) {  // §15: nothing persists (quirk l) — snapshot included;
+                      // cap_ov stays sticky (diagnostic latch)
+      *f(s.snap_index, n) = 0;
+      *f(s.snap_term, n) = 0;
+      *f(s.snap_digest, n) = 0;
+    }
     if (d.mailbox) {  // §10: owned slots die with the process
       for (int p = 1; p <= d.N; p++) {
         *nn(s.vq_due, n, p) = -1;
@@ -217,8 +252,16 @@ static bool append_handler(Group& gr, const Inputs& in, int p,
     *gr.f(s.commit, p) = leader_commit < li ? leader_commit : li;
   }
   int32_t li = *gr.f(s.last_index, p);
-  bool success = (prev_li == -1) ||
-                 (li > prev_li && prev_li >= 0 && gr.log_get_term(p, prev_li) == prev_lt);
+  bool success;
+  if (prev_li == -1) {
+    success = true;
+  } else if (gr.compact() && prev_li >= 0 && prev_li < gr.base(p) - 1) {
+    success = true;  // §15 absorb: below p's snapshot base (folded)
+  } else {
+    // §15 boundary: prev_li == base-1 checks snap_term (term_at).
+    success = li > prev_li && prev_li >= 0 &&
+              gr.term_at(p, prev_li) == prev_lt;
+  }
   if (success && has_entry) gr.log_add(p, prev_li + 1, ent_term, ent_cmd);
   *resp_term = *gr.f(s.term, p);
   return success;
@@ -418,6 +461,48 @@ static void tick_group(Group& gr, const Dims& d, const Inputs& in, int32_t t,
     }
   }
 
+  // §15 InstallSnapshot handler on p + leader response (SEMANTICS.md §15;
+  // mirrors the §6.2 shape). Shared by the synchronous and §10 paths.
+  auto install_exchange = [&](int l, int p, int32_t req_term,
+                              int32_t req_si, int32_t req_st,
+                              int32_t req_dg, int32_t req_commit) {
+    if (req_term > *gr.f(s.term, p)) {
+      *gr.f(s.term, p) = req_term;
+      *gr.f(s.voted_for, p) = -1;
+      *gr.f(s.role, p) = FOLLOWER;
+      gr.reset_el_timer(in, p);
+    }
+    if (l != p) {                                      // quirk-d mirror
+      *gr.f(s.role, p) = FOLLOWER;
+      gr.reset_el_timer(in, p);
+    }
+    if (req_si > *gr.f(s.last_index, p)) {             // install
+      *gr.f(s.snap_index, p) = req_si;
+      *gr.f(s.snap_term, p) = req_st;
+      *gr.f(s.snap_digest, p) = req_dg;
+      *gr.f(s.last_index, p) = req_si;                 // window empties
+      *gr.f(s.phys_len, p) = req_si;                   // (slot bits kept)
+      *gr.f(s.commit, p) = req_si;
+    }
+    if (req_commit > *gr.f(s.commit, p)) {             // quirk-e flavor
+      int32_t li = *gr.f(s.last_index, p);
+      *gr.f(s.commit, p) = req_commit < li ? req_commit : li;
+    }
+    int32_t resp_term = *gr.f(s.term, p);
+    if (resp_term > *gr.f(s.term, l)) {
+      *gr.f(s.term, l) = resp_term;
+      *gr.f(s.role, l) = FOLLOWER;
+      gr.reset_el_timer(in, l);
+      return;                                          // return@launch
+    }
+    *gr.nn(s.next_index, l, p) = req_si + 1;
+    *gr.nn(s.match_index, l, p) = req_si;
+    int cnt = 0;
+    for (int q = 1; q <= N; q++)
+      if (*gr.nn(s.match_index, l, q) > *gr.f(s.commit, l)) cnt++;
+    if (cnt >= d.majority) (*gr.f(s.commit, l))++;     // quirk a
+  };
+
   // Leader-side processing of an append response (RaftServer.kt:146-168), against
   // l's LIVE state; shared by the synchronous and §10 delivery paths.
   auto append_process = [&](int l, int p, int32_t resp_term, bool success,
@@ -450,6 +535,13 @@ static void tick_group(Group& gr, const Dims& d, const Inputs& in, int32_t t,
     if (*gr.nn(s.aq_due, l, p) != 0) return;
     *gr.nn(s.aq_due, l, p) = -1;
     if (!ok(p, l)) return;
+    if (gr.compact() && *gr.nn(s.aq_hase, l, p) == 2) {
+      // §15 InstallSnapshot slot: snapshot triple in pli/plt/ent_t seats.
+      install_exchange(l, p, *gr.nn(s.aq_term, l, p),
+                       *gr.nn(s.aq_pli, l, p), *gr.nn(s.aq_plt, l, p),
+                       *gr.nn(s.aq_ent_t, l, p), *gr.nn(s.aq_commit, l, p));
+      return;
+    }
     bool has_entry = *gr.nn(s.aq_hase, l, p) != 0;
     int32_t prev_li = *gr.nn(s.aq_pli, l, p);
     int32_t resp_term;
@@ -482,10 +574,29 @@ static void tick_group(Group& gr, const Dims& d, const Inputs& in, int32_t t,
           // Request construction + §5 skip rules at the send tick
           // (post-delivery: the delivery above may have advanced next_index).
           int32_t i = *gr.nn(s.next_index, l, p);
+          if (gr.compact() && gr.base(l) >= 1 && i <= gr.base(l)) {
+            // §15: entries folded — send InstallSnapshot (aq_hase = 2,
+            // snapshot triple riding the pli/plt/ent_t seats).
+            if (ok(l, p)) {
+              *gr.nn(s.aq_term, l, p) = *gr.f(s.term, l);
+              *gr.nn(s.aq_pli, l, p) = *gr.f(s.snap_index, l);
+              *gr.nn(s.aq_plt, l, p) = *gr.f(s.snap_term, l);
+              *gr.nn(s.aq_hase, l, p) = 2;
+              *gr.nn(s.aq_ent_t, l, p) = *gr.f(s.snap_digest, l);
+              *gr.nn(s.aq_ent_c, l, p) = 0;
+              *gr.nn(s.aq_commit, l, p) = *gr.f(s.commit, l);
+              *gr.nn(s.aq_due, l, p) = delay_of(l, p);
+            }
+            if (d.delay_lo == 0) append_deliver(l, p);
+            continue;
+          }
           int32_t prev_li = i - 2, prev_lt = -1;
           bool skip = false;
           if (prev_li >= 0) {
-            if (gr.log_valid(l, prev_li)) prev_lt = gr.log_get_term(l, prev_li);
+            if (gr.compact() && prev_li == gr.base(l) - 1)
+              prev_lt = *gr.f(s.snap_term, l);   // §15 boundary row
+            else if (gr.log_valid(l, prev_li))
+              prev_lt = gr.log_get_term(l, prev_li);
             else skip = true;           // exception -> skip peer
           }
           bool has_entry = false;
@@ -530,10 +641,23 @@ static void tick_group(Group& gr, const Dims& d, const Inputs& in, int32_t t,
       }
       for (int p = 1; p <= N; p++) {
         int32_t i = *gr.nn(s.next_index, l, p);
+        if (gr.compact() && gr.base(l) >= 1 && i <= gr.base(l)) {
+          // §15 synchronous InstallSnapshot exchange.
+          if (!(ok(l, p) && ok(p, l))) continue;     // dropped exchange
+          install_exchange(l, p, *gr.f(s.term, l), *gr.f(s.snap_index, l),
+                           *gr.f(s.snap_term, l), *gr.f(s.snap_digest, l),
+                           *gr.f(s.commit, l));
+          continue;
+        }
         int32_t prev_li = i - 2, prev_lt;
         if (prev_li >= 0) {
-          if (!gr.log_valid(l, prev_li)) continue;   // exception -> skip peer
-          prev_lt = gr.log_get_term(l, prev_li);
+          if (gr.compact() && prev_li == gr.base(l) - 1) {
+            prev_lt = *gr.f(s.snap_term, l);         // §15 boundary row
+          } else if (!gr.log_valid(l, prev_li)) {
+            continue;                                // exception -> skip peer
+          } else {
+            prev_lt = gr.log_get_term(l, prev_li);
+          }
         } else {
           prev_lt = -1;
         }
@@ -552,6 +676,29 @@ static void tick_group(Group& gr, const Dims& d, const Inputs& in, int32_t t,
                                       *gr.f(s.commit, l), &resp_term);
         append_process(l, p, resp_term, success, has_entry, prev_li);
       }
+    }
+  }
+
+  // Phase C — §15 snapshot fold (compaction), on the final log: mirrors
+  // the kernel's end-of-tick fold (digest arithmetic in uint32_t — the
+  // same wrapping two's-complement bits as XLA int32).
+  if (gr.compact()) {
+    for (int n = 1; n <= N; n++) {
+      if (!*gr.f(s.up, n)) continue;
+      int32_t cm = *gr.f(s.commit, n), si = *gr.f(s.snap_index, n);
+      int32_t avail = cm - si;
+      if (avail < d.compact_watermark) continue;
+      int32_t cnt = avail < d.compact_chunk ? avail : d.compact_chunk;
+      int32_t dg = *gr.f(s.snap_digest, n), st_v = *gr.f(s.snap_term, n);
+      for (int32_t j = 0; j < cnt; j++) {
+        int32_t pos = si + j;
+        st_v = gr.log_get_term(n, pos);
+        dg = (int32_t)((uint32_t)dg * 1000003u +
+                       (uint32_t)gr.log_get_cmd(n, pos));
+      }
+      *gr.f(s.snap_index, n) = si + cnt;
+      *gr.f(s.snap_term, n) = st_v;
+      *gr.f(s.snap_digest, n) = dg;
     }
   }
 }
@@ -590,7 +737,10 @@ int raft_run(const Dims* dims, State* state, const Inputs* inputs, Trace* trace)
   return 0;
 }
 
-int raft_abi_version() { return 3; }  // v3: Inputs.leader_iso (§12 scenario
+int raft_abi_version() { return 4; }  // v4: §15 log compaction (Dims.compact_*,
+                                      // State.snap_*/cap_ov, InstallSnapshot
+                                      // via aq_hase == 2, ring log window).
+                                      // v3: Inputs.leader_iso (§12 scenario
                                       // partition programs).
                                       // v2: §10 mailbox (Dims.delay_*/mailbox,
                                       // State.vq_*/aq_*, Inputs.delay)

@@ -44,6 +44,7 @@ import dataclasses
 import functools
 import hashlib
 import json
+import os
 from typing import Callable, Optional
 
 import jax
@@ -143,8 +144,15 @@ def make_batch_runner(cfg: RaftConfig, n_ticks: int,
         rng = jax.jit(lambda: make_rng(cfg), out_shardings=rng_sh)()
         mk_state = lambda: mesh_mod.init_sharded(cfg, mesh)
 
+    if mesh is not None:
+        # Taint-mask operands shard like every (G,) channel.
+        lanes_sh = jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec(("dcn", "ici")))
+        jit_kw["in_shardings"] = jit_kw["in_shardings"] + (
+            lanes_sh, lanes_sh)
+
     @functools.partial(jax.jit, **jit_kw)
-    def run(st, rng):
+    def run(st, rng, tr0, tu0):
         def body(carry, _):
             s, tel, mon = carry
             s2 = tick_fn(s, rng)
@@ -157,13 +165,22 @@ def make_batch_runner(cfg: RaftConfig, n_ticks: int,
         tel0 = telemetry_mod.telemetry_zeros()
         mon0 = telemetry_mod.monitor_init(cfg.n_groups, n_ticks,
                                           per_group=True)
+        # Seed the sticky quirk-taint masks (soak_run carries them across
+        # checkpoint-rotated segments — a mid-run segment boundary must
+        # not forget that a group restarted in an earlier segment).
+        mon0 = dict(mon0)
+        mon0["taint_restart"] = mon0["taint_restart"] | tr0
+        mon0["taint_unsafe"] = mon0["taint_unsafe"] | tu0
         (end, tel, mon), _ = jax.lax.scan(body, (st, tel0, mon0), None,
                                           length=n_ticks)
         return end, tel, mon
 
-    def call(state0=None):
+    def call(state0=None, taints=None):
         st = state0 if state0 is not None else mk_state()
-        return run(st, rng)
+        if taints is None:
+            z = jnp.zeros((cfg.n_groups,), bool)
+            taints = (z, z)
+        return run(st, rng, *taints)
 
     return call
 
@@ -464,6 +481,152 @@ def fuzz_farm(cfg: RaftConfig, n_ticks: int, universes: Optional[int] = None,
             for line in corpus_lines(records):
                 f.write(line + "\n")
     return result
+
+
+def laggard_spec(farm_seed: int = 21) -> ScenarioSpec:
+    """§15 laggard-catch-up universe family: crash/restart-heavy fault
+    lattices, so leaders routinely snapshot PAST a crashed follower's
+    frontier and the rejoin must travel InstallSnapshot — exactly the
+    scenario Raft §7 exists for. Run with a compaction config
+    (laggard_config)."""
+    return ScenarioSpec(
+        farm_seed=farm_seed, drop_max=0.1, crash_max=0.05, restart_max=0.3)
+
+
+def laggard_config(groups: int, farm_seed: int = 21,
+                   seed: int = 9) -> RaftConfig:
+    """The §15 laggard-catch-up batch config: a small bounded log window
+    with an aggressive watermark, so any committed progress folds quickly
+    and crashed-then-restarted followers come back BELOW the leaders'
+    snapshot bases."""
+    return RaftConfig(n_groups=groups, n_nodes=3, log_capacity=32,
+                      cmd_period=5, seed=seed,
+                      compact_watermark=4, compact_chunk=4,
+                      scenario=laggard_spec(farm_seed)).stressed(10)
+
+
+def partition_snapshot_spec(farm_seed: int = 22) -> ScenarioSpec:
+    """§15 snapshot-during-partition universe family: scripted
+    split/asym/leader partition programs over a compacting cluster — the
+    isolated side's frontier freezes while the majority side folds, so
+    heals exercise the install path under every partition geometry."""
+    return ScenarioSpec(
+        farm_seed=farm_seed, drop_max=0.15, crash_max=0.01,
+        restart_max=0.15, partitions=("split", "asym", "leader"),
+        part_period_lo=5, part_period_hi=40)
+
+
+def partition_snapshot_config(groups: int, farm_seed: int = 22,
+                              seed: int = 9) -> RaftConfig:
+    """The §15 snapshot-during-partition batch config (see the spec)."""
+    return RaftConfig(n_groups=groups, n_nodes=3, log_capacity=32,
+                      cmd_period=5, seed=seed,
+                      compact_watermark=4, compact_chunk=4,
+                      scenario=partition_snapshot_spec(farm_seed)
+                      ).stressed(10)
+
+
+def soak_run(cfg: RaftConfig, n_ticks: int, segment: Optional[int] = None,
+             ckpt_dir: Optional[str] = None, verbose: bool = False,
+             mesh=None) -> dict:
+    """§15 standing-soak service: run `n_ticks` monitored ticks in
+    checkpoint-rotated segments — the mode compaction unlocks (without
+    truncation every run died at log_capacity; with it a farm universe
+    runs forever under rotation). Each segment runs a monitored batch
+    from the carried state, checkpoints it, RELOADS the checkpoint and
+    continues from the loaded state — so the published end state has
+    round-tripped the rotation path, not just the device.
+
+    Returns {"ticks", "segments", "inv_status", "statuses",
+    "snap_index_min/max", "window_hw", "cap_exhausted_groups",
+    "log_bytes", "telemetry"}: `window_hw` is the live-window high-water
+    max(phys_len - snap_index) of the END state — a soak is healthy when
+    it stays <= log_capacity with the monitor clean and the latch empty
+    (the acceptance shape of ISSUE 12: flat log memory, unbounded
+    lifetime). `inv_status` is the first non-clean segment verdict, else
+    "clean"."""
+    import tempfile
+
+    from raft_kotlin_tpu.models.state import init_state
+    from raft_kotlin_tpu.utils import checkpoint as ckpt_mod
+
+    assert cfg.uses_compaction, (
+        "soak_run needs a §15 compaction config (compact_watermark > 0) — "
+        "without truncation the run dies at log_capacity")
+    segment = segment or max(1, min(n_ticks, 2 * cfg.log_capacity))
+    tmp = None
+    if ckpt_dir is None:
+        tmp = tempfile.TemporaryDirectory(prefix="raft_soak_")
+        ckpt_dir = tmp.name
+    path = os.path.join(ckpt_dir, "soak.npz")
+    state = init_state(cfg)
+    taints = None
+    statuses, tel_total = [], {}
+    done, seg_i = 0, 0
+    status = "clean"
+    try:
+        while done < n_ticks:
+            t_seg = min(segment, n_ticks - done)
+            # Key on the Mesh itself (hashable) — id() of a dead mesh can
+            # be recycled and hand back a runner closed over stale devices.
+            rkey = (cfg, t_seg, mesh)
+            runner = _SOAK_RUNNERS.get(rkey)
+            if runner is None:
+                runner = make_batch_runner(cfg, t_seg, mesh=mesh)
+                _SOAK_RUNNERS[rkey] = runner
+                while len(_SOAK_RUNNERS) > _SOAK_RUNNERS_CAP:
+                    _SOAK_RUNNERS.pop(next(iter(_SOAK_RUNNERS)))
+            else:
+                _SOAK_RUNNERS[rkey] = _SOAK_RUNNERS.pop(rkey)  # LRU touch
+            state, tel, mon = runner(state, taints=taints)
+            taints = (mon["taint_restart"], mon["taint_unsafe"])
+            summ = telemetry_mod.summarize_monitor(mon)
+            statuses.append(summ["inv_status"])
+            if summ["inv_status"] != "clean" and status == "clean":
+                status = summ["inv_status"]
+            for k, v in telemetry_mod.summarize_telemetry(tel).items():
+                tel_total[k] = tel_total.get(k, 0) + v
+            # Checkpoint rotation: publish, reload, continue from the
+            # loaded state (the resume path IS the soaked path).
+            ckpt_mod.save(path, state, cfg,
+                          extra={"soak_segment": seg_i, "ticks": done + t_seg})
+            state, _ = ckpt_mod.load(path, expect_cfg=cfg)
+            done += t_seg
+            seg_i += 1
+            if verbose:
+                si = np.asarray(jax.device_get(state.snap_index))
+                print(f"soak segment {seg_i}: ticks {done}/{n_ticks} "
+                      f"inv={summ['inv_status']} snap_index "
+                      f"[{si.min()}, {si.max()}]")
+    finally:
+        if tmp is not None:
+            tmp.cleanup()
+    host = jax.device_get({
+        "si": state.snap_index, "pl": state.phys_len, "cap": state.cap_ov,
+        "lt": state.log_term})
+    si = np.asarray(host["si"])
+    window = np.asarray(host["pl"]).astype(np.int64) - si.astype(np.int64)
+    return {
+        "ticks": done,
+        "segments": seg_i,
+        "inv_status": status,
+        "statuses": statuses,
+        "snap_index_min": int(si.min()),
+        "snap_index_max": int(si.max()),
+        "window_hw": int(window.max()),
+        "cap_exhausted_groups": int(
+            np.sum(np.any(np.asarray(host["cap"]) != 0, axis=0))),
+        "log_bytes": int(np.asarray(host["lt"]).nbytes * 2),
+        "telemetry": tel_total,
+    }
+
+
+# Compiled-runner cache for soak segments (same cfg + segment shape reuse
+# one jit across rotations — the whole point of the fixed segment size).
+# LRU-bounded: a standing service soaking many configs must not pin every
+# compiled executable (and its closure's mesh + rng operands) forever.
+_SOAK_RUNNERS: dict = {}
+_SOAK_RUNNERS_CAP = 8
 
 
 def smoke_spec(farm_seed: int = 12) -> ScenarioSpec:

@@ -64,7 +64,9 @@ class OracleLog:
         return self.cmds[i]
 
     def add(self, i: int, term: int, cmd: int) -> bool:
-        # Commons.kt:56-68
+        # Commons.kt:56-68. Returns whether the caller's bookkeeping may
+        # proceed; the CAPACITY clip is the one False-with-consequences
+        # branch (OracleNode.log_add latches cap_ov on it).
         if self.last_index == i:
             if self.phys_len >= self.capacity:
                 return False  # capacity clip [canon], SEMANTICS.md §3
@@ -83,6 +85,67 @@ class OracleLog:
         return list(zip(self.terms[: self.last_index], self.cmds[: self.last_index]))
 
 
+class RingLog:
+    """§15 ring-window log: the OracleLog semantics over FIXED ring arrays
+    of `capacity` slots with a sliding base (= the node's snap_index).
+    Logical position p lives at ring slot p % capacity, valid while
+    p ∈ [base, base + capacity); positions below base are folded into the
+    snapshot. Mirrors the kernel's translate-or-latch map bit for bit —
+    including absorbing writes below base and latching the capacity clip
+    on the live window phys_len - base."""
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self.base = 0
+        self.last_index = 0
+        self.phys_len = 0
+        self.terms = [0] * capacity  # ring slots (stale bits retained)
+        self.cmds = [0] * capacity
+
+    def valid(self, i: int) -> bool:
+        return self.base <= i < self.last_index
+
+    def get_term(self, i: int) -> int:
+        assert self.valid(i) or self.base <= i < self.phys_len, i
+        return self.terms[i % self.capacity]
+
+    def get_cmd(self, i: int) -> int:
+        assert self.valid(i) or self.base <= i < self.phys_len, i
+        return self.cmds[i % self.capacity]
+
+    def add(self, i: int, term: int, cmd: int) -> bool:
+        C = self.capacity
+        if 0 <= i < self.base:
+            return True  # §15 absorb: already folded (committed) content
+        if self.last_index == i:
+            if self.phys_len - self.base >= C:
+                return False  # capacity clip on the LIVE window
+            self.terms[self.phys_len % C] = term  # physical END (ghost rule)
+            self.cmds[self.phys_len % C] = cmd
+            self.phys_len += 1
+            self.last_index += 1
+            return True
+        if self.last_index < i:
+            return False
+        self.terms[i % C] = term
+        self.cmds[i % C] = cmd
+        self.last_index = i + 1  # logical truncation (quirk j)
+        return True
+
+    def install(self, snap_index: int) -> None:
+        """§15 InstallSnapshot application: the log window empties onto
+        the snapshot (ring slot CONTENTS untouched — the kernel leaves
+        stale bits in place and so does the oracle, keeping the arrays
+        bit-comparable across engines)."""
+        self.base = snap_index
+        self.last_index = snap_index
+        self.phys_len = snap_index
+
+    def entries(self):
+        return [(self.get_term(i), self.get_cmd(i))
+                for i in range(self.base, self.last_index)]
+
+
 class OracleNode:
     """Per-node state (reference RaftServer.kt:35-48 + SEMANTICS.md §2)."""
 
@@ -98,7 +161,13 @@ class OracleNode:
         self.voted_for = -1
         self.role = FOLLOWER
         self.commit = 0
-        self.log = OracleLog(cfg.log_capacity)
+        self.log = (RingLog(cfg.log_capacity) if cfg.uses_compaction
+                    else OracleLog(cfg.log_capacity))
+        # §15 snapshot state (compaction configs; == kernel snap_* fields).
+        self.snap_index = 0
+        self.snap_term = 0
+        self.snap_digest = 0
+        self.cap_ov = 0            # §15 capacity-exhaustion latch (sticky)
 
         self.t_ctr = 0
         self.b_ctr = 0
@@ -158,17 +227,49 @@ class OracleNode:
         self.el_left = self._draw_timeout()
 
     def last_log_term(self) -> int:
-        # RaftServer.kt:202
-        return 0 if self.log.last_index == 0 else self.log.get_term(self.log.last_index - 1)
+        # RaftServer.kt:202; §15 boundary: a fully folded window's
+        # lastLogTerm is the snapshot term. A quirk-a fold can push the
+        # base PAST last_index (tick.py log_add's absorb note) — the
+        # kernel's masked gather (_win_ok) reads 0 there, so this must
+        # too, not assert.
+        li = self.log.last_index
+        if li == 0:
+            return 0
+        if self.cfg.uses_compaction and li == self.snap_index:
+            return self.snap_term
+        if self.cfg.uses_compaction and li < self.snap_index:
+            return 0
+        return self.log.get_term(li - 1)
+
+    def term_at(self, i: int) -> int:
+        """§15 boundary read: log term at position i, serving the folded
+        boundary row snap_index - 1 from the snapshot."""
+        if self.cfg.uses_compaction and i == self.snap_index - 1:
+            return self.snap_term
+        return self.log.get_term(i)
+
+    def log_add(self, i: int, term: int, cmd: int) -> bool:
+        """log.add with the §15 capacity-exhaustion latch (satellite 1)."""
+        ok = self.log.add(i, term, cmd)
+        if not ok and i == self.log.last_index:
+            self.cap_ov |= 1  # the clip branch — latch, sticky
+        return ok
 
     def restart(self) -> None:
         """SEMANTICS.md §9 restart: wipe everything except the RNG counters (quirk l —
-        the reference persists nothing, RaftServer.kt:35-48); re-arm the timer."""
+        the reference persists nothing, RaftServer.kt:35-48); re-arm the timer.
+        §15: the snapshot dies with the process too (nothing persists);
+        cap_ov stays sticky (a diagnostic latch, not protocol state)."""
         self.term = 0
         self.voted_for = -1
         self.role = FOLLOWER
         self.commit = 0
-        self.log = OracleLog(self.cfg.log_capacity)
+        self.log = (RingLog(self.cfg.log_capacity)
+                    if self.cfg.uses_compaction
+                    else OracleLog(self.cfg.log_capacity))
+        self.snap_index = 0
+        self.snap_term = 0
+        self.snap_digest = 0
         self.round_state = IDLE
         self.round_left = 0
         self.round_age = 0
@@ -204,6 +305,17 @@ class AppendReq:
     leader_commit: int
 
 
+@dataclasses.dataclass
+class InstallReq:
+    """§15 InstallSnapshot (rides the §10 append slot with aq_hase == 2)."""
+    term: int
+    leader_id: int
+    snap_index: int
+    snap_term: int
+    snap_digest: int
+    leader_commit: int
+
+
 def vote_handler(p: OracleNode, req: VoteReq) -> tuple[int, bool]:
     """SEMANTICS.md §6.1 / RaftServer.kt:228-251. Mutates p; returns (term, granted)."""
     if req.term < p.term:
@@ -212,9 +324,10 @@ def vote_handler(p: OracleNode, req: VoteReq) -> tuple[int, bool]:
         granted = p.voted_for == req.cand  # quirk g
     else:
         li = p.log.last_index
-        if li >= 1 and req.last_log_term < p.log.get_term(li - 1):
+        p_llt = p.last_log_term()  # §15 boundary-aware lastLogTerm
+        if li >= 1 and req.last_log_term < p_llt:
             granted = False  # no term adopt (quirk f)
-        elif li >= 1 and req.last_log_term == p.log.get_term(li - 1) and req.last_log_index < li:
+        elif li >= 1 and req.last_log_term == p_llt and req.last_log_index < li:
             granted = False  # no term adopt (quirk f)
         else:
             p.term = req.term
@@ -237,14 +350,59 @@ def append_handler(p: OracleNode, req: AppendReq) -> tuple[int, bool]:
         p.reset_election_timer()  # possibly the second reset this exchange
     if req.leader_commit > p.commit:  # quirk e: BEFORE the consistency check
         p.commit = min(req.leader_commit, p.log.last_index)
-    success = req.prev_log_index == -1 or (
-        p.log.last_index > req.prev_log_index
-        and req.prev_log_index >= 0
-        and p.log.get_term(req.prev_log_index) == req.prev_log_term
-    )
+    pli = req.prev_log_index
+    if pli == -1:
+        success = True
+    elif p.cfg.uses_compaction and 0 <= pli < p.snap_index - 1:
+        success = True  # §15 absorb: below p's snapshot base (folded)
+    else:
+        # §15 boundary: pli == snap_index - 1 checks against snap_term
+        # (p.term_at); in-window reads are the historical rule.
+        success = (p.log.last_index > pli and pli >= 0
+                   and p.term_at(pli) == req.prev_log_term)
     if success and req.entry is not None:
-        p.log.add(req.prev_log_index + 1, req.entry[0], req.entry[1])
+        p.log_add(pli + 1, req.entry[0], req.entry[1])
     return p.term, success
+
+
+def install_handler(p: OracleNode, req: InstallReq) -> tuple[int, bool]:
+    """§15 InstallSnapshot handler on p (SEMANTICS.md §15; mirrors the
+    §6.2 shape: term adoption, the quirk-d foreign demote+reset, the
+    install iff req.snap_index > p.last_index, the quirk-e commit
+    advance). Always reports success."""
+    if req.term > p.term:
+        p.term = req.term
+        p.voted_for = -1
+        p.role = FOLLOWER
+        p.reset_election_timer()
+    if req.leader_id != p.id:  # quirk-d mirror
+        p.role = FOLLOWER
+        p.reset_election_timer()
+    if req.snap_index > p.log.last_index:
+        p.snap_index = req.snap_index
+        p.snap_term = req.snap_term
+        p.snap_digest = req.snap_digest
+        p.log.install(req.snap_index)
+        p.commit = req.snap_index
+    if req.leader_commit > p.commit:
+        p.commit = min(req.leader_commit, p.log.last_index)
+    return p.term, True
+
+
+def install_process(l: OracleNode, p: OracleNode, resp_term: int,
+                    snap_index: int, majority: int) -> None:
+    """§15 leader-side processing of an install response (mirrors
+    RaftServer.kt:146-168's shape): demote on a higher term, else jump the
+    peer's frontier to the snapshot and run the quirk-a commit tally."""
+    if resp_term > l.term:
+        l.term = resp_term
+        l.role = FOLLOWER
+        l.reset_election_timer()
+        return
+    l.next_index[p.id - 1] = snap_index + 1
+    l.match_index[p.id - 1] = snap_index
+    if sum(1 for m in l.match_index if m > l.commit) >= majority:
+        l.commit += 1  # quirk a
 
 
 class OracleGroup:
@@ -363,14 +521,14 @@ class OracleGroup:
             n = nodes[cfg.cmd_node - 1]
             if n.up:
                 at = n.log.last_index
-                added = n.log.add(at, n.term, t)
+                added = n.log_add(at, n.term, t)
                 ev and emit("0", "command", node=n.id, cmd=t, term=n.term, at=at,
                      accepted=added, via="workload")
         for node_id, cmd in self.schedule.get(t, []):
             n = nodes[node_id - 1]
             if n.up:
                 at = n.log.last_index
-                added = n.log.add(at, n.term, cmd)
+                added = n.log_add(at, n.term, cmd)
                 ev and emit("0", "command", node=n.id, cmd=cmd, term=n.term, at=at,
                      accepted=added, via="driver")
 
@@ -543,6 +701,20 @@ class OracleGroup:
                 if not ok(p.id, l.id):
                     ev and emit("5", "append_dropped", leader=l.id, peer=p.id)
                     return
+                if slot.get("inst"):
+                    # §15 InstallSnapshot delivery (aq_hase == 2 on the
+                    # kernel side): handler on p, then the leader response
+                    # (always success) against live leader state.
+                    req_i = InstallReq(slot["term"], l.id, slot["pli"],
+                                       slot["plt"], slot["digest"],
+                                       slot["commit"])
+                    resp_term, _ = install_handler(p, req_i)
+                    install_process(l, p, resp_term, slot["pli"],
+                                    cfg.majority)
+                    ev and emit("5", "install_snapshot", leader=l.id,
+                         peer=p.id, snap_index=slot["pli"],
+                         snap_term=slot["plt"])
+                    return
                 req = AppendReq(slot["term"], l.id, slot["pli"], slot["plt"],
                                 slot["entry"], slot["commit"])
                 p_pre_commit = p.commit
@@ -593,11 +765,36 @@ class OracleGroup:
                         # (post-delivery: the delivery above may have advanced
                         # next_index).
                         i = l.next_index[p.id - 1]
+                        if (cfg.uses_compaction and i <= l.snap_index
+                                and l.snap_index >= 1):
+                            # §15: the peer's frontier fell at/below l's
+                            # snapshot base — send InstallSnapshot instead
+                            # (snapshot triple in the pli/plt seats,
+                            # digest alongside, aq_hase == 2 kernel-side).
+                            if ok(l.id, p.id):
+                                l.aq[p.id - 1] = {
+                                    "due": delay_of(l.id, p.id),
+                                    "term": l.term, "pli": l.snap_index,
+                                    "plt": l.snap_term,
+                                    "digest": l.snap_digest,
+                                    "entry": None, "commit": l.commit,
+                                    "inst": True,
+                                }
+                                ev and emit("5", "install_sent",
+                                     leader=l.id, peer=p.id,
+                                     snap_index=l.snap_index,
+                                     due=l.aq[p.id - 1]["due"])
+                            if cfg.delay_lo == 0:
+                                append_deliver(l, p)
+                            continue
                         pli = i - 2
                         skip = False
                         plt = -1
                         if pli >= 0:
-                            if l.log.valid(pli):
+                            if (cfg.uses_compaction
+                                    and pli == l.snap_index - 1):
+                                plt = l.snap_term  # §15 boundary row
+                            elif l.log.valid(pli):
                                 plt = l.log.get_term(pli)
                             else:
                                 skip = True  # exception -> skip peer
@@ -646,13 +843,37 @@ class OracleGroup:
                      final=not l.hb_armed)
                 for p in nodes:
                     i = l.next_index[p.id - 1]
+                    if (cfg.uses_compaction and i <= l.snap_index
+                            and l.snap_index >= 1):
+                        # §15: append cannot serve this peer (entries
+                        # folded) — the synchronous InstallSnapshot
+                        # exchange runs instead.
+                        if not (ok(l.id, p.id) and ok(p.id, l.id)):
+                            ev and emit("5", "append_dropped", leader=l.id,
+                                 peer=p.id)
+                            continue
+                        snap_i = l.snap_index
+                        req_i = InstallReq(l.term, l.id, snap_i,
+                                           l.snap_term, l.snap_digest,
+                                           l.commit)
+                        resp_term, _ = install_handler(p, req_i)
+                        install_process(l, p, resp_term, snap_i,
+                                        cfg.majority)
+                        ev and emit("5", "install_snapshot", leader=l.id,
+                             peer=p.id, snap_index=snap_i,
+                             snap_term=l.snap_term)
+                        continue
                     prev_log_index = i - 2
                     if prev_log_index >= 0:
-                        if not l.log.valid(prev_log_index):
+                        if (cfg.uses_compaction
+                                and prev_log_index == l.snap_index - 1):
+                            prev_log_term = l.snap_term  # §15 boundary row
+                        elif not l.log.valid(prev_log_index):
                             ev and emit("5", "skip_peer", leader=l.id, peer=p.id,
                                  reason="prev_log_invalid", next_index=i)
                             continue  # exception -> skip peer (RaftServer.kt:170)
-                        prev_log_term = l.log.get_term(prev_log_index)
+                        else:
+                            prev_log_term = l.log.get_term(prev_log_index)
                     else:
                         prev_log_term = -1
                     entry = None
@@ -693,6 +914,40 @@ class OracleGroup:
                          leader_commit=(l_pre_commit, l.commit),
                          next_index=l.next_index[p.id - 1],
                          match_index=l.match_index[p.id - 1])
+
+        # Phase C — §15 snapshot fold (compaction), on the final log:
+        # every live node whose unfolded committed backlog reached the
+        # watermark folds up to compact_chunk oldest committed entries and
+        # slides the ring base (== snap_index). Mirrors the kernel's
+        # end-of-tick fold phase bit for bit (fold_digest_py is the same
+        # wrapping-int32 arithmetic).
+        if cfg.uses_compaction:
+            from raft_kotlin_tpu.models.state import fold_digest_py
+
+            W, CH = cfg.compact_watermark, cfg.compact_chunk
+            for n in nodes:
+                if not n.up:
+                    continue
+                avail = n.commit - n.snap_index
+                if avail >= W:
+                    cnt = min(avail, CH)
+                    for j in range(cnt):
+                        pos = n.snap_index + j
+                        # Raw ring-slot reads, NOT get_term/get_cmd: the
+                        # quirk-a tally can push commit past phys_len (an
+                        # install lowers phys_len while stale responses
+                        # keep processing — tick.py log_add's past-the-
+                        # frontier note), so the fold may reach positions
+                        # the live-window assert rejects. The kernel and
+                        # native folds read the stale slot bits there;
+                        # bit-parity requires the same read here.
+                        n.snap_term = n.log.terms[pos % n.log.capacity]
+                        n.snap_digest = fold_digest_py(
+                            n.snap_digest, n.log.cmds[pos % n.log.capacity])
+                    n.snap_index += cnt
+                    n.log.base = n.snap_index
+                    ev and emit("C", "snapshot_fold", node=n.id,
+                         snap_index=n.snap_index, snap_term=n.snap_term)
 
         self.tick_count += 1
 
@@ -809,13 +1064,19 @@ def _delay_all_groups(cfg: RaftConfig, tick: int):
 def _fault_masks_all_groups(cfg: RaftConfig, tick: int):
     base = rngmod.base_key(cfg.seed)
     G, N = cfg.n_groups, cfg.n_nodes
+    crash = np.asarray(rngmod.event_mask(
+        base, rngmod.KIND_CRASH, tick, (G, N), cfg.p_crash,
+        thresh=_scen_thresh(cfg, "crash_t")))
+    restart = np.asarray(rngmod.event_mask(
+        base, rngmod.KIND_RESTART, tick, (G, N), cfg.p_restart,
+        thresh=_scen_thresh(cfg, "restart_t")))
+    # §15 warmup-down: the same deterministic post-processing the kernel
+    # applies (utils/rng.apply_warmup_faults), host-side numpy.
+    crash, restart = rngmod.apply_warmup_faults(
+        cfg.scenario, cfg.cmd_node, tick, crash, restart, xp=np)
     return {
-        "crash": np.asarray(rngmod.event_mask(
-            base, rngmod.KIND_CRASH, tick, (G, N), cfg.p_crash,
-            thresh=_scen_thresh(cfg, "crash_t"))),
-        "restart": np.asarray(rngmod.event_mask(
-            base, rngmod.KIND_RESTART, tick, (G, N), cfg.p_restart,
-            thresh=_scen_thresh(cfg, "restart_t"))),
+        "crash": crash,
+        "restart": restart,
         "link_fail": np.asarray(rngmod.event_mask(
             base, rngmod.KIND_LINK_FAIL, tick, (G, N, N), cfg.p_link_fail,
             thresh=_scen_thresh(cfg, "link_fail_t"))),

@@ -95,6 +95,16 @@ class RaftState:
     # iteration, reference RaftServer.kt:191-223).
     rounds: jax.Array       # (N, G) i32
 
+    # Capacity-exhaustion latch (§15; r15): bit 0 set on every node that
+    # EVER had a phase-0/5 append rejected by the capacity clip (§3's
+    # silent clip was an undiagnosed failure mode — ISSUE 12 satellite 1).
+    # Sticky across restarts (a diagnostic, not protocol state; the §9
+    # restart wipe deliberately leaves it). Same carry/loud-fail contract
+    # as the §14 width-overflow latch: lane-shaped in every engine's scan
+    # carry, reduced once at scan exit, host-checked by runners that opt
+    # in (check_cap_ov). Compaction (§15) is the documented remedy.
+    cap_ov: jax.Array       # (N, G) i16 latch bitmask
+
     tick: jax.Array         # () i32 — global tick counter
 
     # §10 mailbox (present only when cfg.uses_mailbox; None otherwise): capacity-1
@@ -123,6 +133,37 @@ class RaftState:
     aq_ent_c: Optional[jax.Array] = None
     aq_commit: Optional[jax.Array] = None  # leaderCommit
 
+    # §15 snapshot/compaction state (present only when cfg.uses_compaction;
+    # None otherwise — the same optionality contract as the §10 mailbox).
+    # snap_index doubles as the RING BASE of the log window: positions
+    # below it are folded into the snapshot and their ring slots recycled.
+    snap_index: Optional[jax.Array] = None   # (N, G) i32 folded prefix length
+    snap_term: Optional[jax.Array] = None    # (N, G) i32 term at snap_index-1
+    snap_digest: Optional[jax.Array] = None  # (N, G) i32 folded-cmd digest
+
+
+# §15 snapshot fields (present iff cfg.uses_compaction), canonical order.
+SNAPSHOT_FIELDS = ("snap_index", "snap_term", "snap_digest")
+
+# Position-valued fields: bounded by log_capacity WITHOUT compaction
+# (int16 NARROW16 storage); UNBOUNDED logical positions under §15
+# compaction (the window slides forever), so field_dtype widens them to
+# int32 when cfg.uses_compaction.
+POSITION_FIELDS = ("commit", "last_index", "phys_len", "next_index",
+                   "match_index", "vq_lli", "aq_pli", "aq_commit")
+
+# §15 command-digest fold: digest' = digest * DIGEST_MULT + cmd in
+# WRAPPING int32 (two's complement — XLA int32 mul/add wrap; the oracle
+# masks to 32 bits and re-signs; the C++ engine computes in uint32_t).
+DIGEST_MULT = 1000003
+
+
+def fold_digest_py(digest: int, cmd: int) -> int:
+    """The §15 digest fold on host ints, bit-identical to the kernels'
+    wrapping-int32 arithmetic (the Python oracle's form)."""
+    v = (digest * DIGEST_MULT + cmd) & 0xFFFFFFFF
+    return v - (1 << 32) if v >= (1 << 31) else v
+
 
 # Structurally bounded fields stored int16 (round-4 narrowing): node ids,
 # vote tallies, role/round enums, timer countdowns (<= el_hi/bo_hi/round_ticks
@@ -148,6 +189,11 @@ def field_dtype(name: str, cfg: RaftConfig):
         return jnp.int16 if cfg.log_dtype == "int16" else jnp.int32
     if name in ("el_armed", "hb_armed", "up", "responded", "link_up"):
         return jnp.bool_
+    if name == "cap_ov":
+        return jnp.int16
+    if cfg.uses_compaction and name in POSITION_FIELDS:
+        # §15: logical positions are unbounded once the window slides.
+        return jnp.int32
     return jnp.int16 if name in NARROW16 else jnp.int32
 
 
@@ -173,6 +219,10 @@ def init_state(cfg: RaftConfig) -> RaftState:
     zi = lambda *s: jnp.zeros(s, dtype=jnp.int32)
     z16 = lambda *s: jnp.zeros(s, dtype=jnp.int16)
     zb = lambda *s: jnp.zeros(s, dtype=bool)
+    # Position-valued fields honor the §15 widening (field_dtype):
+    # int16 without compaction (bit-identical to the pre-§15 layout),
+    # int32 once the window can slide.
+    zp = lambda name, *s: jnp.zeros(s, dtype=field_dtype(name, cfg))
     # Log storage dtype (cfg.log_dtype): int16 halves the dominant deep-log HBM
     # cost (BASELINE config 5); all handler arithmetic widens to int32 at read
     # (ops/tick.log_gather) and narrows at write (log_add).
@@ -187,9 +237,9 @@ def init_state(cfg: RaftConfig) -> RaftState:
         term=zi(N, G),
         voted_for=jnp.full((N, G), -1, dtype=jnp.int16),
         role=z16(N, G),
-        commit=z16(N, G),
-        last_index=z16(N, G),
-        phys_len=z16(N, G),
+        commit=zp("commit", N, G),
+        last_index=zp("last_index", N, G),
+        phys_len=zp("phys_len", N, G),
         log_term=jnp.zeros((N, C, G), dtype=ldt),
         log_cmd=jnp.zeros((N, C, G), dtype=ldt),
         last_term=zi(N, G),
@@ -202,8 +252,8 @@ def init_state(cfg: RaftConfig) -> RaftState:
         responses=z16(N, G),
         responded=zb(N, N, G),
         bo_left=z16(N, G),
-        next_index=z16(N, N, G),
-        match_index=z16(N, N, G),
+        next_index=zp("next_index", N, N, G),
+        match_index=zp("match_index", N, N, G),
         hb_armed=zb(N, G),
         hb_left=z16(N, G),
         up=jnp.ones((N, G), dtype=bool),
@@ -211,12 +261,13 @@ def init_state(cfg: RaftConfig) -> RaftState:
         t_ctr=jnp.ones((N, G), dtype=jnp.int32),
         b_ctr=zi(N, G),
         rounds=zi(N, G),
+        cap_ov=z16(N, G),
         tick=jnp.zeros((), dtype=jnp.int32),
         **(
             {
                 "vq_due": jnp.full((N, N, G), -1, dtype=jnp.int16),
                 "aq_due": jnp.full((N, N, G), -1, dtype=jnp.int16),
-                **{k: (z16(N, N, G) if k in NARROW16 else zi(N, N, G))
+                **{k: zp(k, N, N, G)
                    for k in (
                     "vq_term", "vq_lli", "vq_llt", "vq_round",
                     "aq_term", "aq_pli", "aq_plt", "aq_hase",
@@ -224,6 +275,11 @@ def init_state(cfg: RaftConfig) -> RaftState:
                 )},
             }
             if cfg.uses_mailbox
+            else {}
+        ),
+        **(
+            {k: zi(N, G) for k in SNAPSHOT_FIELDS}
+            if cfg.uses_compaction
             else {}
         ),
     )
@@ -277,6 +333,16 @@ CTRL_FIELDS = ("role", "round_state", "el_armed", "hb_armed", "up")
 # Wide (N, N, G) bool/flag planes that become (N, G) N-bit masks.
 PEER_BIT_FIELDS = {"responded": "responded_bits", "link_up": "link_bits",
                    "aq_hase": "aq_hase_bits"}
+
+
+def peer_bit_fields(cfg: RaftConfig) -> dict:
+    """The peer-bit plane set under `cfg`: aq_hase is only 1-bit-packable
+    without §15 compaction — the InstallSnapshot discriminator (aq_hase
+    == 2) needs the full value, so compaction configs keep it as a plain
+    narrow field."""
+    if not cfg.uses_compaction:
+        return dict(PEER_BIT_FIELDS)
+    return {k: v for k, v in PEER_BIT_FIELDS.items() if k != "aq_hase"}
 # Term-valued / monotone-counter fields: int16 under the overflow latch.
 LATCH16 = (
     "term", "last_term", "t_ctr", "b_ctr", "rounds",
@@ -319,6 +385,7 @@ class PackedRaftState:
     rounds: jax.Array          # (N, G) i16 (latched)
     tick: jax.Array            # () i32
     ov: jax.Array              # (G,) i8 per-group width-overflow latch
+    cap_ov: jax.Array          # (N, G) i16 §15 capacity-exhaustion latch
 
     # §10 mailbox (present only when cfg.uses_mailbox, like RaftState).
     vq_due: Optional[jax.Array] = None     # (N, N, G) i8|i16
@@ -331,9 +398,23 @@ class PackedRaftState:
     aq_pli: Optional[jax.Array] = None     # (N, N, G) i8|i16
     aq_plt: Optional[jax.Array] = None     # (N, N, G) i16 (latched)
     aq_hase_bits: Optional[jax.Array] = None  # (N, G) u8|u16 peer mask
-    aq_ent_t: Optional[jax.Array] = None   # (N, N, G) i16 (latched)
+    aq_ent_t: Optional[jax.Array] = None   # (N, N, G) i16 (latched);
+    #                                        i32 under §15 compaction (the
+    #                                        install digest rides this seat)
     aq_ent_c: Optional[jax.Array] = None   # (N, N, G) i16 (latched)
     aq_commit: Optional[jax.Array] = None  # (N, N, G) i8|i16
+
+    # §15 snapshot state (present only when cfg.uses_compaction). Position
+    # counters are unbounded, so snap_index (and every POSITION_FIELDS
+    # member) packs int16 UNDER THE WIDTH-OVERFLOW LATCH — a soak that
+    # outgrows int16 positions latches loudly and re-runs wide.
+    snap_index: Optional[jax.Array] = None   # (N, G) i16 (latched)
+    snap_term: Optional[jax.Array] = None    # (N, G) i16 (latched)
+    snap_digest: Optional[jax.Array] = None  # (N, G) i32 (full-width fold)
+    # Compaction configs keep aq_hase UNPACKED (the InstallSnapshot
+    # discriminator value 2 does not fit a 1-bit plane — peer_bit_fields);
+    # aq_hase_bits is then absent and this plain narrow field rides instead.
+    aq_hase: Optional[jax.Array] = None      # (N, N, G) i8
 
 
 def assert_packed_bounds(cfg: RaftConfig) -> None:
@@ -357,6 +438,22 @@ def packed_field_dtype(name: str, cfg: RaftConfig):
         return jnp.uint32
     if name in ("responded_bits", "link_bits", "aq_hase_bits"):
         return jnp.uint8 if cfg.n_nodes <= 8 else jnp.uint16
+    if name == "cap_ov":
+        return jnp.int16
+    if name == "snap_digest":
+        return jnp.int32  # full-width wrapping fold — never narrowed
+    if cfg.uses_compaction and name == "aq_ent_t":
+        # §15 mailbox: an in-flight InstallSnapshot rides the ent_t seat
+        # with the full-width snap_digest (tick.py install send) — the
+        # digest is a wrapping i32 fold, so narrowing would latch on the
+        # first install. The pli/plt seats carry snap_index/snap_term,
+        # which keep their usual latched-int16 packing.
+        return jnp.int32
+    if cfg.uses_compaction and (name in POSITION_FIELDS
+                                or name in ("snap_index", "snap_term")):
+        # §15: unbounded positions pack int16 under the width latch
+        # (narrow() range-checks every value — a wrapped pack latches).
+        return jnp.int16
     if name in LATCH16:
         return jnp.int16
     if name == "log_term":
@@ -365,6 +462,8 @@ def packed_field_dtype(name: str, cfg: RaftConfig):
         return jnp.int16
     if name in ("voted_for", "votes", "responses"):
         return jnp.int8  # node ids / tallies <= N <= 10
+    if name == "aq_hase":
+        return jnp.int8  # unpacked under compaction: values in {0, 1, 2}
     i8 = lambda fits: jnp.int8 if fits else jnp.int16
     if name in ("commit", "last_index", "phys_len", "next_index",
                 "match_index", "vq_lli", "aq_pli", "aq_commit"):
@@ -432,14 +531,15 @@ def pack_fields(cfg: RaftConfig, s: dict):
              | (bits1(s["up"]) << (2 * N)))
     out["ctrl_bits"] = jnp.stack(
         [word2(s["role"]), word2(s["round_state"]), flags]).astype(jnp.uint32)
-    for name, packed_name in PEER_BIT_FIELDS.items():
+    pbf = peer_bit_fields(cfg)
+    for name, packed_name in pbf.items():
         if name not in s:
             continue
         v = (s[name] != 0).astype(jnp.uint32)
         word = jnp.sum(v << _peer_shifts(N), axis=1, dtype=jnp.uint32)
         out[packed_name] = word.astype(packed_field_dtype(packed_name, cfg))
     for name, v in s.items():
-        if name in CTRL_FIELDS or name in PEER_BIT_FIELDS:
+        if name in CTRL_FIELDS or name in pbf:
             continue
         out[name] = narrow(name, v)
     return out, ov
@@ -533,3 +633,22 @@ def check_packed_ov(ov) -> None:
             "exceeded its packed storage width (models/state.py LATCH16 "
             "latch) — the packed bits are invalid; re-run with "
             'layout="wide"')
+
+
+def check_cap_ov(cap_ov) -> None:
+    """Host-side loud-fail guard on the §15 capacity-exhaustion latch:
+    a nonzero latch means some node's append was silently clipped at
+    log_capacity (§3 capacity clip) — the run outlived its log window.
+    Accepts the (N, G) state field, any reduction of it, or a RaftState.
+    The documented remedy is enabling compaction
+    (cfg.compact_watermark > 0) or raising log_capacity."""
+    import numpy as np
+
+    if isinstance(cap_ov, RaftState):
+        cap_ov = cap_ov.cap_ov
+    if np.any(np.asarray(jax.device_get(cap_ov))):
+        raise RuntimeError(
+            "log capacity exhausted: an append was rejected by the §3 "
+            "capacity clip (models/state.py cap_ov latch) — the run "
+            "outlived its log window; enable §15 compaction "
+            "(compact_watermark > 0) or raise log_capacity")

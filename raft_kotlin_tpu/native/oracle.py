@@ -38,14 +38,16 @@ class _Dims(ct.Structure):
     _fields_ = [(k, ct.c_int32) for k in (
         "G", "N", "C", "hb_ticks", "round_ticks", "retry_ticks", "majority",
         "cmd_period", "cmd_node", "t0", "T", "Kt", "Kb",
-        "delay_lo", "delay_hi", "mailbox")]
+        "delay_lo", "delay_hi", "mailbox",
+        "compact_watermark", "compact_chunk")]
 
 
 _STATE_FIELDS_I32 = (
     "term", "voted_for", "role", "commit", "last_index", "phys_len",
     "log_term", "log_cmd", "el_left", "round_state", "round_left", "round_age",
     "votes", "responses", "bo_left", "next_index", "match_index", "hb_left",
-    "t_ctr", "b_ctr", "rounds",
+    "t_ctr", "b_ctr", "rounds", "snap_index", "snap_term", "snap_digest",
+    "cap_ov",
 )
 _STATE_FIELDS_U8 = ("el_armed", "responded", "hb_armed", "up", "link_up")
 
@@ -68,7 +70,12 @@ _STATE_ORDER = (
     ("hb_armed", _U8P), ("hb_left", _I32P),
     ("up", _U8P), ("link_up", _U8P),
     ("t_ctr", _I32P), ("b_ctr", _I32P), ("rounds", _I32P),
-) + tuple((k, _I32P) for k in _MAILBOX_ORDER)
+) + tuple((k, _I32P) for k in _MAILBOX_ORDER) + (
+    # §15 (abi v4): snapshot state (null unless cfg.uses_compaction) +
+    # the always-present capacity-exhaustion latch.
+    ("snap_index", _I32P), ("snap_term", _I32P), ("snap_digest", _I32P),
+    ("cap_ov", _I32P),
+)
 
 
 class _State(ct.Structure):
@@ -146,7 +153,7 @@ def _lib() -> ct.CDLL:
             ct.POINTER(_Dims), ct.POINTER(_State), ct.POINTER(_Inputs),
             ct.POINTER(_Trace),
         ]
-        assert lib.raft_abi_version() == 3
+        assert lib.raft_abi_version() == 4
         _lib_handle = lib
     return _lib_handle
 
@@ -242,17 +249,32 @@ def _tick_masks(cfg: RaftConfig, t0: int, T: int) -> Dict[str, Optional[np.ndarr
             out["leader_iso"] = np.ascontiguousarray(
                 (act & (scen["part_kind"][None] == PART_LEADER))
                 .astype(np.uint8))
+    warmup = cfg.scenario is not None and cfg.scenario.warmup_down > 0
     if cfg.p_crash > 0 or cfg.p_restart > 0 or "crash_t" in scen \
-            or "restart_t" in scen:
+            or "restart_t" in scen or warmup:
         crash_t = jnp.asarray(scen["crash_t"]) if "crash_t" in scen else None
         restart_t = jnp.asarray(scen["restart_t"]) \
             if "restart_t" in scen else None
-        out["crash_m"] = stack(
-            lambda t: rngmod.event_mask(base, rngmod.KIND_CRASH, t, (G, N),
-                                        cfg.p_crash, thresh=crash_t))
-        out["restart_m"] = stack(
-            lambda t: rngmod.event_mask(base, rngmod.KIND_RESTART, t, (G, N),
-                                        cfg.p_restart, thresh=restart_t))
+
+        def _fault_pair(t):
+            # §15 warmup-down rides the same deterministic post-processing
+            # as the kernels (utils/rng.apply_warmup_faults).
+            crash = rngmod.event_mask(base, rngmod.KIND_CRASH, t, (G, N),
+                                      cfg.p_crash, thresh=crash_t)
+            restart = rngmod.event_mask(base, rngmod.KIND_RESTART, t,
+                                        (G, N), cfg.p_restart,
+                                        thresh=restart_t)
+            return rngmod.apply_warmup_faults(
+                cfg.scenario, cfg.cmd_node, t, crash, restart)
+
+        # One stacked pass for BOTH masks (each _fault_pair call computes
+        # the crash AND restart draws — mapping it twice doubled the work).
+        crash_m, restart_m = jax.jit(
+            lambda: jax.lax.map(_fault_pair, ticks))()
+        out["crash_m"] = np.ascontiguousarray(
+            np.asarray(crash_m, dtype=np.uint8))
+        out["restart_m"] = np.ascontiguousarray(
+            np.asarray(restart_m, dtype=np.uint8))
     if cfg.p_link_fail > 0 or cfg.p_link_heal > 0 or "link_fail_t" in scen \
             or "link_heal_t" in scen:
         lf_t = jnp.asarray(scen["link_fail_t"]) \
@@ -334,6 +356,8 @@ class NativeOracle:
                 Kt=self._Kt, Kb=self._Kb,
                 delay_lo=cfg.delay_lo, delay_hi=cfg.delay_hi,
                 mailbox=1 if cfg.uses_mailbox else 0,
+                compact_watermark=cfg.compact_watermark,
+                compact_chunk=cfg.compact_chunk,
             )
             state = _State(**{
                 k: _ptr(self.arrays.get(k), typ) for k, typ in _STATE_ORDER

@@ -216,6 +216,11 @@ def make_deep_scan(cfg, n_ticks: int, return_state: bool = False,
         RaftState, check_packed_ov, pack_state, unpack_state)
     from raft_kotlin_tpu.ops import tick as tick_mod
 
+    if cfg.uses_compaction:
+        raise ValueError(
+            "the frontier-cache engine does not support §15 compaction "
+            "(the cache predates the ring map) — plan_for routes "
+            "compaction configs to the batched/flat engines")
     tick_plain = tick_mod.make_tick(cfg)
     N, G = cfg.n_nodes, cfg.n_groups
     packed = layout == "packed"
@@ -585,6 +590,9 @@ def make_sharded_deep_scan(cfg, mesh, n_ticks: int,
     if layout not in ("wide", "packed"):
         raise ValueError(f"unknown layout {layout!r}")
     assert engine in ("fc", "batched", "flat"), engine
+    assert not (cfg.uses_compaction and engine == "fc"), (
+        "the frontier-cache engine does not support §15 compaction — "
+        "plan_for routes compaction configs to batched/flat")
     assert not (cfg.uses_mailbox and not cfg.known_delivery
                 and engine != "flat"), \
         "τ=0 mailbox configs support only the per-pair flat engine"

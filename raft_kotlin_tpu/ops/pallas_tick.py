@@ -33,7 +33,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from raft_kotlin_tpu.models.state import MAILBOX_FIELDS, NARROW16, RaftState
+from raft_kotlin_tpu.models.state import (MAILBOX_FIELDS, NARROW16,
+                                          SNAPSHOT_FIELDS, RaftState)
 from raft_kotlin_tpu.ops import tick as tick_mod
 from raft_kotlin_tpu.ops.tick import AUX_FIELDS, STATE_FIELDS, BodyFlags, state_fields
 from raft_kotlin_tpu.utils.config import RaftConfig
@@ -126,7 +127,8 @@ def fused_snapshot_fields(cfg: RaftConfig, telemetry: bool = False,
     replay the T per-tick transitions between launches. Ordered canonically
     (STATE_FIELDS then mailbox) so kernel output lists are deterministic."""
     from raft_kotlin_tpu.utils.telemetry import (
-        MONITOR_STATE_FIELDS, TELEMETRY_MAILBOX_FIELDS,
+        MONITOR_COMPACT_FIELDS, MONITOR_STATE_FIELDS,
+        TELEMETRY_COMPACT_FIELDS, TELEMETRY_MAILBOX_FIELDS,
         TELEMETRY_STATE_FIELDS)
 
     want = []
@@ -134,11 +136,16 @@ def fused_snapshot_fields(cfg: RaftConfig, telemetry: bool = False,
         want += list(FUSED_TRACE_FIELDS)
     if telemetry:
         want += list(TELEMETRY_STATE_FIELDS)
+        if cfg.uses_compaction:
+            want += list(TELEMETRY_COMPACT_FIELDS)
     if monitor:
         want += list(MONITOR_STATE_FIELDS)
+        if cfg.uses_compaction:
+            want += list(MONITOR_COMPACT_FIELDS)
     if (telemetry or monitor) and cfg.uses_mailbox:
         want += list(TELEMETRY_MAILBOX_FIELDS)
-    order = {k: i for i, k in enumerate(STATE_FIELDS + MAILBOX_FIELDS)}
+    order = {k: i for i, k in enumerate(
+        STATE_FIELDS + MAILBOX_FIELDS + SNAPSHOT_FIELDS)}
     return tuple(sorted(set(want), key=order.__getitem__))
 
 
@@ -170,6 +177,9 @@ def choose_impl(cfg: RaftConfig) -> str:
         return "xla"
     if cfg.uses_dyn_log:
         return "xla"  # dyn-log band: the batched XLA engine (ops/tick.py)
+    if cfg.uses_compaction:
+        return "xla"  # §15 ring translate: CPU-interpret-proven, no
+        #                hardware artifact yet (plan_for's shallow guard)
     try:
         default_tile(cfg, cfg.n_groups, interpret=False)
     except ValueError:
@@ -260,6 +270,7 @@ def make_pallas_core(cfg: RaftConfig, lanes: int, tile_g: int, interpret: bool,
         "responded": (N * N, tile_g), "next_index": (N * N, tile_g),
         "match_index": (N * N, tile_g), "link_up": (N * N, tile_g),
         **{k: (N * N, tile_g) for k in MAILBOX_FIELDS},
+        **{k: (N, tile_g) for k in SNAPSHOT_FIELDS},
     }
     aux_shapes = {
         "edge_iid": (N * N, tile_g), "crash_m": (N, tile_g),
@@ -420,6 +431,7 @@ def _make_fused_core(cfg: RaftConfig, lanes: int, tile_g: int,
         "responded": (N * N, tile_g), "next_index": (N * N, tile_g),
         "match_index": (N * N, tile_g), "link_up": (N * N, tile_g),
         **{k: (N * N, tile_g) for k in MAILBOX_FIELDS},
+        **{k: (N, tile_g) for k in SNAPSHOT_FIELDS},
     }
     aux_rows = {
         "edge_iid": N * N, "crash_m": N, "restart_m": N, "link_fail": N * N,
@@ -862,6 +874,7 @@ def make_pallas_core_k(cfg: RaftConfig, lanes: int, tile_g: int,
         "responded": (N * N, tile_g), "next_index": (N * N, tile_g),
         "match_index": (N * N, tile_g), "link_up": (N * N, tile_g),
         **{k: (N * N, tile_g) for k in MAILBOX_FIELDS},
+        **{k: (N, tile_g) for k in SNAPSHOT_FIELDS},
     }
     aux_rows = {
         "edge_iid": N * N, "crash_m": N, "restart_m": N, "link_fail": N * N,

@@ -38,10 +38,12 @@ from raft_kotlin_tpu.models.state import (
     ACTIVE,
     BACKOFF,
     CANDIDATE,
+    DIGEST_MULT,
     FOLLOWER,
     IDLE,
     LEADER,
     MAILBOX_FIELDS,
+    SNAPSHOT_FIELDS,
     RaftState,
 )
 from raft_kotlin_tpu.utils import rng as rngmod
@@ -51,17 +53,21 @@ from raft_kotlin_tpu.utils.config import RaftConfig
 _I32 = jnp.int32
 
 # The CORE phase_body state fields, in canonical order (everything except the tick
-# scalar and the optional §10 mailbox fields — see state_fields()).
+# scalar, the optional §10 mailbox fields, and the optional §15 snapshot
+# fields — see state_fields()).
 STATE_FIELDS = tuple(
     f.name for f in dataclasses.fields(RaftState)
     if f.name != "tick" and f.name not in MAILBOX_FIELDS
+    and f.name not in SNAPSHOT_FIELDS
 )
 
 
 def state_fields(flags: "BodyFlags") -> tuple:
-    """The state fields phase_body operates on under `flags`: the core set, plus
-    the §10 mailbox slots when the delay path is compiled in."""
-    return STATE_FIELDS + (MAILBOX_FIELDS if flags.delay else ())
+    """The state fields phase_body operates on under `flags`: the core set,
+    plus the §10 mailbox slots when the delay path is compiled in, plus
+    the §15 snapshot fields when compaction is compiled in."""
+    return (STATE_FIELDS + (MAILBOX_FIELDS if flags.delay else ())
+            + (SNAPSHOT_FIELDS if flags.compact else ()))
 
 
 # Pre-drawn randomness + driver inputs consumed by phase_body.
@@ -200,6 +206,16 @@ class BodyFlags:
     # per-pair dyn runs (the mailbox+deep corner) leave it False and get
     # per-node (C, G) slice operands, an ~Nx cut per log op.
     sharded: bool = False
+    # §15 log compaction / snapshotting (cfg.uses_compaction): snapshot
+    # state fields ride `s`, log addressing goes through the ring-window
+    # translate (position -> slot = position mod C, valid in
+    # [snap_index, snap_index + C)), phase 5 grows the InstallSnapshot
+    # exchange, and a fold phase runs at tick end. False compiles the
+    # bit-identical pre-§15 program (the migration-equality contract).
+    # Mailbox compaction configs keep the per-pair engine: an install
+    # delivery JUMPS next_index, breaking the known-delivery batched
+    # engine's static row-window invariant.
+    compact: bool = False
 
 
 def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
@@ -312,6 +328,34 @@ def _phase_lattice(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
         assert cfg.known_delivery, (
             "batched deep engines under the mailbox need the known-delivery "
             "regime (delay_lo >= 1); τ=0 configs keep the per-pair engine")
+        assert not flags.compact, (
+            "mailbox compaction configs keep the per-pair engine: an "
+            "InstallSnapshot delivery jumps next_index, breaking the "
+            "known-delivery batched row-window invariant (SEMANTICS.md §15)")
+    # §15 compaction setup: the ring translate + window test every log
+    # access routes through (THE shared translate-or-latch index map), and
+    # the watermark/chunk constants of the end-of-tick fold phase.
+    compact = flags.compact
+    if compact:
+        assert fcache is None, (
+            "the frontier-cache engine does not support §15 compaction "
+            "(plan_for routes compaction configs to batched/flat)")
+        W_cmp, CH_cmp = cfg.compact_watermark, cfg.compact_chunk
+
+        def ring(pos):
+            # Ring slot of a position: pos mod C via lax.rem (C-style
+            # truncation — a NEGATIVE position stays negative and matches
+            # no log row, the non-compact out-of-range convention).
+            return lax.rem(pos.astype(_I32), C)
+
+        def _win_ok(n, idx):
+            # The translate-or-latch window test: positions below the
+            # node's snapshot base are FOLDED (readable only as snap_term
+            # at base-1 / via InstallSnapshot); at/above base + C they
+            # would alias a live slot.
+            b = col("snap_index", n).astype(_I32)
+            i32 = idx.astype(_I32)
+            return (i32 >= b) & (i32 < b + C)
     logrow_c = None if flags.dyn_log else jax.lax.broadcasted_iota(_I32, (C, G), 0)
     # The columnar view pays off inside the Mosaic megakernel (grid rebuilds
     # measured ~31% of it); deep-log (dyn) configs are XLA-only, where the
@@ -408,7 +452,8 @@ def _phase_lattice(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
     # algebra, never select-of-i1-values (Mosaic limits).
     _COLF = ("term", "voted_for", "role", "commit", "last_index", "phys_len",
              "last_term", "el_armed", "round_state", "round_age", "votes",
-             "responses", "hb_armed", "hb_left", "up", "t_ctr", "rounds")
+             "responses", "hb_armed", "hb_left", "up", "t_ctr", "rounds",
+             "cap_ov") + (SNAPSHOT_FIELDS if flags.compact else ())
     _PAIRV = ("responded", "next_index", "match_index") + \
         (MAILBOX_FIELDS if flags.delay else ())
     view: dict = {}
@@ -454,41 +499,97 @@ def _phase_lattice(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
             return
         s[name] = _set_row(s[name], pair(a, b), vals)
 
+    # Read addressing. All three engine forms route through the same §15
+    # translate-or-latch discipline when flags.compact: `idx` is a LOGICAL
+    # POSITION, its ring slot is ring(idx) = idx mod C, and validity is the
+    # node's live window [snap_index, snap_index + C) (_win_ok) — with
+    # snap_index == 0 (compaction off) this degenerates to the historical
+    # [0, C) structural bound, and the non-compact branches below compile
+    # the byte-identical pre-§15 program.
     if flags.dyn_log and use_slices:
-        def _gather1(arr, idx):
-            v = jnp.take_along_axis(
-                arr, jnp.clip(idx, 0, C - 1)[None, :], axis=0)[0]
-            return jnp.where((idx >= 0) & (idx < C), v, 0).astype(_I32)
+        if compact:
+            def log_gather(name, n, idx):
+                rows = ring(jnp.maximum(idx.astype(_I32), 0))[None, :]
+                v = jnp.take_along_axis(
+                    (lt if name == "log_term" else lc)[n - 1], rows,
+                    axis=0)[0]
+                return jnp.where(_win_ok(n, idx), v, 0).astype(_I32)
 
-        def log_gather(name, n, idx):
-            # (G,) read of node n's physical slot idx via a per-lane dynamic
-            # gather on its (C, G) log; 0 where idx is out of [0, C).
-            return _gather1((lt if name == "log_term" else lc)[n - 1], idx)
+            def log_gather_tc(n, idx):
+                rows = ring(jnp.maximum(idx.astype(_I32), 0))[None, :]
+                ok = _win_ok(n, idx)
+                tv = jnp.take_along_axis(lt[n - 1], rows, axis=0)[0]
+                cv = jnp.take_along_axis(lc[n - 1], rows, axis=0)[0]
+                return (jnp.where(ok, tv, 0).astype(_I32),
+                        jnp.where(ok, cv, 0).astype(_I32))
+        else:
+            def _gather1(arr, idx):
+                v = jnp.take_along_axis(
+                    arr, jnp.clip(idx, 0, C - 1)[None, :], axis=0)[0]
+                return jnp.where((idx >= 0) & (idx < C), v, 0).astype(_I32)
 
-        def log_gather_tc(n, idx):
-            # (term, cmd) at one slot, sharing the clip/bounds work.
-            rows = jnp.clip(idx, 0, C - 1)[None, :]
-            ok = (idx >= 0) & (idx < C)
-            tv = jnp.take_along_axis(lt[n - 1], rows, axis=0)[0]
-            cv = jnp.take_along_axis(lc[n - 1], rows, axis=0)[0]
-            return (jnp.where(ok, tv, 0).astype(_I32),
-                    jnp.where(ok, cv, 0).astype(_I32))
+            def log_gather(name, n, idx):
+                # (G,) read of node n's physical slot idx via a per-lane
+                # dynamic gather on its (C, G) log; 0 where idx is out of
+                # [0, C).
+                return _gather1((lt if name == "log_term" else lc)[n - 1],
+                                idx)
+
+            def log_gather_tc(n, idx):
+                # (term, cmd) at one slot, sharing the clip/bounds work.
+                rows = jnp.clip(idx, 0, C - 1)[None, :]
+                ok = (idx >= 0) & (idx < C)
+                tv = jnp.take_along_axis(lt[n - 1], rows, axis=0)[0]
+                cv = jnp.take_along_axis(lc[n - 1], rows, axis=0)[0]
+                return (jnp.where(ok, tv, 0).astype(_I32),
+                        jnp.where(ok, cv, 0).astype(_I32))
     elif flags.dyn_log:
         # Per-pair dyn engine, FLAT addressing (global row (n-1)*C + slot).
         # The bounds terms are load-bearing here: an out-of-range idx in the
         # flat layout would otherwise alias an ADJACENT node's row.
+        if compact:
+            def log_gather(name, n, idx):
+                rows = (n - 1) * C + ring(jnp.maximum(idx.astype(_I32), 0))
+                v = jnp.take_along_axis(s[name], rows[None, :], axis=0)[0]
+                return jnp.where(_win_ok(n, idx), v, 0).astype(_I32)
+
+            def log_gather_tc(n, idx):
+                rows = ((n - 1) * C
+                        + ring(jnp.maximum(idx.astype(_I32), 0)))[None, :]
+                ok = _win_ok(n, idx)
+                tv = jnp.take_along_axis(s["log_term"], rows, axis=0)[0]
+                cv = jnp.take_along_axis(s["log_cmd"], rows, axis=0)[0]
+                return (jnp.where(ok, tv, 0).astype(_I32),
+                        jnp.where(ok, cv, 0).astype(_I32))
+        else:
+            def log_gather(name, n, idx):
+                rows = (n - 1) * C + jnp.clip(idx, 0, C - 1)
+                v = jnp.take_along_axis(s[name], rows[None, :], axis=0)[0]
+                return jnp.where((idx >= 0) & (idx < C), v, 0).astype(_I32)
+
+            def log_gather_tc(n, idx):
+                rows = ((n - 1) * C + jnp.clip(idx, 0, C - 1))[None, :]
+                ok = (idx >= 0) & (idx < C)
+                tv = jnp.take_along_axis(s["log_term"], rows, axis=0)[0]
+                cv = jnp.take_along_axis(s["log_cmd"], rows, axis=0)[0]
+                return (jnp.where(ok, tv, 0).astype(_I32),
+                        jnp.where(ok, cv, 0).astype(_I32))
+    elif compact:
+        # One-hot form with the ring translate (Mosaic-compatible: rem by
+        # a constant + compare; a negative position's rem stays negative
+        # and matches no row, out-of-window matches are masked by _win_ok).
         def log_gather(name, n, idx):
-            rows = (n - 1) * C + jnp.clip(idx, 0, C - 1)
-            v = jnp.take_along_axis(s[name], rows[None, :], axis=0)[0]
-            return jnp.where((idx >= 0) & (idx < C), v, 0).astype(_I32)
+            oh = logrow_c == ring(idx)[None, :]
+            v = jnp.sum(jnp.where(oh, (lt if name == "log_term" else
+                                       lc)[n - 1], 0), axis=0).astype(_I32)
+            return jnp.where(_win_ok(n, idx), v, 0)
 
         def log_gather_tc(n, idx):
-            rows = ((n - 1) * C + jnp.clip(idx, 0, C - 1))[None, :]
-            ok = (idx >= 0) & (idx < C)
-            tv = jnp.take_along_axis(s["log_term"], rows, axis=0)[0]
-            cv = jnp.take_along_axis(s["log_cmd"], rows, axis=0)[0]
-            return (jnp.where(ok, tv, 0).astype(_I32),
-                    jnp.where(ok, cv, 0).astype(_I32))
+            oh = logrow_c == ring(idx)[None, :]
+            ok = _win_ok(n, idx)
+            tv = jnp.sum(jnp.where(oh, lt[n - 1], 0), axis=0).astype(_I32)
+            cv = jnp.sum(jnp.where(oh, lc[n - 1], 0), axis=0).astype(_I32)
+            return jnp.where(ok, tv, 0), jnp.where(ok, cv, 0)
     else:
         def _gather1(arr, idx):
             # One-hot contraction over (C, G) (no gather op — the
@@ -509,24 +610,64 @@ def _phase_lattice(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
             return (jnp.sum(jnp.where(oh, lt[n - 1], 0), axis=0).astype(_I32),
                     jnp.sum(jnp.where(oh, lc[n - 1], 0), axis=0).astype(_I32))
 
+    def log_term_b(n, idx):
+        """log_term at POSITION idx with the §15 snapshot boundary: the
+        folded boundary row base-1 reads snap_term (base == 0 degenerates
+        to the historical read — snap_term is then structurally 0 and
+        idx == -1 callers mask it out themselves)."""
+        v = log_gather("log_term", n, idx)
+        if not compact:
+            return v
+        b = col("snap_index", n).astype(_I32)
+        return jnp.where(idx.astype(_I32) == b - 1,
+                         col("snap_term", n).astype(_I32), v)
+
     def log_add(n, i, term_v, cmd_v, mask):
         # SEMANTICS.md §3 add(): physical append / reject / overwrite-truncate.
         # The write slot is always in-range where the write mask holds (append
         # needs phys_len < C; overwrite needs i < last_index <= C).
+        # §15 (compact): the capacity clip tests the LIVE WINDOW
+        # phys_len - snap_index < C, overwrites below the snapshot base are
+        # ABSORBED (already folded — a no-op reported as success by the
+        # caller's own succ term), and slots are ring-translated.
         li = col("last_index", n)
         pl = col("phys_len", n)
+        if compact:
+            b_n = col("snap_index", n)
+            has_room = (pl - b_n) < C
+        else:
+            has_room = pl < C
         # `mask` is the deepest input (it carries the exchange's succ/demote
         # chain) — joined LAST so the local compares issue ahead of it.
-        app = ((i == li) & (pl < C)) & mask
-        ovw = ((i < li) & (i >= 0)) & mask
+        # §15: the absorb rule tests the POSITION before any branch (the
+        # RingLog.add order) — quirk-a lets commit outrun the leader's own
+        # last_index, so an aggressive fold can push base past li and the
+        # next APPEND (i == li < base) is folded content too: success,
+        # no write, no li advance, and no capacity test (the oracle's
+        # absorb returns before its clip).
+        if compact:
+            app = ((i == li) & (i >= b_n) & has_room) & mask
+            ovw = ((i < li) & (i >= b_n)) & mask
+        else:
+            app = ((i == li) & has_room) & mask
+            ovw = ((i < li) & (i >= 0)) & mask
+        # §15 capacity-exhaustion latch (satellite 1): an append REJECTED
+        # by the capacity clip was, until now, a silent undiagnosed death —
+        # latch it per node (sticky; check_cap_ov is the loud-fail guard).
+        cap_hit = (mask & (i == li)) & ~has_room
+        if compact:
+            cap_hit = cap_hit & (i >= b_n)
+        cur_cap = col("cap_ov", n)
+        setcol("cap_ov", n, cap_hit, cur_cap | jnp.ones_like(cur_cap))
         wr = app | ovw
-        slot = jnp.where(app, pl, i)
+        slot = jnp.where(app, pl, i)  # logical POSITION (== slot when off)
         if batched_logs and defer["on"]:
             # Phases 0/5: record only; applied at end of tick as one
             # duplicate-resolved scatter per node (reads in between go
             # through patch()). Masked lanes get row C — dropped by the
             # scatter, never matched by patch (read rows are < C).
-            row_eff = jnp.where(wr, jnp.clip(slot, 0, C - 1), C)
+            row_eff = jnp.where(wr, (ring(slot) if compact
+                                     else jnp.clip(slot, 0, C - 1)), C)
             pending[n].append((row_eff, term_v, cmd_v, wr))
             if use_fc:
                 slot32 = slot.astype(_I32)
@@ -577,9 +718,13 @@ def _phase_lattice(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
             setcol("phys_len", n, app, pl + 1)
             return None
         ldt = s["log_term"].dtype  # narrow at write (cfg.log_dtype)
+        # §15: the write slot is the ring translate of the position (the
+        # clip below keeps masked-out lanes' garbage rows in range).
+        w_slot = (jnp.clip(ring(slot), 0, C - 1) if compact
+                  else jnp.clip(slot, 0, C - 1))
         if flags.dyn_log and not use_slices:
             # Flat masked read-modify-write of one global row per lane.
-            rows = ((n - 1) * C + jnp.clip(slot, 0, C - 1))[None, :]
+            rows = ((n - 1) * C + w_slot)[None, :]
             for name, v in (("log_term", term_v), ("log_cmd", cmd_v)):
                 cur = jnp.take_along_axis(s[name], rows, axis=0)
                 new = jnp.where(wr[None, :], v.astype(ldt)[None, :], cur)
@@ -587,7 +732,7 @@ def _phase_lattice(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
                     s[name], rows, new, axis=0, inplace=False)
         elif flags.dyn_log:
             # Masked read-modify-write of one slot per lane (scatter form).
-            rows = jnp.clip(slot, 0, C - 1)[None, :]
+            rows = w_slot[None, :]
             for store, v in ((lt, term_v), (lc, cmd_v)):
                 cur = jnp.take_along_axis(store[n - 1], rows, axis=0)
                 new = jnp.where(wr[None, :], v.astype(ldt)[None, :], cur)
@@ -596,7 +741,8 @@ def _phase_lattice(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
         else:
             # One-hot masked write over the (C, G) log (Mosaic-compatible
             # form); term and cmd share the mask.
-            oh = (logrow_c == slot[None, :]) & wr[None, :]
+            oh = (logrow_c == (ring(slot) if compact
+                               else slot)[None, :]) & wr[None, :]
             lt[n - 1] = jnp.where(oh, term_v.astype(ldt)[None, :], lt[n - 1])
             lc[n - 1] = jnp.where(oh, cmd_v.astype(ldt)[None, :], lc[n - 1])
         setcol("last_index", n, wr, i + 1)  # app => i == li: both branches = i+1
@@ -678,6 +824,13 @@ def _phase_lattice(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
         s["match_index"] = s["match_index"] * keep
         s["hb_armed"] = s["hb_armed"] & ~rst
         s["hb_left"] = jnp.where(rst, 0, s["hb_left"])
+        if compact:
+            # §15: the reference persists nothing (quirk l) — a restart
+            # wipes the snapshot too (the node rejoins empty and catches
+            # up via InstallSnapshot). cap_ov stays sticky: a diagnostic
+            # latch, not protocol state.
+            for k_sn in SNAPSHOT_FIELDS:
+                s[k_sn] = jnp.where(rst, 0, s[k_sn])
         if flags.delay:
             # §10: restart clears the slots the node OWNS (its sent requests died
             # with the process); crash clears nothing (messages stay on the wire).
@@ -823,7 +976,14 @@ def _phase_lattice(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
             li_n = col("last_index", n)
             raw = log_gather("log_term", n, li_n - 1)
             if batched_logs:
-                raw = patch("log_term", n, jnp.clip(li_n - 1, 0, C - 1), raw)
+                prow_lt = (ring(jnp.maximum(li_n.astype(_I32) - 1, 0))
+                           if compact else jnp.clip(li_n - 1, 0, C - 1))
+                raw = patch("log_term", n, prow_lt, raw)
+            if compact:
+                # §15 boundary: a fully folded log's lastLogTerm is the
+                # snapshot term (position base - 1).
+                raw = jnp.where(li_n == col("snap_index", n),
+                                col("snap_term", n).astype(_I32), raw)
             s["last_term"] = _set_row(
                 s["last_term"], n - 1, jnp.where(li_n >= 1, raw, 0))
 
@@ -1099,7 +1259,20 @@ def _phase_lattice(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
         setcol("commit", p, cadv, jnp.minimum(req_commit, p_li))  # quirk e
         if p_plt is None:
             p_plt = log_gather("log_term", p, pli)
-        succ = (pli == -1) | ((p_li > pli) & (pli >= 0) & (p_plt == plt))
+        if compact:
+            # §15: p's snapshot covers positions below its base — the
+            # boundary row base-1 checks against snap_term, rows below it
+            # are ABSORBED (folded ⇒ committed ⇒ matching by the committed-
+            # prefix guarantee; a quirk run that violated it has already
+            # latched the monitor).
+            b_p = col("snap_index", p).astype(_I32)
+            p_plt = jnp.where((pli >= 0) & (pli == b_p - 1),
+                              col("snap_term", p).astype(_I32), p_plt)
+            below = (pli >= 0) & (pli < b_p - 1)
+            succ = ((pli == -1) | below
+                    | ((p_li > pli) & (pli >= 0) & (p_plt == plt)))
+        else:
+            succ = (pli == -1) | ((p_li > pli) & (pli >= 0) & (p_plt == plt))
         add_info = log_add(p, pli + 1, ent_t, ent_c,
                            (act5 & has_entry) & succ)
         resp_term = col("term", p)
@@ -1193,6 +1366,68 @@ def _phase_lattice(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
             upd("f_ppli", jnp.where(wrote_im1, ent_w, zero), wrote_im1,
                 zero, no)
 
+    def install_exchange(l, p, act, req_term, req_si, req_st, req_dg,
+                         req_commit):
+        """§15 InstallSnapshot handler on p + leader response processing,
+        masked by `act`; request fields are (G,) snapshots (live reads on
+        the synchronous path, §10 slot contents — aq_hase == 2 — on the
+        mailbox path). Mirrors the §6.2 append shape: term adoption, the
+        quirk-d foreign demote+reset, install iff req.snap_index >
+        p.last_index (log window emptied onto the snapshot; ring slot
+        CONTENTS untouched — stale bits stay bit-comparable across
+        engines), the quirk-e commit advance, then the leader response:
+        always success — next_index := snap_index + 1, match_index :=
+        snap_index, with the quirk-a commit tally."""
+        req_si = req_si.astype(_I32)
+        req_st = req_st.astype(_I32)
+        req_dg = req_dg.astype(_I32)
+        p_term = col("term", p)
+        if p != l:
+            adopt = act & (req_term > p_term)
+            setcol("term", p, adopt, req_term)
+            setcol("voted_for", p, adopt, -1)
+            setcol("role", p, act, FOLLOWER)  # quirk-d mirror
+            reset_el_timer_col(p, adopt)
+            reset_el_timer_col(p, act)
+        p_li = col("last_index", p)
+        do_inst = act & (req_si > p_li.astype(_I32))
+        setcol("snap_index", p, do_inst, req_si)
+        setcol("snap_term", p, do_inst, req_st)
+        setcol("snap_digest", p, do_inst, req_dg)
+        setcol("last_index", p, do_inst, req_si)
+        setcol("phys_len", p, do_inst, req_si)
+        setcol("commit", p, do_inst, req_si)
+        setcol("last_term", p, do_inst, req_st)  # empty window: snap_term
+        # quirk-e-flavor commit advance rides the message's leaderCommit.
+        p_li2 = col("last_index", p)
+        p_commit = col("commit", p)
+        cadv = act & (req_commit > p_commit)
+        setcol("commit", p, cadv, jnp.minimum(req_commit, p_li2))
+        resp_term = col("term", p)
+        if p != l:
+            l_term = col("term", l)
+            demote = act & (resp_term > l_term)
+            setcol("term", l, demote, resp_term)
+            setcol("role", l, demote, FOLLOWER)
+            reset_el_timer_col(l, demote)
+        else:
+            demote = jnp.zeros((G,), dtype=_I32) > 0
+        proc = act & ~demote
+        ni = prow("next_index", l, p)
+        set_prow("next_index", l, p,
+                 jnp.where(proc, (req_si + 1).astype(ni.dtype), ni))
+        mi = prow("match_index", l, p)
+        set_prow("match_index", l, p,
+                 jnp.where(proc, req_si.astype(mi.dtype), mi))
+        # quirk-a tally on the "pretend" post-update rows (see
+        # append_exchange's commit note — identical discipline).
+        l_commit = col("commit", l)
+        m_maj = _kth_largest(
+            [prow("match_index", l, q) if q != p
+             else jnp.where(proc, req_si.astype(mi.dtype), mi)
+             for q in range(1, N + 1)], maj)
+        setcol("commit", l, proc & (m_maj > l_commit), l_commit + 1)
+
     def append_deliver(l, p, p_plt=None, due=None):
         # §10 delivery: response leg at the delivery tick; either-end failure voids
         # the exchange. No straggler guard — append responses always process
@@ -1208,9 +1443,22 @@ def _phase_lattice(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
                ("aq_term", "aq_commit", "aq_pli", "aq_plt",
                 "aq_hase", "aq_ent_t", "aq_ent_c")}
         put_pair("aq_due", l, p, due, jnp.full((G,), -1, dtype=s["aq_due"].dtype))
-        append_exchange(l, p, att, req["aq_term"], req["aq_commit"],
-                        req["aq_pli"], req["aq_plt"], req["aq_hase"] != 0,
-                        req["aq_ent_t"], req["aq_ent_c"], p_plt=p_plt)
+        if compact:
+            # §15: slots with aq_hase == 2 are InstallSnapshot messages
+            # (snap_index/snap_term/digest riding the pli/plt/ent_t seats).
+            is_inst = req["aq_hase"] == 2
+            append_exchange(l, p, att & ~is_inst, req["aq_term"],
+                            req["aq_commit"], req["aq_pli"], req["aq_plt"],
+                            req["aq_hase"] == 1, req["aq_ent_t"],
+                            req["aq_ent_c"], p_plt=p_plt)
+            install_exchange(l, p, att & is_inst, req["aq_term"],
+                             req["aq_pli"], req["aq_plt"],
+                             req["aq_ent_t"], req["aq_commit"])
+        else:
+            append_exchange(l, p, att, req["aq_term"], req["aq_commit"],
+                            req["aq_pli"], req["aq_plt"],
+                            req["aq_hase"] != 0,
+                            req["aq_ent_t"], req["aq_ent_c"], p_plt=p_plt)
 
     if use_columnar:
         enter_cols()  # phase 5 runs on the columnar view
@@ -1223,8 +1471,12 @@ def _phase_lattice(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
                  for l in range(1, N + 1) for p in range(1, N + 1)}
 
     if batched_logs:
-        def bounded(idx, v):
-            # log_gather's out-of-[0, C) => 0 convention for a raw take.
+        def bounded(idx, v, n=None):
+            # log_gather's out-of-[0, C) => 0 convention for a raw take;
+            # §15 (compact, with the owning node supplied): the node's
+            # live-window test instead (same translate-or-latch map).
+            if compact and n is not None:
+                return jnp.where(_win_ok(n, idx), v, 0)
             return jnp.where((idx >= 0) & (idx < C), v, 0)
 
         def inr(r):
@@ -1475,18 +1727,22 @@ def _phase_lattice(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
             # and are DROPPED (mode="drop"), and duplicate real rows are
             # pre-resolved to the last write's value.
             T_LLT, T_GHOST = 3 * N, 3 * N + 1
+            # §15 (compact): takes address RING SLOTS; the parallel bpos_t
+            # POSITION lists feed the tick-end ghost overlay's equality
+            # tests (two distinct positions can share a ring slot, so slot
+            # equality is not position equality there).
+            rslot = ((lambda x: ring(jnp.maximum(x.astype(_I32), 0)))
+                     if compact else (lambda x: jnp.clip(x, 0, C - 1)))
+            bpos_t = {}
             for n in range(1, N + 1):
-                brows_t[n] = (
-                    [jnp.clip(i_all[(n, q)] - 2, 0, C - 1)
-                     for q in range(1, N + 1)]
-                    + [jnp.clip(i_all[(n, q)] - 1, 0, C - 1)
-                       for q in range(1, N + 1)]
-                    + [jnp.clip(i_all[(l, n)] - 2, 0, C - 1)
-                       for l in range(1, N + 1)]
-                    + [jnp.clip(col("last_index", n) - 1, 0, C - 1)]
-                    + [jnp.clip(i_all[(l, n)] - 1, 0, C - 1)
-                       for l in range(1, N + 1)]
+                bpos_t[n] = (
+                    [i_all[(n, q)] - 2 for q in range(1, N + 1)]
+                    + [i_all[(n, q)] - 1 for q in range(1, N + 1)]
+                    + [i_all[(l, n)] - 2 for l in range(1, N + 1)]
+                    + [col("last_index", n) - 1]
+                    + [i_all[(l, n)] - 1 for l in range(1, N + 1)]
                 )
+                brows_t[n] = [rslot(x) for x in bpos_t[n]]
                 brows_c[n] = brows_t[n][N:2 * N]
             Rt, Rc = 4 * N + 1, N
         from raft_kotlin_tpu.ops import deep_gather
@@ -1590,6 +1846,13 @@ def _phase_lattice(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
             # rule). ~(pli < li) is pli >= li — one compare, not compare+not
             # (last_index is the deep input here).
             skip = (pli >= 0) & (pli >= li_l)
+            if compact:
+                # §15 InstallSnapshot send condition: the peer's frontier
+                # fell at/below l's snapshot base — the append path cannot
+                # serve it (the entries are folded). b_l >= 1 keeps the
+                # base-0 case on the historical quirk-i path.
+                b_l = col("snap_index", l).astype(_I32)
+                inst = fire & (i.astype(_I32) <= b_l) & (b_l >= 1)
             if use_fc:
                 # Frontier-cache consume: the cached values ARE the rows
                 # the old prefetch would have taken (ops/deep_cache.py);
@@ -1632,10 +1895,15 @@ def _phase_lattice(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
                     bounded(pli, patch("log_term", l, r_pli, v_pli)), -1)
             elif batched_logs:
                 raw_plt = bounded(pli, patch(
-                    "log_term", l, brows_t[l][p - 1], bvals_t[l][p - 1]))
+                    "log_term", l, brows_t[l][p - 1], bvals_t[l][p - 1]), l)
+                if compact:
+                    # §15 boundary: prevLog at l's own base-1 is snap_term.
+                    raw_plt = jnp.where(pli.astype(_I32) == b_l - 1,
+                                        col("snap_term", l).astype(_I32),
+                                        raw_plt)
                 plt = jnp.where(pli >= 0, raw_plt, -1)
             else:
-                plt = jnp.where(pli >= 0, log_gather("log_term", l, pli), -1)
+                plt = jnp.where(pli >= 0, log_term_b(l, pli), -1)
             has_entry = li_l >= i
             skip = skip | (has_entry & (i <= 0))  # quirk i underflow
             if use_fc:
@@ -1674,26 +1942,58 @@ def _phase_lattice(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
                 ent_c = bounded(i - 1, patch("log_cmd", l, r_ec, v_ec))
             elif batched_logs:
                 ent_t = bounded(i - 1, patch(
-                    "log_term", l, brows_t[l][N + p - 1], bvals_t[l][N + p - 1]))
+                    "log_term", l, brows_t[l][N + p - 1],
+                    bvals_t[l][N + p - 1]), l)
                 ent_c = bounded(i - 1, patch(
-                    "log_cmd", l, brows_c[l][p - 1], bvals_c[l][p - 1]))
+                    "log_cmd", l, brows_c[l][p - 1], bvals_c[l][p - 1]), l)
                 p_plt_b = bounded(pli, patch(
                     "log_term", p, brows_t[p][2 * N + l - 1],
-                    bvals_t[p][2 * N + l - 1]))
+                    bvals_t[p][2 * N + l - 1]), p)
             else:
                 ent_t, ent_c = log_gather_tc(l, i - 1)
             if flags.delay:
                 # request leg at send tick; skip (the deep input) joins last
                 att = (fire & edge_ok(l, p)) & ~skip
-                put_pair("aq_term", l, p, att, col("term", l))
-                put_pair("aq_commit", l, p, att, col("commit", l))
-                put_pair("aq_pli", l, p, att, pli)
-                put_pair("aq_plt", l, p, att, plt)
-                put_pair("aq_hase", l, p, att,
-                         has_entry.astype(prow("aq_hase", l, p).dtype))
-                put_pair("aq_ent_t", l, p, att, ent_t)
-                put_pair("aq_ent_c", l, p, att, ent_c)
-                put_pair("aq_due", l, p, att, delay_for(l, p))
+                if compact:
+                    # §15: install sends ride the SAME slot, discriminated
+                    # by aq_hase == 2; the snapshot triple occupies the
+                    # pli/plt/ent_t seats. Lanes taking the install path
+                    # are excluded from the append send (disjoint masks,
+                    # one merged put per field).
+                    att_i = inst & edge_ok(l, p)
+                    att = att & ~inst
+                    a_any = att | att_i
+                    h_dt = prow("aq_hase", l, p).dtype
+                    put_pair("aq_term", l, p, a_any, col("term", l))
+                    put_pair("aq_commit", l, p, a_any, col("commit", l))
+                    put_pair("aq_pli", l, p, a_any,
+                             jnp.where(att_i, b_l,
+                                       pli.astype(_I32)).astype(
+                                           prow("aq_pli", l, p).dtype))
+                    put_pair("aq_plt", l, p, a_any,
+                             jnp.where(att_i,
+                                       col("snap_term", l).astype(_I32),
+                                       plt))
+                    put_pair("aq_hase", l, p, a_any,
+                             jnp.where(att_i, jnp.asarray(2, h_dt),
+                                       has_entry.astype(h_dt)))
+                    put_pair("aq_ent_t", l, p, a_any,
+                             jnp.where(att_i,
+                                       col("snap_digest", l).astype(_I32),
+                                       ent_t))
+                    put_pair("aq_ent_c", l, p, a_any,
+                             jnp.where(att_i, 0, ent_c))
+                    put_pair("aq_due", l, p, a_any, delay_for(l, p))
+                else:
+                    put_pair("aq_term", l, p, att, col("term", l))
+                    put_pair("aq_commit", l, p, att, col("commit", l))
+                    put_pair("aq_pli", l, p, att, pli)
+                    put_pair("aq_plt", l, p, att, plt)
+                    put_pair("aq_hase", l, p, att,
+                             has_entry.astype(prow("aq_hase", l, p).dtype))
+                    put_pair("aq_ent_t", l, p, att, ent_t)
+                    put_pair("aq_ent_c", l, p, att, ent_c)
+                    put_pair("aq_due", l, p, att, delay_for(l, p))
                 if cfg.delay_lo == 0:
                     append_deliver(l, p)  # τ=0: same-iteration delivery
             else:
@@ -1701,9 +2001,23 @@ def _phase_lattice(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
                 # skip chain and join it in one op.
                 skip = skip | ~(edge_ok(l, p) & edge_ok(p, l))
                 act5 = fire & ~skip
-                append_exchange(l, p, act5, col("term", l), col("commit", l),
-                                pli, plt, has_entry, ent_t, ent_c,
-                                p_plt=p_plt_b if batched_logs else None)
+                if compact:
+                    both_edges = edge_ok(l, p) & edge_ok(p, l)
+                    act5 = act5 & ~inst
+                    append_exchange(l, p, act5, col("term", l),
+                                    col("commit", l), pli, plt, has_entry,
+                                    ent_t, ent_c,
+                                    p_plt=p_plt_b if batched_logs else None)
+                    install_exchange(l, p, inst & both_edges,
+                                     col("term", l), b_l,
+                                     col("snap_term", l),
+                                     col("snap_digest", l),
+                                     col("commit", l))
+                else:
+                    append_exchange(l, p, act5, col("term", l),
+                                    col("commit", l), pli, plt, has_entry,
+                                    ent_t, ent_c,
+                                    p_plt=p_plt_b if batched_logs else None)
 
     if use_columnar:
         exit_cols()
@@ -1824,15 +2138,32 @@ def _phase_lattice(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
             # prefetch-time base (li unchanged) plus the ghost rows (li moved
             # by an append; see the batch-row comment). This tick's writes
             # overlay LAST via patch() — a ghost row that was also written
-            # this tick must report the written value.
-            row = jnp.clip(li_f - 1, 0, C - 1)
-            raw = bvals_t[n][T_LLT]
-            for j in range(T_GHOST, T_GHOST + N):
-                raw = jnp.where(brows_t[n][j] == row, bvals_t[n][j], raw)
-            raw = patch("log_term", n, row, raw)
+            # this tick must report the written value. §15 (compact): the
+            # overlay matches on POSITIONS (bpos_t) — ring-slot equality is
+            # not position equality — and a fully folded window (li ==
+            # base, e.g. right after an install) reads snap_term.
+            if compact:
+                pos_lt = li_f.astype(_I32) - 1
+                row = ring(jnp.maximum(pos_lt, 0))
+                raw = bvals_t[n][T_LLT]
+                for j in range(T_GHOST, T_GHOST + N):
+                    raw = jnp.where(bpos_t[n][j].astype(_I32) == pos_lt,
+                                    bvals_t[n][j], raw)
+                raw = patch("log_term", n, row, raw)
+                raw = jnp.where(
+                    li_f.astype(_I32) == s["snap_index"][n - 1].astype(_I32),
+                    s["snap_term"][n - 1].astype(_I32), raw)
+            else:
+                row = jnp.clip(li_f - 1, 0, C - 1)
+                raw = bvals_t[n][T_LLT]
+                for j in range(T_GHOST, T_GHOST + N):
+                    raw = jnp.where(brows_t[n][j] == row, bvals_t[n][j], raw)
+                raw = patch("log_term", n, row, raw)
             v = jnp.where(li_f >= 1, raw, 0)
         else:
-            v = log_gather("log_term", n, li_f - 1)
+            v = log_term_b(n, li_f - 1)
+            if compact:
+                v = jnp.where(li_f >= 1, v, 0)
         s["last_term"] = _set_row(s["last_term"], n - 1, v)
 
     if use_slices and not batched_logs:
@@ -1841,6 +2172,48 @@ def _phase_lattice(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
         # land in the flat arrays directly via the merged scatter above.)
         s["log_term"] = jnp.concatenate(lt, axis=0)
         s["log_cmd"] = jnp.concatenate(lc, axis=0)
+
+    # -- phase C: §15 snapshot fold (compaction) ----------------------------
+    # End of tick, on the FINAL log: every live node whose unfolded
+    # committed backlog commit - snap_index has reached the watermark folds
+    # up to compact_chunk oldest committed entries into its snapshot
+    # (digest' = digest * DIGEST_MULT + cmd, wrapping i32; snap_term = the
+    # last folded entry's term) and advances snap_index — which IS the ring
+    # base, so the window slides with no data movement. The chunk bound
+    # keeps the fold a fixed-shape vector op; steady state keeps ~watermark
+    # committed entries unfolded (the laggard-catch-up retention margin).
+    if compact:
+        for n in range(1, N + 1):
+            cm_f = s["commit"][n - 1].astype(_I32)
+            si_f = s["snap_index"][n - 1].astype(_I32)
+            avail = cm_f - si_f
+            due_f = (s["up"][n - 1] != 0) & (avail >= W_cmp)
+            cnt_f = jnp.where(due_f, jnp.minimum(avail, CH_cmp), 0)
+            dg_f = s["snap_digest"][n - 1].astype(_I32)
+            st_f = s["snap_term"][n - 1].astype(_I32)
+            lt_f = s["log_term"][(n - 1) * C:n * C]
+            lc_f = s["log_cmd"][(n - 1) * C:n * C]
+            if flags.dyn_log:
+                rows_f = jnp.stack([ring(si_f + j) for j in range(CH_cmp)])
+                tvs = jnp.take_along_axis(lt_f, rows_f, axis=0).astype(_I32)
+                cvs = jnp.take_along_axis(lc_f, rows_f, axis=0).astype(_I32)
+            for j in range(CH_cmp):
+                active = due_f & (jnp.asarray(j, _I32) < cnt_f)
+                if flags.dyn_log:
+                    tv_j, cv_j = tvs[j], cvs[j]
+                else:
+                    oh_j = logrow_c == ring(si_f + j)[None, :]
+                    tv_j = jnp.sum(jnp.where(oh_j, lt_f, 0),
+                                   axis=0).astype(_I32)
+                    cv_j = jnp.sum(jnp.where(oh_j, lc_f, 0),
+                                   axis=0).astype(_I32)
+                dg_f = jnp.where(
+                    active, dg_f * jnp.asarray(DIGEST_MULT, _I32) + cv_j,
+                    dg_f)
+                st_f = jnp.where(active, tv_j, st_f)
+            s["snap_index"] = _set_row(s["snap_index"], n - 1, si_f + cnt_f)
+            s["snap_term"] = _set_row(s["snap_term"], n - 1, st_f)
+            s["snap_digest"] = _set_row(s["snap_digest"], n - 1, dg_f)
 
     if use_fc:
         # Restack the frontier cache + the per-lane overflow flag into the
@@ -1891,9 +2264,14 @@ def make_flags(cfg: RaftConfig, inject_present: bool = False,
         # Mailbox configs take the batched engines only in the
         # known-delivery regime (delay_lo >= 1 — see BodyFlags.batched);
         # τ=0 stays per-pair on every path, even when `batched` pins True.
+        # §15 compaction under the mailbox also pins per-pair: an install
+        # delivery JUMPS next_index, breaking the batched engine's static
+        # row-window invariant (BodyFlags.compact).
         batched=dyn and (not cfg.uses_mailbox or cfg.known_delivery)
+        and not (cfg.uses_mailbox and cfg.uses_compaction)
         and batched is not False,
         sharded=dyn and sharded,
+        compact=cfg.uses_compaction,
     )
 
 
@@ -1951,12 +2329,17 @@ def make_aux(cfg: RaftConfig, base, tkeys, bkeys, state: RaftState,
     aux["edge_iid"] = edge.transpose(1, 2, 0).reshape(N * N, G) \
         .astype(jnp.int16)
     if flags.faults:
-        crash_m = rngmod.event_mask(
+        crash_c = rngmod.event_mask(
             base, rngmod.KIND_CRASH, t, (G, N), cfg.p_crash,
-            thresh=scen.get("crash_t")).T
-        restart_m = rngmod.event_mask(
+            thresh=scen.get("crash_t"))
+        restart_c = rngmod.event_mask(
             base, rngmod.KIND_RESTART, t, (G, N), cfg.p_restart,
-            thresh=scen.get("restart_t")).T
+            thresh=scen.get("restart_t"))
+        # §15 warmup-down: deterministic hold/rejoin on the canonical
+        # orientation BEFORE the kernel transpose (no draws consumed).
+        crash_c, restart_c = rngmod.apply_warmup_faults(
+            cfg.scenario, cfg.cmd_node, t, crash_c, restart_c)
+        crash_m, restart_m = crash_c.T, restart_c.T
         if fault_cmd is not None:
             crash_m = crash_m | (fault_cmd.T == 1)
             restart_m = restart_m | (fault_cmd.T == 2)
@@ -1987,7 +2370,8 @@ def flatten_state(cfg: RaftConfig, state: RaftState) -> dict:
     """RaftState -> the rank-2 dict phase_body operates on (free reshapes).
     §10 mailbox fields are included iff present on the state (cfg.uses_mailbox)."""
     N, C, G = cfg.n_nodes, cfg.log_capacity, cfg.n_groups
-    fields = STATE_FIELDS + (MAILBOX_FIELDS if cfg.uses_mailbox else ())
+    fields = (STATE_FIELDS + (MAILBOX_FIELDS if cfg.uses_mailbox else ())
+              + (SNAPSHOT_FIELDS if cfg.uses_compaction else ()))
     s = {}
     for k in fields:
         v = getattr(state, k)

@@ -63,7 +63,7 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
 CACHE_PATH = os.path.join(REPO_ROOT, ".autotune_cache.json")
 
 PLAN_FIELDS = ("engine", "ilp_subtiles", "fused_ticks", "layout",
-               "sharding", "tile")
+               "sharding", "tile", "compaction")
 REGIMES = ("shallow", "deep")
 DEEP_ENGINES = ("fc", "batched", "flat")
 LAYOUTS = ("wide", "packed")
@@ -196,10 +196,11 @@ def default_plan(key: dict) -> dict:
     """The conservative always-correct plan (resolution path 5)."""
     if key["regime"] == "deep":
         return {"engine": "flat", "ilp_subtiles": 1, "fused_ticks": 1,
-                "layout": "wide", "sharding": "shard_map", "tile": None}
+                "layout": "wide", "sharding": "shard_map", "tile": None,
+                "compaction": "off"}
     return {"engine": "pallas", "ilp_subtiles": 1, "fused_ticks": 1,
             "layout": "wide", "sharding": "shard_map",
-            "tile": key["lanes"]}
+            "tile": key["lanes"], "compaction": "off"}
 
 
 def apply_guards(key: dict, plan: dict) -> dict:
@@ -223,6 +224,10 @@ def apply_guards(key: dict, plan: dict) -> dict:
     """
     plan = dict(plan)
     plan.setdefault("layout", "wide")
+    # r15 migration contract: rows/caches predating the §15 compaction
+    # dimension normalize to "off" (plan_for overrides from the config —
+    # compaction is a CONFIG property, never a tunable).
+    plan.setdefault("compaction", "off")
     if key["platform"] == "cpu":
         if key["regime"] == "deep":
             plan["engine"] = "flat"
@@ -431,12 +436,36 @@ def plan_for(cfg, mesh=None, platform: Optional[str] = None,
                 with_source=True)
         plan = dict(plan)
         plan["sharding"] = "shard_map" if mesh is not None else "single"
+        if cfg.uses_compaction:
+            # §15 compaction dimension (r15): a config property, stamped
+            # onto the plan. The fc engine has no ring-map support (its
+            # frontier cache predates §15 — ops/deep_cache.py), and the
+            # mailbox regime pins per-pair (the install jump breaks the
+            # known-delivery batched row window — BodyFlags.compact), so
+            # the routed engine degrades conservatively. The
+            # no-compaction path is untouched — pinned bit-identical.
+            plan["compaction"] = "ring"
+            if cfg.uses_mailbox:
+                plan["engine"] = "flat"
+            elif plan["engine"] == "fc":
+                plan["engine"] = "batched"
         return (plan, source) if with_source else plan
     # Shallow: pallas when the tile model fits on an accelerator, else xla.
     interpret = pclass == "cpu"
     engine = "xla"
     tile = None
     k, T = 1, 1
+    if cfg.uses_compaction:
+        # §15 shallow compaction routes XLA for now: the ring translate
+        # (lax.rem) inside the Mosaic megakernel is CPU-interpret-proven
+        # (tests/test_compaction.py pins pallas == xla) but has no
+        # hardware artifact yet — route conservatively until a BENCH
+        # round pins it (same discipline as every unmeasured dimension).
+        plan = {"engine": "xla", "ilp_subtiles": 1, "fused_ticks": 1,
+                "layout": "wide", "compaction": "ring",
+                "sharding": "spmd" if mesh is not None else "single",
+                "tile": None}
+        return (plan, "guard") if with_source else plan
     if not interpret:
         from raft_kotlin_tpu.ops.pallas_tick import (
             _snapshot_rows, fused_snapshot_fields, resolve_fused_geometry)
@@ -460,7 +489,7 @@ def plan_for(cfg, mesh=None, platform: Optional[str] = None,
                                         with_source=True)
         layout = row_plan.get("layout", "wide")
     plan = {"engine": engine, "ilp_subtiles": int(k), "fused_ticks": int(T),
-            "layout": layout,
+            "layout": layout, "compaction": "off",
             "sharding": ("shard_map" if engine == "pallas" else "spmd")
             if mesh is not None else "single", "tile": tile}
     return (plan, source) if with_source else plan

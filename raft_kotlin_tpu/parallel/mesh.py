@@ -263,7 +263,8 @@ def _field_ndims() -> dict:
     construction."""
     shapes = jax.eval_shape(
         lambda: init_state(
-            RaftConfig(n_groups=1, n_nodes=2, log_capacity=2, mailbox=True))
+            RaftConfig(n_groups=1, n_nodes=2, log_capacity=2, mailbox=True,
+                       compact_watermark=1))
     )
     return {f.name: getattr(shapes, f.name).ndim for f in dataclasses.fields(RaftState)}
 
@@ -274,13 +275,15 @@ def state_sharding(mesh: Mesh, cfg: Optional[RaftConfig] = None) -> RaftState:
     (models/state.py); rank-0 scalars (the tick counter) replicated. §10 mailbox
     fields get shardings only when `cfg.uses_mailbox` (None otherwise, matching the
     state pytree's structure)."""
-    from raft_kotlin_tpu.models.state import MAILBOX_FIELDS
+    from raft_kotlin_tpu.models.state import MAILBOX_FIELDS, SNAPSHOT_FIELDS
 
     use_mail = cfg is not None and cfg.uses_mailbox
+    use_cmp = cfg is not None and cfg.uses_compaction
     ndims = _field_ndims()
     fields = {}
     for f in dataclasses.fields(RaftState):
-        if f.name in MAILBOX_FIELDS and not use_mail:
+        if (f.name in MAILBOX_FIELDS and not use_mail) or (
+                f.name in SNAPSHOT_FIELDS and not use_cmp):
             fields[f.name] = None
             continue
         nd = ndims[f.name]

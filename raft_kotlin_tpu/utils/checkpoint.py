@@ -40,12 +40,16 @@ from raft_kotlin_tpu.utils.config import RaftConfig, config_from_dict
 _HEADER_KEY = "__raft_config_json__"
 _EXTRA_KEY = "__raft_extra_json__"
 _VERSION_KEY = "__raft_ckpt_version__"
-_VERSION = 6  # v2: +up/+link_up fault-model fields; v3: groups-minor array layout;
+_VERSION = 7  # v2: +up/+link_up fault-model fields; v3: groups-minor array layout;
               # v4: optional §10 mailbox arrays (present iff cfg.uses_mailbox);
               # v5: +last_term lastLogTerm cache (derived from the log on load
               # of older checkpoints); v6: narrowed int16 storage for
               # structurally bounded fields (models/state.NARROW16) — loads of
-              # ANY version cast to the canonical field dtypes (_canon_dtypes)
+              # ANY version cast to the canonical field dtypes (_canon_dtypes);
+              # v7: +cap_ov capacity latch (zero-filled on older loads) and
+              # optional §15 snapshot arrays (present iff cfg.uses_compaction
+              # — snap_index is also the ring base, so a resume across a
+              # truncation boundary restores the whole sliding window)
 
 
 def _canon_dtypes(arrays: dict, cfg: RaftConfig) -> dict:
@@ -251,7 +255,7 @@ def load_sharded(
     with open(os.path.join(dirpath, "manifest.json")) as f:
         manifest = json.load(f)
     version = int(manifest.get("version", 0))
-    if version not in (4, 5, _VERSION):
+    if version not in (4, 5, 6, _VERSION):
         # The sharded layout first existed at v4 — fail loudly on
         # future/corrupt manifests, mirroring _load_impl's gate.
         raise ValueError(
@@ -271,6 +275,10 @@ def load_sharded(
         # shard file carries its own full (N, C, g_slice) log).
         manifest["fields"] = list(manifest["fields"]) + ["last_term"]
         manifest["shapes"]["last_term"] = manifest["shapes"]["term"]
+    if version < 7 and "cap_ov" not in manifest["fields"]:
+        # pre-§15 checkpoints: a clean latch, zero-filled per shard.
+        manifest["fields"] = list(manifest["fields"]) + ["cap_ov"]
+        manifest["shapes"]["cap_ov"] = manifest["shapes"]["term"]
 
     loaded: dict = {}
 
@@ -283,6 +291,8 @@ def load_sharded(
             if "last_term" not in d:
                 d["last_term"] = _derive_last_term(
                     d["log_term"], d["last_index"])
+            if "cap_ov" not in d:
+                d["cap_ov"] = np.zeros(d["term"].shape, dtype=np.int16)
             loaded[k] = _canon_dtypes(d, cfg)
         return loaded[k]
 
@@ -356,7 +366,7 @@ def load_sharded(
 def _load_impl(path, expect_cfg, sharding):
     with np.load(path) as z:
         version = int(z[_VERSION_KEY])
-        if version not in (1, 2, 3, 4, 5, _VERSION):
+        if version not in (1, 2, 3, 4, 5, 6, _VERSION):
             raise ValueError(
                 f"checkpoint version {version} not supported (can load 1-{_VERSION})")
         cfg_dict = json.loads(bytes(z[_HEADER_KEY].tobytes()).decode())
@@ -386,14 +396,19 @@ def _load_impl(path, expect_cfg, sharding):
     if version < 5 and "last_term" not in arrays:
         arrays["last_term"] = _derive_last_term(
             arrays["log_term"], arrays["last_index"])
+    if version < 7 and "cap_ov" not in arrays:
+        # v7 predates the §15 capacity latch: clean by assumption (pre-v7
+        # configs had no latch to record).
+        arrays["cap_ov"] = np.zeros(arrays["term"].shape, dtype=np.int16)
     cfg = config_from_dict(cfg_dict)  # rebuilds a nested ScenarioSpec too
     arrays = _canon_dtypes(arrays, cfg)
-    from raft_kotlin_tpu.models.state import MAILBOX_FIELDS
+    from raft_kotlin_tpu.models.state import MAILBOX_FIELDS, SNAPSHOT_FIELDS
 
     missing = [
         f.name for f in dataclasses.fields(RaftState)
         if f.name not in arrays
         and (f.name not in MAILBOX_FIELDS or cfg.uses_mailbox)
+        and (f.name not in SNAPSHOT_FIELDS or cfg.uses_compaction)
     ]
     if missing:
         raise ValueError(

@@ -46,6 +46,13 @@ class ScenarioSpec:
       to engines whose aux is precomputed ahead of state (the fused-T
       Pallas kernel falls back to T=1; everything else works).
 
+    `warmup_down` (§15, SEMANTICS.md) is NOT a sampled channel but a
+    deterministic schedule post-processed onto the crash/restart masks
+    (utils/rng.apply_warmup_faults — no draws consumed): every non-cmd
+    node is held crashed for t < warmup_down and rejoins at t ==
+    warmup_down, so cmd_node wins every group's first election and a
+    compaction universe stays capacity-clean at any group count.
+
     `degenerate=True` is the provable degenerate case: the bank is built
     from the config's own SCALAR fault fields (all groups identical), and
     every engine must be bit-identical to the scalar path — the farm's
@@ -63,6 +70,17 @@ class ScenarioSpec:
     partitions: tuple = ()
     part_period_lo: int = 8
     part_period_hi: int = 64
+    # §15 warmup-down (SEMANTICS.md §15): for warmup_down = W > 0, every
+    # node except cfg.cmd_node is held crashed on ticks t < W (crash
+    # asserted, random restarts suppressed) and restarted at exactly
+    # t == W. Deterministic — no draws consumed — so all engines apply
+    # the identical rule (utils/rng.apply_warmup_faults). Because quirk k
+    # routes every client command to cmd_node, this makes cmd_node win
+    # each group's first election by term + log dominance: the one
+    # universe family whose committed prefix keeps pace with the client
+    # in EVERY group, which a bounded §15 ring needs to stay
+    # capacity-clean at any group count.
+    warmup_down: int = 0
 
     def __post_init__(self):
         # Coerce to tuple so a list argument cannot build an unhashable
@@ -80,13 +98,21 @@ class ScenarioSpec:
             raise ValueError(
                 f"need 1 <= part_period_lo <= part_period_hi, got "
                 f"{self.part_period_lo}/{self.part_period_hi}")
+        if self.warmup_down < 0:
+            raise ValueError(
+                f"warmup_down must be >= 0, got {self.warmup_down}")
+        if self.warmup_down > 0 and self.degenerate:
+            raise ValueError(
+                "warmup_down is a scheduled fault program — it cannot ride "
+                "a degenerate (scalar-anchor) spec")
 
     @property
     def has_faults(self) -> bool:
-        """Whether the sampled bank carries crash/restart channels (the
-        phase-F faults flag must compile in)."""
-        return not self.degenerate and (
-            self.crash_max > 0 or self.restart_max > 0)
+        """Whether the sampled bank carries crash/restart channels or the
+        §15 warmup-down schedule (the phase-F faults flag must compile
+        in)."""
+        return self.warmup_down > 0 or (not self.degenerate and (
+            self.crash_max > 0 or self.restart_max > 0))
 
     @property
     def has_links(self) -> bool:
@@ -176,6 +202,17 @@ class RaftConfig:
     delay_hi: int = 0
     mailbox: bool = False
 
+    # §15 log compaction / snapshotting (Raft §7; SEMANTICS.md §15).
+    # compact_watermark W > 0 enables the subsystem: each tick (phase C),
+    # every live node whose unfolded committed backlog commit - snap_index
+    # reaches W folds up to compact_chunk oldest committed entries into
+    # its fixed-shape snapshot (snap_index/snap_term/snap_digest) and
+    # slides the ring window (ring base == snap_index). W = 0 (default)
+    # compiles the subsystem OUT — the pre-§15 program, bit-identical
+    # (the migration-equality contract, tests/test_compaction.py).
+    compact_watermark: int = 0
+    compact_chunk: int = 8
+
     seed: int = 0
 
     # Per-group scenario heterogeneity (the fuzzing-farm bank, SEMANTICS.md
@@ -191,6 +228,17 @@ class RaftConfig:
                 f"need 0 <= delay_lo <= delay_hi, got {self.delay_lo}/{self.delay_hi}")
         if self.log_dtype not in ("int32", "int16"):
             raise ValueError(f"log_dtype must be int32 or int16, got {self.log_dtype}")
+        if self.compact_watermark < 0:
+            raise ValueError(
+                f"compact_watermark must be >= 0, got {self.compact_watermark}")
+        if self.compact_watermark > 0:
+            if self.compact_chunk < 1:
+                raise ValueError(
+                    f"compact_chunk must be >= 1, got {self.compact_chunk}")
+            if self.compact_watermark > self.log_capacity:
+                raise ValueError(
+                    "compact_watermark must be <= log_capacity (a window "
+                    "that can never fold cannot bound the log)")
         s = self.scenario
         if s is not None and not s.degenerate:
             if s.delay_windows and not self.delay_lo < self.delay_hi:
@@ -205,6 +253,14 @@ class RaftConfig:
         """Whether exchanges route through the deliverable-at-tick mailbox
         (SEMANTICS.md §10) instead of resolving synchronously within the tick."""
         return self.mailbox or self.delay_hi > 0
+
+    @property
+    def uses_compaction(self) -> bool:
+        """Whether the §15 snapshot/compaction subsystem is compiled in:
+        snapshot state present, ring-window log addressing, InstallSnapshot
+        exchanges, the end-of-tick fold phase. False (W = 0) compiles the
+        bit-identical pre-§15 program — THE migration-equality switch."""
+        return self.compact_watermark > 0
 
     @property
     def known_delivery(self) -> bool:

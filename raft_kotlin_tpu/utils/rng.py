@@ -347,3 +347,23 @@ def scenario_link_down(scen: dict, tick, leader_gn, N: int, xp=jnp):
     down = ((k == PART_SPLIT) & split) | ((k == PART_ASYM) & asym) \
         | ((k == PART_LEADER) & ldr)
     return down & active[:, None, None] & (s_id != r_id)
+
+
+def apply_warmup_faults(spec, cmd_node: int, tick, crash, restart, xp=jnp):
+    """§15 warmup-down post-processing of the §9 crash/restart event masks
+    (canonical (G, N) orientation, 0-based tick). For warmup_down = W > 0
+    every node except cmd_node is held crashed on ticks t < W (crash
+    asserted, random restarts suppressed) and restarted at exactly
+    t == W; cmd_node and all other channels are untouched. Deterministic
+    integer/boolean arithmetic on the already-drawn masks — no draws are
+    consumed, so the RNG streams stay aligned and the XLA/Pallas kernels,
+    the Python oracle and the native engine apply the SAME rule (`xp` is
+    jnp for the kernels, np for the host-side builders)."""
+    W = 0 if spec is None else getattr(spec, "warmup_down", 0)
+    if not W:
+        return crash, restart
+    N = crash.shape[-1]
+    notcmd = (xp.arange(N) != (cmd_node - 1))[None, :]
+    hold = (tick < W) & notcmd
+    rejoin = (tick == W) & notcmd
+    return crash | hold, (restart & ~hold) | rejoin

@@ -99,6 +99,13 @@ TELEMETRY_FIELDS = (
     "mailbox_inflight_hw",
     "ov_fallbacks",
     "fault_events",
+    # §15 compaction (r15): snapshot folds, InstallSnapshot applications,
+    # and new capacity-exhaustion latches — all transition-derived, so
+    # they stay engine-independent and 0 on non-compaction configs
+    # (except cap_exhausted_events, which counts on every config).
+    "snapshots_taken",
+    "installsnap_deliveries",
+    "cap_exhausted_events",
 )
 
 # The state fields one telemetry step reads (node grids (N, G) + pair grids
@@ -106,8 +113,12 @@ TELEMETRY_FIELDS = (
 # views from exactly this list.
 TELEMETRY_STATE_FIELDS = (
     "role", "up", "rounds", "votes", "commit", "match_index", "next_index",
+    "last_index", "cap_ov",
 )
 TELEMETRY_MAILBOX_FIELDS = ("vq_due", "aq_due")
+# §15: read when present (compaction configs only) — views supply None
+# otherwise and the snapshot counters stay 0.
+TELEMETRY_COMPACT_FIELDS = ("snap_index",)
 
 
 def telemetry_zeros() -> Dict[str, jax.Array]:
@@ -168,6 +179,31 @@ def telemetry_step_arrays(prev: dict, cur: dict, tel: Dict[str, jax.Array],
     out["append_rejects"] = tel["append_rejects"] + _s(
         jnp.where(owner_reset, 0, jnp.maximum(-d_ni, 0)))
     out["fault_events"] = tel["fault_events"] + _s(prev_up != cur_up)
+    # §15 capacity latch events: nodes whose cap_ov latched THIS tick.
+    if cur.get("cap_ov") is not None:
+        out["cap_exhausted_events"] = tel["cap_exhausted_events"] + _s(
+            (cur["cap_ov"] != 0) & ~(prev["cap_ov"] != 0))
+    # §15 snapshot counters, from snap_index transitions: a FOLD advances
+    # snap_index while staying within the pre-tick readable log
+    # (snap' <= li_prev) — EXCEPT the quirk-a case where commit outran the
+    # node's own last_index and an aggressive fold pushes the base past li
+    # (tick.py log_add's absorb note), which leaves li' < snap'. An INSTALL
+    # jumps snap past everything the node had AND re-seats last_index at
+    # the new base (li' >= snap' always — a post-install fold can't fire,
+    # avail == 0), so the li' >= snap' test separates the two. A phase-F
+    # restart wipes snap/log to 0 BEFORE this tick's deliveries land
+    # (quirk l), so restarted nodes classify against the wiped baseline —
+    # the same restart floor the vote/frontier deltas above use.
+    if cur.get("snap_index") is not None:
+        si_c = cur["snap_index"].astype(_I32)
+        si_p = jnp.where(restarted, 0, prev["snap_index"].astype(_I32))
+        li_p = jnp.where(restarted, 0, prev["last_index"].astype(_I32))
+        adv = si_c > si_p
+        inst = (adv & (si_c > li_p)
+                & (si_c <= cur["last_index"].astype(_I32)))
+        out["snapshots_taken"] = tel["snapshots_taken"] + _s(adv & ~inst)
+        out["installsnap_deliveries"] = (tel["installsnap_deliveries"]
+                                         + _s(inst))
     if cur.get("vq_due") is not None:
         inflight = _s(cur["vq_due"] >= 0) + _s(cur["aq_due"] >= 0)
         out["mailbox_inflight_hw"] = jnp.maximum(
@@ -179,9 +215,10 @@ def telemetry_step_arrays(prev: dict, cur: dict, tel: Dict[str, jax.Array],
 
 def state_view(state) -> dict:
     """The telemetry view of a RaftState (shared by every RaftState-carrying
-    runner). Mailbox due slots included when present on the state."""
+    runner). Mailbox due slots / §15 snapshot fields included when present
+    on the state."""
     v = {k: getattr(state, k) for k in TELEMETRY_STATE_FIELDS}
-    for k in TELEMETRY_MAILBOX_FIELDS:
+    for k in TELEMETRY_MAILBOX_FIELDS + TELEMETRY_COMPACT_FIELDS:
         v[k] = getattr(state, k, None)
     return v
 
@@ -208,6 +245,8 @@ def flat_view(flat: dict, n_nodes: int) -> dict:
     for k in TELEMETRY_MAILBOX_FIELDS:
         a = flat.get(k)
         v[k] = a.reshape(N, N, -1) if a is not None else None
+    for k in TELEMETRY_COMPACT_FIELDS:
+        v[k] = flat.get(k)
     return v
 
 
@@ -307,6 +346,15 @@ INVARIANT_IDS = (
     "leader_completeness",
     "commit_monotonic",
     "committed_prefix",
+    # 6 (§15, compaction configs only — structurally clean otherwise):
+    # two nodes with EQUAL nonzero snap_index folded the same committed
+    # prefix, so their (snap_term, snap_digest) must be bit-equal. The
+    # entry-wise checks (2/3/5) stop at the snapshot boundary; this is
+    # the check that extends Log Matching / State Machine Safety ACROSS
+    # the truncation boundary. Gates: taint_restart, taint_unsafe, the
+    # stale-append hazard window, and any capacity-latched group (a
+    # clipped log legitimately folds §3 stale-slot content).
+    "snapshot_consistency",
 )
 N_INVARIANTS = len(INVARIANT_IDS)
 
@@ -322,9 +370,14 @@ _RING_BIG = jnp.iinfo(jnp.int32).max
 
 # State fields one monitor step reads (canonical shapes: node grids (N, G),
 # logs (N, C, G); plus TELEMETRY_MAILBOX_FIELDS when the config runs §10).
-# hb_armed feeds the stale-append hazard window (see invariant_matrix).
+# hb_armed feeds the stale-append hazard window (see invariant_matrix);
+# cap_ov gates snapshot_consistency on capacity-clipped groups.
 MONITOR_STATE_FIELDS = ("role", "up", "term", "commit", "last_index",
-                        "phys_len", "hb_armed", "log_term", "log_cmd")
+                        "phys_len", "hb_armed", "log_term", "log_cmd",
+                        "cap_ov")
+# §15 snapshot fields: read when present (compaction configs) — the
+# position-based ring addressing and invariant 6 switch on their presence.
+MONITOR_COMPACT_FIELDS = ("snap_index", "snap_term", "snap_digest")
 
 
 def monitor_ring_stride(n_ticks: int, windows: int = MONITOR_WINDOWS) -> int:
@@ -413,6 +466,22 @@ def invariant_matrix(prev: dict, cur: dict, taint_restart: jax.Array,
     cm_p = prev["commit"].astype(_I32)
     cm_c = cur["commit"].astype(_I32)
 
+    # §15 ring addressing (compaction configs — snap_index present): slot
+    # s of a node with base b stores the unique position p ≡ s (mod C)
+    # inside the live window [b, b + C). Entry-wise checks then compare
+    # POSITIONS (two nodes' same slot holds the same position only where
+    # their windows overlap), and the folded prefix below max(bases) is
+    # covered by invariant 6 (snapshot_consistency) instead.
+    si_c = cur.get("snap_index")
+    compacted = si_c is not None
+    if compacted:
+        b_c = si_c.astype(_I32)
+        b_p = prev["snap_index"].astype(_I32)
+        st_c = cur["snap_term"].astype(_I32)
+
+        def pos_of(b_n):
+            return b_n[None] + jnp.remainder(slot - b_n[None], C)
+
     # Taints, updated before the gated checks (see docstring). The restart
     # taint is sticky for the run; the unsafe-commit taint follows the
     # paper's §5.4.2 rule exactly: a quirk-a commit whose TOP newly
@@ -426,8 +495,15 @@ def invariant_matrix(prev: dict, cur: dict, taint_restart: jax.Array,
     unsafe = jnp.zeros((G,), dtype=bool)
     justify = jnp.zeros((G,), dtype=bool)
     for n in range(N):
-        top = jnp.sum(jnp.where(slot == cm_c[n][None] - 1,
-                                lt_c[n], 0), axis=0).astype(_I32)
+        if compacted:
+            top = jnp.sum(jnp.where(pos_of(b_c[n]) == cm_c[n][None] - 1,
+                                    lt_c[n], 0), axis=0).astype(_I32)
+            # Fully folded committed prefix: the top committed entry IS
+            # the snapshot boundary — its term is snap_term.
+            top = jnp.where(cm_c[n] == b_c[n], st_c[n], top)
+        else:
+            top = jnp.sum(jnp.where(slot == cm_c[n][None] - 1,
+                                    lt_c[n], 0), axis=0).astype(_I32)
         top_cur = top == term[n]
         unsafe = unsafe | (adv[n] & ~top_cur)
         justify = justify | (adv[n] & top_cur)
@@ -469,7 +545,13 @@ def invariant_matrix(prev: dict, cur: dict, taint_restart: jax.Array,
     cont = lead & lead_p & (term == term_p)
     v1 = jnp.zeros((G,), dtype=bool)
     for n in range(N):
-        keep = slot < jnp.minimum(li_p[n], li_c[n])[None]
+        if compacted:
+            # Compare per POSITION: a slot whose position changed between
+            # ticks was recycled by the sliding window, not rewritten.
+            pc, pp = pos_of(b_c[n]), pos_of(b_p[n])
+            keep = (pc == pp) & (pc < jnp.minimum(li_p[n], li_c[n])[None])
+        else:
+            keep = slot < jnp.minimum(li_p[n], li_c[n])[None]
         changed = jnp.any(
             keep & ((lt_p[n] != lt_c[n]) | (lc_p[n] != lc_c[n])), axis=0)
         v1 = v1 | (cont[n] & changed)
@@ -490,15 +572,52 @@ def invariant_matrix(prev: dict, cur: dict, taint_restart: jax.Array,
         for b in range(a + 1, N):
             mism = (lt_c[a] != lt_c[b]) | (lc_c[a] != lc_c[b])   # (C, G)
             both = jnp.minimum(li_c[a], li_c[b])[None]
-            valid = slot < both
-            # Inclusive prefix-mismatch: an entry with matching terms at i
-            # demands identical entries at ALL j <= i (cmd included).
-            bad_pref = jnp.cumsum((mism & valid).astype(_I32), axis=0) > 0
+            if compacted:
+                # Comparable slots: the position is in BOTH live windows
+                # (pa == pb ⇔ the position lies in the window overlap
+                # [max(bases), min(bases) + C)).
+                pa, pb = pos_of(b_c[a]), pos_of(b_c[b])
+                shared = pa == pb
+                valid = shared & (pa < both)
+                # Position-ordered inclusive prefix over the RING: the
+                # overlap starts at position lo = max(bases) = ring slot
+                # lo mod C, so a position interval [lo, p] is the slot
+                # interval [lo mod C, p mod C] — possibly WRAPPED. One
+                # slot-order cumsum + the wrap algebra recovers the
+                # position-ordered prefix counts.
+                lo = jnp.maximum(b_c[a], b_c[b])       # (G,)
+                cs = jnp.cumsum((mism & valid).astype(_I32), axis=0)
+                lmod = jnp.remainder(lo, C)            # (G,)
+                s_lm1 = jnp.where(
+                    lmod > 0,
+                    jnp.take_along_axis(
+                        cs, jnp.clip(lmod - 1, 0, C - 1)[None],
+                        axis=0)[0],
+                    0)
+                pref = jnp.where(slot >= lmod[None], cs - s_lm1[None],
+                                 cs[C - 1][None] - s_lm1[None] + cs)
+                bad_pref = pref > 0
+            else:
+                valid = slot < both
+                # Inclusive prefix-mismatch: an entry with matching terms
+                # at i demands identical entries at ALL j <= i (cmd
+                # included).
+                bad_pref = jnp.cumsum((mism & valid).astype(_I32),
+                                      axis=0) > 0
             v2 = v2 | (pristine[a] & pristine[b] & jnp.any(
                 valid & (lt_c[a] == lt_c[b]) & bad_pref, axis=0))
             for l, n in ((a, b), (b, a)):
                 lim = jnp.minimum(rc[n], li_c[l])[None]
-                diff = jnp.any(mism & (slot < lim), axis=0)
+                if compacted:
+                    # Entry-wise containment only over the window overlap;
+                    # the follower's committed prefix below the leader's
+                    # base is folded on the leader — covered by invariant
+                    # 6, not comparable entry-wise (and not a violation).
+                    pl_, pn_ = (pa, pb) if l == a else (pb, pa)
+                    diff = jnp.any(mism & (pl_ == pn_) & (pl_ < lim),
+                                   axis=0)
+                else:
+                    diff = jnp.any(mism & (slot < lim), axis=0)
                 v3 = v3 | (lead[l] & pristine[l] & pristine[n]
                            & ~restarted[n]
                            & ((rc[n] > li_c[l]) | diff))
@@ -521,15 +640,42 @@ def invariant_matrix(prev: dict, cur: dict, taint_restart: jax.Array,
     # is untainted; quirk-a old-term commits set taint_unsafe first).
     v5 = jnp.zeros((G,), dtype=bool)
     for n in range(N):
-        keep = slot < jnp.minimum(cm_p[n], li_p[n])[None]
+        if compacted:
+            # Position-based content form (see v1): slots the sliding
+            # window recycled this tick carry NEW positions — the old
+            # position's content is in the snapshot digest (invariant 6).
+            pc, pp = pos_of(b_c[n]), pos_of(b_p[n])
+            keep = (pc == pp) & (pp < jnp.minimum(cm_p[n], li_p[n])[None])
+        else:
+            keep = slot < jnp.minimum(cm_p[n], li_p[n])[None]
         changed = jnp.any(
             keep & ((lt_p[n] != lt_c[n]) | (lc_p[n] != lc_c[n])), axis=0)
         v5 = v5 | (~restarted[n] & changed)
     v5 = v5 & ~taint_restart & ~taint_unsafe & ~hazard
 
+    # 6 — snapshot consistency (§15, compaction only): equal nonzero
+    # snap_index ⇒ identical (snap_term, snap_digest) — the cross-node
+    # durability check that survives the truncation boundary. Gated like
+    # 3/5, plus capacity-latched groups (a §3 clip makes later folds read
+    # stale ring content — canonical garbage, deterministic per engine
+    # but not cross-node comparable).
+    v6 = jnp.zeros((G,), dtype=bool)
+    if compacted:
+        dg_c = cur["snap_digest"].astype(_I32)
+        cap = cur.get("cap_ov")
+        cap_any = (jnp.any(cap != 0, axis=0) if cap is not None
+                   else jnp.zeros((G,), dtype=bool))
+        for a in range(N):
+            for b in range(a + 1, N):
+                eq = (b_c[a] == b_c[b]) & (b_c[a] > 0)
+                v6 = v6 | (eq & ((st_c[a] != st_c[b])
+                                 | (dg_c[a] != dg_c[b])))
+        v6 = (v6 & ~taint_restart & ~taint_unsafe & ~hazard & ~cap_any)
+
     V = jnp.stack([
         v0.astype(_I32), v1.astype(_I32), v2.astype(_I32),
-        v3.astype(_I32), v4.astype(_I32), v5.astype(_I32)]) != 0
+        v3.astype(_I32), v4.astype(_I32), v5.astype(_I32),
+        v6.astype(_I32)]) != 0
     return V, taint_restart, taint_unsafe
 
 
@@ -609,10 +755,10 @@ def monitor_step_arrays(prev: dict, cur: dict, mon: Dict[str, jax.Array]
 def monitor_view(state) -> dict:
     """The monitor view of a RaftState (every RaftState-carrying runner).
     `rounds` rides opportunistically — only the per-group stress counters
-    (PER_GROUP_KEYS) read it."""
+    (PER_GROUP_KEYS) read it. §15 snapshot fields ride when present."""
     v = {k: getattr(state, k) for k in MONITOR_STATE_FIELDS}
     v["rounds"] = getattr(state, "rounds", None)
-    for k in TELEMETRY_MAILBOX_FIELDS:
+    for k in TELEMETRY_MAILBOX_FIELDS + MONITOR_COMPACT_FIELDS:
         v[k] = getattr(state, k, None)
     return v
 
@@ -630,6 +776,8 @@ def monitor_flat_view(flat: dict, n_nodes: int) -> dict:
     for k in TELEMETRY_MAILBOX_FIELDS:
         a = flat.get(k)
         v[k] = a.reshape(N, N, -1) if a is not None else None
+    for k in MONITOR_COMPACT_FIELDS:
+        v[k] = flat.get(k)
     return v
 
 

@@ -72,6 +72,26 @@ def main() -> int:
                     "windows when LO < HI")
     ap.add_argument("--stress", type=int, default=10,
                     help="pacing compression factor (RaftConfig.stressed)")
+    ap.add_argument("--compact", type=int, nargs=2, default=None,
+                    metavar=("WATERMARK", "CHUNK"),
+                    help="enable §15 log compaction (snapshot fold + "
+                    "InstallSnapshot; unbounded-lifetime runs on the "
+                    "bounded log window)")
+    ap.add_argument("--soak", type=int, default=0, metavar="TICKS",
+                    help="standing-soak mode (§15): run TICKS monitored "
+                    "ticks under checkpoint rotation instead of the "
+                    "batch campaign — requires --compact; exit 0 only on "
+                    "a clean verdict with an empty capacity latch")
+    ap.add_argument("--soak-segment", type=int, default=0,
+                    help="ticks per soak segment (0 = 2x log_capacity)")
+    ap.add_argument("--warmup", type=int, default=0, metavar="TICKS",
+                    help="§15 warmup-down: hold every non-cmd node "
+                    "crashed for t < TICKS and rejoin at t == TICKS, so "
+                    "cmd_node wins every group's first election (quirk k "
+                    "sends all client commands there) — the universe "
+                    "family whose committed prefix keeps pace in every "
+                    "group, which a standing --soak needs to stay "
+                    "capacity-clean")
     ap.add_argument("--out", default=None, help="JSONL corpus path")
     ap.add_argument("--json", action="store_true",
                     help="print the full summary as JSON")
@@ -97,12 +117,15 @@ def main() -> int:
         drop_max=args.drop_max, crash_max=args.crash_max,
         restart_max=args.restart_max, link_fail_max=args.link_fail_max,
         link_heal_max=args.link_heal_max,
-        delay_windows=delay_lo < delay_hi, partitions=parts)
+        delay_windows=delay_lo < delay_hi, partitions=parts,
+        warmup_down=args.warmup)
     batch = args.batch or args.universes
+    cw, cc = args.compact if args.compact else (0, 8)
     cfg = RaftConfig(
         n_groups=batch, n_nodes=args.nodes,
         log_capacity=args.log_capacity, cmd_period=args.cmd_period,
         delay_lo=delay_lo, delay_hi=delay_hi, seed=args.seed,
+        compact_watermark=cw, compact_chunk=cc,
         scenario=spec).stressed(args.stress)
 
     mesh = None
@@ -110,6 +133,29 @@ def main() -> int:
         from raft_kotlin_tpu.parallel.mesh import make_mesh
 
         mesh = make_mesh()
+
+    if args.soak:
+        # §15 standing-soak service: what compaction turns the farm into —
+        # without truncation every universe died at log_capacity; with it
+        # a batch runs forever under checkpoint rotation.
+        if not cfg.uses_compaction:
+            print("--soak requires --compact (the soak outlives "
+                  "log_capacity by design)", file=sys.stderr)
+            return 2
+        res = fuzz.soak_run(cfg, args.soak,
+                            segment=args.soak_segment or None,
+                            verbose=not args.json, mesh=mesh)
+        if args.json:
+            print(json.dumps(res, sort_keys=True))
+        else:
+            print(f"soak {res['ticks']} ticks / {res['segments']} segments"
+                  f" inv={res['inv_status']}"
+                  f" window_hw={res['window_hw']}/{args.log_capacity}"
+                  f" snap_index=[{res['snap_index_min']},"
+                  f" {res['snap_index_max']}]"
+                  f" cap_exhausted_groups={res['cap_exhausted_groups']}")
+        return 0 if (res["inv_status"] == "clean"
+                     and res["cap_exhausted_groups"] == 0) else 1
 
     res = fuzz.fuzz_farm(cfg, args.ticks, universes=args.universes,
                          batch_groups=batch, out_path=args.out,

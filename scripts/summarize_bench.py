@@ -88,6 +88,11 @@ INV_LEGS = (
     ("fuzz_inv_status", "fuzz inv", "suspect"),
     # r13 (ISSUE 10): the monitored pod run's Figure-3 verdict.
     ("pod_inv_status", "pod inv", "suspect"),
+    # r15 (ISSUE 12): the §15 bounded-window compaction leg — the
+    # monitor verdict ACROSS truncation boundaries (4x log_capacity
+    # ticks; a latch here means the ring window or InstallSnapshot
+    # broke a Figure-3 property the classical legs can't reach).
+    ("compaction_inv_status", "compaction inv", "suspect"),
 )
 
 # Boolean audit fields (r13): pod_dryrun marks the virtual-device
@@ -165,7 +170,12 @@ def load_record(path: str) -> Optional[dict]:
                   # the bytes/tick trajectory rows + the packed-encoding
                   # regression gate (check_bytes).
                   "bytes_per_tick", "bytes_per_tick_packed",
-                  "packed_vs_wide"):
+                  "packed_vs_wide",
+                  # r15 (ISSUE 12): the HBM-bound trajectory — the
+                  # config-5 deep shape's GB with its log bounded to the
+                  # compaction window (lower is better; the unbounded
+                  # figure stays published as deeplog_hbm_gb).
+                  "compaction_deeplog_hbm_gb"):
         v = parsed.get(field)
         if not isinstance(v, (int, float)):
             v = _extract_field(tail, field)
@@ -327,8 +337,13 @@ def main(argv=None) -> int:
         print("".join(row))
     # r14 (ISSUE 11): bytes/tick trajectory rows (lower is better —
     # concrete-pytree accounting of the routed and packed layouts).
+    # r15 (ISSUE 12): the HBM-bound row — config-5 deep GB at the
+    # bounded compaction window (vs the unbounded 7.49 deeplog_hbm_gb;
+    # with §15 the window bounds bytes while lifetime is unbounded).
     for field, label in (("bytes_per_tick", "bytes/tick"),
-                         ("bytes_per_tick_packed", "bytes/tick packed")):
+                         ("bytes_per_tick_packed", "bytes/tick packed"),
+                         ("compaction_deeplog_hbm_gb",
+                          "compact deep GB")):
         if not any(field in r.get("aux_num", {}) for r in recs):
             continue
         row = [label.ljust(18)]

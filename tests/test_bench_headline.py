@@ -55,6 +55,10 @@ def _fake_record():
         "bytes_per_tick": 153_395_216,
         "bytes_per_tick_packed": 153_395_216,
         "packed_vs_wide": 2.36,
+        "compaction_inv_status": "clean",
+        "snapshots_taken": 24_812,
+        "installsnap_deliveries": 312,
+        "compaction_deeplog_hbm_gb": 0.94,
         "suspect": False,
         # plus the long tail of fields that overflowed the driver window
         **{f"filler_{i}": [0.1234] * 8 for i in range(80)},
@@ -127,14 +131,23 @@ def test_compact_headline_is_last_line_and_complete():
     for k in ("layout", "bytes_per_tick", "bytes_per_tick_packed",
               "packed_vs_wide"):
         assert k in bench.COMPACT_EXTRA_FIELDS, k
+    # The r15 additions (ISSUE 12): the §15 compaction leg's Figure-3
+    # verdict, the snapshot/install counters and the bounded-window
+    # deep-log HBM figure — summarize_bench's compaction safety row and
+    # HBM-bound trajectory row, and the round's acceptance gate
+    # (flat window, clean verdict, cap census 0) read them from the
+    # authoritative tail.
+    for k in ("compaction_inv_status", "snapshots_taken",
+              "installsnap_deliveries", "compaction_deeplog_hbm_gb"):
+        assert k in bench.COMPACT_EXTRA_FIELDS, k
     for k in bench.COMPACT_EXTRA_FIELDS:
         assert k in last, k
         assert last[k] == record[k], k
     # Small enough that the driver's tail window always captures it whole
-    # (the r13 pod/plan fields grew the line; a violation status is ~30
-    # chars longer per leg than "clean", so keep generous headroom under
-    # the multi-KB driver window).
-    assert len(lines[-1]) < 1200, lines[-1]
+    # (the r15 compaction fields grew the line past the old 1200 bound; a
+    # violation status is ~30 chars longer per leg than "clean", so keep
+    # generous headroom under the multi-KB driver window).
+    assert len(lines[-1]) < 1500, lines[-1]
 
 
 def test_compact_headline_handles_missing_fields():

@@ -536,7 +536,9 @@ def test_monitor_scalars_and_status_helpers():
     viol = dict(sc, inv_latch_tick=12, inv_latch_group=7,
                 inv_latch_inv=INVARIANT_IDS.index("log_matching"))
     assert status_from_scalars(viol) == "log_matching@t12/g7"
-    assert len(INVARIANT_IDS) == N_INVARIANTS == 6
+    # 7 ids since r15: snapshot_consistency (§15) joined the Figure-3 six.
+    assert len(INVARIANT_IDS) == N_INVARIANTS == 7
+    assert INVARIANT_IDS[-1] == "snapshot_consistency"
 
 
 def test_figure3_host_path_shares_monitor_definitions():
