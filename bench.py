@@ -287,7 +287,16 @@ COMPACT_EXTRA_FIELDS = ("deeplog_parity_rate", "deeplog_ov_fallback",
                         # authoritative tail.
                         "compaction_inv_status", "snapshots_taken",
                         "installsnap_deliveries",
-                        "compaction_deeplog_hbm_gb")
+                        "compaction_deeplog_hbm_gb",
+                        # r16 (ISSUE 14): the §16 physical ring window —
+                        # the bounded-ring round's bit-equality verdict +
+                        # Figure-3 status, the ring residency figures, and
+                        # the deep-shape ring byte model — summarize_bench's
+                        # ring trajectory row and the ring-residency
+                        # regression gate read these from the tail.
+                        "compaction_ring_capacity", "compaction_ring_equal",
+                        "compaction_ring_inv_status",
+                        "deeplog_ring_capacity", "deeplog_ring_hbm_gb")
 
 # Flight-recorder counters published verbatim from the headline run's
 # median rep (stats tel_* keys — utils/telemetry.TELEMETRY_FIELDS).
@@ -886,22 +895,31 @@ def parity_stage(cfg, groups, ticks, impl):
     return float(np.mean(ok)), int(groups), impl, tri
 
 
-def fc_parity_stage(cfg, groups, ticks):
+def fc_parity_stage(cfg, groups, ticks, sharded=True):
     """Deep parity with the HEADLINE engine itself (VERDICT r5 next-round
-    #6): the sharded frontier-cache runner in trace mode over a 1-device
-    mesh vs the native C++ engine — closing the transitive chain the old
-    plain-engine parity leg left open (deeplog_parity_impl used to report
-    "xla" while the headline came from shardmap-fcache). Auto-triages on
-    mismatch like parity_stage."""
+    #6): the frontier-cache runner in trace mode vs the native C++ engine
+    — closing the transitive chain the old plain-engine parity leg left
+    open (deeplog_parity_impl used to report "xla" while the headline came
+    from shardmap-fcache). `sharded=False` runs the SINGLE-DEVICE fc
+    runner instead (ADVICE r5 #3: the CPU headline is "xla-fcache", and
+    its parity leg must exercise that same engine, not the shard_map
+    wrapper). Auto-triages on mismatch like parity_stage."""
     from raft_kotlin_tpu.models.state import init_state
     from raft_kotlin_tpu.native.oracle import NativeOracle, trace_parity
-    from raft_kotlin_tpu.ops.deep_cache import make_sharded_deep_scan
+    from raft_kotlin_tpu.ops.deep_cache import (
+        make_deep_scan, make_sharded_deep_scan)
     from raft_kotlin_tpu.ops.tick import make_rng
     from raft_kotlin_tpu.parallel.mesh import make_mesh
 
     pcfg = dataclasses.replace(cfg, n_groups=groups)
-    mesh = make_mesh(jax.devices()[:1])
-    run = make_sharded_deep_scan(pcfg, mesh, ticks, engine="fc", trace=True)
+    if sharded:
+        mesh = make_mesh(jax.devices()[:1])
+        run = make_sharded_deep_scan(pcfg, mesh, ticks, engine="fc",
+                                     trace=True)
+        impl = "shardmap-fcache"
+    else:
+        run = make_deep_scan(pcfg, ticks, trace=True)
+        impl = "xla-fcache"
     ktr, ov = run(init_state(pcfg), make_rng(pcfg))
     ntr = NativeOracle(pcfg).run(ticks)
     ok, first = trace_parity(ktr, ntr)
@@ -909,7 +927,7 @@ def fc_parity_stage(cfg, groups, ticks):
     if first:
         print(f"fc parity: {first}", file=sys.stderr)
         tri = _auto_triage(pcfg, ktr, ntr)
-    impl = "shardmap-fcache" + ("-ovfb" if ov else "")
+    impl = impl + ("-ovfb" if ov else "")
     return float(np.mean(ok)), int(groups), impl, tri
 
 
@@ -1234,11 +1252,15 @@ def main() -> None:
                 dpar_groups = int(os.environ.get(
                     "RAFT_BENCH_DEEP_PARITY_GROUPS",
                     256 if on_accel else 64))
-                if deep_impl.startswith("shardmap-fcache"):
+                if "fcache" in deep_impl:
+                    # ANY *-fcache headline (sharded or the single-device
+                    # CPU "xla-fcache") routes to an fc parity leg of the
+                    # SAME engine form — ADVICE r5 #3 closed.
                     try:
                         (deep_parity_rate, deep_parity_n, deep_parity_impl,
                          deep_parity_triage) = fc_parity_stage(
-                            deep_cfg, dpar_groups, deep_ticks)
+                            deep_cfg, dpar_groups, deep_ticks,
+                            sharded=deep_impl.startswith("shardmap"))
                     except Exception as e:
                         # e.g. the parity group count breaks the scatter
                         # kernel's tile model at a shape the headline never
@@ -1560,6 +1582,8 @@ def main() -> None:
     compaction_inv_status = None
     compaction_stats = {}
     compaction_hbm_gb = None
+    deeplog_ring_capacity = None
+    deeplog_ring_hbm_gb = None
     cmp_cfg = None
     try:
         from raft_kotlin_tpu.models.state import init_state
@@ -1616,6 +1640,55 @@ def main() -> None:
             "RAFT_BENCH_COMPACTION_DEEP_WINDOW", 1024))
         compaction_hbm_gb = round(dataclasses.replace(
             deep_cfg, log_capacity=cmp_window).hbm_bytes() / 1e9, 2)
+
+        # §16 ring round: the SAME compaction config on a bounded physical
+        # ring (ring_capacity ≪ C). Bit-equality of every (N, G) seat with
+        # the full-window round above is the in-artifact proof that the
+        # ring is pure storage, and the zero capacity-latch census that
+        # the window held at this warmup config.
+        # Default 56: the measured window high-water at this config is ~45
+        # (warmup backlog dominates; seeds/group counts vary it by a few),
+        # so 56 holds it with headroom while staying < C=64 — the latch
+        # census (must be 0) is the in-artifact proof the window held.
+        cmp_ring = int(os.environ.get("RAFT_BENCH_COMPACTION_RING", 56))
+        rcfg = dataclasses.replace(cmp_cfg, ring_capacity=cmp_ring)
+        with trace_span("bench/compaction-ring"):
+            rend, _, _rtel, rmon = make_run(
+                rcfg, cmp_ticks, trace=False, telemetry=True,
+                monitor=True,
+                batched=None if on_accel else False)(init_state(rcfg))
+        rsc = {k: int(v) for k, v in monitor_scalars(rmon).items()}
+        rhost = jax.device_get(
+            {"si": rend.snap_index, "pl": rend.phys_len,
+             "cap": rend.cap_ov})
+        ring_equal = all(bool(np.array_equal(
+            np.asarray(jax.device_get(getattr(cend, f))),
+            np.asarray(jax.device_get(getattr(rend, f)))))
+            for f in ("term", "voted_for", "role", "commit", "last_index",
+                      "last_term", "rounds", "snap_index", "snap_term",
+                      "snap_digest", "phys_len", "cap_ov"))
+        compaction_stats.update({
+            "compaction_ring_capacity": cmp_ring,
+            "compaction_ring_window_hw": int(
+                (np.asarray(rhost["pl"]).astype(np.int64)
+                 - np.asarray(rhost["si"]).astype(np.int64)).max()),
+            "compaction_ring_cap_groups": int(np.sum(np.any(
+                np.asarray(rhost["cap"]) != 0, axis=0))),
+            "compaction_ring_equal": bool(ring_equal),
+            "compaction_ring_inv_status": _auto_inv_triage(
+                rcfg, status_from_scalars(rsc), rsc),
+        })
+        # The §16 headline accounting figure: the config-5 deep shape
+        # resident on a ring window — unbounded i32 positions (compaction
+        # widens them; the byte model is honest about it), log planes at
+        # C_phys. vs deeplog_hbm_gb this is the "same logical capacity,
+        # >=10x fewer bytes" trajectory row.
+        ring_window = int(os.environ.get("RAFT_BENCH_DEEP_RING_WINDOW",
+                                         512))
+        deeplog_ring_capacity = ring_window
+        deeplog_ring_hbm_gb = round(dataclasses.replace(
+            deep_cfg, compact_watermark=8, compact_chunk=8,
+            ring_capacity=ring_window).hbm_bytes() / 1e9, 2)
     except Exception as e:
         print(f"compaction leg failed: {str(e)[:300]}", file=sys.stderr)
 
@@ -1822,6 +1895,11 @@ def main() -> None:
         "compaction_inv_status": compaction_inv_status,
         **compaction_stats,
         "compaction_deeplog_hbm_gb": compaction_hbm_gb,
+        # §16 ring window (ISSUE 14): the deep shape's resident physical
+        # window and its byte model — read against deeplog_hbm_gb for the
+        # >=10x residency claim (summarize_bench's ring trajectory row).
+        "deeplog_ring_capacity": deeplog_ring_capacity,
+        "deeplog_ring_hbm_gb": deeplog_ring_hbm_gb,
         # Pod scale-out leg (ISSUE 10): per-pod throughput next to the
         # per-chip headline, the per-chip scaling efficiency vs an
         # identically-measured 1-device mesh, sharded parity (pod run ≡

@@ -37,6 +37,11 @@ struct Dims {
   int32_t mailbox;             // nonzero: route exchanges through the §10 mailbox
   int32_t compact_watermark;   // §15 log compaction: 0 = off (abi v4)
   int32_t compact_chunk;       // §15 max entries folded per node per tick
+  int32_t ring_capacity;       // §16 physical ring window (abi v5): rows
+                               // actually allocated per (group, node) log
+                               // plane; 0 = same as C. Only meaningful
+                               // under compaction — logical positions are
+                               // unbounded and translate mod this.
 };
 
 // All per-(group,node) state, flattened C-order. Caller-owned, mutated in place.
@@ -104,14 +109,21 @@ struct Group {
   uint8_t* nn(uint8_t* base, int a, int b) const {
     return base + ((g * d.N + (a - 1)) * d.N + (b - 1));
   }
+  // §16: physical rows per (group, node) log plane — ring_capacity when
+  // set (compaction only), else C. The slot stride, the ring translate
+  // and the capacity clip all address THIS window; logical positions
+  // stay unbounded.
+  int32_t phys() const {
+    return (d.ring_capacity > 0) ? d.ring_capacity : d.C;
+  }
   int32_t* slot(int32_t* base, int n, int i) const {
-    return base + ((g * d.N + (n - 1)) * d.C + i);
+    return base + ((g * d.N + (n - 1)) * phys() + i);
   }
 
-  // -- Log semantics (SEMANTICS.md §3 + §15 ring window) -------------------
+  // -- Log semantics (SEMANTICS.md §3 + §15/§16 ring window) ---------------
   bool compact() const { return d.compact_watermark > 0; }
   int32_t base(int n) const { return compact() ? *f(s.snap_index, n) : 0; }
-  int32_t rslot(int32_t p) const { return compact() ? (p % d.C) : p; }
+  int32_t rslot(int32_t p) const { return compact() ? (p % phys()) : p; }
   bool log_valid(int n, int32_t i) const {
     return base(n) <= i && i < *f(s.last_index, n);
   }
@@ -131,7 +143,7 @@ struct Group {
     int32_t b = base(n);
     if (compact() && 0 <= i && i < b) return;  // §15 absorb (folded)
     if (i == li) {                    // physical append at slot phys_len
-      if (pl - b >= d.C) {            // capacity clip [canon] on the window
+      if (pl - b >= phys()) {         // capacity clip [canon] on the window
         *f(s.cap_ov, n) |= 1;         // §15 capacity-exhaustion latch
         return;
       }
@@ -737,7 +749,10 @@ int raft_run(const Dims* dims, State* state, const Inputs* inputs, Trace* trace)
   return 0;
 }
 
-int raft_abi_version() { return 4; }  // v4: §15 log compaction (Dims.compact_*,
+int raft_abi_version() { return 5; }  // v5: §16 Dims.ring_capacity — physical
+                                      // ring window decoupled from logical
+                                      // capacity (0 = same as C).
+                                      // v4: §15 log compaction (Dims.compact_*,
                                       // State.snap_*/cap_ov, InstallSnapshot
                                       // via aq_hase == 2, ring log window).
                                       // v3: Inputs.leader_iso (§12 scenario

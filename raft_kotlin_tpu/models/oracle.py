@@ -161,7 +161,7 @@ class OracleNode:
         self.voted_for = -1
         self.role = FOLLOWER
         self.commit = 0
-        self.log = (RingLog(cfg.log_capacity) if cfg.uses_compaction
+        self.log = (RingLog(cfg.phys_capacity) if cfg.uses_compaction
                     else OracleLog(cfg.log_capacity))
         # §15 snapshot state (compaction configs; == kernel snap_* fields).
         self.snap_index = 0
@@ -264,7 +264,7 @@ class OracleNode:
         self.voted_for = -1
         self.role = FOLLOWER
         self.commit = 0
-        self.log = (RingLog(self.cfg.log_capacity)
+        self.log = (RingLog(self.cfg.phys_capacity)
                     if self.cfg.uses_compaction
                     else OracleLog(self.cfg.log_capacity))
         self.snap_index = 0

@@ -214,7 +214,9 @@ def assert_narrow_bounds(cfg: RaftConfig) -> None:
 
 
 def init_state(cfg: RaftConfig) -> RaftState:
-    G, N, C = cfg.n_groups, cfg.n_nodes, cfg.log_capacity
+    # Log planes allocate PHYSICAL rows (§16): ring_capacity when set,
+    # log_capacity otherwise. Position-valued fields stay logical.
+    G, N, C = cfg.n_groups, cfg.n_nodes, cfg.phys_capacity
     assert_narrow_bounds(cfg)
     zi = lambda *s: jnp.zeros(s, dtype=jnp.int32)
     z16 = lambda *s: jnp.zeros(s, dtype=jnp.int16)

@@ -39,7 +39,7 @@ class _Dims(ct.Structure):
         "G", "N", "C", "hb_ticks", "round_ticks", "retry_ticks", "majority",
         "cmd_period", "cmd_node", "t0", "T", "Kt", "Kb",
         "delay_lo", "delay_hi", "mailbox",
-        "compact_watermark", "compact_chunk")]
+        "compact_watermark", "compact_chunk", "ring_capacity")]
 
 
 _STATE_FIELDS_I32 = (
@@ -153,7 +153,7 @@ def _lib() -> ct.CDLL:
             ct.POINTER(_Dims), ct.POINTER(_State), ct.POINTER(_Inputs),
             ct.POINTER(_Trace),
         ]
-        assert lib.raft_abi_version() == 4
+        assert lib.raft_abi_version() == 5
         _lib_handle = lib
     return _lib_handle
 
@@ -358,6 +358,7 @@ class NativeOracle:
                 mailbox=1 if cfg.uses_mailbox else 0,
                 compact_watermark=cfg.compact_watermark,
                 compact_chunk=cfg.compact_chunk,
+                ring_capacity=cfg.ring_capacity or 0,
             )
             state = _State(**{
                 k: _ptr(self.arrays.get(k), typ) for k, typ in _STATE_ORDER

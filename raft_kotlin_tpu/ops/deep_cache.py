@@ -126,7 +126,7 @@ def refill_all(cfg, state) -> dict:
     """Populate EVERY cache entry from the current state with one flat take
     per log array (the plain engine's full row set, paid once per call
     start instead of every tick)."""
-    N, C = cfg.n_nodes, cfg.log_capacity
+    N, C = cfg.n_nodes, cfg.phys_capacity
     G = state.term.shape[-1]
     ni = state.next_index.reshape(N * N, G).astype(_I32)
     li = state.last_index.astype(_I32)
@@ -183,7 +183,7 @@ def refill_all(cfg, state) -> dict:
 
 def make_deep_scan(cfg, n_ticks: int, return_state: bool = False,
                    telemetry: bool = False, monitor: bool = False,
-                   layout: str = "wide"):
+                   trace: bool = False, layout: str = "wide"):
     """Multi-tick runner for the frontier-cached deep engine.
 
     run(state, rng[, summarize]) executes n_ticks through the fcache tick
@@ -241,7 +241,7 @@ def make_deep_scan(cfg, n_ticks: int, return_state: bool = False,
                                   el_dirty, state.tick)
         return st, fc, ov
 
-    def scan_of(tick_fn, with_fc):
+    def scan_of(tick_fn, with_fc, with_trace=False):
         def run(st, fc, rng):
             def body(carry, _):
                 s, f, acc, ova, tel, mon = carry
@@ -258,8 +258,9 @@ def make_deep_scan(cfg, n_ticks: int, return_state: bool = False,
                 if mon is not None:
                     mon = telemetry_mod.monitor_step(w, s2, mon)
                 acc = acc + jnp.sum(s2.log_cmd[:, 0, :].astype(_I32))
+                y = _trace_row(s2) if with_trace else None
                 nxt = pack_state(cfg, s2, ov=s.ov) if packed else s2
-                return (nxt, f2, acc, ova, tel, mon), None
+                return (nxt, f2, acc, ova, tel, mon), y
 
             tel0 = telemetry_mod.telemetry_zeros() if telemetry else None
             mon0 = telemetry_mod.monitor_init(cfg.n_groups, n_ticks,
@@ -267,18 +268,43 @@ def make_deep_scan(cfg, n_ticks: int, return_state: bool = False,
             st0 = pack_state(cfg, st) if packed else st
             carry0 = (st0, fc, jnp.zeros((), _I32), jnp.zeros((), bool),
                       tel0, mon0)
-            (end, _, acc, ova, tel, mon), _ = jax.lax.scan(
+            (end, _, acc, ova, tel, mon), ys = jax.lax.scan(
                 body, carry0, None, length=n_ticks)
             pov = jnp.any(end.ov != 0) if packed else jnp.zeros((), _I32)
             if packed:
                 end = unpack_state(cfg, end)
-            return end, acc, ova, tel, mon, pov
+            return end, acc, ova, tel, mon, ys, pov
         return run
 
     fc_scan = scan_of(fc_tick, True)
     plain_scan = scan_of(lambda s, rng: tick_plain(s, rng=rng), False)
 
-    def reductions(end, acc, ova, tel, mon, pov, summarize):
+    if trace:
+        # Single-device deep parity leg (ADVICE r5 #3): the "xla-fcache"
+        # HEADLINE engine itself produces the differential observable, so
+        # deeplog_parity_impl can equal deeplog_impl on the CPU path too.
+        # OV contract as everywhere: an overflow discards the fc trace and
+        # re-collects it from the plain batched engine with the SAME rng
+        # operand — the published trace is always the published bits'.
+        fc_scan_t = scan_of(fc_tick, True, with_trace=True)
+        plain_scan_t = scan_of(lambda s, rng: tick_plain(s, rng=rng),
+                               False, with_trace=True)
+        jfc_t = jax.jit(lambda s, r, f: fc_scan_t(s, f, r))
+        jplain_t = jax.jit(lambda s, r: plain_scan_t(s, None, r))
+        refill_t = jax.jit(lambda s: refill_all(cfg, s))
+
+        def run_trace(st, rng):
+            _, _, ova, _tel, _mon, ys, pov = jfc_t(st, rng, refill_t(st))
+            ov = bool(jax.device_get(ova))
+            if ov:
+                _, _, _, _tel, _mon, ys, pov = jplain_t(st, rng)
+            if packed:
+                check_packed_ov(pov)
+            return jax.device_get(ys), ov
+
+        return run_trace
+
+    def reductions(end, acc, ova, tel, mon, ys, pov, summarize):
         out = _reduction(end, acc, ova.astype(_I32), summarize, tel=tel,
                          mon=mon)
         if packed:
@@ -294,10 +320,10 @@ def make_deep_scan(cfg, n_ticks: int, return_state: bool = False,
         jplain_s = jax.jit(lambda s, r: plain_scan(s, None, r))
 
         def run_state(st, rng):
-            end, _, ova, _tel, mon, pov = jfc_s(st, rng, refill_jit(st))
+            end, _, ova, _tel, mon, _ys, pov = jfc_s(st, rng, refill_jit(st))
             ov = bool(jax.device_get(ova))
             if ov:
-                end, _, _, _tel, mon, pov = jplain_s(st, rng)
+                end, _, _, _tel, mon, _ys, pov = jplain_s(st, rng)
             if packed:
                 check_packed_ov(pov)
             if monitor:

@@ -260,7 +260,7 @@ def _phase_body_shapes(cfg, g_count, flags, cut=None):
     env var, no warning; analysis only)."""
     from raft_kotlin_tpu.ops.pallas_tick import kernel_field_dtype
 
-    N, C = cfg.n_nodes, cfg.log_capacity
+    N, C = cfg.n_nodes, cfg.phys_capacity
     if flags is None:
         flags = make_flags(cfg)
     sfields = state_fields(flags)

@@ -151,7 +151,7 @@ def fused_snapshot_fields(cfg: RaftConfig, telemetry: bool = False,
 
 def _snapshot_rows(cfg: RaftConfig, fields) -> int:
     """Model rows one tick's snapshot output set occupies (VMEM model)."""
-    N, C = cfg.n_nodes, cfg.log_capacity
+    N, C = cfg.n_nodes, cfg.phys_capacity
     pair = ("responded", "next_index", "match_index",
             "link_up") + MAILBOX_FIELDS
     r = 0
@@ -247,7 +247,7 @@ def make_pallas_core(cfg: RaftConfig, lanes: int, tile_g: int, interpret: bool,
     if fused_ticks > 1:
         return _make_fused_core(cfg, lanes, tile_g, interpret, subtiles,
                                 fused_ticks, resets_bound, tick_states)
-    N, C = cfg.n_nodes, cfg.log_capacity
+    N, C = cfg.n_nodes, cfg.phys_capacity
     assert lanes % tile_g == 0, (lanes, tile_g)
     SUB = max(1, subtiles)
     assert tile_g % SUB == 0, (tile_g, subtiles)
@@ -411,7 +411,7 @@ def _make_fused_core(cfg: RaftConfig, lanes: int, tile_g: int,
     takes [state..., aux T-slabs..., el_table (N*W, lanes), b_table
     (N*T, lanes)] and returns state fields (aliased), the overflow count,
     then T * len(snap_fields) snapshot blocks (tick-major)."""
-    N, C = cfg.n_nodes, cfg.log_capacity
+    N, C = cfg.n_nodes, cfg.phys_capacity
     assert lanes % tile_g == 0, (lanes, tile_g)
     SUB = max(1, subtiles)
     assert tile_g % SUB == 0, (tile_g, subtiles)
@@ -712,7 +712,7 @@ def make_pallas_tick(cfg: RaftConfig, tile_g: Optional[int] = None,
     draw-table overflow flag is checked when the call runs EAGERLY
     (raises RuntimeError); under an outer jit the check cannot run —
     use make_pallas_scan, whose scan-level channels always surface it."""
-    N, C, G = cfg.n_nodes, cfg.log_capacity, cfg.n_groups
+    N, C, G = cfg.n_nodes, cfg.phys_capacity, cfg.n_groups
     default_rng: list = []  # derived lazily; wrappers always pass rng explicitly
 
     if interpret is None:
@@ -860,7 +860,7 @@ def make_pallas_core_k(cfg: RaftConfig, lanes: int, tile_g: int,
     nonzero overflow as invalidating the whole launch — make_pallas_scan
     raises). `resets_bound` overrides the structural per-tick bound
     (tests shrink it to exercise the overflow path)."""
-    N, C = cfg.n_nodes, cfg.log_capacity
+    N, C = cfg.n_nodes, cfg.phys_capacity
     assert lanes % tile_g == 0, (lanes, tile_g)
     log_dt = jnp.int16 if cfg.log_dtype == "int16" else _I32
     if resets_bound is None:
@@ -1277,7 +1277,7 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
         n_launch, rem = divmod(n_ticks, T_f)
     else:
         n_launch, rem = 0, n_ticks
-    C_log = cfg.log_capacity
+    C_log = cfg.phys_capacity
 
     # Packed-carry adapters (ISSUE 11): the flat i32 kernel form <-> the
     # packed rest layout, applied once per scan step around the launch
@@ -1508,7 +1508,7 @@ def default_tile(cfg: RaftConfig, lanes: int, interpret: bool,
     _snapshot_rows): plain stored output blocks, not lattice-live
     temporaries, so they are counted at 1/5 of the model's fitted
     ~20 B/(row,lane) — i.e. at their ~4 B storage cost."""
-    N, C = cfg.n_nodes, cfg.log_capacity
+    N, C = cfg.n_nodes, cfg.phys_capacity
     K = max(1, k_per_launch)
     if interpret:
         return min(lanes, 256)
@@ -1538,6 +1538,6 @@ def default_tile(cfg: RaftConfig, lanes: int, interpret: bool,
                 "pad with pad_groups_for_pallas()")
         raise ValueError(
             f"no tile in {_TILES} dividing {lanes} lanes fits the scoped-VMEM "
-            f"budget for n_nodes={N}, log_capacity={C}; shrink the config or "
+            f"budget for n_nodes={N}, phys_capacity={C}; shrink the config or "
             "pass tile_g explicitly")
     return t

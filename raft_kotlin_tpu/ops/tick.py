@@ -260,8 +260,15 @@ def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
 def _phase_lattice(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
                    fcache: Optional[dict], cut: Optional[int], _ps):
     """phase_body's lattice (all semantics documented there); `_ps` is the
-    caller-owned profiler scope manager, closed by the caller."""
-    N, C, maj = cfg.n_nodes, cfg.log_capacity, cfg.majority
+    caller-owned profiler scope manager, closed by the caller.
+
+    C here is the PHYSICAL log window (§16 cfg.phys_capacity): the ring
+    translate, the window-validity test, the capacity clip and every
+    per-node log slice address physical rows. Logical positions
+    (last_index/commit/next_index/...) are bounded by this C only
+    without compaction; with it they are unbounded i32 and only their
+    ring image lands in [0, C)."""
+    N, C, maj = cfg.n_nodes, cfg.phys_capacity, cfg.majority
     G = s["term"].shape[-1]
     # Probe-only phase ablation (scripts/probe_phase_cuts.py): compile the
     # lattice cut after phase k — output bits are then MEANINGLESS; used
@@ -2369,7 +2376,7 @@ def make_aux(cfg: RaftConfig, base, tkeys, bkeys, state: RaftState,
 def flatten_state(cfg: RaftConfig, state: RaftState) -> dict:
     """RaftState -> the rank-2 dict phase_body operates on (free reshapes).
     §10 mailbox fields are included iff present on the state (cfg.uses_mailbox)."""
-    N, C, G = cfg.n_nodes, cfg.log_capacity, cfg.n_groups
+    N, C, G = cfg.n_nodes, cfg.phys_capacity, cfg.n_groups
     fields = (STATE_FIELDS + (MAILBOX_FIELDS if cfg.uses_mailbox else ())
               + (SNAPSHOT_FIELDS if cfg.uses_compaction else ()))
     s = {}
@@ -2387,7 +2394,7 @@ def flatten_state(cfg: RaftConfig, state: RaftState) -> dict:
 
 def unflatten_state(cfg: RaftConfig, s: dict) -> dict:
     """Inverse of flatten_state (still a dict; add the tick scalar to build RaftState)."""
-    N, C, G = cfg.n_nodes, cfg.log_capacity, cfg.n_groups
+    N, C, G = cfg.n_nodes, cfg.phys_capacity, cfg.n_groups
     out = dict(s)
     for k in _PAIR_FIELDS:
         if k not in out:

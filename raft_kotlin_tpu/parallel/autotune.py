@@ -118,8 +118,8 @@ def _key_order(key: dict) -> tuple:
     numeric shape) — NOT the json-string order, which would sort
     lanes=1024 before lanes=128."""
     return (0 if key["regime"] == "shallow" else 1, key["capacity"],
-            key["lanes"], bool(key["mailbox"]), key["dtype"],
-            key["platform"])
+            key.get("ring", 0), key["lanes"], bool(key["mailbox"]),
+            key["dtype"], key["platform"])
 
 
 def format_rows(entries) -> tuple:
@@ -179,10 +179,19 @@ def platform_class(platform: Optional[str]) -> str:
 
 
 def deep_key(capacity: int, lanes: int, mailbox: bool = False,
-             dtype: str = "int16", platform: Optional[str] = None) -> dict:
-    return {"regime": "deep", "capacity": int(capacity), "lanes": int(lanes),
-            "dtype": dtype, "mailbox": bool(mailbox),
-            "platform": platform_class(platform)}
+             dtype: str = "int16", platform: Optional[str] = None,
+             ring: int = 0) -> dict:
+    """`ring` is the §16 physical window (cfg.ring_capacity); it joins the
+    key ONLY when nonzero so every pre-§16 pinned row and cached entry
+    keeps its canonical bytes (the layout/compaction migration-contract
+    pattern — a ring config is a distinct perf class, never a silent
+    rewrite of an existing one)."""
+    key = {"regime": "deep", "capacity": int(capacity), "lanes": int(lanes),
+           "dtype": dtype, "mailbox": bool(mailbox),
+           "platform": platform_class(platform)}
+    if ring:
+        key["ring"] = int(ring)
+    return key
 
 
 def shallow_key(tile: int, platform: Optional[str] = None,
@@ -280,7 +289,12 @@ def _nearest(key: dict, entries) -> Optional[dict]:
     cands = [e for e in entries
              if e["key"]["regime"] == key["regime"]
              and e["key"]["mailbox"] == key["mailbox"]
-             and e["key"]["platform"] == key["platform"]]
+             and e["key"]["platform"] == key["platform"]
+             # §16: ring-windowed keys are their own perf class — a small
+             # resident window changes the engine crossover, so they never
+             # inherit a full-window neighbor (fall to default_plan = flat,
+             # the always-correct route, until measured).
+             and bool(e["key"].get("ring")) == bool(key.get("ring"))]
     if not cands:
         return None
     if key["regime"] == "shallow":
@@ -432,7 +446,8 @@ def plan_for(cfg, mesh=None, platform: Optional[str] = None,
         else:
             plan, source = resolve_plan(
                 deep_key(cfg.log_capacity, lanes, mailbox=cfg.uses_mailbox,
-                         dtype=cfg.log_dtype, platform=pclass),
+                         dtype=cfg.log_dtype, platform=pclass,
+                         ring=cfg.ring_capacity or 0),
                 with_source=True)
         plan = dict(plan)
         plan["sharding"] = "shard_map" if mesh is not None else "single"
@@ -594,15 +609,25 @@ def measure_deep_key(key: dict, n_ticks: int = 10, reps: int = 2) -> tuple:
     from raft_kotlin_tpu.utils.config import RaftConfig
 
     bench = _bench()
+    ring = int(key.get("ring", 0))
     cfg = RaftConfig(
         n_groups=key["lanes"], n_nodes=7, log_capacity=key["capacity"],
         log_dtype=key["dtype"], cmd_period=2, p_drop=0.05, seed=3,
+        # §16 ring keys measure under compaction (ring_capacity is only
+        # valid there); watermark/chunk scale with the window so the fold
+        # keeps the backlog inside it at this drop rate.
+        compact_watermark=max(ring // 2, 1) if ring else 0,
+        compact_chunk=max(ring // 4, 1) if ring else 0,
+        ring_capacity=ring or None,
     ).stressed(10)
     if key["mailbox"]:
         cfg = dc.replace(cfg, delay_lo=1, delay_hi=3)
     mesh = make_mesh(jax.devices()[:1])
     timings = {}
-    for engine in DEEP_ENGINES:
+    # fc has no ring-map support (ops/deep_cache.py refuses compaction) —
+    # measuring it at a ring key would only record a refusal.
+    engines = [e for e in DEEP_ENGINES if not (ring and e == "fc")]
+    for engine in engines:
         def gen(cfg_c, engine=engine):
             yield (lambda n: make_sharded_deep_scan(
                 cfg_c, mesh, n, engine=engine)), f"shardmap-{engine}"

@@ -501,7 +501,7 @@ def _make_shardmap_xla_tick(cfg: RaftConfig, mesh: Mesh,
         # no cache state (multi-tick fc runs live in
         # ops/deep_cache.make_sharded_deep_scan, which routes itself).
         batched = route_deep_engine(
-            cfg.log_capacity, cfg.n_groups // n_dev,
+            cfg.phys_capacity, cfg.n_groups // n_dev,
             mesh.devices.flatten()[0].platform,
             mailbox=cfg.uses_mailbox) != "flat"
     batched_arg: Optional[bool] = None if batched else False

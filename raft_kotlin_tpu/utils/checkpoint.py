@@ -40,7 +40,7 @@ from raft_kotlin_tpu.utils.config import RaftConfig, config_from_dict
 _HEADER_KEY = "__raft_config_json__"
 _EXTRA_KEY = "__raft_extra_json__"
 _VERSION_KEY = "__raft_ckpt_version__"
-_VERSION = 7  # v2: +up/+link_up fault-model fields; v3: groups-minor array layout;
+_VERSION = 8  # v2: +up/+link_up fault-model fields; v3: groups-minor array layout;
               # v4: optional §10 mailbox arrays (present iff cfg.uses_mailbox);
               # v5: +last_term lastLogTerm cache (derived from the log on load
               # of older checkpoints); v6: narrowed int16 storage for
@@ -49,7 +49,14 @@ _VERSION = 7  # v2: +up/+link_up fault-model fields; v3: groups-minor array layo
               # v7: +cap_ov capacity latch (zero-filled on older loads) and
               # optional §15 snapshot arrays (present iff cfg.uses_compaction
               # — snap_index is also the ring base, so a resume across a
-              # truncation boundary restores the whole sliding window)
+              # truncation boundary restores the whole sliding window);
+              # v8: §16 ring-window aware — the log planes are declared to be
+              # the SAVED config's physical window (slot of position p is
+              # p % phys_capacity), so a load may rebase the live logical
+              # window [snap_index, phys_len) onto a DIFFERENT ring_capacity
+              # (_resize_ring_window; expect_cfg may differ in ring_capacity
+              # only). No array format change — v7 compaction checkpoints
+              # (ring_capacity None, phys == C) resize-load the same way.
 
 
 def _canon_dtypes(arrays: dict, cfg: RaftConfig) -> dict:
@@ -73,6 +80,50 @@ def _derive_last_term(log_term, last_index):
     idx = np.clip(li - 1, 0, log_term.shape[1] - 1)
     vals = np.take_along_axis(log_term, idx[:, None, :], axis=1)[:, 0, :]
     return np.where(li >= 1, vals, 0).astype(np.int32)
+
+
+def _ring_only_mismatch(saved: RaftConfig, expect: RaftConfig) -> bool:
+    """True when expect differs from the saved config ONLY in ring_capacity
+    (§16) — the one semantics-free degree of freedom: logical positions are
+    unbounded and the ring is pure storage, so the trace is unchanged."""
+    return dataclasses.replace(saved, ring_capacity=expect.ring_capacity) == expect
+
+
+def _resize_ring_window(arrays: dict, saved: RaftConfig,
+                        target: RaftConfig) -> dict:
+    """§16 resize-on-load: rebase the stored physical ring onto the target
+    window. The stored slot of position p is p % C_phys_saved (the §15/§16
+    ring map); the target slot is p % C_phys_target. Only the live window
+    [snap_index, phys_len) transfers — every other row is dead (folded into
+    the snapshot seat or never written). Loud-fails when any node's live
+    window does not fit the target window: those rows exist nowhere else,
+    so silently dropping them would corrupt the resume."""
+    C_old, C_new = saved.phys_capacity, target.phys_capacity
+    if C_old == C_new:
+        return arrays
+    assert saved.uses_compaction  # rings differ => both configs compact
+    b = arrays["snap_index"].astype(np.int64)    # (N, G) window base
+    live = arrays["phys_len"].astype(np.int64) - b
+    hw = int(live.max()) if live.size else 0
+    if hw > C_new:
+        raise ValueError(
+            f"checkpoint live log window ({hw} rows) does not fit the "
+            f"target ring_capacity ({C_new}): resume at a window >= {hw} "
+            f"or let compaction drain the backlog before saving")
+    out = dict(arrays)
+    for name in ("log_term", "log_cmd"):
+        a = arrays[name]                         # (N, C_old, G)
+        new = np.zeros((a.shape[0], C_new, a.shape[2]), dtype=a.dtype)
+        for k in range(hw):                      # hw <= C_new, host-side
+            p = b + k                            # (N, G) logical positions
+            vals = np.take_along_axis(a, (p % C_old)[:, None, :], axis=1)
+            dst = (p % C_new)[:, None, :]
+            keep = np.take_along_axis(new, dst, axis=1)
+            np.put_along_axis(
+                new, dst, np.where((k < live)[:, None, :], vals, keep),
+                axis=1)
+        out[name] = new
+    return out
 
 
 def _normalize_wide(state, cfg: RaftConfig):
@@ -255,7 +306,7 @@ def load_sharded(
     with open(os.path.join(dirpath, "manifest.json")) as f:
         manifest = json.load(f)
     version = int(manifest.get("version", 0))
-    if version not in (4, 5, 6, _VERSION):
+    if version not in (4, 5, 6, 7, _VERSION):
         # The sharded layout first existed at v4 — fail loudly on
         # future/corrupt manifests, mirroring _load_impl's gate.
         raise ValueError(
@@ -266,9 +317,18 @@ def load_sharded(
     # the PR-8 fuzz-farm bank made scenario configs checkpointable state
     # holders, and a sharded farm resume must roundtrip them (r13).
     cfg = config_from_dict(manifest["cfg"])
+    ring_to = None
     if expect_cfg is not None and expect_cfg != cfg:
-        raise ValueError(
-            f"checkpoint config mismatch:\n saved   {cfg}\n expected {expect_cfg}")
+        if not _ring_only_mismatch(cfg, expect_cfg):
+            raise ValueError(
+                f"checkpoint config mismatch:\n saved   {cfg}\n expected {expect_cfg}")
+        # §16 resize-on-load, shard-locally: the ring rebase is per-(n, g)
+        # along the C axis, so each shard file remaps its own groups slice
+        # without gathering. The manifest's global log shapes switch to the
+        # target window so device placement sizes the new arrays.
+        ring_to = expect_cfg
+        for name in ("log_term", "log_cmd"):
+            manifest["shapes"][name][1] = expect_cfg.phys_capacity
     spans = manifest["offsets"]
     if version < 5 and "last_term" not in manifest["fields"]:
         # v4 predates the lastLogTerm cache: derive per shard on read (each
@@ -293,8 +353,16 @@ def load_sharded(
                     d["log_term"], d["last_index"])
             if "cap_ov" not in d:
                 d["cap_ov"] = np.zeros(d["term"].shape, dtype=np.int16)
-            loaded[k] = _canon_dtypes(d, cfg)
+            d = _canon_dtypes(d, cfg)
+            if ring_to is not None:
+                d = _resize_ring_window(d, cfg, ring_to)
+            loaded[k] = d
         return loaded[k]
+
+    # The resumed run IS the target config when a ring rebase happened —
+    # the returned cfg sizes its runner's arrays (shard_file keeps the
+    # saved cfg: it is the source geometry of the rebase).
+    cfg_out = ring_to if ring_to is not None else cfg
 
     if mesh is None:
         fields = {}
@@ -302,11 +370,11 @@ def load_sharded(
             parts = [shard_file(k)[name] for k in range(len(spans))]
             fields[name] = jax.device_put(
                 parts[0] if parts[0].ndim == 0 else np.concatenate(parts, axis=-1))
-        return _apply_layout(RaftState(**fields), cfg, layout), cfg
+        return _apply_layout(RaftState(**fields), cfg_out, layout), cfg_out
 
     from raft_kotlin_tpu.parallel.mesh import state_sharding
 
-    sh = state_sharding(mesh, cfg)
+    sh = state_sharding(mesh, cfg_out)
     G = cfg.n_groups
 
     def device_slice(name, lo, hi):
@@ -360,13 +428,13 @@ def load_sharded(
             singles.append(jax.device_put(device_slice(name, lo, hi), dev))
         fields[name] = jax.make_array_from_single_device_arrays(
             full_shape, target, singles)
-    return _apply_layout(RaftState(**fields), cfg, layout), cfg
+    return _apply_layout(RaftState(**fields), cfg_out, layout), cfg_out
 
 
 def _load_impl(path, expect_cfg, sharding):
     with np.load(path) as z:
         version = int(z[_VERSION_KEY])
-        if version not in (1, 2, 3, 4, 5, 6, _VERSION):
+        if version not in (1, 2, 3, 4, 5, 6, 7, _VERSION):
             raise ValueError(
                 f"checkpoint version {version} not supported (can load 1-{_VERSION})")
         cfg_dict = json.loads(bytes(z[_HEADER_KEY].tobytes()).decode())
@@ -415,9 +483,15 @@ def _load_impl(path, expect_cfg, sharding):
             f"checkpoint {path!r} is corrupt/truncated: missing arrays {missing}"
         )
     if expect_cfg is not None and expect_cfg != cfg:
-        raise ValueError(
-            f"checkpoint config mismatch:\n saved   {cfg}\n expected {expect_cfg}"
-        )
+        if not _ring_only_mismatch(cfg, expect_cfg):
+            raise ValueError(
+                f"checkpoint config mismatch:\n saved   {cfg}\n expected {expect_cfg}"
+            )
+        # §16: ring_capacity is the one tolerated difference — rebase the
+        # live window onto the requested physical ring and resume AS the
+        # requested config (the returned cfg sizes the runner's arrays).
+        arrays = _resize_ring_window(arrays, cfg, expect_cfg)
+        cfg = expect_cfg
     if sharding is not None:
         state = RaftState(
             **{

@@ -213,6 +213,19 @@ class RaftConfig:
     compact_watermark: int = 0
     compact_chunk: int = 8
 
+    # §16 physical ring window (ISSUE 14). ring_capacity C_phys < C
+    # decouples log STORAGE from logical capacity: under compaction the
+    # log arrays (and every position-indexed plane the engines derive
+    # from them) allocate (N, C_phys, G) while logical positions stay
+    # unbounded i32 and the §15 translate-or-latch map goes mod C_phys.
+    # Requires compact_watermark > 0 (without folds nothing reclaims
+    # ring rows) and C_phys >= watermark + chunk (the fold must always
+    # have room to make progress before the window fills). The existing
+    # cap_ov latch is the loud-fail when a group's backlog outruns the
+    # physical window. None (default) keeps the physical window ==
+    # log_capacity — the bit-identical r15 program.
+    ring_capacity: Optional[int] = None
+
     seed: int = 0
 
     # Per-group scenario heterogeneity (the fuzzing-farm bank, SEMANTICS.md
@@ -239,6 +252,22 @@ class RaftConfig:
                 raise ValueError(
                     "compact_watermark must be <= log_capacity (a window "
                     "that can never fold cannot bound the log)")
+        if self.ring_capacity is not None:
+            if self.compact_watermark <= 0:
+                raise ValueError(
+                    "ring_capacity needs compact_watermark > 0 — without "
+                    "folds nothing ever reclaims physical ring rows")
+            if self.ring_capacity < self.compact_watermark + self.compact_chunk:
+                raise ValueError(
+                    f"ring_capacity {self.ring_capacity} must be >= "
+                    f"compact_watermark + compact_chunk "
+                    f"({self.compact_watermark} + {self.compact_chunk}): the "
+                    "fold must fit the window it is reclaiming")
+            if self.ring_capacity > self.log_capacity:
+                raise ValueError(
+                    f"ring_capacity {self.ring_capacity} must be <= "
+                    f"log_capacity {self.log_capacity} (the physical window "
+                    "bounds storage, never extends it)")
         s = self.scenario
         if s is not None and not s.degenerate:
             if s.delay_windows and not self.delay_lo < self.delay_hi:
@@ -273,12 +302,24 @@ class RaftConfig:
         return self.uses_mailbox and self.delay_lo >= 1
 
     @property
+    def phys_capacity(self) -> int:
+        """Physical rows per (node, group) log plane — the allocation and
+        ring-translate modulus every engine uses (§16). ring_capacity when
+        set, else log_capacity: logical positions are bounded by
+        log_capacity without compaction, by nothing (i32) with it."""
+        return (self.ring_capacity if self.ring_capacity is not None
+                else self.log_capacity)
+
+    @property
     def uses_dyn_log(self) -> bool:
         """Whether the kernel uses dynamic (gather/scatter) log addressing —
         the deep-log band. THE one threshold shared by engine selection
         (ops/tick.make_aux), backend choice (ops/pallas_tick.choose_impl),
-        and sharded-run routing (parallel/mesh.make_sharded_run)."""
-        return self.log_capacity >= 256
+        and sharded-run routing (parallel/mesh.make_sharded_run). Keyed on
+        the PHYSICAL window (§16): a deep logical capacity bounded to a
+        small ring addresses few enough resident rows for the shallow
+        band's columnar one-hot forms — the ring's perf lever."""
+        return self.phys_capacity >= 256
 
     @property
     def majority(self) -> int:
@@ -289,8 +330,10 @@ class RaftConfig:
 
     def state_bytes_per_group(self) -> int:
         """Bytes of RaftState per group under this config (log dtype included).
-        The log dominates for deep-log configs: N * C * 2 arrays."""
-        N, C = self.n_nodes, self.log_capacity
+        The log dominates for deep-log configs: N * C_phys * 2 arrays —
+        physical rows, so a §16 ring window shrinks the byte model by
+        ~C / C_phys."""
+        N, C = self.n_nodes, self.phys_capacity
         itemsize = 2 if self.log_dtype == "int16" else 4
         log = N * C * 2 * itemsize
         per_node_i32 = 17 * N * 4     # (N,) int32 grids incl. counters/timers

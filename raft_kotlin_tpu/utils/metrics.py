@@ -94,7 +94,10 @@ def check_invariants(prev: RaftState, cur: RaftState, cfg: RaftConfig) -> Dict[s
                          either +=1 or adoption of a strictly higher term) — except
                          across a §9 restart, which wipes term to 0 (a node that came
                          up this tick is exempt)
-    - log_window:        0 <= last_index <= phys_len <= capacity  (SEMANTICS.md §3)
+    - log_window:        0 <= last_index <= phys_len, and the live window
+                         phys_len - snap_index fits the physical ring
+                         (snap_index taken as 0 without compaction —
+                         SEMANTICS.md §3, §15/§16)
     - role_range:        role in {F, C, L}; round_state in {IDLE, BACKOFF, ACTIVE}
     - vote_accounting:   0 <= votes <= responses <= N, and responses ==
                          count(responded) for nodes in an ACTIVE round
@@ -147,10 +150,15 @@ def check_invariants(prev: RaftState, cur: RaftState, cfg: RaftConfig) -> Dict[s
     return {
         **extra,
         "term_monotone": cnt((cur.term < prev.term) & ~restarted),
+        # §3 bound without compaction; §15/§16: positions are unbounded
+        # but the LIVE WINDOW phys_len - snap_index must fit the physical
+        # ring (the log_add capacity clip guarantees it).
         "log_window": cnt(
             (cur.last_index < 0)
             | (cur.last_index > cur.phys_len)
-            | (cur.phys_len > cfg.log_capacity)
+            | ((cur.phys_len
+                - (cur.snap_index if cfg.uses_compaction else 0))
+               > cfg.phys_capacity)
         ),
         "role_range": cnt((cur.role < 0) | (cur.role > LEADER))
         + cnt((cur.round_state < 0) | (cur.round_state > ACTIVE)),

@@ -1,0 +1,185 @@
+"""§16 physical-ring-window sweep (ISSUE 14 tentpole evidence + pinning).
+
+The deep-log engine's HBM footprint used to be priced by LOGICAL capacity:
+(N, C, G) log planes at C=10k are 7.49 GB and bound the groups-per-chip
+ceiling. With §15 compaction folding the committed prefix, the live window
+[snap_index, phys_len) stays near watermark+chunk — so §16 allocates the
+planes at ring_capacity = C_phys ≪ C and translates unbounded logical
+positions mod C_phys (utils/config.ring_capacity; SEMANTICS.md §16). This
+probe sweeps C_phys through bench.measure — the SAME timing-trap-hardened
+harness the headline uses (distinct per-rep rng operands, in-region host
+materialization, medians) — and per point emits:
+
+- gsps of the production runner (make_run impl-auto discipline: the plan
+  layer routes the engine, which is the point — a small resident window
+  crosses uses_dyn_log and makes the deep tick a candidate for the
+  shallow columnar band and its pallas/fused-T rungs);
+- the deterministic byte model (state_bytes/group, hbm_gb) at that C_phys
+  — the residency trajectory the summarize_bench ring row gates on;
+- the live-window high-water vs C_phys and the capacity-latch census — a
+  latched point is published honestly (valid=false) and can never win:
+  the latch is §16's loud-fail when the backlog outruns the window.
+
+--pin rewrites the probed shape's ring-keyed DEEP entry of the unified
+TUNING_TABLE (parallel/autotune.deep_key(ring=...) — ring keys are their
+own perf class and never collide with full-window rows). Refused on CPU:
+interpreter timings cannot pin a hardware table.
+
+  python scripts/probe_ring_window.py [groups] [ticks] [--pin]
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+jax.config.update("jax_compilation_cache_dir", os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
+def window_high_water(cfg, ticks: int):
+    """(high-water of phys_len - snap_index over `ticks`, cap_ov census) —
+    stepped per tick on the cfg-seeded trajectory (the same one every rep
+    starts from), host-read each tick: a probe-grade observable, not a
+    timed leg."""
+    from raft_kotlin_tpu.models.state import init_state
+    from raft_kotlin_tpu.ops.tick import make_run, make_rng
+
+    on_accel = jax.default_backend() != "cpu"
+    run1 = make_run(cfg, 1, trace=False, rng=make_rng(cfg),
+                    batched=None if on_accel else False)
+    st = init_state(cfg)
+    hw = 0
+    for _ in range(ticks):
+        st, _ = run1(st)
+        hw = max(hw, int((np.asarray(st.phys_len).astype(np.int64)
+                          - np.asarray(st.snap_index)).max()))
+    cap = int(np.sum(np.asarray(st.cap_ov) != 0))
+    return hw, cap
+
+
+def pin_table(cfg, groups: int, ring: int, source: str) -> None:
+    """Pin the probed shape's ring-keyed deep entry (the winner's routed
+    plan) into the unified TUNING_TABLE — byte-stable like every pin, and
+    a NEW canonical row: ring keys never rewrite full-window entries."""
+    from raft_kotlin_tpu.parallel import autotune
+
+    key = autotune.deep_key(cfg.log_capacity, groups,
+                            mailbox=cfg.uses_mailbox, dtype=cfg.log_dtype,
+                            platform="tpu", ring=ring)
+    plan = dict(autotune.plan_for(
+        dataclasses.replace(cfg, ring_capacity=ring)))
+    plan.pop("compaction", None)  # config property, never pinned
+    by_key = {autotune.canonical_key(e["key"]): dict(e)
+              for e in autotune.TUNING_TABLE}
+    by_key[autotune.canonical_key(key)] = {
+        "key": key, "plan": plan, "provenance": {"source": source}}
+    autotune.pin_entries(list(by_key.values()))
+
+
+def main():
+    import bench
+    from raft_kotlin_tpu.ops.tick import make_tick
+    from raft_kotlin_tpu.utils.config import RaftConfig, ScenarioSpec
+
+    args = [a for a in sys.argv[1:] if a != "--pin"]
+    do_pin = "--pin" in sys.argv[1:]
+    on_accel = jax.default_backend() != "cpu"
+    groups = int(args[0]) if len(args) > 0 else (4096 if on_accel else 64)
+    ticks = int(args[1]) if len(args) > 1 else (100 if on_accel else 8)
+    reps = int(os.environ.get("RAFT_PROBE_REPS", 3 if on_accel else 1))
+    C = int(os.environ.get("RAFT_PROBE_RING_CAPACITY",
+                           4096 if on_accel else 512))
+    # The bench compaction leg's discipline (§15 warmup-down: commit — and
+    # therefore the fold — keeps moving at any group count), deep-shaped.
+    base = RaftConfig(
+        n_groups=groups, n_nodes=3, log_capacity=C, cmd_period=2,
+        p_drop=0.05, seed=0, compact_watermark=16, compact_chunk=16,
+        scenario=ScenarioSpec(warmup_down=40)).stressed(10)
+
+    def candidates(cfg_pt):
+        def gen(cfg_c):
+            # The production tick at this C_phys through measure()'s own
+            # harness (scan_runner: livepin, scalar outputs, one jit). The
+            # plan layer routes the engine inside make_tick, which is the
+            # point — a small resident window crosses uses_dyn_log and
+            # makes the deep tick a candidate for the shallow band.
+            tick = make_tick(cfg_c, batched=None if on_accel else False)
+            yield bench.scan_runner(tick, cfg=cfg_c), (
+                f"ring{cfg_pt.ring_capacity or 0}")
+        return gen
+
+    floor = base.compact_watermark + base.compact_chunk
+    rings = [None] + [C // d for d in (2, 4, 8, 16, 32, 64)
+                      if C // d >= max(floor, 8)]
+    sweep = []
+    full_gsps = None
+    for ring in rings:
+        cfg_pt = (base if ring is None
+                  else dataclasses.replace(base, ring_capacity=ring))
+        hw, cap = window_high_water(cfg_pt, ticks)
+        point = {
+            "ring": ring or 0,
+            "phys_capacity": cfg_pt.phys_capacity,
+            "window_hw": hw,
+            "cap_groups": cap,
+            "valid": cap == 0,
+            "state_bytes_per_group": cfg_pt.state_bytes_per_group(),
+            "hbm_gb": round(cfg_pt.hbm_bytes() / 1e9, 3),
+            "uses_dyn_log": cfg_pt.uses_dyn_log,
+        }
+        try:
+            ts, _stats, impl = bench.measure(cfg_pt, ticks, reps,
+                                             candidates(cfg_pt))
+            best = bench.median(ts)
+            point["impl"] = impl
+            point["gsps"] = round(groups * ticks / best, 1)
+            point["rep_times_s"] = [round(t, 4) for t in ts]
+            if ring is None:
+                full_gsps = point["gsps"]
+            elif full_gsps:
+                point["speedup_vs_full"] = round(
+                    point["gsps"] / full_gsps, 3)
+        except Exception as e:
+            point["error"] = str(e)[:160]
+        sweep.append(point)
+
+    valid = [p for p in sweep if p.get("gsps") and p["valid"] and p["ring"]]
+    winner = max(valid, key=lambda p: p["gsps"]) if valid else None
+    record = {
+        "probe": "ring_window",
+        "platform": jax.devices()[0].platform,
+        "groups": groups,
+        "ticks": ticks,
+        "log_capacity": C,
+        "compact_watermark": base.compact_watermark,
+        "compact_chunk": base.compact_chunk,
+        "ring_sweep": sweep,
+        "winner": winner,
+        "pinned": False,
+    }
+    if do_pin and winner:
+        if not on_accel:
+            print("--pin refused: CPU interpreter timings cannot pin a "
+                  "hardware table", file=sys.stderr)
+        else:
+            src = (f"probe_ring_window {time.strftime('%Y-%m-%d')}: "
+                   f"{winner['gsps']} gsps at ring={winner['ring']} "
+                   f"(C={C}, G={groups}, window_hw={winner['window_hw']})")
+            pin_table(base, groups, winner["ring"], src)
+            record["pinned"] = True
+    print(json.dumps(record), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -93,6 +93,11 @@ INV_LEGS = (
     # ticks; a latch here means the ring window or InstallSnapshot
     # broke a Figure-3 property the classical legs can't reach).
     ("compaction_inv_status", "compaction inv", "suspect"),
+    # r16 (ISSUE 14): the §16 bounded-ring round — the same compaction
+    # config on a physical ring window ≪ C; a latch here means the ring
+    # translate (mod C_phys) broke a Figure-3 property the full-window
+    # round can't reach.
+    ("compaction_ring_inv_status", "ring inv", "suspect"),
 )
 
 # Boolean audit fields (r13): pod_dryrun marks the virtual-device
@@ -175,7 +180,13 @@ def load_record(path: str) -> Optional[dict]:
                   # config-5 deep shape's GB with its log bounded to the
                   # compaction window (lower is better; the unbounded
                   # figure stays published as deeplog_hbm_gb).
-                  "compaction_deeplog_hbm_gb"):
+                  "compaction_deeplog_hbm_gb",
+                  # r16 (ISSUE 14): the §16 ring-residency figures — the
+                  # deep shape's GB on its resident physical window (the
+                  # regression gate, check_ring) and the unbounded figure
+                  # it divides (the gsps/GB efficiency trajectory row).
+                  "deeplog_ring_hbm_gb", "deeplog_ring_capacity",
+                  "deeplog_hbm_gb"):
         v = parsed.get(field)
         if not isinstance(v, (int, float)):
             v = _extract_field(tail, field)
@@ -185,6 +196,10 @@ def load_record(path: str) -> Optional[dict]:
         # The bytes gate vets on the headline suspect flag (accounting
         # rides the same record as the measurements it describes).
         vetted["bytes_per_tick_packed"] = gate_value("suspect")
+    if "deeplog_ring_hbm_gb" in aux_num:
+        # The ring-residency gate (ISSUE 14) vets the same way — it arms
+        # once the first vetted ring round lands.
+        vetted["deeplog_ring_hbm_gb"] = gate_value("suspect")
     aux_bool: Dict[str, bool] = {}
     for field in AUDIT_BOOLS:
         v = parsed.get(field)
@@ -300,6 +315,35 @@ def check_bytes(recs: List[dict],
     return []
 
 
+def check_ring(recs: List[dict],
+               tol: float = REGRESSION_TOL) -> List[Tuple[str, float,
+                                                          float]]:
+    """[(label, latest, best prior)] when the LATEST round's deep-shape
+    ring-residency GB (deeplog_ring_hbm_gb) GREW more than `tol` above the
+    best (lowest) prior VETTED round that published it (ISSUE 14): the
+    figure is deterministic accounting of the resident physical window, so
+    growth means the window (or the byte model behind it) was silently
+    widened — a residency regression. Arms itself only once a vetted ring
+    round exists, exactly like the packed-bytes gate; rounds predating the
+    field are skipped, never guessed."""
+    if len(recs) < 2:
+        return []
+    latest = recs[-1]
+    cur = latest.get("aux_num", {}).get("deeplog_ring_hbm_gb")
+    if cur is None:
+        return []
+    prior = [(r["aux_num"]["deeplog_ring_hbm_gb"], r["round"])
+             for r in recs[:-1]
+             if "deeplog_ring_hbm_gb" in r.get("aux_num", {})
+             and r["vetted"].get("deeplog_ring_hbm_gb")]
+    if not prior:
+        return []
+    best, best_round = min(prior)
+    if cur > (1.0 + tol) * best:
+        return [("deep ring GB", cur, best)]
+    return []
+
+
 def check_violations(recs: List[dict]) -> List[Tuple[str, str]]:
     """[(leg label, verdict)] for every vetted invariant leg of the LATEST
     round whose verdict is not "clean" — the safety gate (ISSUE 6)."""
@@ -340,19 +384,41 @@ def main(argv=None) -> int:
     # r15 (ISSUE 12): the HBM-bound row — config-5 deep GB at the
     # bounded compaction window (vs the unbounded 7.49 deeplog_hbm_gb;
     # with §15 the window bounds bytes while lifetime is unbounded).
-    for field, label in (("bytes_per_tick", "bytes/tick"),
-                         ("bytes_per_tick_packed", "bytes/tick packed"),
-                         ("compaction_deeplog_hbm_gb",
-                          "compact deep GB")):
+    # r16 (ISSUE 14): the ring-residency row (deep GB on the resident
+    # physical window) rides the same loop, vetted by its own gate key.
+    for field, label, vetkey, fmt in (
+            ("bytes_per_tick", "bytes/tick", "bytes_per_tick_packed", ",.0f"),
+            ("bytes_per_tick_packed", "bytes/tick packed",
+             "bytes_per_tick_packed", ",.0f"),
+            ("compaction_deeplog_hbm_gb", "compact deep GB",
+             "bytes_per_tick_packed", ",.0f"),
+            ("deeplog_ring_hbm_gb", "ring deep GB",
+             "deeplog_ring_hbm_gb", ",.2f")):
         if not any(field in r.get("aux_num", {}) for r in recs):
             continue
         row = [label.ljust(18)]
         for r in recs:
             v = r.get("aux_num", {}).get(field)
             mark = "" if r["vetted"].get(
-                "bytes_per_tick_packed", r["vetted"].get("value")) else "?"
+                vetkey, r["vetted"].get("value")) else "?"
             row.append(("-" if v is None
-                        else f"{v:,.0f}{mark}").rjust(14))
+                        else f"{v:{fmt}}{mark}").rjust(14))
+        print("".join(row))
+    # r16 (ISSUE 14): the deep-band EFFICIENCY trajectory — headline deep
+    # gsps per GB of HBM footprint (deeplog_group_steps_per_sec /
+    # deeplog_hbm_gb, computed per round; higher is better). The ring
+    # window's whole point is moving this number: same logical capacity,
+    # ~C/C_phys fewer resident bytes.
+    if any("deeplog_hbm_gb" in r.get("aux_num", {})
+           and "deeplog_group_steps_per_sec" in r["legs"] for r in recs):
+        row = ["deep gsps/GB".ljust(18)]
+        for r in recs:
+            gsps = r["legs"].get("deeplog_group_steps_per_sec")
+            gb = r.get("aux_num", {}).get("deeplog_hbm_gb")
+            mark = "" if r["vetted"].get(
+                "deeplog_group_steps_per_sec") else "?"
+            row.append(("-" if not (gsps and gb)
+                        else f"{gsps / gb:,.1f}{mark}").rjust(14))
         print("".join(row))
     print("('?' = unvetted: no suspect:false gate in that round's record;"
           " excluded from the regression baseline)")
@@ -381,6 +447,13 @@ def main(argv=None) -> int:
               f"{100 * (cur / best - 1):.1f}% above the best prior vetted "
               f"round ({best:,.0f}) — a packed encoding was widened "
               "(models/state.py packed_field_dtype)", file=sys.stderr)
+    ring_fails = check_ring(recs)
+    for label, cur, best in ring_fails:
+        print(f"RING RESIDENCY REGRESSION: {label} r{latest:02d} = "
+              f"{cur:,.2f} is {100 * (cur / best - 1):.1f}% above the best "
+              f"prior vetted round ({best:,.2f}) — the resident physical "
+              "window grew (utils/config.py ring_capacity / the byte "
+              "model behind it)", file=sys.stderr)
     for field, _v in check_tuning_drift(recs):
         print(f"WARNING: tuning-table drift — r{latest:02d} {field} is "
               "false (the unified TUNING_TABLE disagrees with this "
@@ -397,7 +470,7 @@ def main(argv=None) -> int:
     for f, v in unvetted_bad:
         print(f"WARNING: {f} latched '{v}' on an UNVETTED (suspect) leg — "
               "not gating, but not clean either", file=sys.stderr)
-    if regs or viols or pod_fails or byte_fails:
+    if regs or viols or pod_fails or byte_fails or ring_fails:
         return 1
     clean_legs = sum(1 for f, v in latest_rec.get("inv", {}).items()
                      if v == "clean" and latest_rec["vetted"].get(f))

@@ -158,6 +158,50 @@ def test_resolution_order_and_sources(no_cache):
     assert plan["ilp_subtiles"] == 1 and plan["fused_ticks"] == 1
 
 
+def test_ring_key_dimension(no_cache):
+    # §16: ring_capacity is a KEY dimension, and ring-windowed keys are
+    # their own perf class (a small resident window changes the engine
+    # crossover entirely).
+    base = autotune.deep_key(10_000, 13_312, platform="tpu")
+    assert "ring" not in base  # pre-§16 rows keep their canonical bytes
+    assert autotune.deep_key(10_000, 13_312, platform="tpu", ring=0) == base
+    rk = autotune.deep_key(10_000, 13_312, platform="tpu", ring=512)
+    assert rk["ring"] == 512
+    assert autotune.canonical_key(rk) != autotune.canonical_key(base)
+    # The ordering key is total over mixed tables (deterministic pins).
+    assert autotune._key_order(base) != autotune._key_order(rk)
+    # With no measured ring rows, a ring key must NOT inherit the pinned
+    # full-window fc winner of the same (C, G) via nearest — it falls to
+    # the always-correct default until a probe pins it.
+    plan, src = autotune.resolve_plan(rk, with_source=True)
+    assert (src, plan["engine"]) == ("default", "flat")
+    # And a ring PIN never shadows the full-window resolution.
+    full_plan, full_src = autotune.resolve_plan(base, with_source=True)
+    assert (full_src, full_plan["engine"]) == ("pinned", "fc")
+
+
+def test_plan_for_ring_rebanding(no_cache):
+    # plan_for prices the regime by PHYSICAL capacity: a logically-deep
+    # compacting config stays in the deep band at ring=512 (keyed with
+    # ring), and re-bands into the shallow program at ring=64 — the §16
+    # perf lever.
+    import dataclasses
+    cfg = RaftConfig(n_groups=1024, n_nodes=3, log_capacity=10_000,
+                     compact_watermark=8, compact_chunk=8, seed=1)
+    deep = autotune.plan_for(cfg, platform="tpu")
+    assert deep["compaction"] == "ring"
+    mid, src = autotune.plan_for(
+        dataclasses.replace(cfg, ring_capacity=512),
+        platform="tpu", with_source=True)
+    assert mid["compaction"] == "ring"
+    assert src == "default"  # the ring class, unmeasured -> flat
+    shallow, src_s = autotune.plan_for(
+        dataclasses.replace(cfg, ring_capacity=64),
+        platform="tpu", with_source=True)
+    assert src_s == "guard"  # §15 shallow compaction routes xla for now
+    assert shallow["engine"] == "xla" and shallow["compaction"] == "ring"
+
+
 def test_measure_on_first_use_cache(tmp_path):
     cache = str(tmp_path / "cache.json")
     key = autotune.deep_key(2_048, 4_096, platform="tpu")  # not pinned

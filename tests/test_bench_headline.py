@@ -59,6 +59,11 @@ def _fake_record():
         "snapshots_taken": 24_812,
         "installsnap_deliveries": 312,
         "compaction_deeplog_hbm_gb": 0.94,
+        "compaction_ring_capacity": 56,
+        "compaction_ring_equal": True,
+        "compaction_ring_inv_status": "clean",
+        "deeplog_ring_capacity": 512,
+        "deeplog_ring_hbm_gb": 0.42,
         "suspect": False,
         # plus the long tail of fields that overflowed the driver window
         **{f"filler_{i}": [0.1234] * 8 for i in range(80)},

@@ -336,7 +336,11 @@ def test_identity_sharded_runner():
 
 # -- the ring window is the unbounded log -----------------------------------
 
+@pytest.mark.slow
 def test_bounded_window_equals_unbounded_log():
+    # Slow-tiered (r16): tests/test_ring_window.py runs the stronger
+    # form of this theorem (C_phys < C vs full window, same universe)
+    # in tier-1; this unbounded-log cross-check rides the slow tier.
     # A compacting cluster whose POSITIONS outgrow C: the C=24 ring must
     # reproduce, bit for bit, the same universe on a no-compaction config
     # whose log is big enough to never clip. This is the §7 theorem the

@@ -153,7 +153,11 @@ def test_straggler_vote_mutates_peer_but_not_candidate():
     assert c.responses == 0 and c.votes == 0
 
 
+@pytest.mark.slow
 def test_mailbox_deep_sliced_engine_matches_flat():
+    # Slow-tiered (r16): two full deep-engine compiles + 100 stepped
+    # ticks is the heaviest tier-1 differential by far, and the sliced
+    # == flat contract is re-proven every round by the sharded suites.
     # The "actually sharded" flags bit (BodyFlags.sharded): a SINGLE-DEVICE
     # mailbox+deep config (delay > 0, C >= 256) runs the per-pair dyn engine
     # on per-node (C, G) slice operands — ~Nx less log-op cost than the flat

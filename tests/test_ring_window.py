@@ -1,0 +1,362 @@
+"""§16 physical ring window differential suite (ISSUE 14).
+
+The deep-log planes used to be allocated at LOGICAL capacity (N, C, G)
+even though §15 compaction keeps the live window [snap_index, phys_len)
+near watermark + chunk. §16 decouples them: `ring_capacity` (C_phys)
+allocates the planes at (N, C_phys, G) and every engine translates
+unbounded logical positions mod C_phys (utils/config.phys_capacity;
+SEMANTICS.md §16). These tests pin the round's contracts:
+
+- config surface: ring_capacity needs compaction, respects the
+  watermark + chunk floor and the C ceiling, and re-bands the plan
+  layer's shallow/deep split through phys_capacity (uses_dyn_log);
+- the equality theorem: a C_phys << C ring reproduces the full-capacity
+  program bit for bit — same traces, same telemetry, same end state
+  modulo the plane shapes, same LOGICAL window content — on the
+  boundary universe (positions outgrow C_phys) AND through real
+  InstallSnapshot catch-ups (the laggard family);
+- the loud fail: a ring smaller than the live window latches cap_ov
+  (sticky, host check raises) instead of silently wrapping;
+- three-way parity: kernel ≡ native C++ (abi v5 Dims.ring_capacity) ≡
+  Python oracle (models/oracle.RingLog) under a bounded ring;
+- checkpoint v8 resize-on-load: a checkpoint saved at one C_phys loads
+  at another (both directions, wide and packed layouts, single-file and
+  sharded) by remapping the live window — and refuses loudly when the
+  window does not fit the target ring;
+- the fc deep runner's trace mode (make_deep_scan(trace=True)) emits
+  the SAME per-tick differential trace as make_run — what lets the
+  bench route an fcache headline to a single-device parity leg.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from conftest import assert_states_equal
+
+from raft_kotlin_tpu.models.state import check_cap_ov, init_state
+from raft_kotlin_tpu.ops.tick import make_rng, make_run
+from raft_kotlin_tpu.utils.config import RaftConfig, ScenarioSpec
+
+TRACE_FIELDS = ("role", "term", "commit", "last_index", "voted_for",
+                "rounds", "up")
+
+# The §15 boundary universe (tests/test_compaction.py BOUNDARY): a
+# compacting cluster whose positions outgrow C and whose committed
+# prefix keeps pace in every group. Measured live-window high-water 19
+# (warmup backlog) — ring_capacity=20 fits with one row to spare, 8
+# does not.
+BOUNDARY = RaftConfig(
+    n_groups=4, n_nodes=3, log_capacity=24, cmd_period=2, seed=1,
+    compact_watermark=2, compact_chunk=2,
+    scenario=ScenarioSpec(warmup_down=34),
+).stressed(10)
+
+RING = dataclasses.replace(BOUNDARY, ring_capacity=20)
+
+
+def _equal_modulo_log(a, b):
+    """Bit-equality on every field except the (shape-divergent) log
+    planes; the planes are compared LOGICALLY via _window_rows."""
+    for f in dataclasses.fields(type(a)):
+        if f.name in ("log_term", "log_cmd"):
+            continue
+        av, bv = getattr(a, f.name), getattr(b, f.name)
+        if av is None and bv is None:
+            continue
+        assert np.array_equal(np.asarray(av), np.asarray(bv)), f.name
+
+
+def _window_rows(st, cfg):
+    """The logical live window [snap_index, phys_len) of every node,
+    read through the cfg's ring translation — the content §16 must
+    preserve across any C_phys."""
+    lt, lc = np.asarray(st.log_term), np.asarray(st.log_cmd)
+    b = np.asarray(st.snap_index).astype(np.int64)
+    pl = np.asarray(st.phys_len).astype(np.int64)
+    Cp = cfg.phys_capacity
+    hw = int((pl - b).max()) if b.size else 0
+    rows_t, rows_c = [], []
+    for k in range(hw):
+        p = ((b + k) % Cp)[:, None, :]
+        live = (k < (pl - b))
+        rows_t.append(np.where(live, np.take_along_axis(lt, p, axis=1)[:, 0, :], 0))
+        rows_c.append(np.where(live, np.take_along_axis(lc, p, axis=1)[:, 0, :], 0))
+    z = np.zeros((0,) + lt.shape[::2], lt.dtype)
+    return (np.asarray(rows_t) if rows_t else z,
+            np.asarray(rows_c) if rows_c else z)
+
+
+# -- config surface ----------------------------------------------------------
+
+def test_ring_config_validation():
+    with pytest.raises(ValueError, match="compact_watermark"):
+        RaftConfig(n_groups=1, ring_capacity=8)
+    with pytest.raises(ValueError, match="ring_capacity"):
+        RaftConfig(n_groups=1, compact_watermark=4, compact_chunk=4,
+                   ring_capacity=6)  # below the W + CH floor
+    with pytest.raises(ValueError, match="ring_capacity"):
+        RaftConfig(n_groups=1, log_capacity=16, compact_watermark=2,
+                   ring_capacity=32)  # above C: the ring never helps
+    cfg = RaftConfig(n_groups=1, log_capacity=4096, compact_watermark=8,
+                     compact_chunk=8, ring_capacity=64)
+    assert cfg.phys_capacity == 64
+    assert dataclasses.replace(cfg, ring_capacity=None).phys_capacity == 4096
+    # The perf lever: a small resident window re-bands a logically-deep
+    # config into the shallow columnar band (plan-layer dimension).
+    assert not cfg.uses_dyn_log
+    assert dataclasses.replace(cfg, ring_capacity=None).uses_dyn_log
+    assert dataclasses.replace(cfg, ring_capacity=512).uses_dyn_log
+    # Bytes are priced by C_phys, not C.
+    assert cfg.state_bytes_per_group() < dataclasses.replace(
+        cfg, ring_capacity=None).state_bytes_per_group() / 10
+
+
+# -- the equality theorem ----------------------------------------------------
+
+def test_ring_equals_full_capacity():
+    # C_phys=20 vs the full C=24 window on the boundary universe:
+    # positions outgrow BOTH capacities, the live window fits the ring,
+    # and every observable — per-tick traces, recorder counters, the
+    # end state modulo plane shapes, the logical window content — is
+    # bit-identical. HBM is priced down by the ring.
+    n_ticks = 150
+    e0, tr0, tel0 = make_run(BOUNDARY, n_ticks, trace=True,
+                             telemetry=True)(init_state(BOUNDARY))
+    e1, tr1, tel1 = make_run(RING, n_ticks, trace=True,
+                             telemetry=True)(init_state(RING))
+    assert int(tel1["snapshots_taken"]) > 0
+    for k in tr0:
+        assert np.array_equal(np.asarray(tr0[k]), np.asarray(tr1[k])), k
+    for k in tel0:
+        assert np.array_equal(np.asarray(tel0[k]), np.asarray(tel1[k])), k
+    assert e1.log_term.shape[1] == RING.ring_capacity
+    assert int(np.asarray(e1.last_index).max()) > RING.ring_capacity, (
+        "positions never outgrew the ring — the test proved nothing")
+    assert not np.asarray(e1.cap_ov).any()
+    _equal_modulo_log(jax.device_get(e0), jax.device_get(e1))
+    w0, c0 = _window_rows(jax.device_get(e0), BOUNDARY)
+    w1, c1 = _window_rows(jax.device_get(e1), RING)
+    assert np.array_equal(w0, w1) and np.array_equal(c0, c1)
+    assert RING.state_bytes_per_group() < BOUNDARY.state_bytes_per_group()
+
+
+def test_ring_install_catchup_parity():
+    # The equality must survive leaving the identity regime: the §15
+    # laggard family forces real InstallSnapshot catch-ups (leaders
+    # snapshot past a crashed follower's frontier), and the ring run
+    # must deliver the SAME installs at the same ticks as the full
+    # window. Measured laggard window high-water 17 — ring=20 fits.
+    from raft_kotlin_tpu.api.fuzz import laggard_config
+
+    cfg = laggard_config(4)
+    ring = dataclasses.replace(cfg, ring_capacity=20)
+    n_ticks = 160
+    e0, tr0, tel0 = make_run(cfg, n_ticks, trace=True,
+                             telemetry=True)(init_state(cfg))
+    e1, tr1, tel1 = make_run(ring, n_ticks, trace=True,
+                             telemetry=True)(init_state(ring))
+    assert int(tel1["installsnap_deliveries"]) > 0, (
+        "no install fired — the laggard family lost its point")
+    for k in tr0:
+        assert np.array_equal(np.asarray(tr0[k]), np.asarray(tr1[k])), k
+    for k in tel0:
+        assert np.array_equal(np.asarray(tel0[k]), np.asarray(tel1[k])), k
+    assert not np.asarray(e1.cap_ov).any()
+    _equal_modulo_log(jax.device_get(e0), jax.device_get(e1))
+
+
+def test_ring_capacity_latch():
+    # A ring smaller than the live window is a configuration error the
+    # system must surface LOUDLY: cap_ov latches (sticky bitmask), the
+    # host check raises, the recorder counts the event — never a silent
+    # wraparound corrupting entries. Boundary warmup backlog peaks at
+    # 19; ring=8 cannot absorb it.
+    small = dataclasses.replace(BOUNDARY, ring_capacity=8)
+    e, _, tel = make_run(small, 150, trace=False,
+                         telemetry=True)(init_state(small))
+    assert np.asarray(e.cap_ov).any()
+    assert int(tel["cap_exhausted_events"]) > 0
+    with pytest.raises(RuntimeError, match="log capacity exhausted"):
+        check_cap_ov(e)
+    # The SAME universe at ring=20 stays clean (test_ring_equals_full
+    # pins the bits; this pins the remedy).
+    e2, _ = make_run(RING, 150, trace=False)(init_state(RING))
+    check_cap_ov(e2)
+
+
+# -- three-way parity under a bounded ring -----------------------------------
+
+def test_ring_three_way_parity():
+    # Kernel ≡ native C++ (abi v5: Dims.ring_capacity drives slot
+    # stride and ring translation) ≡ Python oracle (RingLog allocated
+    # at phys) on the boundary universe under ring=20, snapshot state
+    # included.
+    from raft_kotlin_tpu.models.oracle import (
+        OracleGroup, make_edge_ok_fn, make_faults_fn, predraw)
+    from raft_kotlin_tpu.native.oracle import NativeOracle, trace_parity
+
+    cfg = RING
+    n_ticks = 120
+    end, tr, tel = make_run(cfg, n_ticks, trace=True,
+                            telemetry=True)(init_state(cfg))
+    assert int(tel["snapshots_taken"]) > 0
+    ok, first = trace_parity(tr, NativeOracle(cfg).run(n_ticks))
+    assert ok.all(), first
+    kt = {k: np.asarray(v).transpose(0, 2, 1) for k, v in tr.items()}
+    draws = predraw(cfg)
+    for g in range(cfg.n_groups):
+        grp = OracleGroup(cfg, group=g, draws=draws[g])
+        snaps = grp.run(n_ticks, edge_ok_fn=make_edge_ok_fn(cfg, g),
+                        faults_fn=make_faults_fn(cfg, g))
+        for ti, snap in enumerate(snaps):
+            for k in TRACE_FIELDS:
+                assert np.array_equal(kt[k][ti, g],
+                                      np.asarray(snap[k])), (k, ti, g)
+        for f in ("snap_index", "snap_term", "snap_digest", "cap_ov"):
+            assert [getattr(n, f) for n in grp.nodes] == list(
+                np.asarray(getattr(end, f))[:, g]), (f, g)
+
+
+# -- checkpoint v8: resize on load -------------------------------------------
+
+def _resumed_protocol_equal(ref, resumed):
+    _equal_modulo_log(jax.device_get(ref), jax.device_get(resumed))
+
+
+def test_checkpoint_ring_resize_both_directions(tmp_path):
+    # v8: a checkpoint saved at one C_phys loads at another when the
+    # expected config differs ONLY in ring_capacity — the live window
+    # is remapped onto the target ring. Both directions, with a
+    # bit-exact resume against the uninterrupted reference.
+    from raft_kotlin_tpu.utils import checkpoint as ckpt
+
+    mid_full, _ = make_run(BOUNDARY, 110, trace=False)(init_state(BOUNDARY))
+    mid_full = jax.device_get(mid_full)
+    assert int(np.asarray(mid_full.snap_index).min()) > 0
+    ref, _ = make_run(BOUNDARY, 30, trace=False)(mid_full)
+
+    # full (24) -> ring (20): shrink.
+    p = str(tmp_path / "full.npz")
+    ckpt.save(p, mid_full, BOUNDARY)
+    down, cfg_d = ckpt.load(p, expect_cfg=RING)
+    assert cfg_d == RING
+    assert down.log_term.shape[1] == RING.ring_capacity
+    _equal_modulo_log(mid_full, jax.device_get(down))
+    assert np.array_equal(
+        np.stack(_window_rows(mid_full, BOUNDARY)),
+        np.stack(_window_rows(jax.device_get(down), RING)))
+    resumed_d, _ = make_run(RING, 30, trace=False)(down)
+    _resumed_protocol_equal(ref, resumed_d)
+
+    # ring (20) -> full (24): grow. The ring run's own trajectory is
+    # bit-identical (the equality theorem), so the full-window resume
+    # must land on the same reference.
+    mid_ring, _ = make_run(RING, 110, trace=False)(init_state(RING))
+    pr = str(tmp_path / "ring.npz")
+    ckpt.save(pr, mid_ring, RING)
+    up, cfg_u = ckpt.load(pr, expect_cfg=BOUNDARY)
+    assert cfg_u == BOUNDARY
+    assert up.log_term.shape[1] == BOUNDARY.log_capacity
+    _equal_modulo_log(mid_full, jax.device_get(up))
+    resumed_u, _ = make_run(BOUNDARY, 30, trace=False)(up)
+    _resumed_protocol_equal(ref, resumed_u)
+
+    # Same-cfg load stays the ordinary bit-exact path.
+    same, _ = ckpt.load(pr, expect_cfg=RING)
+    assert_states_equal(mid_ring, jax.device_get(same))
+
+
+def test_checkpoint_ring_resize_packed_layout(tmp_path):
+    # The resize composes with §14 packed layout on both ends: a packed
+    # state saves (normalized wide on disk), loads resized, and a
+    # resized load re-packs on request and resumes bit-exactly.
+    from raft_kotlin_tpu.models.state import (
+        PackedRaftState, pack_state, unpack_state)
+    from raft_kotlin_tpu.utils import checkpoint as ckpt
+
+    mid, _ = make_run(BOUNDARY, 110, trace=False)(init_state(BOUNDARY))
+    mid = jax.device_get(mid)
+    ref, _ = make_run(BOUNDARY, 30, trace=False)(mid)
+    p = str(tmp_path / "pk.npz")
+    ckpt.save(p, pack_state(BOUNDARY, mid), BOUNDARY)
+    w, _ = ckpt.load(p, expect_cfg=RING)
+    _equal_modulo_log(mid, jax.device_get(w))
+    pk, cfg_p = ckpt.load(p, expect_cfg=RING, layout="packed")
+    assert isinstance(pk, PackedRaftState)
+    wide = unpack_state(cfg_p, pk)
+    assert wide.log_term.shape[1] == RING.ring_capacity
+    resumed, _ = make_run(RING, 30, trace=False, layout="packed")(wide)
+    _resumed_protocol_equal(ref, resumed)
+
+
+def test_checkpoint_ring_resize_refusals(tmp_path):
+    # The loud fails: (a) a target ring the live window does not fit
+    # raises (mid-warmup backlog is 17 rows; ring=8 cannot hold it) —
+    # never a silent truncation of live entries; (b) a mismatch in any
+    # OTHER field still refuses even when ring_capacity also differs.
+    from raft_kotlin_tpu.utils import checkpoint as ckpt
+
+    early, _ = make_run(BOUNDARY, 40, trace=False)(init_state(BOUNDARY))
+    p = str(tmp_path / "early.npz")
+    ckpt.save(p, early, BOUNDARY)
+    with pytest.raises(ValueError, match="does not fit"):
+        ckpt.load(p, expect_cfg=dataclasses.replace(
+            BOUNDARY, ring_capacity=8))
+    with pytest.raises(ValueError, match="config mismatch"):
+        ckpt.load(p, expect_cfg=dataclasses.replace(
+            RING, el_hi=RING.el_hi + 1))
+
+
+@pytest.mark.slow
+def test_checkpoint_ring_resize_sharded(tmp_path):
+    # v8 sharded: the remap is shard-local (each shard file holds its
+    # groups slice; the window math never crosses shards), the manifest
+    # advertises the TARGET plane shapes, and both assemblies (sharded
+    # under the mesh, unsharded) agree and resume bit-exactly.
+    from raft_kotlin_tpu.parallel.mesh import (
+        init_sharded, make_mesh, make_sharded_run)
+    from raft_kotlin_tpu.utils import checkpoint as ckpt
+
+    cfg = dataclasses.replace(BOUNDARY, n_groups=16)
+    ring = dataclasses.replace(cfg, ring_capacity=20)
+    mesh = make_mesh()
+    mid = make_sharded_run(cfg, mesh, 120)(init_sharded(cfg, mesh))[0]
+    assert int(np.asarray(jax.device_get(mid.snap_index)).min()) > 0
+    d = str(tmp_path / "sh")
+    ckpt.save_sharded(d, mid, cfg)
+
+    w, cfg2 = ckpt.load_sharded(d, mesh=mesh, expect_cfg=ring)
+    assert cfg2 == ring
+    assert w.log_term.shape[1] == ring.ring_capacity
+    _equal_modulo_log(jax.device_get(mid), jax.device_get(w))
+    flat, _ = ckpt.load_sharded(d, expect_cfg=ring)
+    assert_states_equal(jax.device_get(w), jax.device_get(flat))
+
+    ref = make_sharded_run(cfg, mesh, 20)(mid)[0]
+    resumed = make_sharded_run(ring, mesh, 20)(w)[0]
+    _equal_modulo_log(jax.device_get(ref), jax.device_get(resumed))
+
+
+# -- the fc deep runner's trace mode (bench parity hook) ---------------------
+
+@pytest.mark.slow
+def test_deep_scan_trace_matches_run():
+    # make_deep_scan(trace=True) returns (trace, ov) with the SAME
+    # per-tick differential trace make_run emits — the hook that lets
+    # bench route an fcache headline to a single-device parity leg
+    # (three-way parity needs per-tick rows, not just an end state).
+    from raft_kotlin_tpu.ops.deep_cache import make_deep_scan
+
+    cfg = RaftConfig(n_groups=8, n_nodes=3, log_capacity=256,
+                     cmd_period=30, seed=7).stressed(10)
+    T = 40
+    rng = make_rng(cfg)
+    ys, ov = make_deep_scan(cfg, T, trace=True)(init_state(cfg), rng)
+    assert not ov
+    _, tr = make_run(cfg, T, trace=True, rng=rng)(init_state(cfg))
+    assert set(ys) == set(tr)
+    for k in ys:
+        assert np.array_equal(np.asarray(ys[k]), np.asarray(tr[k])), k

@@ -156,6 +156,60 @@ def test_bytes_regression_gate(tmp_path):
         sb.load_all(str(tmp_path / "BENCH_r*.json"))) == []
 
 
+def test_ring_residency_gate(tmp_path):
+    # ISSUE 14 satellite: once a vetted round publishes the deep-shape
+    # ring-residency figure (deeplog_ring_hbm_gb — deterministic window
+    # accounting), a later round whose figure GREW >10% gates exit-1 (the
+    # resident window was silently widened); a ring-leg invariant
+    # violation (compaction_ring_inv_status) gates like every inv leg.
+    sb = _mod()
+    assert ("compaction_ring_inv_status", "ring inv", "suspect") \
+        in sb.INV_LEGS
+
+    def art(n, ring_gb=None, ring_inv="clean", suspect="false"):
+        rec = {"ticks_per_sec": 400.0, "suspect": False,
+               "inv_status": "clean",
+               "compaction_ring_inv_status": ring_inv}
+        if ring_gb is not None:
+            rec["deeplog_ring_hbm_gb"] = ring_gb
+            rec["deeplog_ring_capacity"] = 512
+        tail = json.dumps(rec) + "\n"
+        tail = tail.replace('"suspect": false', f'"suspect": {suspect}')
+        return {"n": n, "rc": 0, "tail": tail, "parsed": None}
+
+    # No prior ring round -> unarmed, clean exit.
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(art(1)))
+    (tmp_path / "BENCH_r02.json").write_text(
+        json.dumps(art(2, ring_gb=0.42)))
+    assert sb.check_ring(sb.load_all(str(tmp_path / "BENCH_r*.json"))) \
+        == []
+    assert sb.main([str(tmp_path / "BENCH_r*.json")]) == 0
+    # Residency grew 50% above the vetted prior -> gate.
+    (tmp_path / "BENCH_r03.json").write_text(
+        json.dumps(art(3, ring_gb=0.63)))
+    recs = sb.load_all(str(tmp_path / "BENCH_r*.json"))
+    fails = sb.check_ring(recs)
+    assert len(fails) == 1 and fails[0][1] == 0.63
+    assert sb.main([str(tmp_path / "BENCH_r*.json")]) == 1
+    # Shrinking residency never gates — lower is better.
+    (tmp_path / "BENCH_r03.json").write_text(
+        json.dumps(art(3, ring_gb=0.40)))
+    assert sb.main([str(tmp_path / "BENCH_r*.json")]) == 0
+    # A SUSPECT prior must not arm the baseline.
+    (tmp_path / "BENCH_r02.json").write_text(
+        json.dumps(art(2, ring_gb=0.10, suspect="true")))
+    (tmp_path / "BENCH_r03.json").write_text(
+        json.dumps(art(3, ring_gb=0.63)))
+    assert sb.check_ring(
+        sb.load_all(str(tmp_path / "BENCH_r*.json"))) == []
+    # A ring-leg violation on the latest vetted round gates exit-1.
+    (tmp_path / "BENCH_r04.json").write_text(json.dumps(
+        art(4, ring_gb=0.63, ring_inv="committed_prefix@t9/g2")))
+    recs = sb.load_all(str(tmp_path / "BENCH_r*.json"))
+    assert ("ring inv", "committed_prefix@t9/g2") in sb.check_violations(recs)
+    assert sb.main([str(tmp_path / "BENCH_r*.json")]) == 1
+
+
 def test_fuzz_violation_gate(tmp_path):
     # ISSUE 9 satellite: a non-clean fuzz-farm verdict on the latest
     # vetted round gates exit-1 exactly like the classical inv legs.
