@@ -296,7 +296,17 @@ COMPACT_EXTRA_FIELDS = ("deeplog_parity_rate", "deeplog_ov_fallback",
                         # regression gate read these from the tail.
                         "compaction_ring_capacity", "compaction_ring_equal",
                         "compaction_ring_inv_status",
-                        "deeplog_ring_capacity", "deeplog_ring_hbm_gb")
+                        "deeplog_ring_capacity", "deeplog_ring_hbm_gb",
+                        # r17 (ISSUE 15): the routed aux source, the aux
+                        # stream's own byte term (staged = written+read
+                        # [+fused draw tables]; inkernel = amortized
+                        # resident-table read), and the modeled
+                        # staged/inkernel whole-tick ratio — the round's
+                        # acceptance gate (within 5% of the 2*state floor)
+                        # and summarize_bench's aux trajectory row read
+                        # these from the authoritative tail.
+                        "aux_source", "aux_bytes_per_tick",
+                        "aux_vs_staged")
 
 # Flight-recorder counters published verbatim from the headline run's
 # median rep (stats tel_* keys — utils/telemetry.TELEMETRY_FIELDS).
@@ -411,6 +421,21 @@ def _headline_layout(cfg):
         return "wide"
 
 
+def _headline_aux_source(cfg):
+    """The plan-routed aux source for a config's timed headline
+    (parallel/autotune.plan_for's `aux_source` dimension, ISSUE 15);
+    "staged" on any resolution failure — the proven legacy stream."""
+    try:
+        from raft_kotlin_tpu.parallel.autotune import plan_for
+
+        return plan_for(cfg, telemetry=True, monitor=True).get(
+            "aux_source", "staged")
+    except Exception as e:
+        print(f"aux_source resolution failed: {str(e)[:120]}",
+              file=sys.stderr)
+        return "staged"
+
+
 def tick_candidates(cfg):
     from raft_kotlin_tpu.ops.pallas_tick import (
         choose_impl, make_pallas_scan, resolve_fused_geometry)
@@ -423,6 +448,10 @@ def tick_candidates(cfg):
         # fused draw overflow); the XLA fallback rung stays wide, matching
         # plan_for's own engine=xla resolution.
         layout = _headline_layout(cfg)
+        # Routed aux source (ISSUE 15): "inkernel" draws the per-tick aux
+        # set inside the kernel from resident counter tables — no XLA aux
+        # pre-pass on the hot path. CPU/interpret plans pin "staged".
+        aux_source = _headline_aux_source(cfg)
         # Flat-carry multi-tick runner: state<->kernel-form conversions once
         # per call, not once per tick (~0.3 ms/tick on the headline config).
         # The flight recorder (ISSUE 5) AND the safety-invariant monitor
@@ -439,7 +468,8 @@ def tick_candidates(cfg):
                                           jitted=False,
                                           telemetry=True,
                                           monitor=True,
-                                          layout=layout)), "pallas"
+                                          layout=layout,
+                                          aux_source=aux_source)), "pallas"
         try:
             # Resolve with the SAME snapshot rows the headline builder
             # carries (recorder+monitor on): the bare model can route a T
@@ -452,7 +482,8 @@ def tick_candidates(cfg):
                                            monitor=True)
             routed_t = resolve_fused_geometry(
                 cfg, interpret=False,
-                snap_rows=_snapshot_rows(cfg, _snaps))[2]
+                snap_rows=_snapshot_rows(cfg, _snaps),
+                aux_source=aux_source)[2]
         except Exception:
             routed_t = 1
         if routed_t > 1:
@@ -461,7 +492,9 @@ def tick_candidates(cfg):
                                               telemetry=True,
                                               monitor=True,
                                               fused_ticks=1,
-                                              layout=layout)), "pallas-nofuse"
+                                              layout=layout,
+                                              aux_source=aux_source)
+                   ), "pallas-nofuse"
     yield scan_runner(make_tick(cfg), telemetry=True, monitor=True), "xla"
 
 
@@ -475,7 +508,9 @@ def pallas_t1_only(cfg):
     yield (lambda n: make_pallas_scan(cfg, n, interpret=False, jitted=False,
                                       telemetry=True, monitor=True,
                                       fused_ticks=1,
-                                      layout=layout)), "pallas-t1"
+                                      layout=layout,
+                                      aux_source=_headline_aux_source(cfg))
+           ), "pallas-t1"
 
 
 def xla_only(cfg):
@@ -763,28 +798,53 @@ def _tree_nbytes(shapes) -> int:
                for leaf in jax.tree_util.tree_leaves(shapes))
 
 
-def state_aux_bytes_per_tick(cfg, layout: str = "wide") -> int:
-    """HBM bytes the tick must move at minimum under `layout`: every state
-    array read once and written once (the Pallas megakernel achieves
-    exactly this; XLA re-reads across fusion islands), plus the per-tick
-    aux set read once.
-
-    Both terms are CONCRETE-pytree accounting (ISSUE 11 satellite): the
-    state term is the summed leaf nbytes of the routed layout's actual
-    pytree (init_state, packed through models/state.pack_state when
-    layout="packed") and the aux term the summed leaf nbytes of the dict
-    make_aux actually assembles — eval_shape on the real builders, so a
-    new field or dtype change can never silently drift out of the model
-    (the r5-r13 hand-maintained formula undercounted the periodic-command
-    row and had to mirror every narrowing by hand)."""
+def state_bytes_per_tick(cfg, layout: str = "wide") -> int:
+    """The state term of the tick's minimum HBM traffic under `layout`:
+    every state array read once and written once (the Pallas megakernel
+    achieves exactly this; XLA re-reads across fusion islands).
+    CONCRETE-pytree accounting (ISSUE 11): summed leaf nbytes of the
+    routed layout's actual pytree. `2 * state_bytes_per_tick` is the
+    deterministic floor the in-kernel aux path is measured against
+    (ISSUE 15 acceptance)."""
     from raft_kotlin_tpu.models.state import init_state, pack_state
-    from raft_kotlin_tpu.ops import tick as tick_mod
 
     def build_state():
         st = init_state(cfg)
         return pack_state(cfg, st) if layout == "packed" else st
 
-    state = _tree_nbytes(jax.eval_shape(build_state))
+    return 2 * _tree_nbytes(jax.eval_shape(build_state))
+
+
+def aux_bytes_per_tick(cfg, aux_source: str = "staged",
+                       fused_ticks: int = 1) -> int:
+    """The aux term of the tick's HBM traffic, per `aux_source` (ISSUE 15
+    satellite — the r14 model counted the staged set ONCE, but the staged
+    path writes it in the XLA pre-pass AND reads it in the kernel, and
+    the fused path additionally stages the counter-keyed el/backoff draw
+    tables per launch):
+
+    - "staged": 2x the summed leaf nbytes of the dict make_aux actually
+      assembles (eval_shape on the real builder, so a new field or dtype
+      change can never silently drift out of the model), plus — fused —
+      2x the draw tables' per-tick share. The tables are (N*W, G) +
+      (N*T, G) i32 with W = resets_bound*T (ops/pallas_tick.draw_tables),
+      so their per-tick share N*(resets_bound+1)*G*4 is T-invariant.
+    - "inkernel": the resident key tables read once per launch, amortized
+      over the fused block — (inkernel_table_rows + 4N) rows x G x 4
+      bytes / T (ops/pallas_tick.inkernel_aux_operands: ktab + the two
+      key-word planes). No per-tick write: the tables are built once per
+      RUN, not per launch, and nothing else is staged."""
+    from raft_kotlin_tpu.models.state import init_state
+    from raft_kotlin_tpu.ops import tick as tick_mod
+
+    if aux_source == "inkernel":
+        from raft_kotlin_tpu.ops.pallas_tick import inkernel_table_rows
+
+        resident = (inkernel_table_rows(cfg) + 4 * cfg.n_nodes) \
+            * cfg.n_groups * 4
+        return resident // max(fused_ticks, 1)
+    if aux_source != "staged":
+        raise ValueError(f"unknown aux_source {aux_source!r}")
 
     def build_aux():
         st = init_state(cfg)
@@ -793,8 +853,25 @@ def state_aux_bytes_per_tick(cfg, layout: str = "wide") -> int:
                                    scen=scen)
         return aux
 
-    aux = _tree_nbytes(jax.eval_shape(build_aux))
-    return 2 * state + aux
+    aux = 2 * _tree_nbytes(jax.eval_shape(build_aux))
+    if fused_ticks > 1:
+        from raft_kotlin_tpu.ops.pallas_tick import resets_per_tick_bound
+
+        rb = resets_per_tick_bound(cfg.n_nodes,
+                                   cfg.uses_mailbox and cfg.delay_lo == 0)
+        aux += 2 * cfg.n_nodes * (rb + 1) * cfg.n_groups * 4
+    return aux
+
+
+def state_aux_bytes_per_tick(cfg, layout: str = "wide",
+                             aux_source: str = "staged",
+                             fused_ticks: int = 1) -> int:
+    """HBM bytes the tick must move at minimum: state read+written once
+    (state_bytes_per_tick) plus the aux stream per `aux_source`
+    (aux_bytes_per_tick — staged is written AND read; inkernel is the
+    amortized resident-table read)."""
+    return state_bytes_per_tick(cfg, layout) \
+        + aux_bytes_per_tick(cfg, aux_source, fused_ticks)
 
 
 def _auto_triage(pcfg, ktr, ntr):
@@ -987,6 +1064,11 @@ def main() -> None:
     # roofline accounting below must describe the layout actually run.
     # The packed/wide A/B is concrete-pytree accounting either way.
     headline_layout = _headline_layout(cfg)
+    # Routed aux source (ISSUE 15): like layout, the plan layer picks
+    # staged|inkernel; the accounting below must describe the source the
+    # winning rung actually carried (aux_source_run), with the refined
+    # fused-aware aux term substituted once the fused-T probe resolves.
+    headline_aux = _headline_aux_source(cfg)
     bytes_per_tick_wide = state_aux_bytes_per_tick(cfg, layout="wide")
     bytes_per_tick_packed = state_aux_bytes_per_tick(cfg, layout="packed")
     packed_vs_wide = round(bytes_per_tick_wide / bytes_per_tick_packed, 2)
@@ -1073,16 +1155,41 @@ def main() -> None:
             _snaps = fused_snapshot_fields(cfg, telemetry=True, monitor=True)
             _, ilp_subtiles, fused_ticks = resolve_fused_geometry(
                 cfg, interpret=False,
-                snap_rows=_snapshot_rows(cfg, _snaps))
+                snap_rows=_snapshot_rows(cfg, _snaps),
+                aux_source=headline_aux)
         elif impl == "pallas-nofuse":
             _, ilp_subtiles, fused_ticks = resolve_fused_geometry(
-                cfg, interpret=False, fused_ticks=1)
+                cfg, interpret=False, fused_ticks=1,
+                aux_source=headline_aux)
         else:
             ilp_subtiles, fused_ticks = 1, 1
     except Exception as e:
         print(f"fused/ilp routing probe failed: {str(e)[:120]}",
               file=sys.stderr)
         ilp_subtiles, fused_ticks = 1, 1
+
+    # Refined roofline accounting (ISSUE 15 satellite): now that the
+    # measured program's (layout, aux_source, fused T) are all known,
+    # substitute the routed aux term — the staged stream is written AND
+    # read (plus the fused draw tables); the in-kernel stream is just the
+    # amortized resident-table read. achieved_bw must describe the
+    # program the headline ACTUALLY ran. aux_vs_staged is the modeled
+    # whole-tick byte ratio staged/inkernel at the same layout+T — the
+    # round's headline lever, published regardless of routing.
+    aux_source_run = (headline_aux if impl.startswith("pallas")
+                      else "staged")
+    aux_bpt = aux_bytes_per_tick(cfg, aux_source_run, fused_ticks)
+    bytes_per_tick = state_bytes_per_tick(cfg, layout_run) + aux_bpt
+    achieved_bw = bytes_per_tick * (ticks / best)
+    hbm_bw_frac = round(achieved_bw / peak, 3) if peak else None
+    if (hbm_bw_frac is not None and hbm_bw_frac > 1.0
+            and not suspect_reasons):
+        suspect_reasons = [f"hbm_bw_frac {hbm_bw_frac} > 1.0 "
+                           "(physically impossible)"]
+    aux_vs_staged = round(
+        state_aux_bytes_per_tick(cfg, layout_run, "staged", fused_ticks)
+        / state_aux_bytes_per_tick(cfg, layout_run, "inkernel",
+                                   fused_ticks), 2)
 
     # Fused-vs-T=1 A/B (ISSUE 7): the same builder with fused_ticks pinned
     # to 1 — the measured launch-amortization payoff, and the source of the
@@ -1799,10 +1906,20 @@ def main() -> None:
         "rep_times_s": [round(t, 4) for t in times1],
         "churn_rep_times_s": [round(t, 4) for t in ctimes],
         # Perf model (roofline anchor). bytes_per_tick is CONCRETE-pytree
-        # accounting for the layout the headline actually ran (ISSUE 11);
-        # the packed/wide pair and their ratio are the layout A/B.
+        # accounting for the (layout, aux_source, fused T) the headline
+        # actually ran (ISSUE 11 + ISSUE 15); the packed/wide pair and
+        # their ratio are the layout A/B at the staged T=1 model. The aux
+        # stream is published as its own term: staged is written by the
+        # XLA pre-pass AND read by the kernel (plus the fused draw
+        # tables); inkernel is the amortized resident-table read, and
+        # aux_vs_staged is the modeled whole-tick ratio at the same
+        # layout+T (the distance to the 2*state floor the staged stream
+        # was costing).
         "bytes_per_tick": bytes_per_tick,
         "layout": layout_run,
+        "aux_source": aux_source_run,
+        "aux_bytes_per_tick": aux_bpt,
+        "aux_vs_staged": aux_vs_staged,
         "bytes_per_tick_wide": bytes_per_tick_wide,
         "bytes_per_tick_packed": bytes_per_tick_packed,
         "packed_vs_wide": packed_vs_wide,

@@ -9,11 +9,18 @@ is read from HBM once, lives in VMEM across all phases, and is written back once
 Division of labor (bit-compatibility by construction):
 - The phase logic is literally ops/tick.phase_body — the same function object the XLA
   tick runs; this module only changes where its inputs/outputs live.
-- ALL randomness stays outside the kernel in ordinary XLA jax.random ops
-  (ops/tick.make_aux / finish_tick): every draw phase_body needs is derivable from
-  pre-tick state, except the deferred election draws, which the kernel reports back
-  via an el_dirty output and finish_tick materializes. No threefry in Mosaic, no
-  bit-replication risk.
+- Randomness has TWO routed sources (aux_source, a plan dimension since r17):
+  "staged" keeps every draw outside the kernel in ordinary XLA jax.random ops
+  (ops/tick.make_aux / finish_tick) — aux masks arrive as materialized HBM
+  arrays the kernel re-reads; "inkernel" re-derives the SAME bits inside the
+  kernel from (seed, tick, group) counters via utils/rng's kt_* threefry
+  twins (SEMANTICS.md §17) — the aux HBM stream and its XLA pre-pass
+  disappear, and only a few resident key/scenario rows cross the launch.
+  ops/tick.make_aux stays the single semantic source; the twin is pinned
+  bit-identical against it (tests/test_inkernel_aux.py), never forked. The
+  deferred election draws are unchanged either way: the kernel reports
+  el_dirty and finish_tick materializes (T=1), or the fused kernel
+  materializes in-kernel.
 - Bool state is passed to Mosaic as int32 (i1 memrefs are poorly supported) and
   converted at the kernel boundary.
 
@@ -33,10 +40,12 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from raft_kotlin_tpu.constants import LEADER
 from raft_kotlin_tpu.models.state import (MAILBOX_FIELDS, NARROW16,
                                           SNAPSHOT_FIELDS, RaftState)
 from raft_kotlin_tpu.ops import tick as tick_mod
 from raft_kotlin_tpu.ops.tick import AUX_FIELDS, STATE_FIELDS, BodyFlags, state_fields
+from raft_kotlin_tpu.utils import rng as rngmod
 from raft_kotlin_tpu.utils.config import RaftConfig
 
 _I32 = jnp.int32
@@ -210,10 +219,170 @@ def kernel_field_dtype(cfg: RaftConfig, k: str):
     return _I32
 
 
+# ---------------------------------------------------------------------------
+# In-kernel aux generation (ISSUE 15, SEMANTICS.md §17): aux_source =
+# "inkernel" deletes the staged aux HBM stream — the kernel re-derives every
+# per-tick mask/draw from a few RESIDENT rows (base-key words, launch tick,
+# global group index, the ScenarioBank's per-group (G,) rows) and the
+# tkeys/bkeys key-word planes, via utils/rng's kt_* threefry twins. The
+# host packers below build those operands; _kt_aux is the kernel-side twin
+# of ops/tick.make_aux (same channel presence rules, same fast paths, same
+# integer-exact compares — pinned bit-identical, never forked).
+
+AUX_SOURCES = ("staged", "inkernel")
+
+
+def inkernel_table_rows(cfg: RaftConfig) -> int:
+    """Rows of the resident i32 key table: [k0; k1; tick0; gidx] + one row
+    per active ScenarioBank channel (rng.scen_layout)."""
+    return 4 + len(rngmod.scen_layout(cfg))
+
+
+def inkernel_aux_statics(cfg: RaftConfig, base, tkeys, bkeys, scen) -> dict:
+    """The launch-invariant halves of the inkernel operands, computed ONCE
+    per run from the rng operand (trivial bitcasts/stacks — runtime values,
+    so compilations stay seed-independent): the key-table head (base-key
+    words) and tail (global group-index iota + scenario rows), plus the
+    (2N, G) timeout/backoff key-word planes."""
+    G = cfg.n_groups
+    scen = scen or {}
+    scen_keys = rngmod.scen_layout(cfg)
+    b0, b1 = rngmod.kt_key_words(base)
+    head = jnp.stack([jnp.broadcast_to(b0.astype(_I32), (G,)),
+                      jnp.broadcast_to(b1.astype(_I32), (G,))])
+    tail = jnp.stack([jnp.arange(G, dtype=_I32)]
+                     + [scen[nm].astype(_I32) for nm in scen_keys])
+    t0, t1 = rngmod.kt_key_words(tkeys)
+    u0, u1 = rngmod.kt_key_words(bkeys)
+    return {"head": head, "tail": tail,
+            "tkw": jnp.concatenate([t0, t1], axis=0),
+            "bkw": jnp.concatenate([u0, u1], axis=0)}
+
+
+def inkernel_aux_operands(stat: dict, tick0) -> list:
+    """The inkernel launch operands [ktab, tkw, bkw] at launch tick `tick0`
+    (the one per-launch row — a broadcast, not a draw: no XLA aux pre-pass
+    remains on the hot path)."""
+    G = stat["head"].shape[-1]
+    row = jnp.broadcast_to(jnp.asarray(tick0, _I32), (1, G))
+    return [jnp.concatenate([stat["head"], row, stat["tail"]], axis=0),
+            stat["tkw"], stat["bkw"]]
+
+
+def _kt_consts(cfg: RaftConfig, scen_keys: tuple, ktab, tkw, bkw) -> dict:
+    """Per-slab launch constants, unpacked INSIDE the kernel from the
+    resident operands: lane-uniform base-key word rows, the launch tick,
+    per-lane linear lattice indices (the row-major counters the host's
+    shaped draws consume: pair element [p, g] sits at g*N*N + p, node
+    element [n, g] at g*N + n), sender/receiver ids, and the scenario
+    rows keyed by rng.scen_layout order."""
+    N = cfg.n_nodes
+    L = ktab.shape[-1]
+    gidx = ktab[3:4]
+    p_col = jax.lax.broadcasted_iota(_I32, (N * N, 1), 0)
+    return {
+        "k0": ktab[0:1], "k1": ktab[1:2], "tick0": ktab[2:3],
+        "scen": {nm: ktab[4 + i:5 + i] for i, nm in enumerate(scen_keys)},
+        "idx_pair": gidx * (N * N)
+        + jax.lax.broadcasted_iota(_I32, (N * N, L), 0),
+        "idx_node": gidx * N + jax.lax.broadcasted_iota(_I32, (N, L), 0),
+        "s_id": p_col // N + 1, "r_id": p_col % N + 1,
+        "n_col": jax.lax.broadcasted_iota(_I32, (N, 1), 0),
+        "tk0": tkw[:N], "tk1": tkw[N:], "bk0": bkw[:N], "bk1": bkw[N:],
+    }
+
+
+def _kt_thresh(cfg: RaftConfig, scen: dict, row: str, scalar: str):
+    """A channel's 23-bit threshold: the scenario row when the bank carries
+    it, else the config scalar through p_threshold, else None (the
+    all-constant fast path) — exactly make_aux's precedence."""
+    if row in scen:
+        return scen[row]
+    p = getattr(cfg, scalar)
+    return rngmod.p_threshold(p) if p > 0 else None
+
+
+def _kt_aux(cfg: RaftConfig, flags: BodyFlags, kt: dict, s: dict, t: int):
+    """One tick's aux dict computed INSIDE the kernel — the kernel twin of
+    ops/tick.make_aux over the same channel set flags select, at launch
+    tick + t. Scripted partitions evaluate from the LIVE VMEM role/up
+    planes (at each fused tick start these equal the staged path's
+    pre-tick state — the evaluation that lifts the fused leader-iso
+    fallback). Channel dtypes match the staged kernel load path: bool for
+    _BOOL_AUX, int32 elsewhere."""
+    N = cfg.n_nodes
+    L = kt["k0"].shape[-1]
+    k0, k1, scen = kt["k0"], kt["k1"], kt["scen"]
+    tick = kt["tick0"] + t
+    aux = {}
+    if flags.delay and cfg.delay_lo < cfg.delay_hi:
+        lo = scen.get("delay_lo", cfg.delay_lo)
+        hi = scen.get("delay_hi", cfg.delay_hi)
+        aux["delay"] = rngmod.kt_delay_mask(k0, k1, tick, kt["idx_pair"],
+                                            lo, hi)
+    et = _kt_thresh(cfg, scen, "drop_t", "p_drop")
+    if et is None:
+        edge = jnp.ones((N * N, L), bool)
+    else:
+        edge = rngmod.kt_edge_ok_mask(k0, k1, tick, kt["idx_pair"], et)
+    if "part_kind" in scen:
+        lead_s, lead_r = None, None
+        if cfg.scenario is not None and cfg.scenario.needs_state:
+            lead = (s["role"] == LEADER) & s["up"]  # (N, L) live planes
+            lead_s = jnp.zeros((N * N, L), bool)
+            lead_r = jnp.zeros((N * N, L), bool)
+            for n in range(N):
+                lead_s = lead_s | ((kt["s_id"] == n + 1) & lead[n:n + 1])
+                lead_r = lead_r | ((kt["r_id"] == n + 1) & lead[n:n + 1])
+        down = rngmod.kt_part_down(
+            scen["part_kind"], scen["part_cut"], scen["part_src"],
+            scen["part_dst"], rngmod.scenario_active(scen, tick),
+            kt["s_id"], kt["r_id"], lead_s, lead_r)
+        edge = edge & ~down
+    aux["edge_iid"] = edge.astype(_I32)
+    if flags.faults:
+        ct = _kt_thresh(cfg, scen, "crash_t", "p_crash")
+        rt = _kt_thresh(cfg, scen, "restart_t", "p_restart")
+        crash = (jnp.zeros((N, L), bool) if ct is None else
+                 rngmod.kt_event_mask(k0, k1, rngmod.KIND_CRASH, tick,
+                                      kt["idx_node"], ct))
+        restart = (jnp.zeros((N, L), bool) if rt is None else
+                   rngmod.kt_event_mask(k0, k1, rngmod.KIND_RESTART, tick,
+                                        kt["idx_node"], rt))
+        W = 0 if cfg.scenario is None else cfg.scenario.warmup_down
+        if W:
+            # §15 warmup-down on the kernel (N, L) orientation — the same
+            # rule as rng.apply_warmup_faults on the transposed lattice.
+            notcmd = kt["n_col"] != (cfg.cmd_node - 1)
+            hold = (tick < W) & notcmd
+            crash = crash | hold
+            restart = (restart & ~hold) | ((tick == W) & notcmd)
+        aux["crash_m"], aux["restart_m"] = crash, restart
+        aux["el_draw_f"] = rngmod.kt_draw_uniform(
+            kt["tk0"], kt["tk1"], s["t_ctr"], cfg.el_lo, cfg.el_hi)
+    if flags.links:
+        ft = _kt_thresh(cfg, scen, "link_fail_t", "p_link_fail")
+        ht = _kt_thresh(cfg, scen, "link_heal_t", "p_link_heal")
+        aux["link_fail"] = (
+            jnp.zeros((N * N, L), _I32) if ft is None else
+            rngmod.kt_event_mask(k0, k1, rngmod.KIND_LINK_FAIL, tick,
+                                 kt["idx_pair"], ft).astype(_I32))
+        aux["link_heal"] = (
+            jnp.zeros((N * N, L), _I32) if ht is None else
+            rngmod.kt_event_mask(k0, k1, rngmod.KIND_LINK_HEAL, tick,
+                                 kt["idx_pair"], ht).astype(_I32))
+    aux["bdraw"] = rngmod.kt_draw_uniform(
+        kt["bk0"], kt["bk1"], s["b_ctr"], cfg.bo_lo, cfg.bo_hi)
+    if flags.periodic:
+        due = ((tick % cfg.cmd_period) == 0) & (tick > 0)
+        aux["periodic"] = jnp.where(due, tick, -jnp.ones_like(tick))
+    return aux
+
+
 def make_pallas_core(cfg: RaftConfig, lanes: int, tile_g: int, interpret: bool,
                      subtiles: int = 1, fused_ticks: int = 1,
                      resets_bound: Optional[int] = None,
-                     tick_states: tuple = ()):
+                     tick_states: tuple = (), aux_source: str = "staged"):
     """Per-flags builder of the raw megakernel over arrays with `lanes` lane columns
     (the flat phase_body layout). Used with lanes = n_groups for single-device runs
     (make_pallas_tick) and lanes = the per-device shard width under shard_map
@@ -243,10 +412,24 @@ def make_pallas_core(cfg: RaftConfig, lanes: int, tile_g: int, interpret: bool,
     the VMEM tile model is unchanged. K must divide tile_g; on hardware the
     sub-slab must stay lane-register aligned (tile_g/K a multiple of 128 —
     route_ilp_subtiles enforces this; tests pass arbitrary K in interpret
-    mode)."""
+    mode).
+
+    `aux_source` = "inkernel" (ISSUE 15, §17) drops the staged aux operands
+    entirely: the kernel's inputs become state + the three RESIDENT planes
+    [ktab (inkernel_table_rows, lanes), tkw (2N, lanes), bkw (2N, lanes)]
+    and every aux channel is re-derived INSIDE the kernel by _kt_aux from
+    the utils/rng kt_* twins — bit-identical to the staged draws by the
+    §17 pins. build_call still returns (call, sfields, aux_names); the
+    aux_names tuple stays the CHANNEL set (introspection), but callers
+    assemble operands per aux_source (inkernel_aux_operands)."""
+    if aux_source not in AUX_SOURCES:
+        raise ValueError(f"unknown aux_source {aux_source!r}")
     if fused_ticks > 1:
         return _make_fused_core(cfg, lanes, tile_g, interpret, subtiles,
-                                fused_ticks, resets_bound, tick_states)
+                                fused_ticks, resets_bound, tick_states,
+                                aux_source=aux_source)
+    inkernel = aux_source == "inkernel"
+    scen_keys = rngmod.scen_layout(cfg) if inkernel else ()
     N, C = cfg.n_nodes, cfg.phys_capacity
     assert lanes % tile_g == 0, (lanes, tile_g)
     SUB = max(1, subtiles)
@@ -298,10 +481,19 @@ def make_pallas_core(cfg: RaftConfig, lanes: int, tile_g: int, interpret: bool,
             or (k == "inject" and flags.inject)
             or (k == "delay" and flags.delay and cfg.delay_lo < cfg.delay_hi)
         )
+        if inkernel and flags.inject:
+            raise ValueError(
+                "aux_source='inkernel' has no inject channel: per-tick "
+                "driver inputs are a staged-aux (T=1 fallback) surface")
+        n_aux_in = 3 if inkernel else len(aux_names)
 
         def kernel(*refs):
-            n_in = len(sfields) + len(aux_names)
-            ins = dict(zip(sfields + aux_names, refs[:n_in]))
+            n_in = len(sfields) + n_aux_in
+            ins = dict(zip(sfields, refs[:len(sfields)]))
+            if not inkernel:
+                ins.update(zip(aux_names, refs[len(sfields):n_in]))
+            else:
+                kt_loads = [r[...] for r in refs[len(sfields):n_in]]
             outs = dict(zip(sfields + ("el_dirty",), refs[n_in:]))
             # Blocks cross HBM in the narrow storage dtypes (the round-4 DMA
             # win); the kernel INTERIOR widens to int32 — Mosaic's int16
@@ -310,7 +502,7 @@ def make_pallas_core(cfg: RaftConfig, lanes: int, tile_g: int, interpret: bool,
             # no faster anyway (probe_headline_dtypes). Logs keep their
             # storage dtype: their (C, tile) one-hot ops are rank-2 and the
             # int16 log kernel is TPU-proven (TPU_PALLAS variant_int16_logs).
-            loaded = {k: ins[k][...] for k in sfields + aux_names}
+            loaded = {k: ins[k][...] for k in ins}
             parts = {k: [] for k in sfields}
             el_parts = []
             for kk in range(SUB):
@@ -330,10 +522,16 @@ def make_pallas_core(cfg: RaftConfig, lanes: int, tile_g: int, interpret: bool,
                         s[k] = v
                     else:
                         s[k] = v.astype(_I32)
-                aux = {}
-                for k in aux_names:
-                    v = slab(loaded[k])
-                    aux[k] = (v != 0) if k in _BOOL_AUX else v.astype(_I32)
+                if inkernel:
+                    kt = _kt_consts(cfg, scen_keys,
+                                    *(slab(v) for v in kt_loads))
+                    aux = _kt_aux(cfg, flags, kt, s, 0)
+                else:
+                    aux = {}
+                    for k in aux_names:
+                        v = slab(loaded[k])
+                        aux[k] = (v != 0) if k in _BOOL_AUX \
+                            else v.astype(_I32)
                 el_dirty = tick_mod.phase_body(cfg, s, aux, flags)
                 for k in sfields:
                     parts[k].append(
@@ -352,7 +550,12 @@ def make_pallas_core(cfg: RaftConfig, lanes: int, tile_g: int, interpret: bool,
             return kernel_field_dtype(cfg, k)
 
         in_specs = [block_spec(field_shapes[k]) for k in sfields]
-        in_specs += [block_spec(aux_shapes[k]) for k in aux_names]
+        if inkernel:
+            in_specs += [block_spec((4 + len(scen_keys), tile_g)),
+                         block_spec((2 * N, tile_g)),
+                         block_spec((2 * N, tile_g))]
+        else:
+            in_specs += [block_spec(aux_shapes[k]) for k in aux_names]
         out_shapes = [
             jax.ShapeDtypeStruct(
                 tuple(field_shapes[k][:-1]) + (lanes,), field_dtype(k))
@@ -377,7 +580,8 @@ def make_pallas_core(cfg: RaftConfig, lanes: int, tile_g: int, interpret: bool,
 
 def _make_fused_core(cfg: RaftConfig, lanes: int, tile_g: int,
                      interpret: bool, subtiles: int, T: int,
-                     resets_bound: Optional[int], tick_states: tuple):
+                     resets_bound: Optional[int], tick_states: tuple,
+                     aux_source: str = "staged"):
     """The fused-T megakernel builder (ISSUE 7): T full phase lattices per
     pallas_call with state resident in VMEM between ticks — HBM load once,
     store once per T-block — composed with the sub-tile ILP: each of the K
@@ -410,7 +614,20 @@ def _make_fused_core(cfg: RaftConfig, lanes: int, tile_g: int,
     build_call(flags) -> (call, sfields, aux_names, snap_fields); call
     takes [state..., aux T-slabs..., el_table (N*W, lanes), b_table
     (N*T, lanes)] and returns state fields (aliased), the overflow count,
-    then T * len(snap_fields) snapshot blocks (tick-major)."""
+    then T * len(snap_fields) snapshot blocks (tick-major).
+
+    `aux_source` = "inkernel" (ISSUE 15, §17) REPLACES the T-stacked aux
+    slabs AND both draw tables with the three resident planes [ktab, tkw,
+    bkw]: every per-tick channel is re-derived inside the T-loop by
+    _kt_aux at launch tick + t, the counted el/backoff draws come from
+    kt_draw_uniform at the LIVE counters (no table window, so no overflow
+    is possible — the overflow output is kept, always zero, preserving
+    the unpack/checked contract), el_left is re-drawn at t_ctr - 1, and
+    scripted partitions read the CURRENT tick's pre-phase role/up planes —
+    which is why leader-isolation banks fuse only on this path
+    (resolve_fused_geometry lifts the sticky T->1 gate)."""
+    inkernel = aux_source == "inkernel"
+    scen_keys = rngmod.scen_layout(cfg) if inkernel else ()
     N, C = cfg.n_nodes, cfg.phys_capacity
     assert lanes % tile_g == 0, (lanes, tile_g)
     SUB = max(1, subtiles)
@@ -458,14 +675,19 @@ def _make_fused_core(cfg: RaftConfig, lanes: int, tile_g: int,
         snap_names = tuple(f"{k}@{t}" for t in range(T) for k in snap_fields)
 
         def kernel(*refs):
-            n_in = len(sfields) + len(aux_names)
             ins = dict(zip(sfields, refs[:len(sfields)]))
-            slabs = {k: r[...] for k, r in
-                     zip(aux_names, refs[len(sfields):n_in])}
-            el_tab = refs[n_in][...].astype(_I32)
-            b_tab = refs[n_in + 1][...].astype(_I32)
+            if inkernel:
+                n_in = len(sfields) + 3
+                kt_loads = [r[...] for r in refs[len(sfields):n_in]]
+                slabs, el_tab, b_tab = {}, None, None
+            else:
+                n_in = len(sfields) + len(aux_names) + 2
+                slabs = {k: r[...] for k, r in
+                         zip(aux_names, refs[len(sfields):])}
+                el_tab = refs[n_in - 2][...].astype(_I32)
+                b_tab = refs[n_in - 1][...].astype(_I32)
             outs = dict(zip(sfields + ("overflow",) + snap_names,
-                            refs[n_in + 2:]))
+                            refs[n_in:]))
             loaded = {k: ins[k][...] for k in sfields}
             parts = {k: [] for k in sfields}
             ov_parts = []
@@ -488,7 +710,12 @@ def _make_fused_core(cfg: RaftConfig, lanes: int, tile_g: int,
                         s[k] = v
                     else:
                         s[k] = v.astype(_I32)
-                el_slab, b_slab = slab(el_tab), slab(b_tab)
+                if inkernel:
+                    kt = _kt_consts(cfg, scen_keys,
+                                    *(slab(v) for v in kt_loads))
+                    el_slab = b_slab = None
+                else:
+                    el_slab, b_slab = slab(el_tab), slab(b_tab)
                 ov = {"m": jnp.zeros((N, sub_w), _I32)}
 
                 def sel(table, Wn, delta):
@@ -513,17 +740,31 @@ def _make_fused_core(cfg: RaftConfig, lanes: int, tile_g: int,
 
                 t0, b0 = s["t_ctr"], s["b_ctr"]
                 for t in range(T):
-                    aux = {}
-                    for name in aux_names:
-                        r = aux_rows[name]
-                        v = slab(slabs[name][t * r:(t + 1) * r])
-                        aux[name] = (v != 0) if name in _BOOL_AUX \
-                            else v.astype(_I32)
-                    if flags.faults:
-                        aux["el_draw_f"] = sel(el_slab, W, s["t_ctr"] - t0)
-                    aux["bdraw"] = sel(b_slab, T, s["b_ctr"] - b0)
+                    if inkernel:
+                        # §17 in-kernel aux: every channel re-drawn here
+                        # from the resident key planes at the LIVE
+                        # counters/tick — no tables, no window, no
+                        # overflow (ov stays zero), and partitions see
+                        # this tick's pre-phase role/up.
+                        aux = _kt_aux(cfg, flags, kt, s, t)
+                    else:
+                        aux = {}
+                        for name in aux_names:
+                            r = aux_rows[name]
+                            v = slab(slabs[name][t * r:(t + 1) * r])
+                            aux[name] = (v != 0) if name in _BOOL_AUX \
+                                else v.astype(_I32)
+                        if flags.faults:
+                            aux["el_draw_f"] = sel(el_slab, W,
+                                                   s["t_ctr"] - t0)
+                        aux["bdraw"] = sel(b_slab, T, s["b_ctr"] - b0)
                     el_dirty = tick_mod.phase_body(cfg, s, aux, flags)
-                    d = sel(el_slab, W, s["t_ctr"] - 1 - t0)
+                    if inkernel:
+                        d = rngmod.kt_draw_uniform(
+                            kt["tk0"], kt["tk1"], s["t_ctr"] - 1,
+                            cfg.el_lo, cfg.el_hi)
+                    else:
+                        d = sel(el_slab, W, s["t_ctr"] - 1 - t0)
                     s["el_left"] = jnp.where(el_dirty, d, s["el_left"])
                     for k in snap_fields:
                         snap_parts[k][t].append(
@@ -550,9 +791,15 @@ def _make_fused_core(cfg: RaftConfig, lanes: int, tile_g: int,
                 if k in ("log_term", "log_cmd") else _I32
 
         in_specs = [block_spec(field_shapes[k]) for k in sfields]
-        in_specs += [block_spec((T * aux_rows[k], tile_g))
-                     for k in aux_names]
-        in_specs += [block_spec((N * W, tile_g)), block_spec((N * T, tile_g))]
+        if inkernel:
+            in_specs += [block_spec((4 + len(scen_keys), tile_g)),
+                         block_spec((2 * N, tile_g)),
+                         block_spec((2 * N, tile_g))]
+        else:
+            in_specs += [block_spec((T * aux_rows[k], tile_g))
+                         for k in aux_names]
+            in_specs += [block_spec((N * W, tile_g)),
+                         block_spec((N * T, tile_g))]
         out_shapes = [
             jax.ShapeDtypeStruct(
                 tuple(field_shapes[k][:-1]) + (lanes,),
@@ -698,7 +945,7 @@ def cast_flat_out(cfg, outs, sfields, with_dirty: bool = True):
 def make_pallas_tick(cfg: RaftConfig, tile_g: Optional[int] = None,
                      interpret: Optional[bool] = None,
                      ilp_subtiles: Optional[int] = None,
-                     fused_ticks: int = 1):
+                     fused_ticks: int = 1, aux_source: str = "staged"):
     """Build tick(state, inject=None, fault_cmd=None[, rng]) -> state — same
     contract and same bits as ops.tick.make_tick(cfg), different compilation
     strategy. `ilp_subtiles` pins the sub-tile ILP count (make_pallas_core);
@@ -711,18 +958,28 @@ def make_pallas_tick(cfg: RaftConfig, tile_g: Optional[int] = None,
     drivers are a T=1 sticky-fallback surface, like trace mode. The
     draw-table overflow flag is checked when the call runs EAGERLY
     (raises RuntimeError); under an outer jit the check cannot run —
-    use make_pallas_scan, whose scan-level channels always surface it."""
+    use make_pallas_scan, whose scan-level channels always surface it.
+
+    `aux_source` = "inkernel" (ISSUE 15, §17) draws every aux channel
+    inside the kernel from the resident key planes — no make_aux /
+    fused_launch_aux pre-pass. inject/fault_cmd are rejected on EVERY
+    inkernel path (per-tick driver inputs are a staged surface)."""
     N, C, G = cfg.n_nodes, cfg.phys_capacity, cfg.n_groups
+    if aux_source not in AUX_SOURCES:
+        raise ValueError(f"unknown aux_source {aux_source!r}")
+    inkernel = aux_source == "inkernel"
     default_rng: list = []  # derived lazily; wrappers always pass rng explicitly
 
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
     if fused_ticks > 1:
         tile_g, ilp_subtiles, T_f = resolve_fused_geometry(
-            cfg, interpret, tile_g, ilp_subtiles, fused_ticks)
+            cfg, interpret, tile_g, ilp_subtiles, fused_ticks,
+            aux_source=aux_source)
         build_call_f = make_pallas_core(cfg, G, tile_g, interpret,
                                         subtiles=ilp_subtiles,
-                                        fused_ticks=T_f)
+                                        fused_ticks=T_f,
+                                        aux_source=aux_source)
 
         def tick_fused(state, inject=None, fault_cmd=None, rng=None):
             assert inject is None and fault_cmd is None, (
@@ -735,11 +992,22 @@ def make_pallas_tick(cfg: RaftConfig, tile_g: Optional[int] = None,
                         default_rng.append(tick_mod.make_rng(cfg))
                 rng = default_rng[0]
             base, tkeys, bkeys, scen = tick_mod.split_rng(rng)
+            flat = tick_mod.flatten_state(cfg, state)
+            if inkernel:
+                stat = inkernel_aux_statics(cfg, base, tkeys, bkeys, scen)
+                call, sfields, aux_names, _snaps = build_call_f(
+                    tick_mod.make_flags(cfg))
+                outs = call(*(cast_flat_in(flat, {}, sfields, ())
+                              + inkernel_aux_operands(stat, state.tick)))
+                s2, ov, _ = unpack_fused_outputs(outs, sfields, (), T_f)
+                s, _ = cast_flat_out(cfg, [s2[k] for k in sfields],
+                                     sfields, with_dirty=False)
+                return RaftState(**tick_mod.unflatten_state(cfg, s),
+                                 tick=state.tick + T_f)
             per, flags, (el_tab, b_tab) = fused_launch_aux(
                 cfg, base, tkeys, bkeys, state.tick, state.t_ctr,
                 state.b_ctr, T_f, scen=scen)
             call, sfields, aux_names, _snaps = build_call_f(flags)
-            flat = tick_mod.flatten_state(cfg, state)
             outs = call(*(cast_flat_in(flat, {}, sfields, ())
                           + fused_aux_slabs(per, aux_names)
                           + [el_tab, b_tab]))
@@ -760,7 +1028,8 @@ def make_pallas_tick(cfg: RaftConfig, tile_g: Optional[int] = None,
         cfg, interpret, 1, tile_g, ilp_subtiles)
 
     build_call = make_pallas_core(cfg, G, tile_g, interpret,
-                                  subtiles=ilp_subtiles)
+                                  subtiles=ilp_subtiles,
+                                  aux_source=aux_source)
 
     def tick(
         state: RaftState,
@@ -779,11 +1048,22 @@ def make_pallas_tick(cfg: RaftConfig, tile_g: Optional[int] = None,
                     default_rng.append(tick_mod.make_rng(cfg))
             rng = default_rng[0]
         base, tkeys, bkeys, scen = tick_mod.split_rng(rng)
-        aux, flags = tick_mod.make_aux(
-            cfg, base, tkeys, bkeys, state, inject, fault_cmd, scen=scen)
-        call, sfields, aux_names = build_call(flags)
         flat = tick_mod.flatten_state(cfg, state)
-        outs = call(*cast_flat_in(flat, aux, sfields, aux_names))
+        if inkernel:
+            if inject is not None or fault_cmd is not None:
+                raise ValueError(
+                    "aux_source='inkernel' takes no per-tick driver inputs "
+                    "(inject/fault_cmd are a staged-aux surface)")
+            stat = inkernel_aux_statics(cfg, base, tkeys, bkeys, scen)
+            call, sfields, aux_names = build_call(tick_mod.make_flags(cfg))
+            outs = call(*(cast_flat_in(flat, {}, sfields, ())
+                          + inkernel_aux_operands(stat, state.tick)))
+        else:
+            aux, flags = tick_mod.make_aux(
+                cfg, base, tkeys, bkeys, state, inject, fault_cmd,
+                scen=scen)
+            call, sfields, aux_names = build_call(flags)
+            outs = call(*cast_flat_in(flat, aux, sfields, aux_names))
         s, el_dirty = cast_flat_out(cfg, outs, sfields)
         return tick_mod.finish_tick(
             cfg, tkeys, tick_mod.unflatten_state(cfg, s), el_dirty, state.tick)
@@ -1039,7 +1319,8 @@ def resolve_fused_geometry(cfg: RaftConfig,
                            fused_ticks: Optional[int] = None,
                            snap_rows: int = 0,
                            lanes: Optional[int] = None,
-                           platform: Optional[str] = None):
+                           platform: Optional[str] = None,
+                           aux_source: str = "staged"):
     """The (tile_g, ilp_subtiles, fused_ticks) a make_pallas_scan call with
     these arguments resolves to — the fused extension of
     resolve_scan_geometry, and like it THE single copy of the resolution
@@ -1058,16 +1339,21 @@ def resolve_fused_geometry(cfg: RaftConfig,
         interpret = jax.default_backend() == "cpu"
     if platform is None:
         platform = "cpu" if interpret else None
-    if cfg.scenario is not None and cfg.scenario.needs_state:
+    if cfg.scenario is not None and cfg.scenario.needs_state \
+            and aux_source != "inkernel":
         # Leader-isolation partition programs (SEMANTICS.md §12) read the
-        # PRE-TICK roles per tick; the fused kernel precomputes all T aux
-        # dicts at launch, before those roles exist. Routed T falls back
-        # sticky to 1; a pinned T is a demand and raises.
+        # PRE-TICK roles per tick; the STAGED fused kernel precomputes all
+        # T aux dicts at launch, before those roles exist. Routed T falls
+        # back sticky to 1; a pinned T is a demand and raises. The
+        # in-kernel aux path (ISSUE 15, §17) evaluates partitions from the
+        # live VMEM role/up planes inside the T-loop, so it is EXEMPT —
+        # leader-iso universes fuse only with aux_source="inkernel".
         if fused_ticks is not None and fused_ticks > 1:
             raise ValueError(
                 "fused_ticks > 1 cannot run a leader-isolation scenario "
-                "bank (cfg.scenario.needs_state): per-tick aux depends on "
-                "pre-tick state the fused launch cannot see")
+                "bank (cfg.scenario.needs_state) with staged aux: per-tick "
+                "aux depends on pre-tick state the fused launch cannot "
+                "see; use aux_source='inkernel'")
         fused_ticks = 1
     if fused_ticks is None:
         try:
@@ -1118,7 +1404,8 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
                      monitor: bool = False,
                      fused_ticks: Optional[int] = None,
                      trace: bool = False,
-                     layout: str = "wide"):
+                     layout: str = "wide",
+                     aux_source: str = "staged"):
     """Multi-tick Pallas runner with a FLAT int32 scan carry.
 
     Scanning make_pallas_tick converts RaftState <-> the kernel's flat int32
@@ -1189,6 +1476,16 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
     telemetry=True and surfaces the latch as the recorder key
     `packed_width_overflow`. The archival K-tick path rejects packed.
 
+    `aux_source` = "inkernel" (ISSUE 15, §17) routes every launch through
+    the in-kernel aux kernels (make_pallas_core(aux_source="inkernel")):
+    the per-tick make_aux / fused_launch_aux XLA pre-passes disappear from
+    the hot path — the scan body only rebuilds the tiny resident key table
+    at the current tick (inkernel_aux_operands) — and the fused overflow
+    channel is structurally zero (live-counter draws have no table
+    window). Bit-identical to "staged" by the §17 twin pins
+    (tests/test_inkernel_aux.py differential suite). Requires
+    k_per_launch == 1 (the archival K-tick kernel stays staged-only).
+
     Returns run(state, rng) -> state (jitted; rng rides as an operand so the
     compilation is seed-independent, as everywhere else)."""
     import types
@@ -1201,6 +1498,13 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
     packed = layout == "packed"
     if layout not in ("wide", "packed"):
         raise ValueError(f"unknown layout {layout!r}")
+    if aux_source not in AUX_SOURCES:
+        raise ValueError(f"unknown aux_source {aux_source!r}")
+    inkernel = aux_source == "inkernel"
+    if inkernel and K > 1:
+        raise ValueError(
+            "aux_source='inkernel' needs k_per_launch == 1 (the archival "
+            "K-tick kernel is a staged-aux surface)")
     if packed and K > 1:
         raise ValueError(
             "layout='packed' needs k_per_launch == 1 (the archival K-tick "
@@ -1240,7 +1544,8 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
             cfg, telemetry=telemetry, monitor=monitor, trace=trace)
         tile_g, ilp_subtiles, T_f = resolve_fused_geometry(
             cfg, interpret, tile_g, ilp_subtiles, fused_ticks,
-            snap_rows=_snapshot_rows(cfg, snap_fields))
+            snap_rows=_snapshot_rows(cfg, snap_fields),
+            aux_source=aux_source)
         if T_f > 1 and not jitted and not telemetry:
             if fused_ticks is not None:
                 raise ValueError(
@@ -1256,7 +1561,8 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
             tile_g, ilp_subtiles = resolve_scan_geometry(
                 cfg, interpret, 1, tile_req, ilp_req)
     build_call = make_pallas_core(cfg, G, tile_g, interpret,
-                                  subtiles=ilp_subtiles)
+                                  subtiles=ilp_subtiles,
+                                  aux_source=aux_source)
     build_call_k = (make_pallas_core_k(cfg, G, tile_g, interpret, K,
                                        resets_bound=_resets_bound)
                     if K > 1 else None)
@@ -1264,13 +1570,15 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
                                      subtiles=ilp_subtiles,
                                      fused_ticks=T_f,
                                      resets_bound=_resets_bound,
-                                     tick_states=snap_fields)
+                                     tick_states=snap_fields,
+                                     aux_source=aux_source)
                     if K == 1 and T_f > 1 else None)
     if K > 1 and not jitted:
         raise ValueError(
             "k_per_launch > 1 requires jitted=True: the draw-table overflow "
             "flag must be host-materialized and checked after each call")
-    sfields = state_fields(tick_mod.make_flags(cfg))
+    flags_ik = tick_mod.make_flags(cfg)  # the in-kernel builders' flags
+    sfields = state_fields(flags_ik)
     if K > 1:
         n_launch, rem = divmod(n_ticks, K)
     elif T_f > 1:
@@ -1318,6 +1626,11 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
 
     def run(state: RaftState, rng):
         base, tkeys, bkeys, scen = tick_mod.split_rng(rng)
+        # The inkernel resident operands: computed ONCE per run from the
+        # rng operand (bitcasts + stacks — runtime values, so the
+        # compilation stays seed-independent like everywhere else).
+        stat = (inkernel_aux_statics(cfg, base, tkeys, bkeys, scen)
+                if inkernel else None)
         flat = tick_mod.flatten_state(cfg, state)
         # One-time entry casts (the per-tick cost this runner removes): the
         # scan carries the i32 kernel form; storage dtypes return at exit.
@@ -1327,17 +1640,24 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
 
         def body(carry, _):
             s, ovc, t, tel, mon = _carry_out(carry)
-            # The flat carry holds the real pre-tick rows, so the shim
-            # carries role/up too — leader-isolation banks work at T=1.
-            shim = types.SimpleNamespace(
-                tick=t, t_ctr=s["t_ctr"], b_ctr=s["b_ctr"],
-                role=s["role"], up=s["up"])
-            aux, flags = tick_mod.make_aux(
-                cfg, base, tkeys, bkeys, shim, None, None, scen=scen)
-            call, sfields, aux_names = build_call(flags)
+            if inkernel:
+                # No make_aux pre-pass: the kernel draws its own aux from
+                # the resident planes; only the launch-tick row changes.
+                call, sfields, aux_names = build_call(flags_ik)
+                ins = [s[k] for k in sfields] \
+                    + inkernel_aux_operands(stat, t)
+            else:
+                # The flat carry holds the real pre-tick rows, so the shim
+                # carries role/up too — leader-isolation banks work at T=1.
+                shim = types.SimpleNamespace(
+                    tick=t, t_ctr=s["t_ctr"], b_ctr=s["b_ctr"],
+                    role=s["role"], up=s["up"])
+                aux, flags = tick_mod.make_aux(
+                    cfg, base, tkeys, bkeys, shim, None, None, scen=scen)
+                call, sfields, aux_names = build_call(flags)
+                ins = [s[k] for k in sfields] + cast_aux_in(aux, aux_names)
             with telemetry_mod.engine_scope("pallas"):
-                outs = call(*([s[k] for k in sfields]
-                              + cast_aux_in(aux, aux_names)))
+                outs = call(*ins)
             s2 = dict(zip(sfields, outs[:-1]))
             s2["el_left"] = tick_mod.materialize_el(
                 cfg, tkeys, s2, outs[-1] != 0)
@@ -1387,14 +1707,22 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
             # outputs — same step functions as the 1-tick body, so their
             # carries are bit-equal to the unfused run.
             s, ovc, t, tel, mon = _carry_out(carry)
-            per, flags, (el_tab, b_tab) = fused_launch_aux(
-                cfg, base, tkeys, bkeys, t, s["t_ctr"], s["b_ctr"], T_f,
-                resets_bound=_resets_bound, scen=scen)
-            call, sfields_f, aux_names, snaps = build_call_f(flags)
+            if inkernel:
+                # No fused_launch_aux pre-pass and no draw tables: the
+                # T-loop draws every channel in-kernel (ov is structurally
+                # zero — live counters have no table window).
+                call, sfields_f, aux_names, snaps = build_call_f(flags_ik)
+                ins = [s[k] for k in sfields_f] \
+                    + inkernel_aux_operands(stat, t)
+            else:
+                per, flags, (el_tab, b_tab) = fused_launch_aux(
+                    cfg, base, tkeys, bkeys, t, s["t_ctr"], s["b_ctr"],
+                    T_f, resets_bound=_resets_bound, scen=scen)
+                call, sfields_f, aux_names, snaps = build_call_f(flags)
+                ins = [s[k] for k in sfields_f] \
+                    + fused_aux_slabs(per, aux_names) + [el_tab, b_tab]
             with telemetry_mod.engine_scope("pallas-fused"):
-                outs = call(*([s[k] for k in sfields_f]
-                              + fused_aux_slabs(per, aux_names)
-                              + [el_tab, b_tab]))
+                outs = call(*ins)
             s2, ov, ticks_f = unpack_fused_outputs(
                 outs, sfields_f, snaps, T_f)
             tel, mon = fused_observe(cfg, s, ticks_f, tel, mon)

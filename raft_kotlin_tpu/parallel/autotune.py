@@ -63,10 +63,11 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
 CACHE_PATH = os.path.join(REPO_ROOT, ".autotune_cache.json")
 
 PLAN_FIELDS = ("engine", "ilp_subtiles", "fused_ticks", "layout",
-               "sharding", "tile", "compaction")
+               "sharding", "tile", "compaction", "aux_source")
 REGIMES = ("shallow", "deep")
 DEEP_ENGINES = ("fc", "batched", "flat")
 LAYOUTS = ("wide", "packed")
+AUX_SOURCES = ("staged", "inkernel")
 
 # The 128-lane vreg floor (ops/pallas_tick.make_pallas_core's hardware
 # assertion): a routed K must keep tile // K a multiple of 128.
@@ -206,10 +207,11 @@ def default_plan(key: dict) -> dict:
     if key["regime"] == "deep":
         return {"engine": "flat", "ilp_subtiles": 1, "fused_ticks": 1,
                 "layout": "wide", "sharding": "shard_map", "tile": None,
-                "compaction": "off"}
+                "compaction": "off", "aux_source": "staged"}
     return {"engine": "pallas", "ilp_subtiles": 1, "fused_ticks": 1,
             "layout": "wide", "sharding": "shard_map",
-            "tile": key["lanes"], "compaction": "off"}
+            "tile": key["lanes"], "compaction": "off",
+            "aux_source": "staged"}
 
 
 def apply_guards(key: dict, plan: dict) -> dict:
@@ -237,12 +239,19 @@ def apply_guards(key: dict, plan: dict) -> dict:
     # dimension normalize to "off" (plan_for overrides from the config —
     # compaction is a CONFIG property, never a tunable).
     plan.setdefault("compaction", "off")
+    # r17 migration contract: rows/caches predating the aux_source
+    # dimension normalize to "staged" (the bit-proven legacy path; a
+    # vetted inkernel round arms via scripts/probe_aux_stream.py --pin).
+    plan.setdefault("aux_source", "staged")
     if key["platform"] == "cpu":
         if key["regime"] == "deep":
             plan["engine"] = "flat"
         plan["ilp_subtiles"] = 1
         plan["fused_ticks"] = 1
         plan["layout"] = "wide"
+        # CPU differential guard: the staged path is the byte-identity
+        # reference the whole interpret-mode suite compares against.
+        plan["aux_source"] = "staged"
         return plan
     tile = plan.get("tile")
     k = int(plan.get("ilp_subtiles") or 1)
@@ -441,7 +450,8 @@ def plan_for(cfg, mesh=None, platform: Optional[str] = None,
             # router applies; a table entry can never override it).
             plan, source = ({"engine": "flat", "ilp_subtiles": 1,
                              "fused_ticks": 1, "layout": "wide",
-                             "sharding": "shard_map", "tile": None},
+                             "sharding": "shard_map", "tile": None,
+                             "aux_source": "staged"},
                             "guard")
         else:
             plan, source = resolve_plan(
@@ -451,6 +461,9 @@ def plan_for(cfg, mesh=None, platform: Optional[str] = None,
                 with_source=True)
         plan = dict(plan)
         plan["sharding"] = "shard_map" if mesh is not None else "single"
+        # The XLA/deep engines have no in-kernel draw path — aux stays
+        # staged regardless of what a (mis)pinned row says.
+        plan["aux_source"] = "staged"
         if cfg.uses_compaction:
             # §15 compaction dimension (r15): a config property, stamped
             # onto the plan. The fc engine has no ring-map support (its
@@ -479,7 +492,7 @@ def plan_for(cfg, mesh=None, platform: Optional[str] = None,
         plan = {"engine": "xla", "ilp_subtiles": 1, "fused_ticks": 1,
                 "layout": "wide", "compaction": "ring",
                 "sharding": "spmd" if mesh is not None else "single",
-                "tile": None}
+                "tile": None, "aux_source": "staged"}
         return (plan, "guard") if with_source else plan
     if not interpret:
         from raft_kotlin_tpu.ops.pallas_tick import (
@@ -499,14 +512,31 @@ def plan_for(cfg, mesh=None, platform: Optional[str] = None,
             engine, tile, k, T = "xla", None, 1, 1
     source = "pinned" if engine == "pallas" else "guard"
     layout = "wide"
+    aux_source = "staged"
     if engine == "pallas" and tile is not None:
         row_plan, source = resolve_plan(shallow_key(tile, platform=pclass),
                                         with_source=True)
         layout = row_plan.get("layout", "wide")
+        # aux_source rides the table row like layout — "staged" until a
+        # vetted inkernel measurement pins it (probe_aux_stream --pin);
+        # CPU/interpret keys were already forced staged by apply_guards.
+        aux_source = row_plan.get("aux_source", "staged")
+        if (aux_source == "inkernel" and cfg.scenario is not None
+                and cfg.scenario.needs_state):
+            # The first geometry pass assumed staged aux and took the
+            # leader-iso sticky T=1; the pinned inkernel row lifts that
+            # gate (ISSUE 15 satellite), so re-resolve at the real source.
+            tile, k, T = resolve_fused_geometry(
+                cfg, interpret=False,
+                snap_rows=_snapshot_rows(cfg, snaps),
+                lanes=lanes if mesh is not None else None,
+                platform=None if mesh is None else pclass,
+                aux_source="inkernel")
     plan = {"engine": engine, "ilp_subtiles": int(k), "fused_ticks": int(T),
             "layout": layout, "compaction": "off",
             "sharding": ("shard_map" if engine == "pallas" else "spmd")
-            if mesh is not None else "single", "tile": tile}
+            if mesh is not None else "single", "tile": tile,
+            "aux_source": aux_source}
     return (plan, source) if with_source else plan
 
 
@@ -532,7 +562,9 @@ def make_planned_run(cfg, n_ticks: int, mesh=None, telemetry: bool = False,
     plan = dict(plan) if plan is not None else plan_for(
         cfg, mesh, telemetry=telemetry, monitor=monitor)
     plan.setdefault("layout", "wide")
+    plan.setdefault("aux_source", "staged")
     layout = plan["layout"]
+    aux_source = plan["aux_source"]
     if cfg.uses_dyn_log:
         from raft_kotlin_tpu.ops.deep_cache import (
             make_deep_scan, make_sharded_deep_scan)
@@ -561,7 +593,9 @@ def make_planned_run(cfg, n_ticks: int, mesh=None, telemetry: bool = False,
                                telemetry=telemetry, monitor=monitor,
                                fused_ticks=plan["fused_ticks"]
                                if impl == "pallas" else None,
-                               layout=layout)
+                               layout=layout,
+                               aux_source=aux_source
+                               if impl == "pallas" else "staged")
         return run, plan
     if plan["engine"] == "pallas":
         from raft_kotlin_tpu.ops.pallas_tick import make_pallas_scan
@@ -570,7 +604,7 @@ def make_planned_run(cfg, n_ticks: int, mesh=None, telemetry: bool = False,
                                ilp_subtiles=plan["ilp_subtiles"],
                                fused_ticks=plan["fused_ticks"],
                                telemetry=telemetry, monitor=monitor,
-                               layout=layout)
+                               layout=layout, aux_source=aux_source)
         return run, plan
     from raft_kotlin_tpu.ops.tick import make_run
 
@@ -671,32 +705,37 @@ def measure_shallow_key(key: dict, n_ticks: int = 20,
             if tile % K or (tile // K) % VREG_LANES:
                 continue
             for L in LAYOUTS:
+                for A in AUX_SOURCES:
 
-                def gen(cfg_c, T=T, K=K, L=L):
-                    yield (lambda n: make_pallas_scan(
-                        cfg_c, n, tile_g=tile, interpret=False,
-                        jitted=False, telemetry=True, monitor=True,
-                        fused_ticks=T, ilp_subtiles=K, layout=L)), \
-                        f"pallas-T{T}K{K}-{L}"
-                try:
-                    ts, stats, _ = bench.measure(cfg, n_ticks, reps, gen)
-                    best = bench.median(ts)
-                    med = stats[ts.index(best)]
-                    if int(med.get("tel_fused_draw_overflow") or 0):
-                        continue  # clamped draws: invalid point
-                    if int(med.get("tel_packed_width_overflow") or 0):
-                        continue  # wrapped packs: invalid point
-                    timings[f"T{T}K{K}-{L}"] = round(n_ticks / best, 2)
-                except Exception as e:
-                    print(f"autotune measure T{T}K{K}-{L} failed: "
-                          f"{str(e)[:160]}")
+                    def gen(cfg_c, T=T, K=K, L=L, A=A):
+                        yield (lambda n: make_pallas_scan(
+                            cfg_c, n, tile_g=tile, interpret=False,
+                            jitted=False, telemetry=True, monitor=True,
+                            fused_ticks=T, ilp_subtiles=K, layout=L,
+                            aux_source=A)), \
+                            f"pallas-T{T}K{K}-{L}-{A}"
+                    try:
+                        ts, stats, _ = bench.measure(cfg, n_ticks, reps,
+                                                     gen)
+                        best = bench.median(ts)
+                        med = stats[ts.index(best)]
+                        if int(med.get("tel_fused_draw_overflow") or 0):
+                            continue  # clamped draws: invalid point
+                        if int(med.get("tel_packed_width_overflow") or 0):
+                            continue  # wrapped packs: invalid point
+                        timings[f"T{T}K{K}-{L}-{A}"] = round(
+                            n_ticks / best, 2)
+                    except Exception as e:
+                        print(f"autotune measure T{T}K{K}-{L}-{A} failed: "
+                              f"{str(e)[:160]}")
     if not timings:
         raise RuntimeError(f"no shallow point measurable at {key}")
     winner = max(timings, key=timings.get)
-    tk, L = winner.split("-")
+    tk, L, A = winner.split("-")
     T, K = (int(x) for x in tk[1:].split("K"))
     plan = {"engine": "pallas", "ilp_subtiles": K, "fused_ticks": T,
-            "layout": L, "sharding": "shard_map", "tile": tile}
+            "layout": L, "sharding": "shard_map", "tile": tile,
+            "aux_source": A}
     prov = {"source": f"autotune measure-on-first-use "
                       f"({jax.devices()[0].platform})",
             "measured": {"ticks_per_sec": timings, "ticks": n_ticks,
@@ -734,7 +773,9 @@ def audit_entries(entries=None, measure_fn: Optional[Callable] = None,
         match = all(plan.get(f) == e["plan"].get(f)
                     for f in ("engine", "ilp_subtiles", "fused_ticks")) \
             and (plan.get("layout") or "wide") == (
-                e["plan"].get("layout") or "wide")
+                e["plan"].get("layout") or "wide") \
+            and (plan.get("aux_source") or "staged") == (
+                e["plan"].get("aux_source") or "staged")
         out.append({"key": e["key"], "pinned": e["plan"], "measured": plan,
                     "provenance": prov, "match": match})
     return out

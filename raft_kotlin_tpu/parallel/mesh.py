@@ -313,7 +313,8 @@ def _make_shardmap_pallas_tick(cfg: RaftConfig, mesh: Mesh,
                                interpret: Optional[bool] = None,
                                fused_ticks: Optional[int] = 1,
                                telemetry: bool = False,
-                               monitor: bool = False):
+                               monitor: bool = False,
+                               aux_source: str = "staged"):
     """The Pallas megakernel applied per device shard via jax.shard_map.
 
     Division of labor mirrors ops/pallas_tick.make_pallas_tick: the RNG/aux
@@ -333,6 +334,14 @@ def _make_shardmap_pallas_tick(cfg: RaftConfig, mesh: Mesh,
     a telemetry-only run never pays the monitor's per-tick log blocks);
     make_sharded_run replays the T transitions from it, OUTSIDE shard_map
     as always. The resolved T is exposed as `tick.fused_ticks`.
+
+    `aux_source` = "inkernel" (ISSUE 15, §17): the resident key-table /
+    key-word operands are built OUTSIDE shard_map at global G (the ktab
+    gidx row carries the GLOBAL group iota, so after the lanes sharding
+    each shard's kernel derives global counter indices — the same bits as
+    the unsharded run) and the make_aux / fused_launch_aux pre-passes
+    disappear. Leader-isolation banks fuse on this path (the
+    resolve_fused_geometry gate is aux_source-aware).
     """
     from raft_kotlin_tpu.ops import tick as tick_mod
     from raft_kotlin_tpu.ops.pallas_tick import (
@@ -340,9 +349,13 @@ def _make_shardmap_pallas_tick(cfg: RaftConfig, mesh: Mesh,
         cast_flat_in,
         cast_flat_out,
         default_tile,
+        inkernel_aux_operands,
+        inkernel_aux_statics,
         make_pallas_core,
         route_ilp_subtiles,
     )
+
+    inkernel = aux_source == "inkernel"
 
     N, G = cfg.n_nodes, cfg.n_groups
     n_dev = math.prod(mesh.devices.shape)
@@ -389,26 +402,36 @@ def _make_shardmap_pallas_tick(cfg: RaftConfig, mesh: Mesh,
     tile_f, sub_k_f, T_f = resolve_fused_geometry(
         cfg, interpret, fused_ticks=fused_ticks,
         snap_rows=_snapshot_rows(cfg, snap_fields),
-        lanes=g_local, platform=platform)
+        lanes=g_local, platform=platform, aux_source=aux_source)
     if T_f <= 1:
         snap_fields = ()
     if T_f > 1:
         build_call_f = make_pallas_core(cfg, g_local, tile_f, interpret,
                                         subtiles=sub_k_f, fused_ticks=T_f,
-                                        tick_states=snap_fields)
+                                        tick_states=snap_fields,
+                                        aux_source=aux_source)
 
         def tick_fused(state: RaftState, rng):
             base, tkeys, bkeys, scen = tick_mod.split_rng(rng)
-            # The aux/draw-table pre-pass is THE shared fused assembly
-            # (fused_launch_aux/fused_aux_slabs — one copy of the
-            # outside-the-kernel half of the bit-compat contract).
-            per, flags, (el_tab, b_tab) = fused_launch_aux(
-                cfg, base, tkeys, bkeys, state.tick, state.t_ctr,
-                state.b_ctr, T_f, scen=scen)
-            call, sfields, aux_names, snaps = build_call_f(flags)
             flat = tick_mod.flatten_state(cfg, state)
-            ins = cast_flat_in(flat, {}, sfields, ()) \
-                + fused_aux_slabs(per, aux_names) + [el_tab, b_tab]
+            if inkernel:
+                # Resident operands at GLOBAL G, sharded over lanes like
+                # everything else — no aux pre-pass, no draw tables.
+                stat = inkernel_aux_statics(cfg, base, tkeys, bkeys, scen)
+                call, sfields, aux_names, snaps = build_call_f(
+                    tick_mod.make_flags(cfg))
+                ins = cast_flat_in(flat, {}, sfields, ()) \
+                    + inkernel_aux_operands(stat, state.tick)
+            else:
+                # The aux/draw-table pre-pass is THE shared fused assembly
+                # (fused_launch_aux/fused_aux_slabs — one copy of the
+                # outside-the-kernel half of the bit-compat contract).
+                per, flags, (el_tab, b_tab) = fused_launch_aux(
+                    cfg, base, tkeys, bkeys, state.tick, state.t_ctr,
+                    state.b_ctr, T_f, scen=scen)
+                call, sfields, aux_names, snaps = build_call_f(flags)
+                ins = cast_flat_in(flat, {}, sfields, ()) \
+                    + fused_aux_slabs(per, aux_names) + [el_tab, b_tab]
             n_out = len(sfields) + 1 + T_f * len(snaps)
             shard_call = shard_map_compat(
                 lambda *a: call(*a),
@@ -431,15 +454,21 @@ def _make_shardmap_pallas_tick(cfg: RaftConfig, mesh: Mesh,
         return tick_fused
 
     build_call = make_pallas_core(cfg, g_local, tile, interpret,
-                                  subtiles=sub_k)
+                                  subtiles=sub_k, aux_source=aux_source)
 
     def tick(state: RaftState, rng) -> RaftState:
         base, tkeys, bkeys, scen = tick_mod.split_rng(rng)
-        aux, flags = tick_mod.make_aux(cfg, base, tkeys, bkeys, state,
-                                       None, None, scen=scen)
-        call, sfields, aux_names = build_call(flags)
         flat = tick_mod.flatten_state(cfg, state)
-        ins = cast_flat_in(flat, aux, sfields, aux_names)
+        if inkernel:
+            stat = inkernel_aux_statics(cfg, base, tkeys, bkeys, scen)
+            call, sfields, aux_names = build_call(tick_mod.make_flags(cfg))
+            ins = cast_flat_in(flat, {}, sfields, ()) \
+                + inkernel_aux_operands(stat, state.tick)
+        else:
+            aux, flags = tick_mod.make_aux(cfg, base, tkeys, bkeys, state,
+                                           None, None, scen=scen)
+            call, sfields, aux_names = build_call(flags)
+            ins = cast_flat_in(flat, aux, sfields, aux_names)
         shard_call = shard_map_compat(
             lambda *a: call(*a),
             mesh=mesh,
@@ -540,7 +569,7 @@ def make_sharded_run(cfg: RaftConfig, mesh: Mesh, n_ticks: int,
                      metrics_every: int = 0, impl: str = "xla",
                      telemetry: bool = False, monitor: bool = False,
                      fused_ticks: Optional[int] = None,
-                     layout: str = "wide"):
+                     layout: str = "wide", aux_source: str = "staged"):
     """Compile run(state [, inject]) -> (state, metrics) sharded over `mesh`.
 
     metrics: dict of cross-group reductions emitted every `metrics_every` ticks
@@ -586,6 +615,13 @@ def make_sharded_run(cfg: RaftConfig, mesh: Mesh, n_ticks: int,
     program is untouched and stays collective-free; only the width-latch
     reduction joins the observers' collective class). External contract
     unchanged (wide in, wide out); the latch is host-checked per call.
+
+    `aux_source`="inkernel" (impl="pallas" only; ISSUE 15) draws the
+    per-tick aux set inside the kernel from resident counter tables
+    instead of staging it through HBM — see _make_shardmap_pallas_tick.
+    Sticky T=1 fallbacks above still apply, but the in-kernel path keeps
+    its aux contract at any T (the fallback rebuild threads aux_source
+    too).
     """
     from raft_kotlin_tpu.models.state import (
         check_packed_ov, pack_state, unpack_state)
@@ -594,12 +630,17 @@ def make_sharded_run(cfg: RaftConfig, mesh: Mesh, n_ticks: int,
     packed = layout == "packed"
     if layout not in ("wide", "packed"):
         raise ValueError(f"unknown layout {layout!r}")
+    if aux_source not in ("staged", "inkernel"):
+        raise ValueError(f"unknown aux_source {aux_source!r}")
+    if aux_source == "inkernel" and impl != "pallas":
+        raise ValueError("aux_source='inkernel' requires impl='pallas'")
 
     fused_block, T_f = None, 1
     if impl == "pallas":
         cand = _make_shardmap_pallas_tick(cfg, mesh, fused_ticks=fused_ticks,
                                           telemetry=telemetry,
-                                          monitor=monitor)
+                                          monitor=monitor,
+                                          aux_source=aux_source)
         T_f = getattr(cand, "fused_ticks", 1)
         if T_f > 1 and ((metrics_every and metrics_every % T_f)
                         or n_ticks < T_f):
@@ -608,9 +649,11 @@ def make_sharded_run(cfg: RaftConfig, mesh: Mesh, n_ticks: int,
             fused_block = cand
         if T_f == 1:
             shardmap_tick = cand if getattr(cand, "fused_ticks", 1) == 1 \
-                else _make_shardmap_pallas_tick(cfg, mesh)
+                else _make_shardmap_pallas_tick(cfg, mesh,
+                                                aux_source=aux_source)
         else:
-            shardmap_tick = _make_shardmap_pallas_tick(cfg, mesh)
+            shardmap_tick = _make_shardmap_pallas_tick(cfg, mesh,
+                                                       aux_source=aux_source)
         tick_fn = lambda st, rng: shardmap_tick(st, rng)
     elif cfg.uses_dyn_log:
         # Deep-log (dyn) configs: phase_body per shard — the SPMD

@@ -349,6 +349,35 @@ def scenario_link_down(scen: dict, tick, leader_gn, N: int, xp=jnp):
     return down & active[:, None, None] & (s_id != r_id)
 
 
+def scen_layout(cfg) -> tuple:
+    """The ordered tuple of ScenarioBank keys `sample_scenario_bank(cfg)`
+    produces — deterministic from the config alone, so an in-kernel launch
+    can lay its resident (G,) scenario rows out at BUILD time and the
+    runtime bank (which rides the rng operand) packs into the same slots.
+    Mirrors sample_scenario_bank's presence rules exactly (a new channel
+    there must be added here; tests/test_inkernel_aux.py pins the two
+    equal over the fuzz specs)."""
+    spec = getattr(cfg, "scenario", None)
+    if spec is None:
+        return ()
+    keys = []
+    if spec.degenerate:
+        for key, (_mx, scalar, _kind) in THRESHOLD_CHANNELS.items():
+            if getattr(cfg, scalar) > 0:
+                keys.append(key)
+        if cfg.delay_lo < cfg.delay_hi:
+            keys += ["delay_lo", "delay_hi"]
+        return tuple(keys)
+    for key, (mx_name, _scalar, _kind) in THRESHOLD_CHANNELS.items():
+        if getattr(spec, mx_name) > 0:
+            keys.append(key)
+    if spec.delay_windows:
+        keys += ["delay_lo", "delay_hi"]
+    if spec.partitions:
+        keys += list(PARTITION_KEYS)
+    return tuple(keys)
+
+
 def apply_warmup_faults(spec, cmd_node: int, tick, crash, restart, xp=jnp):
     """§15 warmup-down post-processing of the §9 crash/restart event masks
     (canonical (G, N) orientation, 0-based tick). For warmup_down = W > 0
@@ -367,3 +396,180 @@ def apply_warmup_faults(spec, cmd_node: int, tick, crash, restart, xp=jnp):
     hold = (tick < W) & notcmd
     rejoin = (tick == W) & notcmd
     return crash | hold, (restart & ~hold) | rejoin
+
+
+# ---------------------------------------------------------------------------
+# Kernel twin (SEMANTICS.md §17): counter-based threefry2x32 as plain int32
+# lattice arithmetic — evaluable inside a Mosaic kernel (adds wrap, xor,
+# shifts; no jax.random machinery) AND on the host, where the unit pins in
+# tests/test_inkernel_aux.py hold every kt_* primitive bit-identical to the
+# jax.random derivation the host channels above consume. The channel
+# functions above stay THE single semantic source; these twins re-derive
+# the same bits from (key words, linear lattice index) so the megakernel
+# can draw its own aux (ops/pallas_tick aux_source="inkernel") instead of
+# re-reading a staged HBM stream. Counter convention (pinned by the tests,
+# matching jax's threefry_partitionable u32 path on shaped draws):
+# bits(key, shape)[..flat index i..] == bitcast_u32(b0 ^ b1) where
+# (b0, b1) = kt_block(k0, k1, 0, i) over the key's two 32-bit words.
+
+_KT_PARITY = np.int32(0x1BD11BDA)
+_KT_ROT = ((13, 15, 26, 6), (17, 29, 16, 24))
+# Key-schedule injections after each 4-round group: (ks index for x0,
+# ks index for x1, round-group counter added into x1).
+_KT_INJ = ((1, 2, 1), (2, 0, 2), (0, 1, 3), (1, 2, 4), (2, 0, 5))
+
+
+def kt_key_words(keys):
+    """A (typed) jax.random key array -> its two int32 key words, shape
+    preserved. Host-side only (jax.random.key_data); the words then travel
+    into the kernel as plain int32 planes."""
+    d = jax.random.key_data(keys)
+    w = jax.lax.bitcast_convert_type(d, jnp.int32)
+    return w[..., 0], w[..., 1]
+
+
+def _kt_rotl(x, r: int):
+    return jax.lax.bitwise_or(
+        jax.lax.shift_left(x, np.int32(r)),
+        jax.lax.shift_right_logical(x, np.int32(32 - r)))
+
+
+def kt_block(k0, k1, c0, c1):
+    """One threefry2x32 block (20 rounds) on int32 words — bit-identical to
+    jax's threefry2x32 on the same (key, counter) words (wrapping int32 adds
+    == u32 adds). All four operands broadcast; returns (x0, x1)."""
+    ks2 = jax.lax.bitwise_xor(jax.lax.bitwise_xor(k0, k1), _KT_PARITY)
+    ks = (k0, k1, ks2)
+    x0 = c0 + ks[0]
+    x1 = c1 + ks[1]
+    for g in range(5):
+        for r in _KT_ROT[g % 2]:
+            x0 = x0 + x1
+            x1 = _kt_rotl(x1, r)
+            x1 = jax.lax.bitwise_xor(x1, x0)
+        a, b, d = _KT_INJ[g]
+        x0 = x0 + ks[a]
+        x1 = x1 + ks[b] + np.int32(d)
+    return x0, x1
+
+
+def kt_fold(k0, k1, d):
+    """fold_in twin: key words of jax.random.fold_in(key, d) from the words
+    of `key` — one block at counter (0, d)."""
+    d = jnp.asarray(d, jnp.int32)
+    return kt_block(k0, k1, jnp.zeros_like(d), d)
+
+
+def kt_bits32(k0, k1, idx):
+    """bits(key, shape, uint32) twin at flat lattice index `idx` (row-major
+    over the host shape), as the int32 BIT PATTERN of the u32 draw."""
+    b0, b1 = kt_block(k0, k1, jnp.zeros_like(idx), idx)
+    return jax.lax.bitwise_xor(b0, b1)
+
+
+def kt_bits23(k0, k1, idx):
+    """_event_bits twin: the 23-bit uniform lattice (bits >> P_SHIFT) behind
+    every event mask, nonneg in int32 so signed compares against the §12
+    thresholds are exact."""
+    return jax.lax.shift_right_logical(kt_bits32(k0, k1, idx),
+                                       np.int32(P_SHIFT))
+
+
+def _kt_umod(x, s):
+    """Unsigned x mod s evaluated on int32 bit patterns (s > 0 int32):
+    (x mod s) == ((x & 0x7fffffff) mod s + sign_bit * (2^31 mod s)) mod s."""
+    lo = jnp.remainder(jax.lax.bitwise_and(x, np.int32(0x7FFFFFFF)), s)
+    sign = jax.lax.bitwise_and(
+        jax.lax.shift_right_logical(x, np.int32(31)), np.int32(1))
+    top = jnp.remainder(
+        np.int32(2) * jnp.remainder(np.int32(2 ** 30), s), s)
+    return jnp.remainder(lo + sign * top, s)
+
+
+def kt_randint(k0, k1, idx, lo, span):
+    """jax.random.randint twin on [lo, lo+span) at flat lattice index `idx`
+    over the (already tick/counter-folded) key words: jax draws two 32-bit
+    lattices (keys fold_in(key, 0) / fold_in(key, 1)) and combines them as
+    (hi % span * (2^32 % span) + lo % span) % span in unsigned arithmetic.
+    `lo`/`span` are int32 scalars or broadcastable arrays (the §12 per-group
+    delay windows); span must satisfy span^2 < 2^31 (every config window
+    does — the unit pins cover the per-group array-bounds case)."""
+    z = jnp.zeros_like(idx)
+    lo = jnp.asarray(lo, jnp.int32)
+    span = jnp.asarray(span, jnp.int32)
+    ka0, ka1 = kt_fold(k0, k1, 0)
+    kb0, kb1 = kt_fold(k0, k1, 1)
+    h0, h1 = kt_block(ka0, ka1, z, idx)
+    l0, l1 = kt_block(kb0, kb1, z, idx)
+    hb = jax.lax.bitwise_xor(h0, h1)
+    lb = jax.lax.bitwise_xor(l0, l1)
+    mult = jnp.remainder(np.int32(2 ** 16), span)
+    mult = jnp.remainder(mult * mult, span)
+    off = jnp.remainder(_kt_umod(hb, span) * mult + _kt_umod(lb, span), span)
+    return lo + off
+
+
+def kt_draw_uniform(k0, k1, ctr, lo, hi):
+    """draw_uniform_keyed twin: the per-(node, group) counted draw on the
+    inclusive [lo, hi] window — fold the live counter into the static-prefix
+    key words (grid_keys), then the scalar-shape randint (lattice index 0)."""
+    c0, c1 = kt_fold(k0, k1, ctr)
+    return kt_randint(c0, c1, jnp.zeros_like(ctr), lo,
+                      jnp.asarray(hi, jnp.int32) - lo + 1)
+
+
+def kt_event_key(k0, k1, kind: int, tick):
+    """The per-(kind, tick) channel key words: fold_in(fold_in(base, kind),
+    tick) — the static half of _event_bits, shared by every lattice the
+    channel draws this tick."""
+    e0, e1 = kt_fold(k0, k1, kind)
+    return kt_fold(e0, e1, tick)
+
+
+def kt_edge_ok_mask(k0, k1, tick, idx, thresh):
+    """edge_ok_mask twin at flat (g*N*N + (s-1)*N + (r-1)) lattice index:
+    True iff the directed message survives — bits23 >= thresh, the same
+    integer-exact compare as the host (thresh scalar or per-lane row).
+    The p_drop <= 0 fast path (all-ones, no draw) is the CALLER's, decided
+    at kernel build time exactly like edge_ok_mask's early return."""
+    e0, e1 = kt_event_key(k0, k1, KIND_FAULT, tick)
+    return kt_bits23(e0, e1, idx) >= thresh
+
+
+def kt_event_mask(k0, k1, kind: int, tick, idx, thresh):
+    """event_mask twin (crash/restart/link-fail/link-heal): True = event
+    fires — bits23 < thresh. The p <= 0 fast path (all-zeros) is the
+    caller's, as in event_mask."""
+    e0, e1 = kt_event_key(k0, k1, kind, tick)
+    return kt_bits23(e0, e1, idx) < thresh
+
+
+def kt_delay_mask(k0, k1, tick, idx, lo, hi):
+    """delay_mask twin at the pair lattice index: the [lo, hi]-inclusive
+    per-directed-pair delay (lo/hi scalars or the §12 per-group rows).
+    The lo == hi scalar fast path (constant, no draw) is the caller's."""
+    d0, d1 = kt_event_key(k0, k1, KIND_DELAY, tick)
+    return kt_randint(d0, d1, idx, lo,
+                      jnp.asarray(hi, jnp.int32) - lo + 1)
+
+
+def kt_part_down(kind, cut, src, dst, active, s_id, r_id,
+                 lead_s=None, lead_r=None):
+    """scenario_link_down twin on the kernel's pair-lattice orientation:
+    every operand pre-broadcast against the (N*N, lanes) block — scen rows
+    (1, lanes), s_id/r_id (N*N, 1) or (N*N, lanes), lead_s/lead_r the
+    live-leader value of the edge's sender/receiver (the in-kernel
+    evaluation that lifts the fused leader-iso fallback: the caller builds
+    them from the CURRENT VMEM role/up planes, which at each fused tick
+    start equal the staged path's pre-tick state). Same program, same
+    flapping gate (`active` = scenario_active at this tick), same
+    self-edge exemption as the host function."""
+    split = (s_id <= cut) != (r_id <= cut)
+    asym = (s_id == src) & (r_id == dst)
+    if lead_s is None:
+        ldr = jnp.zeros(jnp.broadcast_shapes(s_id.shape, kind.shape), bool)
+    else:
+        ldr = (lead_s != 0) | (lead_r != 0)
+    down = ((kind == PART_SPLIT) & split) | ((kind == PART_ASYM) & asym) \
+        | ((kind == PART_LEADER) & ldr)
+    return down & active & (s_id != r_id)

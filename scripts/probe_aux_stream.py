@@ -1,0 +1,160 @@
+"""§17 aux-stream A/B: staged vs in-kernel aux generation (ISSUE 15).
+
+The headline megakernel has two routed randomness sources (SEMANTICS.md
+§17): "staged" draws the per-tick aux set in an XLA pre-pass and streams
+it through HBM (written once, read once — T-stacked per fused launch),
+"inkernel" derives every channel inside the kernel from resident
+(seed, tick, group) counter tables (kernel-twin threefry, bit-identical
+by the §17 pins). This probe runs BOTH sources through bench.measure —
+the SAME timing-trap-hardened harness the headline uses (distinct
+per-rep rng operands, in-region host materialization, medians) — on the
+bench stage-1 fault-soup shape, and emits per source:
+
+- gsps + rep times of the recorder+monitor-on production runner
+  (make_pallas_scan, routed layout/T/K — the exact headline rung);
+- the deterministic byte model (bench.aux_bytes_per_tick /
+  state_aux_bytes_per_tick at the routed fused T) and the modeled
+  aux_vs_staged whole-tick ratio the bench record publishes;
+- the measured inkernel-vs-staged speedup (the tentpole's claim: no XLA
+  aux pre-pass on the hot path).
+
+--pin rewrites the probed tile's SHALLOW entry in the unified
+TUNING_TABLE (parallel/autotune.shallow_key) with the winning source in
+the plan's `aux_source` dimension. Refused on CPU: interpreter timings
+cannot pin a hardware table (and the CPU guard pins "staged" anyway).
+
+  python scripts/probe_aux_stream.py [groups] [ticks] [--pin]
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_compilation_cache_dir", os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
+def pin_table(cfg, aux_source: str, source: str) -> None:
+    """Pin the probed shape's shallow entry with the winning aux_source —
+    the full routed plan is re-resolved so the row stays internally
+    consistent (an inkernel row on a leader-iso shape must carry the
+    LIFTED fused geometry, not the staged T=1 fallback)."""
+    from raft_kotlin_tpu.parallel import autotune
+
+    plan = dict(autotune.plan_for(cfg, telemetry=True, monitor=True))
+    plan["aux_source"] = aux_source
+    key = autotune.shallow_key(plan.get("tile") or cfg.n_groups,
+                               platform="tpu", dtype=cfg.log_dtype,
+                               mailbox=cfg.uses_mailbox)
+    by_key = {autotune.canonical_key(e["key"]): dict(e)
+              for e in autotune.TUNING_TABLE}
+    by_key[autotune.canonical_key(key)] = {
+        "key": key, "plan": plan, "provenance": {"source": source}}
+    autotune.pin_entries(list(by_key.values()))
+
+
+def main():
+    import bench
+    from raft_kotlin_tpu.ops.pallas_tick import (
+        _snapshot_rows, fused_snapshot_fields, make_pallas_scan,
+        resolve_fused_geometry)
+    from raft_kotlin_tpu.utils.config import RaftConfig
+
+    args = [a for a in sys.argv[1:] if a != "--pin"]
+    do_pin = "--pin" in sys.argv[1:]
+    on_accel = jax.default_backend() != "cpu"
+    groups = int(args[0]) if len(args) > 0 else (102_400 if on_accel else 256)
+    ticks = int(args[1]) if len(args) > 1 else (200 if on_accel else 10)
+    reps = int(os.environ.get("RAFT_PROBE_REPS", 3 if on_accel else 1))
+
+    # The bench stage-1 fault soup at the probed width — the shape whose
+    # TUNING_TABLE row a --pin rewrites.
+    cfg = RaftConfig(
+        n_groups=groups, n_nodes=5, log_capacity=32, cmd_period=10,
+        p_drop=0.25, p_crash=0.01, p_restart=0.08,
+        p_link_fail=0.02, p_link_heal=0.08, seed=0,
+    ).stressed(10)
+
+    layout = bench._headline_layout(cfg)
+    snaps = fused_snapshot_fields(cfg, telemetry=True, monitor=True)
+
+    def candidates(aux_source):
+        def gen(cfg_c):
+            yield (lambda n: make_pallas_scan(
+                cfg_c, n, interpret=not on_accel, jitted=False,
+                telemetry=True, monitor=True, layout=layout,
+                aux_source=aux_source)), f"pallas-{aux_source}"
+        return gen
+
+    points = {}
+    for src in ("staged", "inkernel"):
+        _, _, T = resolve_fused_geometry(
+            cfg, interpret=not on_accel,
+            snap_rows=_snapshot_rows(cfg, snaps), aux_source=src)
+        point = {
+            "fused_ticks": T,
+            "aux_bytes_per_tick": bench.aux_bytes_per_tick(cfg, src, T),
+            "bytes_per_tick": bench.state_aux_bytes_per_tick(
+                cfg, layout, src, T),
+        }
+        try:
+            ts, _stats, impl = bench.measure(cfg, ticks, reps,
+                                             candidates(src))
+            best = bench.median(ts)
+            point["impl"] = impl
+            point["gsps"] = round(groups * ticks / best, 1)
+            point["rep_times_s"] = [round(t, 4) for t in ts]
+        except Exception as e:
+            point["error"] = str(e)[:160]
+        points[src] = point
+
+    sp = points["staged"].get("gsps")
+    ip = points["inkernel"].get("gsps")
+    T_i = points["inkernel"]["fused_ticks"]
+    record = {
+        "probe": "aux_stream",
+        "platform": jax.devices()[0].platform,
+        "groups": groups,
+        "ticks": ticks,
+        "layout": layout,
+        "staged": points["staged"],
+        "inkernel": points["inkernel"],
+        "inkernel_vs_staged": (round(ip / sp, 3) if sp and ip else None),
+        # The modeled whole-tick byte ratio the bench tail publishes as
+        # aux_vs_staged — at the INKERNEL leg's fused T for both sides.
+        "aux_vs_staged": round(
+            bench.state_aux_bytes_per_tick(cfg, layout, "staged", T_i)
+            / bench.state_aux_bytes_per_tick(cfg, layout, "inkernel", T_i),
+            2),
+        "floor_2state_bytes": bench.state_bytes_per_tick(cfg, layout),
+        "pinned": False,
+    }
+    winner = None
+    if sp and ip:
+        winner = "inkernel" if ip >= sp else "staged"
+        record["winner"] = winner
+    if do_pin and winner:
+        if not on_accel:
+            print("--pin refused: CPU interpreter timings cannot pin a "
+                  "hardware table", file=sys.stderr)
+        else:
+            src = (f"probe_aux_stream {time.strftime('%Y-%m-%d')}: "
+                   f"{winner} wins ({ip} vs {sp} gsps staged, "
+                   f"G={groups}, T={T_i})")
+            pin_table(cfg, winner, src)
+            record["pinned"] = True
+    print(json.dumps(record), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -186,7 +186,13 @@ def load_record(path: str) -> Optional[dict]:
                   # regression gate, check_ring) and the unbounded figure
                   # it divides (the gsps/GB efficiency trajectory row).
                   "deeplog_ring_hbm_gb", "deeplog_ring_capacity",
-                  "deeplog_hbm_gb"):
+                  "deeplog_hbm_gb",
+                  # r17 (ISSUE 15): the aux-stream byte term (per the
+                  # routed aux_source — staged written+read vs inkernel
+                  # amortized resident read) and the modeled
+                  # staged/inkernel whole-tick ratio; the aux trajectory
+                  # row + regression gate (check_aux) read these.
+                  "aux_bytes_per_tick", "aux_vs_staged"):
         v = parsed.get(field)
         if not isinstance(v, (int, float)):
             v = _extract_field(tail, field)
@@ -200,6 +206,17 @@ def load_record(path: str) -> Optional[dict]:
         # The ring-residency gate (ISSUE 14) vets the same way — it arms
         # once the first vetted ring round lands.
         vetted["deeplog_ring_hbm_gb"] = gate_value("suspect")
+    if "aux_bytes_per_tick" in aux_num:
+        # The aux-stream gate (ISSUE 15) vets the same way; its baseline
+        # additionally filters on aux_source=inkernel (check_aux).
+        vetted["aux_bytes_per_tick"] = gate_value("suspect")
+    aux_str: Dict[str, str] = {}
+    for field in ("aux_source",):
+        v = parsed.get(field)
+        if not isinstance(v, str):
+            v = _extract_str_field(tail, field)
+        if v is not None:
+            aux_str[field] = v
     aux_bool: Dict[str, bool] = {}
     for field in AUDIT_BOOLS:
         v = parsed.get(field)
@@ -222,7 +239,7 @@ def load_record(path: str) -> Optional[dict]:
         rnd = int(m.group(1)) if m else -1
     return {"round": int(rnd), "path": os.path.basename(path),
             "legs": legs, "inv": inv, "vetted": vetted,
-            "aux_num": aux_num, "aux_bool": aux_bool}
+            "aux_num": aux_num, "aux_bool": aux_bool, "aux_str": aux_str}
 
 
 def load_all(pattern: Optional[str] = None) -> List[dict]:
@@ -344,6 +361,38 @@ def check_ring(recs: List[dict],
     return []
 
 
+def check_aux(recs: List[dict],
+              tol: float = REGRESSION_TOL) -> List[Tuple[str, float,
+                                                         float]]:
+    """[(label, latest, best prior)] when the LATEST round's aux-stream
+    byte term (aux_bytes_per_tick) GREW more than `tol` above the best
+    (lowest) prior VETTED round that ran aux_source=inkernel (ISSUE 15):
+    the figure is deterministic accounting of the routed aux stream, so
+    growth means either the resident tables widened or the plan silently
+    fell back to the staged HBM stream — the regression the round
+    existed to delete. The baseline filters on aux_source=inkernel, so
+    the gate arms itself only once a vetted inkernel round lands; the
+    staged-era rounds (whose aux term is the written+read set) are
+    published in the trajectory but never enter the baseline."""
+    if len(recs) < 2:
+        return []
+    latest = recs[-1]
+    cur = latest.get("aux_num", {}).get("aux_bytes_per_tick")
+    if cur is None:
+        return []
+    prior = [(r["aux_num"]["aux_bytes_per_tick"], r["round"])
+             for r in recs[:-1]
+             if "aux_bytes_per_tick" in r.get("aux_num", {})
+             and r.get("aux_str", {}).get("aux_source") == "inkernel"
+             and r["vetted"].get("aux_bytes_per_tick")]
+    if not prior:
+        return []
+    best, best_round = min(prior)
+    if cur > (1.0 + tol) * best:
+        return [("aux bytes/tick", cur, best)]
+    return []
+
+
 def check_violations(recs: List[dict]) -> List[Tuple[str, str]]:
     """[(leg label, verdict)] for every vetted invariant leg of the LATEST
     round whose verdict is not "clean" — the safety gate (ISSUE 6)."""
@@ -393,7 +442,11 @@ def main(argv=None) -> int:
             ("compaction_deeplog_hbm_gb", "compact deep GB",
              "bytes_per_tick_packed", ",.0f"),
             ("deeplog_ring_hbm_gb", "ring deep GB",
-             "deeplog_ring_hbm_gb", ",.2f")):
+             "deeplog_ring_hbm_gb", ",.2f"),
+            # r17 (ISSUE 15): the aux-stream byte term per routed source
+            # (lower is better; the 2*state floor is the target).
+            ("aux_bytes_per_tick", "aux bytes/tick",
+             "aux_bytes_per_tick", ",.0f")):
         if not any(field in r.get("aux_num", {}) for r in recs):
             continue
         row = [label.ljust(18)]
@@ -454,6 +507,13 @@ def main(argv=None) -> int:
               f"prior vetted round ({best:,.2f}) — the resident physical "
               "window grew (utils/config.py ring_capacity / the byte "
               "model behind it)", file=sys.stderr)
+    aux_fails = check_aux(recs)
+    for label, cur, best in aux_fails:
+        print(f"AUX STREAM REGRESSION: {label} r{latest:02d} = {cur:,.0f} "
+              f"is {100 * (cur / best - 1):.1f}% above the best prior "
+              f"vetted inkernel round ({best:,.0f}) — the resident key "
+              "tables widened or the plan fell back to the staged HBM "
+              "stream (parallel/autotune.py aux_source)", file=sys.stderr)
     for field, _v in check_tuning_drift(recs):
         print(f"WARNING: tuning-table drift — r{latest:02d} {field} is "
               "false (the unified TUNING_TABLE disagrees with this "
@@ -470,7 +530,7 @@ def main(argv=None) -> int:
     for f, v in unvetted_bad:
         print(f"WARNING: {f} latched '{v}' on an UNVETTED (suspect) leg — "
               "not gating, but not clean either", file=sys.stderr)
-    if regs or viols or pod_fails or byte_fails or ring_fails:
+    if regs or viols or pod_fails or byte_fails or ring_fails or aux_fails:
         return 1
     clean_legs = sum(1 for f, v in latest_rec.get("inv", {}).items()
                      if v == "clean" and latest_rec["vetted"].get(f))

@@ -235,7 +235,8 @@ def test_plan_for_composition(no_cache):
     plan = autotune.plan_for(dcfg)
     assert plan == {"engine": "flat", "ilp_subtiles": 1, "fused_ticks": 1,
                     "layout": "wide", "compaction": "off",
-                    "sharding": "single", "tile": None}
+                    "sharding": "single", "tile": None,
+                    "aux_source": "staged"}
     # τ=0 mailbox deep: flat is the ONLY valid engine — the caller-level
     # rule overrides any table entry (plan_for composes it in).
     mcfg = RaftConfig(n_groups=8, n_nodes=3, log_capacity=512, mailbox=True,

@@ -64,6 +64,9 @@ def _fake_record():
         "compaction_ring_inv_status": "clean",
         "deeplog_ring_capacity": 512,
         "deeplog_ring_hbm_gb": 0.42,
+        "aux_source": "inkernel",
+        "aux_bytes_per_tick": 4_915_200,
+        "aux_vs_staged": 1.84,
         "suspect": False,
         # plus the long tail of fields that overflowed the driver window
         **{f"filler_{i}": [0.1234] * 8 for i in range(80)},
@@ -144,6 +147,13 @@ def test_compact_headline_is_last_line_and_complete():
     # authoritative tail.
     for k in ("compaction_inv_status", "snapshots_taken",
               "installsnap_deliveries", "compaction_deeplog_hbm_gb"):
+        assert k in bench.COMPACT_EXTRA_FIELDS, k
+    # The r17 additions (ISSUE 15): the routed aux source, its own
+    # bytes/tick share and the staged-vs-inkernel whole-tick ratio —
+    # summarize_bench's aux trajectory/regression rows and the round's
+    # acceptance gate (headline bytes/tick within 5% of the 2x-state
+    # floor under inkernel) read them from the authoritative tail.
+    for k in ("aux_source", "aux_bytes_per_tick", "aux_vs_staged"):
         assert k in bench.COMPACT_EXTRA_FIELDS, k
     for k in bench.COMPACT_EXTRA_FIELDS:
         assert k in last, k

@@ -1,0 +1,389 @@
+"""In-kernel aux generation (ISSUE 15, SEMANTICS.md §17).
+
+Two layers, mirroring the §17 contract:
+
+1. UNIT PINS — every kt_* primitive in utils/rng.py bit-identical to the
+   jax.random derivation the host channels consume, per channel: the raw
+   threefry block / fold_in, the shaped-bits lattice with row-major
+   counters, the §12 integer-exact 23-bit threshold compare, the randint
+   derivation (incl. per-group array bounds and window-inclusive range),
+   and the scripted-partition program on the kernel orientation. A jax
+   upgrade that changes any derivation fails HERE, loudly, before any
+   differential noise.
+
+2. DIFFERENTIAL — make_pallas_scan(aux_source="inkernel") ==
+   aux_source="staged" bit-for-bit (per-tick role/term/commit/last_index
+   traces, flight-recorder counters, safety-monitor latches) across the
+   matrix: sync message soup, mailbox delays [1, 3], tau=0, fused
+   T in {2, 4} x ILP K=2, scenario-bank fuzz universes incl. leader
+   isolation (where inkernel FUSES — the geometry the staged path must
+   refuse), and the 8-device sharded runner. Heaviest cases slow-tiered.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_kotlin_tpu.models.state import init_state
+from raft_kotlin_tpu.ops import pallas_tick as pt
+from raft_kotlin_tpu.ops import tick as tick_mod
+from raft_kotlin_tpu.utils import rng as rngmod
+from raft_kotlin_tpu.utils.config import RaftConfig, ScenarioSpec
+
+
+# ---------------------------------------------------------------------------
+# 1. Unit pins: kt_* twins vs jax.random, bit for bit.
+
+
+def _words(key):
+    return rngmod.kt_key_words(key)
+
+
+def test_kt_block_pins_threefry_bits():
+    # bits(key, shape, u32)[flat i] == bitcast(b0 ^ b1) at counters (0, i).
+    key = jax.random.key(1234)
+    shape = (3, 5, 7)
+    want = jax.random.bits(key, shape, dtype=jnp.uint32)
+    k0, k1 = _words(key)
+    idx = jnp.arange(np.prod(shape), dtype=jnp.int32)
+    got = rngmod.kt_bits32(k0, k1, idx).reshape(shape)
+    np.testing.assert_array_equal(
+        np.asarray(got).view(np.uint32), np.asarray(want))
+
+
+def test_kt_fold_pins_fold_in():
+    key = jax.random.key(77)
+    for d in (0, 1, 7, 12345, jnp.int32(-1)):
+        # -1 = the el_left materialization draw at counter t_ctr - 1 on a
+        # never-reset lane (value masked, derivation still pinned).
+        folded = jax.random.fold_in(key, d)
+        w0, w1 = _words(folded)
+        g0, g1 = rngmod.kt_fold(*_words(key), d)
+        assert int(g0) == int(w0) and int(g1) == int(w1), d
+
+
+def test_kt_bits23_pins_event_bits():
+    base = jax.random.key(5)
+    shape = (4, 3, 3)
+    for kind in (rngmod.KIND_FAULT, rngmod.KIND_CRASH, rngmod.KIND_DELAY):
+        for tick in (0, 1, 99):
+            want = rngmod._event_bits(base, kind, tick, shape)
+            k0, k1 = rngmod.kt_event_key(*_words(base), kind, tick)
+            idx = jnp.arange(np.prod(shape), dtype=jnp.int32)
+            got = rngmod.kt_bits23(k0, k1, idx).reshape(shape)
+            np.testing.assert_array_equal(
+                np.asarray(got).astype(np.uint32), np.asarray(want))
+
+
+def test_kt_edge_ok_pins_23bit_threshold_compare():
+    # The §12 integer-exact compare: (bits >> 9) >= thresh, incl. the exact
+    # p_threshold lattice — pinned against edge_ok_mask AND the bernoulli
+    # identity it encodes.
+    base = jax.random.key(42)
+    G, N = 8, 3
+    for p in (0.05, 0.5, 1.0 / (1 << rngmod.P_BITS)):
+        want = rngmod.edge_ok_mask(base, 3, (G, N, N), p)
+        k0, k1 = _words(base)
+        idx = jnp.arange(G * N * N, dtype=jnp.int32)
+        got = rngmod.kt_edge_ok_mask(
+            k0, k1, 3, idx, rngmod.p_threshold(p)).reshape(G, N, N)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    # Per-group (G,) thresholds broadcast over the pair lattice.
+    th = jnp.arange(G, dtype=jnp.int32) * 1000
+    want = rngmod.edge_ok_mask(base, 7, (G, N, N), 0.0, thresh=th)
+    idx = jnp.arange(G * N * N, dtype=jnp.int32).reshape(G, N * N)
+    got = rngmod.kt_edge_ok_mask(*_words(base), 7, idx, th[:, None])
+    np.testing.assert_array_equal(
+        np.asarray(got).reshape(G, N, N), np.asarray(want))
+
+
+def test_kt_event_mask_pins_host():
+    base = jax.random.key(9)
+    G, N = 6, 4
+    for kind, p in ((rngmod.KIND_CRASH, 0.02), (rngmod.KIND_RESTART, 0.3),
+                    (rngmod.KIND_LINK_FAIL, 0.01)):
+        shape = (G, N) if kind in (rngmod.KIND_CRASH,
+                                   rngmod.KIND_RESTART) else (G, N, N)
+        want = rngmod.event_mask(base, kind, 11, shape, p)
+        idx = jnp.arange(np.prod(shape), dtype=jnp.int32)
+        got = rngmod.kt_event_mask(*_words(base), kind, 11, idx,
+                                   rngmod.p_threshold(p)).reshape(shape)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_kt_delay_pins_randint_bounds():
+    # delay_mask twin: scalar window, and the §12 per-group (lo_g, hi_g)
+    # array-bounds form — same drawn bits, elementwise bounds.
+    base = jax.random.key(31)
+    G, N = 8, 3
+    lo, hi = 1, 3
+    want = rngmod.delay_mask(base, 5, (G, N, N), lo, hi)
+    idx = jnp.arange(G * N * N, dtype=jnp.int32)
+    got = rngmod.kt_delay_mask(*_words(base), 5, idx, lo, hi)
+    np.testing.assert_array_equal(
+        np.asarray(got).reshape(G, N, N), np.asarray(want))
+    assert int(got.min()) >= lo and int(got.max()) <= hi
+    lo_g = jnp.asarray([0, 1, 2, 0, 3, 1, 0, 2], jnp.int32)
+    hi_g = jnp.asarray([3, 3, 2, 5, 3, 4, 0, 6], jnp.int32)
+    want = rngmod.delay_mask(base, 6, (G, N, N), 0, 6, lo_g=lo_g, hi_g=hi_g)
+    got = rngmod.kt_delay_mask(
+        *_words(base), 6, idx.reshape(G, N * N),
+        lo_g[:, None], hi_g[:, None]).reshape(G, N, N)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert bool(jnp.all(got >= lo_g[:, None, None]))
+    assert bool(jnp.all(got <= hi_g[:, None, None]))
+
+
+def test_kt_draw_uniform_pins_keyed_draws():
+    # The live-counter election/backoff draw: fold the counter into the
+    # static-prefix key, then the scalar-shape randint — inclusive window.
+    base = jax.random.key(2)
+    G, N = 5, 3
+    tkeys = rngmod.grid_keys(base, rngmod.KIND_TIMEOUT, G, N).T  # (N, G)
+    ctrs = jnp.arange(N * G, dtype=jnp.int32).reshape(N, G) % 7
+    lo, hi = 10, 19
+    want = rngmod.draw_uniform_keyed(tkeys, ctrs, lo, hi)
+    k0, k1 = _words(tkeys)
+    got = rngmod.kt_draw_uniform(k0, k1, ctrs, lo, hi)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert int(got.min()) >= lo and int(got.max()) <= hi
+    # lo == hi degenerate window (the tau=0-style constant draw).
+    want = rngmod.draw_uniform_keyed(tkeys, ctrs, 4, 4)
+    got = rngmod.kt_draw_uniform(k0, k1, ctrs, 4, 4)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_kt_part_down_pins_scenario_link_down():
+    # The scripted-partition program on the kernel pair-lattice orientation
+    # vs the canonical host evaluation, all three programs + flapping gate.
+    G, N = 12, 4
+    key = jax.random.key(3)
+    scen = {
+        "part_kind": jax.random.randint(key, (G,), 0, 4, dtype=jnp.int32),
+        "part_cut": jnp.full((G,), 2, jnp.int32),
+        "part_src": jnp.full((G,), 1, jnp.int32),
+        "part_dst": jnp.full((G,), 3, jnp.int32),
+        "part_period": jnp.full((G,), 5, jnp.int32),
+        "part_duty": jnp.asarray([1 + g % 5 for g in range(G)], jnp.int32),
+        "part_phase": jnp.asarray([g % 5 for g in range(G)], jnp.int32),
+    }
+    lead = jax.random.bernoulli(jax.random.key(4), 0.3, (G, N))
+    for tick in range(6):
+        want = rngmod.scenario_link_down(scen, tick, lead, N)  # (G, N, N)
+        p = jnp.arange(N * N, dtype=jnp.int32)[:, None]  # (N*N, 1)
+        s_id, r_id = p // N + 1, p % N + 1
+        lead_ng = lead.T.astype(jnp.int32)  # (N, G)
+        lead_s = sum(((s_id == n + 1) & (lead_ng[n:n + 1] != 0))
+                     for n in range(N))
+        lead_r = sum(((r_id == n + 1) & (lead_ng[n:n + 1] != 0))
+                     for n in range(N))
+        active = rngmod.scenario_active(scen, tick)[None, :]
+        got = rngmod.kt_part_down(
+            scen["part_kind"][None, :], scen["part_cut"][None, :],
+            scen["part_src"][None, :], scen["part_dst"][None, :],
+            active, s_id, r_id, lead_s, lead_r)  # (N*N, G)
+        np.testing.assert_array_equal(
+            np.asarray(got).reshape(N, N, G).transpose(2, 0, 1),
+            np.asarray(want), err_msg=f"tick {tick}")
+
+
+def test_scen_layout_matches_bank_keys():
+    # The build-time row layout == the runtime bank's key set, over specs
+    # covering every presence rule (degenerate, thresholds, delay windows,
+    # partitions incl. leader).
+    specs = [
+        None,
+        ScenarioSpec(degenerate=True),
+        ScenarioSpec(farm_seed=1, drop_max=0.2, crash_max=0.01),
+        ScenarioSpec(farm_seed=2, drop_max=0.1, delay_windows=True,
+                     partitions=("split", "asym")),
+        ScenarioSpec(farm_seed=3, partitions=("leader",)),
+    ]
+    for spec in specs:
+        cfg = RaftConfig(n_groups=8, n_nodes=3, p_drop=0.05, delay_hi=2,
+                         scenario=spec)
+        want = (set(tick_mod.make_rng(cfg)[3] or {})
+                if spec is not None else set())
+        got = rngmod.scen_layout(cfg)
+        assert set(got) == want, spec
+        assert len(got) == len(set(got))
+
+# ---------------------------------------------------------------------------
+# 2. Differential: inkernel == staged, bit for bit.
+
+from conftest import assert_states_equal
+
+SOUP = RaftConfig(
+    n_groups=8, n_nodes=3, log_capacity=8, cmd_period=3,
+    p_drop=0.2, p_crash=0.02, p_restart=0.1, seed=11,
+).stressed(10)
+
+# A heterogeneous scenario bank with every channel the kernel twin draws:
+# per-group drop/crash/restart thresholds, per-group delay windows, and all
+# three partition programs — leader isolation included (the state-dependent
+# one whose staged path cannot fuse).
+LEADER_SPEC = ScenarioSpec(farm_seed=7, universe_base=100, drop_max=0.2,
+                           crash_max=0.01, restart_max=0.1,
+                           delay_windows=True,
+                           partitions=("split", "asym", "leader"),
+                           part_period_lo=5, part_period_hi=20)
+HET = RaftConfig(n_groups=8, n_nodes=3, log_capacity=8, seed=31,
+                 cmd_period=9, delay_hi=2,
+                 scenario=LEADER_SPEC).stressed(10)
+
+
+def _traced(cfg, n_ticks, aux_source, T=1, K=1):
+    run = pt.make_pallas_scan(cfg, n_ticks, interpret=True, fused_ticks=T,
+                              ilp_subtiles=K, trace=True,
+                              aux_source=aux_source)
+    end, tr = run(init_state(cfg), tick_mod.make_rng(cfg))
+    return jax.device_get(tr), jax.device_get(end)
+
+
+def _assert_inkernel_matches(cfg, n_ticks, T=1, K=1, ref_T=None,
+                             require_commit=True):
+    """staged (at ref_T, default T) == inkernel (at T): per-tick traces +
+    end states. ref_T=1 with T>1 is the leader-iso case — the staged
+    reference CANNOT legally run fused, the inkernel run must still
+    bit-match it."""
+    ref_tr, ref_end = _traced(cfg, n_ticks, "staged",
+                              T=(T if ref_T is None else ref_T), K=K)
+    if require_commit:
+        assert int(np.max(ref_tr["commit"])) > 0, "soup did nothing"
+    tr, end = _traced(cfg, n_ticks, "inkernel", T=T, K=K)
+    for f in pt.FUSED_TRACE_FIELDS:
+        assert np.array_equal(tr[f], ref_tr[f]), (T, f)
+    assert_states_equal(ref_end, end)
+
+
+def test_inkernel_matches_staged_sync_soup():
+    # The headline regime in miniature, T=1: every fault channel live
+    # (drops, crashes, restarts, periodic commands, timeout/backoff
+    # draws), 21 ticks past the soup's first commit.
+    _assert_inkernel_matches(SOUP, 21)
+
+
+def test_leader_iso_fused_geometry_reachable_inkernel():
+    # Satellite 2: the r17 lift, pinned against the FUSED_TICK_TABLE
+    # derived view. staged: a leader-isolation bank forces routed T
+    # sticky to 1 and REFUSES a pinned T; inkernel: the same config
+    # routes the exact geometry its scenario-free twin gets from the
+    # table (the fused VMEM model is unchanged — staged aux rows are the
+    # conservative bound).
+    cfg = dataclasses.replace(
+        HET, n_groups=2048,
+        scenario=ScenarioSpec(farm_seed=3, partitions=("leader",)))
+    assert cfg.scenario.needs_state
+    assert pt.resolve_fused_geometry(cfg, interpret=False,
+                                     platform="tpu")[2] == 1
+    with pytest.raises(ValueError, match="leader-isolation"):
+        pt.resolve_fused_geometry(cfg, interpret=False, platform="tpu",
+                                  fused_ticks=2)
+    got = pt.resolve_fused_geometry(cfg, interpret=False, platform="tpu",
+                                    aux_source="inkernel")
+    free = pt.resolve_fused_geometry(
+        dataclasses.replace(cfg, scenario=None), interpret=False,
+        platform="tpu")
+    assert got == free
+    assert got[2] == pt.route_fused_ticks(got[0], "tpu") > 1
+
+
+def test_inkernel_rejects_inject_and_validates():
+    # The inkernel kernel has no inject channel (per-tick driver inputs
+    # would reintroduce the staged stream) and the archival K-tick kernel
+    # stays staged-only; unknown sources fail loudly everywhere.
+    from raft_kotlin_tpu.parallel.mesh import make_mesh, make_sharded_run
+
+    tick = pt.make_pallas_tick(SOUP, interpret=True, aux_source="inkernel")
+    inj = jnp.zeros((SOUP.n_nodes, SOUP.n_groups), jnp.int32)
+    with pytest.raises(ValueError, match="driver inputs"):
+        tick(init_state(SOUP), inject=inj)
+    with pytest.raises(ValueError, match="k_per_launch"):
+        pt.make_pallas_scan(SOUP, 4, interpret=True, k_per_launch=2,
+                            jitted=True, aux_source="inkernel")
+    with pytest.raises(ValueError, match="aux_source"):
+        pt.make_pallas_scan(SOUP, 4, interpret=True, aux_source="hbm")
+    with pytest.raises(ValueError, match="aux_source"):
+        make_sharded_run(SOUP, make_mesh(), 4, aux_source="hbm")
+    with pytest.raises(ValueError, match="impl"):
+        make_sharded_run(SOUP, make_mesh(), 4, impl="xla",
+                         aux_source="inkernel")
+
+
+@pytest.mark.slow
+def test_inkernel_fused_t2_t4_with_ilp():
+    # Fused T in {2, 4} x ILP K=2 on the sync soup: the in-kernel draws
+    # ride the live VMEM counters through the T-loop (el_left rematerialized
+    # at t_ctr-1 instead of the staged table select) and must still
+    # bit-match the staged slabs. 21 ticks at T=2 exercises the remainder
+    # tick; 40 at T=4 the deep block.
+    _assert_inkernel_matches(SOUP, 21, T=2, K=2)
+    _assert_inkernel_matches(SOUP, 40, T=4, K=2)
+
+
+@pytest.mark.slow
+def test_inkernel_mailbox_and_tau0():
+    # §10 mailbox [1, 3] (the widest reset-bound window) and τ=0
+    # (same-tick send+deliver double delivery), both at T=1 and fused T=2.
+    mb = RaftConfig(
+        n_groups=8, n_nodes=3, log_capacity=16, cmd_period=3,
+        p_drop=0.15, delay_lo=1, delay_hi=3, seed=13,
+    ).stressed(10)
+    _assert_inkernel_matches(mb, 40)
+    _assert_inkernel_matches(mb, 40, T=2)
+    tau0 = RaftConfig(
+        n_groups=8, n_nodes=3, log_capacity=16, cmd_period=3,
+        p_drop=0.15, mailbox=True, delay_lo=0, delay_hi=0, seed=17,
+    ).stressed(10)
+    _assert_inkernel_matches(tau0, 30, T=2)
+
+
+@pytest.mark.slow
+def test_inkernel_scenario_bank_matches_staged():
+    # The heterogeneous fuzz bank: per-group thresholds and delay windows
+    # stream in as resident (G,) rows and must reproduce the staged
+    # (N*N, G) mask stream bit for bit, partition programs included.
+    _assert_inkernel_matches(HET, 40, require_commit=False)
+
+
+@pytest.mark.slow
+def test_inkernel_leader_iso_fuses_and_matches_staged_t1():
+    # THE lifted restriction (tentpole): a leader-isolation universe
+    # fused T=2 under inkernel — the kernel reads the CURRENT tick's
+    # pre-phase role/up planes inside the T-loop — against the staged
+    # reference, which must run T=1 (pinned staged T=2 raises, covered
+    # fast). Bit-identity here proves the live-plane evaluation equals
+    # the host's stale-free per-tick evaluation.
+    _assert_inkernel_matches(HET, 40, T=2, ref_T=1, require_commit=False)
+
+
+@pytest.mark.slow
+def test_inkernel_sharded_runner_matches_staged():
+    # The 8-device sharded runner (parallel/mesh): inkernel fused T=2 on
+    # the leader-iso bank vs the staged per-tick sharded run — end
+    # states, window metrics, recorder counters, monitor carry. The
+    # resident key tables are built OUTSIDE shard_map from the GLOBAL
+    # group iota, so shard-local kernels draw with global counters.
+    from raft_kotlin_tpu.parallel.mesh import (
+        init_sharded, make_mesh, make_sharded_run, pad_groups)
+
+    mesh = make_mesh()
+    cfg = pad_groups(dataclasses.replace(HET, seed=33), mesh)
+    st0 = init_sharded(cfg, mesh)
+    ref, m0, tel0, mon0 = make_sharded_run(
+        cfg, mesh, 14, metrics_every=4, impl="pallas",
+        telemetry=True, monitor=True)(st0)
+    stI, mI, telI, monI = make_sharded_run(
+        cfg, mesh, 14, metrics_every=4, impl="pallas",
+        telemetry=True, monitor=True, fused_ticks=2,
+        aux_source="inkernel")(st0)
+    assert_states_equal(jax.device_get(ref), jax.device_get(stI))
+    for k in m0:
+        assert np.array_equal(np.asarray(m0[k]), np.asarray(mI[k])), k
+    for k in tel0:
+        assert int(tel0[k]) == int(telI[k]), k
+    for k in mon0:
+        assert np.array_equal(np.asarray(mon0[k]), np.asarray(monI[k])), k

@@ -339,9 +339,11 @@ def test_headline_bytes_ratio_at_least_2x():
     packed = bench.state_aux_bytes_per_tick(cfg, layout="packed")
     assert wide / packed >= 2.0, (wide, packed)
     # The wide figure stays anchored to the r05-era model (~361 MB/tick
-    # at the headline config): concrete accounting is a refinement of the
-    # hand model, not a redefinition.
-    assert 350e6 < wide < 375e6, wide
+    # at the headline config) plus the r17 staged-aux correction (the aux
+    # set is written by the XLA pre-pass AND read by the kernel — counted
+    # twice since ISSUE 15, ~+19 MB here): a refinement of the hand
+    # model, not a redefinition.
+    assert 370e6 < wide < 395e6, wide
     # And the mailbox headline keeps the win (the §10 slots pack too).
     mcfg = dataclasses.replace(cfg, delay_lo=1, delay_hi=3)
     assert (bench.state_aux_bytes_per_tick(mcfg, "wide")
