@@ -306,7 +306,16 @@ COMPACT_EXTRA_FIELDS = ("deeplog_parity_rate", "deeplog_ov_fallback",
                         # and summarize_bench's aux trajectory row read
                         # these from the authoritative tail.
                         "aux_source", "aux_bytes_per_tick",
-                        "aux_vs_staged")
+                        "aux_vs_staged",
+                        # r18 (ISSUE 16): the routed compute domain of the
+                        # headline lattice, the packed hot-plane
+                        # VMEM-per-group model and the unpacked/packed
+                        # ratio — the round's acceptance gate (>= 1.8x at
+                        # the headline config) and summarize_bench's
+                        # VMEM-per-group trajectory row read these from
+                        # the authoritative tail.
+                        "compute", "vmem_per_group_packed",
+                        "packed_compute_vs_unpacked")
 
 # Flight-recorder counters published verbatim from the headline run's
 # median rep (stats tel_* keys — utils/telemetry.TELEMETRY_FIELDS).
@@ -436,6 +445,21 @@ def _headline_aux_source(cfg):
         return "staged"
 
 
+def _headline_compute(cfg):
+    """The plan-routed compute domain for a config's timed headline
+    (parallel/autotune.plan_for's `compute` dimension, ISSUE 16, §18);
+    "unpacked" on any resolution failure — the proven legacy domain."""
+    try:
+        from raft_kotlin_tpu.parallel.autotune import plan_for
+
+        return plan_for(cfg, telemetry=True, monitor=True).get(
+            "compute", "unpacked")
+    except Exception as e:
+        print(f"compute resolution failed: {str(e)[:120]}",
+              file=sys.stderr)
+        return "unpacked"
+
+
 def tick_candidates(cfg):
     from raft_kotlin_tpu.ops.pallas_tick import (
         choose_impl, make_pallas_scan, resolve_fused_geometry)
@@ -452,6 +476,14 @@ def tick_candidates(cfg):
         # set inside the kernel from resident counter tables — no XLA aux
         # pre-pass on the hot path. CPU/interpret plans pin "staged".
         aux_source = _headline_aux_source(cfg)
+        # Routed compute domain (ISSUE 16, §18): "packed" evaluates the
+        # phase lattice on packed peer/ctrl words inside the kernel.
+        # Only valid paired with the packed layout (the builders enforce
+        # it loudly) — demote here if the two plan reads disagree, e.g.
+        # when one resolution fell back independently.
+        compute = _headline_compute(cfg)
+        if layout != "packed":
+            compute = "unpacked"
         # Flat-carry multi-tick runner: state<->kernel-form conversions once
         # per call, not once per tick (~0.3 ms/tick on the headline config).
         # The flight recorder (ISSUE 5) AND the safety-invariant monitor
@@ -469,7 +501,8 @@ def tick_candidates(cfg):
                                           telemetry=True,
                                           monitor=True,
                                           layout=layout,
-                                          aux_source=aux_source)), "pallas"
+                                          aux_source=aux_source,
+                                          compute=compute)), "pallas"
         try:
             # Resolve with the SAME snapshot rows the headline builder
             # carries (recorder+monitor on): the bare model can route a T
@@ -493,7 +526,8 @@ def tick_candidates(cfg):
                                               monitor=True,
                                               fused_ticks=1,
                                               layout=layout,
-                                              aux_source=aux_source)
+                                              aux_source=aux_source,
+                                              compute=compute)
                    ), "pallas-nofuse"
     yield scan_runner(make_tick(cfg), telemetry=True, monitor=True), "xla"
 
@@ -505,11 +539,13 @@ def pallas_t1_only(cfg):
     from raft_kotlin_tpu.ops.pallas_tick import make_pallas_scan
 
     layout = _headline_layout(cfg)
+    compute = _headline_compute(cfg) if layout == "packed" else "unpacked"
     yield (lambda n: make_pallas_scan(cfg, n, interpret=False, jitted=False,
                                       telemetry=True, monitor=True,
                                       fused_ticks=1,
                                       layout=layout,
-                                      aux_source=_headline_aux_source(cfg))
+                                      aux_source=_headline_aux_source(cfg),
+                                      compute=compute)
            ), "pallas-t1"
 
 
@@ -1069,9 +1105,26 @@ def main() -> None:
     # winning rung actually carried (aux_source_run), with the refined
     # fused-aware aux term substituted once the fused-T probe resolves.
     headline_aux = _headline_aux_source(cfg)
+    # Routed compute domain (ISSUE 16, §18): packed-domain lattice
+    # evaluation, paired with the packed layout — demoted like the
+    # tick_candidates builders when the two plan reads disagree.
+    headline_compute = (_headline_compute(cfg)
+                        if headline_layout == "packed" else "unpacked")
     bytes_per_tick_wide = state_aux_bytes_per_tick(cfg, layout="wide")
     bytes_per_tick_packed = state_aux_bytes_per_tick(cfg, layout="packed")
     packed_vs_wide = round(bytes_per_tick_wide / bytes_per_tick_packed, 2)
+    # Packed-compute VMEM model (ISSUE 16, §18): per-group bytes of the
+    # phase lattice's HOT operand rows (roles/flags/tallies/peer planes —
+    # ops/pallas_tick.hot_plane_rows, the ONE shared statement the
+    # default_tile budget consumes), x4 B i32 x2 for the kernel's aliased
+    # in/out residency. The unpacked/packed ratio is the round's headline
+    # lever: the rows the packed domain frees are what lets default_tile
+    # grant a larger G per launch at the same VMEM budget.
+    from raft_kotlin_tpu.ops.pallas_tick import hot_plane_rows
+    vmem_per_group_hot = hot_plane_rows(cfg, "unpacked") * 4 * 2
+    vmem_per_group_packed = hot_plane_rows(cfg, "packed") * 4 * 2
+    packed_compute_vs_unpacked = round(
+        vmem_per_group_hot / vmem_per_group_packed, 2)
     peak = _peak_hbm_bytes_per_sec()
     suspect_reasons = []
     for attempt in range(2):
@@ -1178,6 +1231,10 @@ def main() -> None:
     # round's headline lever, published regardless of routing.
     aux_source_run = (headline_aux if impl.startswith("pallas")
                       else "staged")
+    # The compute domain the WINNING rung actually carried (the XLA
+    # fallback rung runs the unpacked twin regardless of the plan).
+    compute_run = (headline_compute if impl.startswith("pallas")
+                   else "unpacked")
     aux_bpt = aux_bytes_per_tick(cfg, aux_source_run, fused_ticks)
     bytes_per_tick = state_bytes_per_tick(cfg, layout_run) + aux_bpt
     achieved_bw = bytes_per_tick * (ticks / best)
@@ -1924,6 +1981,15 @@ def main() -> None:
         "bytes_per_tick_packed": bytes_per_tick_packed,
         "packed_vs_wide": packed_vs_wide,
         "packed_width_overflow": packed_overflow,
+        # Packed-domain compute (ISSUE 16, §18): the domain the headline
+        # lattice ran in, and the hot-plane VMEM-per-group model pair —
+        # the unpacked/packed ratio is the round's acceptance lever
+        # (>= 1.8x) and what the default_tile budget converts into a
+        # larger G per launch.
+        "compute": compute_run,
+        "vmem_per_group_hot": vmem_per_group_hot,
+        "vmem_per_group_packed": vmem_per_group_packed,
+        "packed_compute_vs_unpacked": packed_compute_vs_unpacked,
         "achieved_hbm_gbps": round(achieved_bw / 1e9, 1),
         "hbm_bw_frac": hbm_bw_frac,
         # Two-sided roofline: the compute half (exact element-op count of

@@ -637,6 +637,156 @@ def check_packed_ov(ov) -> None:
             'layout="wide"')
 
 
+# ---------------------------------------------------------------------------
+# Packed-DOMAIN compute algebra (SEMANTICS.md §18): the §14 encodings above
+# make packing a STORAGE layout — every engine unpacks to full-width planes
+# before the phase lattice runs. §18 executes the lattice's hottest
+# predicates directly on packed words instead (ops/tick.py BodyFlags.
+# packed_compute): the quorum tally becomes a popcount-compare on N-bit
+# peer masks and the per-pair responded plane never exists in the lattice.
+# These helpers are the ONE shared algebra: the XLA twin
+# (ops/tick.make_tick compute="packed"), the Pallas kernel prologue/
+# epilogue (ops/pallas_tick.py) and the flat-carry adapters all compose
+# them, so the bit layout is §14's exactly (word2/bits1/_peer_shifts) and
+# the twins stay differentially pinnable (tests/test_packed_compute.py).
+#
+# Everything runs in int32: all words are < 2^(3N) <= 2^30 (N <= 10,
+# assert_packed_bounds), so i32 carries every §14 u32 word value-exactly
+# and the Mosaic kernel needs no unsigned lanes.
+
+def popcount32(x):
+    """Population count of a non-negative int32 word (SWAR shift-add; no
+    multiply — the §18 quorum compare `popcount(mask) >= majority` runs
+    this inside the Mosaic kernel). Valid for values < 2^31."""
+    x = x - ((x >> 1) & 0x55555555)
+    x = (x & 0x33333333) + ((x >> 2) & 0x33333333)
+    x = (x + (x >> 4)) & 0x0F0F0F0F
+    x = x + (x >> 8)
+    x = x + (x >> 16)
+    return x & 0x3F
+
+
+def pack_peer_word_i32(plane, N: int):
+    """Flat (N*N, ...) 0/1 pair plane (row (a-1)*N + (b-1) = pair (a, b),
+    ops/tick.py pair()) -> (N, ...) int32 N-bit row masks, bit b-1 of row
+    a-1 = pair (a, b) — the §14 peer-mask bit layout in int32."""
+    rows = []
+    for a in range(N):
+        w = (plane[a * N] != 0).astype(jnp.int32)
+        for b in range(1, N):
+            w = w | ((plane[a * N + b] != 0).astype(jnp.int32) << b)
+        rows.append(w)
+    return jnp.stack(rows)
+
+
+def unpack_peer_word_i32(bits, N: int):
+    """Inverse of pack_peer_word_i32: (N, ...) int32 row masks ->
+    (N*N, ...) 0/1 int32 pair plane."""
+    b32 = bits.astype(jnp.int32)
+    return jnp.stack([(b32[a] >> b) & 1
+                      for a in range(N) for b in range(N)])
+
+
+def pack_ctrl_words_i32(role, round_state, el_armed, hb_armed, up):
+    """The five hot (N, ...) head planes -> the (3, ...) ctrl word stack
+    (§14 ctrl_bits bit layout in int32): word 0 = role 2-bit lanes,
+    word 1 = round_state 2-bit lanes, word 2 = el_armed | hb_armed << N |
+    up << 2N. Inputs may be any integer/bool dtype; values must already
+    satisfy the §14 bounds (roles/round states fit 2 bits)."""
+    N = role.shape[0]
+
+    def word2(v):
+        w = (v[0].astype(jnp.int32) & 3)
+        for n in range(1, N):
+            w = w | ((v[n].astype(jnp.int32) & 3) << (2 * n))
+        return w
+
+    def bits1(v, shift):
+        w = (v[0] != 0).astype(jnp.int32) << shift
+        for n in range(1, N):
+            w = w | ((v[n] != 0).astype(jnp.int32) << (shift + n))
+        return w
+
+    flags = (bits1(el_armed, 0) | bits1(hb_armed, N) | bits1(up, 2 * N))
+    return jnp.stack([word2(role), word2(round_state), flags])
+
+
+def unpack_ctrl_words_i32(words, N: int):
+    """Inverse of pack_ctrl_words_i32: the (3, ...) int32 ctrl word stack
+    -> dict of five (N, ...) int32 planes (bool planes as 0/1 — callers
+    apply their own `!= 0` where the lattice wants bools)."""
+    w = words.astype(jnp.int32)
+    return {
+        "role": jnp.stack([(w[0] >> (2 * n)) & 3 for n in range(N)]),
+        "round_state": jnp.stack([(w[1] >> (2 * n)) & 3
+                                  for n in range(N)]),
+        "el_armed": jnp.stack([(w[2] >> n) & 1 for n in range(N)]),
+        "hb_armed": jnp.stack([(w[2] >> (N + n)) & 1 for n in range(N)]),
+        "up": jnp.stack([(w[2] >> (2 * N + n)) & 1 for n in range(N)]),
+    }
+
+
+def synth_vote_bits(responded_bits, votes, N: int):
+    """Synthesize a granted-vote bit word from (responded_bits, votes):
+    the lowest `votes` set bits of responded_bits. The wide state stores
+    only the TALLY (votes = |granted set|), not which peers granted — but
+    the lattice only ever reads popcount(vote_bits) (the §18 win compare),
+    and future grants can only arrive from peers whose responded bit is
+    still clear (the send guard: a pair exchanges at most once per round),
+    so ANY |votes|-subset of the responded set is observationally
+    equivalent. Taking the lowest bits makes the choice deterministic —
+    the §18 equivalence argument, SEMANTICS.md."""
+    v = votes.astype(jnp.int32)
+    rb = responded_bits.astype(jnp.int32)
+    out = jnp.zeros_like(rb)
+    cnt = jnp.zeros_like(rb)
+    for j in range(N):
+        take = (((rb >> j) & 1) != 0) & (cnt < v)
+        t32 = take.astype(jnp.int32)
+        out = out | (t32 << j)
+        cnt = cnt + t32
+    return out
+
+
+def enter_packed_compute(cfg: RaftConfig, s: dict) -> dict:
+    """Flat kernel-form state dict (ops/tick.flatten_state shapes) -> the
+    §18 packed-COMPUTE lattice form: the per-pair responded plane and the
+    votes/responses tallies are replaced by responded_bits/vote_bits
+    ((N, G) int32 row masks) — the set phase_body evaluates packed when
+    BodyFlags.packed_compute is on. Every other field stays wide (the
+    cold unpack-at-read fields, and the ctrl head, which engines pack
+    only across their OWN storage boundary). Bit-exact inverse modulo the
+    vote_bits synthesis, which is observationally equivalent (see
+    synth_vote_bits)."""
+    N = cfg.n_nodes
+    out = dict(s)
+    rb = pack_peer_word_i32(out.pop("responded"), N)
+    votes = out.pop("votes")
+    out.pop("responses")  # == popcount(rb) at every phase boundary (§18)
+    out["responded_bits"] = rb
+    out["vote_bits"] = synth_vote_bits(rb, votes, N)
+    return out
+
+
+def exit_packed_compute(cfg: RaftConfig, s: dict, dtypes: dict = None
+                        ) -> dict:
+    """Inverse of enter_packed_compute: restore the wide responded plane
+    and the votes/responses tallies (popcounts of the §18 words — the
+    identity the whole equivalence argument rests on). `dtypes` maps
+    field name -> the dtype the caller's flat form carries (e.g.
+    flatten_state's int16 pair planes); int32 when absent."""
+    N = cfg.n_nodes
+    dtypes = dtypes or {}
+    out = dict(s)
+    rb = out.pop("responded_bits")
+    vb = out.pop("vote_bits")
+    for name, v in (("responded", unpack_peer_word_i32(rb, N)),
+                    ("votes", popcount32(vb.astype(jnp.int32))),
+                    ("responses", popcount32(rb.astype(jnp.int32)))):
+        out[name] = v.astype(dtypes.get(name, jnp.int32))
+    return out
+
+
 def check_cap_ov(cap_ov) -> None:
     """Host-side loud-fail guard on the §15 capacity-exhaustion latch:
     a nonzero latch means some node's append was silently clipped at

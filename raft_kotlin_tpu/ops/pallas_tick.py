@@ -42,7 +42,12 @@ from jax.experimental import pallas as pl
 
 from raft_kotlin_tpu.constants import LEADER
 from raft_kotlin_tpu.models.state import (MAILBOX_FIELDS, NARROW16,
-                                          SNAPSHOT_FIELDS, RaftState)
+                                          SNAPSHOT_FIELDS, RaftState,
+                                          pack_ctrl_words_i32,
+                                          pack_peer_word_i32, popcount32,
+                                          synth_vote_bits,
+                                          unpack_ctrl_words_i32,
+                                          unpack_peer_word_i32)
 from raft_kotlin_tpu.ops import tick as tick_mod
 from raft_kotlin_tpu.ops.tick import AUX_FIELDS, STATE_FIELDS, BodyFlags, state_fields
 from raft_kotlin_tpu.utils import rng as rngmod
@@ -56,6 +61,106 @@ _I16 = jnp.int16
 _BOOL_STATE = ("el_armed", "hb_armed", "up")
 _BOOL_AUX = ("crash_m", "restart_m")
 _TILES = (1024, 512, 256, 128)
+
+# Packed-domain compute (SEMANTICS.md §18): under compute="packed" the
+# kernel's HOT planes cross HBM (and live in VMEM) as packed i32 words —
+# the nine hot fields below collapse to four word planes, and the phase
+# lattice runs on the packed vote-exchange set directly
+# (BodyFlags.packed_compute). Cold/wide fields (logs, terms, positions,
+# the mailbox slots) keep the §14 unpack-at-read path; match_index stays
+# wide because the r8 order-statistic commit sorts its full-width rows.
+COMPUTES = ("unpacked", "packed")
+HOT_FIELDS = ("role", "round_state", "el_armed", "hb_armed", "up",
+              "votes", "responses", "responded", "link_up")
+PACKED_WORD_FIELDS = ("ctrl_words", "responded_bits", "link_bits",
+                      "vote_bits")
+
+
+def packed_operand_fields(sfields) -> tuple:
+    """The kernel operand field tuple under compute="packed": the hot
+    planes replaced (in place, tail position) by the packed word planes —
+    deterministic order shared by operand lists, output zips and the
+    aliasing map."""
+    return tuple(k for k in sfields if k not in HOT_FIELDS) \
+        + PACKED_WORD_FIELDS
+
+
+def packed_word_shape(k: str, N: int, lanes: int) -> tuple:
+    """Block shape of a packed word plane: the ctrl stack is 3 words
+    (role / round_state / el|hb|up flags), peer masks one word per node."""
+    return (3 if k == "ctrl_words" else N, lanes)
+
+
+def hot_plane_rows(cfg: RaftConfig, compute: str = "unpacked") -> int:
+    """VMEM-model rows the HOT planes occupy per direction (the quantity
+    the §18 acceptance ratio is stated over): 7 node fields + 2 pair
+    planes unpacked; 3 ctrl words + 3 N-row word planes packed."""
+    N = cfg.n_nodes
+    if compute == "packed":
+        return 3 + 3 * N
+    return 7 * N + 2 * N * N
+
+
+def flat_to_packed_compute(cfg: RaftConfig, s: dict) -> dict:
+    """Flat i32 kernel-form dict -> the §18 packed operand dict: the nine
+    HOT planes collapse to the four packed word planes (§14 bit layouts,
+    models/state helpers). vote_bits is synthesized from (responded_bits,
+    votes) — observationally equivalent, see synth_vote_bits."""
+    N = cfg.n_nodes
+    out = {k: v for k, v in s.items() if k not in HOT_FIELDS}
+    out["ctrl_words"] = pack_ctrl_words_i32(
+        s["role"], s["round_state"], s["el_armed"], s["hb_armed"], s["up"])
+    rb = pack_peer_word_i32(s["responded"], N)
+    out["responded_bits"] = rb
+    out["link_bits"] = pack_peer_word_i32(s["link_up"], N)
+    out["vote_bits"] = synth_vote_bits(rb, s["votes"], N)
+    return out
+
+
+def packed_compute_to_flat(cfg: RaftConfig, s: dict) -> dict:
+    """Inverse of flat_to_packed_compute: restore the nine wide hot planes
+    (votes/responses as popcounts — the §18 identity) in i32, the flat
+    carry's dtype for every hot field."""
+    N = cfg.n_nodes
+    out = {k: v for k, v in s.items() if k not in PACKED_WORD_FIELDS}
+    out.update(unpack_ctrl_words_i32(s["ctrl_words"], N))
+    rb = s["responded_bits"].astype(_I32)
+    out["responded"] = unpack_peer_word_i32(rb, N)
+    out["link_up"] = unpack_peer_word_i32(s["link_bits"], N)
+    out["votes"] = popcount32(s["vote_bits"].astype(_I32))
+    out["responses"] = popcount32(rb)
+    return out
+
+
+def _enter_packed_lattice(cfg: RaftConfig, s: dict) -> dict:
+    """Kernel-interior prologue (per slab, ONCE per launch): unpack the
+    ctrl words and the link word to the wide planes phase_body reads —
+    the in-lattice §18 set keeps ONLY responded_bits/vote_bits packed
+    (the vote-exchange words phase_body evaluates directly under
+    BodyFlags.packed_compute)."""
+    N = cfg.n_nodes
+    ctrl = unpack_ctrl_words_i32(s.pop("ctrl_words"), N)
+    s["role"] = ctrl["role"]
+    s["round_state"] = ctrl["round_state"]
+    s["el_armed"] = ctrl["el_armed"] != 0
+    s["hb_armed"] = ctrl["hb_armed"] != 0
+    s["up"] = ctrl["up"] != 0
+    s["link_up"] = unpack_peer_word_i32(s.pop("link_bits"), N)
+    return s
+
+
+def _exit_packed_lattice(cfg: RaftConfig, s: dict) -> dict:
+    """Kernel-interior epilogue: repack the ctrl/link planes for the HBM
+    store (responded_bits/vote_bits are already words in `s`)."""
+    N = cfg.n_nodes
+    out = dict(s)
+    out["ctrl_words"] = pack_ctrl_words_i32(
+        s["role"], s["round_state"], s["el_armed"], s["hb_armed"], s["up"])
+    out["link_bits"] = pack_peer_word_i32(s["link_up"], N)
+    for k in ("role", "round_state", "el_armed", "hb_armed", "up",
+              "link_up"):
+        del out[k]
+    return out
 
 
 def pick_tile(G: int, total_rows: int = 0) -> Optional[int]:
@@ -382,7 +487,8 @@ def _kt_aux(cfg: RaftConfig, flags: BodyFlags, kt: dict, s: dict, t: int):
 def make_pallas_core(cfg: RaftConfig, lanes: int, tile_g: int, interpret: bool,
                      subtiles: int = 1, fused_ticks: int = 1,
                      resets_bound: Optional[int] = None,
-                     tick_states: tuple = (), aux_source: str = "staged"):
+                     tick_states: tuple = (), aux_source: str = "staged",
+                     compute: str = "unpacked"):
     """Per-flags builder of the raw megakernel over arrays with `lanes` lane columns
     (the flat phase_body layout). Used with lanes = n_groups for single-device runs
     (make_pallas_tick) and lanes = the per-device shard width under shard_map
@@ -421,13 +527,25 @@ def make_pallas_core(cfg: RaftConfig, lanes: int, tile_g: int, interpret: bool,
     the utils/rng kt_* twins — bit-identical to the staged draws by the
     §17 pins. build_call still returns (call, sfields, aux_names); the
     aux_names tuple stays the CHANNEL set (introspection), but callers
-    assemble operands per aux_source (inkernel_aux_operands)."""
+    assemble operands per aux_source (inkernel_aux_operands).
+
+    `compute` = "packed" (ISSUE 16, §18) swaps the nine HOT operand
+    planes for the four packed word planes (packed_operand_fields): the
+    state crosses HBM packed, the ctrl/link words unpack ONCE per launch
+    inside the kernel, and phase_body runs with
+    BodyFlags.packed_compute=True — the vote-exchange set
+    (responded_bits/vote_bits) is evaluated as popcount-compare words,
+    never widened. build_call's returned field tuple is then the packed
+    OPERAND ordering (callers zip against it)."""
     if aux_source not in AUX_SOURCES:
         raise ValueError(f"unknown aux_source {aux_source!r}")
+    if compute not in COMPUTES:
+        raise ValueError(f"unknown compute {compute!r}")
     if fused_ticks > 1:
         return _make_fused_core(cfg, lanes, tile_g, interpret, subtiles,
                                 fused_ticks, resets_bound, tick_states,
-                                aux_source=aux_source)
+                                aux_source=aux_source, compute=compute)
+    pc = compute == "packed"
     inkernel = aux_source == "inkernel"
     scen_keys = rngmod.scen_layout(cfg) if inkernel else ()
     N, C = cfg.n_nodes, cfg.phys_capacity
@@ -454,6 +572,7 @@ def make_pallas_core(cfg: RaftConfig, lanes: int, tile_g: int, interpret: bool,
         "match_index": (N * N, tile_g), "link_up": (N * N, tile_g),
         **{k: (N * N, tile_g) for k in MAILBOX_FIELDS},
         **{k: (N, tile_g) for k in SNAPSHOT_FIELDS},
+        **{k: packed_word_shape(k, N, tile_g) for k in PACKED_WORD_FIELDS},
     }
     aux_shapes = {
         "edge_iid": (N * N, tile_g), "crash_m": (N, tile_g),
@@ -472,6 +591,9 @@ def make_pallas_core(cfg: RaftConfig, lanes: int, tile_g: int, interpret: bool,
         flags = dataclasses.replace(flags, dyn_log=False, batched=False,
                                     sharded=False)
         sfields = state_fields(flags)
+        cfields = packed_operand_fields(sfields) if pc else sfields
+        bflags = dataclasses.replace(flags, packed_compute=True) if pc \
+            else flags
         aux_names = tuple(
             k for k in AUX_FIELDS
             if (k in ("edge_iid", "bdraw"))
@@ -488,13 +610,13 @@ def make_pallas_core(cfg: RaftConfig, lanes: int, tile_g: int, interpret: bool,
         n_aux_in = 3 if inkernel else len(aux_names)
 
         def kernel(*refs):
-            n_in = len(sfields) + n_aux_in
-            ins = dict(zip(sfields, refs[:len(sfields)]))
+            n_in = len(cfields) + n_aux_in
+            ins = dict(zip(cfields, refs[:len(cfields)]))
             if not inkernel:
-                ins.update(zip(aux_names, refs[len(sfields):n_in]))
+                ins.update(zip(aux_names, refs[len(cfields):n_in]))
             else:
-                kt_loads = [r[...] for r in refs[len(sfields):n_in]]
-            outs = dict(zip(sfields + ("el_dirty",), refs[n_in:]))
+                kt_loads = [r[...] for r in refs[len(cfields):n_in]]
+            outs = dict(zip(cfields + ("el_dirty",), refs[n_in:]))
             # Blocks cross HBM in the narrow storage dtypes (the round-4 DMA
             # win); the kernel INTERIOR widens to int32 — Mosaic's int16
             # layout handling crashes on the columnar (G,) rows (layout.h
@@ -503,7 +625,7 @@ def make_pallas_core(cfg: RaftConfig, lanes: int, tile_g: int, interpret: bool,
             # storage dtype: their (C, tile) one-hot ops are rank-2 and the
             # int16 log kernel is TPU-proven (TPU_PALLAS variant_int16_logs).
             loaded = {k: ins[k][...] for k in ins}
-            parts = {k: [] for k in sfields}
+            parts = {k: [] for k in cfields}
             el_parts = []
             for kk in range(SUB):
                 # SUB independent lane slabs, SUB independent phase-lattice
@@ -514,7 +636,7 @@ def make_pallas_core(cfg: RaftConfig, lanes: int, tile_g: int, interpret: bool,
                     return v if SUB == 1 else \
                         v[:, kk * sub_w:(kk + 1) * sub_w]
                 s = {}
-                for k in sfields:
+                for k in cfields:
                     v = slab(loaded[k])
                     if k in _BOOL_STATE:
                         s[k] = v != 0
@@ -522,6 +644,10 @@ def make_pallas_core(cfg: RaftConfig, lanes: int, tile_g: int, interpret: bool,
                         s[k] = v
                     else:
                         s[k] = v.astype(_I32)
+                if pc:
+                    # ctrl/link words unpack ONCE per launch; the
+                    # vote-exchange words stay packed through phase_body.
+                    s = _enter_packed_lattice(cfg, s)
                 if inkernel:
                     kt = _kt_consts(cfg, scen_keys,
                                     *(slab(v) for v in kt_loads))
@@ -532,8 +658,10 @@ def make_pallas_core(cfg: RaftConfig, lanes: int, tile_g: int, interpret: bool,
                         v = slab(loaded[k])
                         aux[k] = (v != 0) if k in _BOOL_AUX \
                             else v.astype(_I32)
-                el_dirty = tick_mod.phase_body(cfg, s, aux, flags)
-                for k in sfields:
+                el_dirty = tick_mod.phase_body(cfg, s, aux, bflags)
+                if pc:
+                    s = _exit_packed_lattice(cfg, s)
+                for k in cfields:
                     parts[k].append(
                         s[k] if k in ("log_term", "log_cmd")
                         else s[k].astype(kernel_field_dtype(cfg, k)))
@@ -542,14 +670,14 @@ def make_pallas_core(cfg: RaftConfig, lanes: int, tile_g: int, interpret: bool,
             def join(ps):
                 return ps[0] if SUB == 1 else jnp.concatenate(ps, axis=1)
 
-            for k in sfields:
+            for k in cfields:
                 outs[k][...] = join(parts[k])
             outs["el_dirty"][...] = join(el_parts)
 
         def field_dtype(k):
             return kernel_field_dtype(cfg, k)
 
-        in_specs = [block_spec(field_shapes[k]) for k in sfields]
+        in_specs = [block_spec(field_shapes[k]) for k in cfields]
         if inkernel:
             in_specs += [block_spec((4 + len(scen_keys), tile_g)),
                          block_spec((2 * N, tile_g)),
@@ -559,9 +687,9 @@ def make_pallas_core(cfg: RaftConfig, lanes: int, tile_g: int, interpret: bool,
         out_shapes = [
             jax.ShapeDtypeStruct(
                 tuple(field_shapes[k][:-1]) + (lanes,), field_dtype(k))
-            for k in sfields
+            for k in cfields
         ] + [jax.ShapeDtypeStruct((N, lanes), _I32)]
-        out_specs = [block_spec(field_shapes[k]) for k in sfields]
+        out_specs = [block_spec(field_shapes[k]) for k in cfields]
         out_specs += [block_spec((N, tile_g))]  # el_dirty (i16)
 
         call = pl.pallas_call(
@@ -570,10 +698,10 @@ def make_pallas_core(cfg: RaftConfig, lanes: int, tile_g: int, interpret: bool,
             in_specs=in_specs,
             out_specs=out_specs,
             out_shape=out_shapes,
-            input_output_aliases={i: i for i in range(len(sfields))},
+            input_output_aliases={i: i for i in range(len(cfields))},
             interpret=interpret,
         )
-        return call, sfields, aux_names
+        return call, cfields, aux_names
 
     return build_call
 
@@ -581,7 +709,8 @@ def make_pallas_core(cfg: RaftConfig, lanes: int, tile_g: int, interpret: bool,
 def _make_fused_core(cfg: RaftConfig, lanes: int, tile_g: int,
                      interpret: bool, subtiles: int, T: int,
                      resets_bound: Optional[int], tick_states: tuple,
-                     aux_source: str = "staged"):
+                     aux_source: str = "staged",
+                     compute: str = "unpacked"):
     """The fused-T megakernel builder (ISSUE 7): T full phase lattices per
     pallas_call with state resident in VMEM between ticks — HBM load once,
     store once per T-block — composed with the sub-tile ILP: each of the K
@@ -625,7 +754,15 @@ def _make_fused_core(cfg: RaftConfig, lanes: int, tile_g: int,
     the unpack/checked contract), el_left is re-drawn at t_ctr - 1, and
     scripted partitions read the CURRENT tick's pre-phase role/up planes —
     which is why leader-isolation banks fuse only on this path
-    (resolve_fused_geometry lifts the sticky T->1 gate)."""
+    (resolve_fused_geometry lifts the sticky T->1 gate).
+
+    `compute` = "packed" (ISSUE 16, §18): the HOT planes cross HBM as the
+    four packed word planes and the vote-exchange words stay packed
+    across ALL T fused lattices — the ctrl/link unpack and the terminal
+    repack happen once per launch, not once per tick. Snapshots remain
+    the wide per-tick planes ("votes" is derived by popcount at each
+    snapshot point), so the observability surface is unchanged."""
+    pc = compute == "packed"
     inkernel = aux_source == "inkernel"
     scen_keys = rngmod.scen_layout(cfg) if inkernel else ()
     N, C = cfg.n_nodes, cfg.phys_capacity
@@ -649,6 +786,7 @@ def _make_fused_core(cfg: RaftConfig, lanes: int, tile_g: int,
         "match_index": (N * N, tile_g), "link_up": (N * N, tile_g),
         **{k: (N * N, tile_g) for k in MAILBOX_FIELDS},
         **{k: (N, tile_g) for k in SNAPSHOT_FIELDS},
+        **{k: packed_word_shape(k, N, tile_g) for k in PACKED_WORD_FIELDS},
     }
     aux_rows = {
         "edge_iid": N * N, "crash_m": N, "restart_m": N, "link_fail": N * N,
@@ -663,6 +801,9 @@ def _make_fused_core(cfg: RaftConfig, lanes: int, tile_g: int,
         flags = dataclasses.replace(flags, dyn_log=False, batched=False,
                                     sharded=False, inject=False)
         sfields = state_fields(flags)
+        cfields = packed_operand_fields(sfields) if pc else sfields
+        bflags = dataclasses.replace(flags, packed_compute=True) if pc \
+            else flags
         aux_names = tuple(
             k for k in AUX_FIELDS
             if (k == "edge_iid")
@@ -675,21 +816,21 @@ def _make_fused_core(cfg: RaftConfig, lanes: int, tile_g: int,
         snap_names = tuple(f"{k}@{t}" for t in range(T) for k in snap_fields)
 
         def kernel(*refs):
-            ins = dict(zip(sfields, refs[:len(sfields)]))
+            ins = dict(zip(cfields, refs[:len(cfields)]))
             if inkernel:
-                n_in = len(sfields) + 3
-                kt_loads = [r[...] for r in refs[len(sfields):n_in]]
+                n_in = len(cfields) + 3
+                kt_loads = [r[...] for r in refs[len(cfields):n_in]]
                 slabs, el_tab, b_tab = {}, None, None
             else:
-                n_in = len(sfields) + len(aux_names) + 2
+                n_in = len(cfields) + len(aux_names) + 2
                 slabs = {k: r[...] for k, r in
-                         zip(aux_names, refs[len(sfields):])}
+                         zip(aux_names, refs[len(cfields):])}
                 el_tab = refs[n_in - 2][...].astype(_I32)
                 b_tab = refs[n_in - 1][...].astype(_I32)
-            outs = dict(zip(sfields + ("overflow",) + snap_names,
+            outs = dict(zip(cfields + ("overflow",) + snap_names,
                             refs[n_in:]))
-            loaded = {k: ins[k][...] for k in sfields}
-            parts = {k: [] for k in sfields}
+            loaded = {k: ins[k][...] for k in cfields}
+            parts = {k: [] for k in cfields}
             ov_parts = []
             snap_parts = {k: [[] for _ in range(T)] for k in snap_fields}
             for kk in range(SUB):
@@ -702,7 +843,7 @@ def _make_fused_core(cfg: RaftConfig, lanes: int, tile_g: int,
                     return v if SUB == 1 else \
                         v[:, kk * sub_w:(kk + 1) * sub_w]
                 s = {}
-                for k in sfields:
+                for k in cfields:
                     v = slab(loaded[k])
                     if k in _BOOL_STATE:
                         s[k] = v != 0
@@ -710,6 +851,11 @@ def _make_fused_core(cfg: RaftConfig, lanes: int, tile_g: int,
                         s[k] = v
                     else:
                         s[k] = v.astype(_I32)
+                if pc:
+                    # Unpack ctrl/link ONCE per launch — the
+                    # vote-exchange words stay packed across all T
+                    # lattices (§18's "packed across fused T ticks").
+                    s = _enter_packed_lattice(cfg, s)
                 if inkernel:
                     kt = _kt_consts(cfg, scen_keys,
                                     *(slab(v) for v in kt_loads))
@@ -758,7 +904,7 @@ def _make_fused_core(cfg: RaftConfig, lanes: int, tile_g: int,
                             aux["el_draw_f"] = sel(el_slab, W,
                                                    s["t_ctr"] - t0)
                         aux["bdraw"] = sel(b_slab, T, s["b_ctr"] - b0)
-                    el_dirty = tick_mod.phase_body(cfg, s, aux, flags)
+                    el_dirty = tick_mod.phase_body(cfg, s, aux, bflags)
                     if inkernel:
                         d = rngmod.kt_draw_uniform(
                             kt["tk0"], kt["tk1"], s["t_ctr"] - 1,
@@ -767,10 +913,17 @@ def _make_fused_core(cfg: RaftConfig, lanes: int, tile_g: int,
                         d = sel(el_slab, W, s["t_ctr"] - 1 - t0)
                     s["el_left"] = jnp.where(el_dirty, d, s["el_left"])
                     for k in snap_fields:
+                        # Under packed compute the only snapshot field
+                        # without a wide in-lattice plane is "votes" —
+                        # derive it by the §18 popcount identity.
+                        sv = popcount32(s["vote_bits"]) \
+                            if pc and k == "votes" else s[k]
                         snap_parts[k][t].append(
-                            s[k] if k in ("log_term", "log_cmd")
-                            else s[k].astype(_I32))
-                for k in sfields:
+                            sv if k in ("log_term", "log_cmd")
+                            else sv.astype(_I32))
+                if pc:
+                    s = _exit_packed_lattice(cfg, s)
+                for k in cfields:
                     parts[k].append(
                         s[k] if k in ("log_term", "log_cmd")
                         else s[k].astype(kernel_field_dtype(cfg, k)))
@@ -779,7 +932,7 @@ def _make_fused_core(cfg: RaftConfig, lanes: int, tile_g: int,
             def join(ps):
                 return ps[0] if SUB == 1 else jnp.concatenate(ps, axis=1)
 
-            for k in sfields:
+            for k in cfields:
                 outs[k][...] = join(parts[k])
             outs["overflow"][...] = join(ov_parts)
             for t in range(T):
@@ -790,7 +943,7 @@ def _make_fused_core(cfg: RaftConfig, lanes: int, tile_g: int,
             return (_I16 if cfg.log_dtype == "int16" else _I32) \
                 if k in ("log_term", "log_cmd") else _I32
 
-        in_specs = [block_spec(field_shapes[k]) for k in sfields]
+        in_specs = [block_spec(field_shapes[k]) for k in cfields]
         if inkernel:
             in_specs += [block_spec((4 + len(scen_keys), tile_g)),
                          block_spec((2 * N, tile_g)),
@@ -804,9 +957,9 @@ def _make_fused_core(cfg: RaftConfig, lanes: int, tile_g: int,
             jax.ShapeDtypeStruct(
                 tuple(field_shapes[k][:-1]) + (lanes,),
                 kernel_field_dtype(cfg, k))
-            for k in sfields
+            for k in cfields
         ] + [jax.ShapeDtypeStruct((N, lanes), _I32)]  # overflow counts
-        out_specs = [block_spec(field_shapes[k]) for k in sfields]
+        out_specs = [block_spec(field_shapes[k]) for k in cfields]
         out_specs += [block_spec((N, tile_g))]
         for _t in range(T):
             for k in snap_fields:
@@ -820,10 +973,10 @@ def _make_fused_core(cfg: RaftConfig, lanes: int, tile_g: int,
             in_specs=in_specs,
             out_specs=out_specs,
             out_shape=out_shapes,
-            input_output_aliases={i: i for i in range(len(sfields))},
+            input_output_aliases={i: i for i in range(len(cfields))},
             interpret=interpret,
         )
-        return call, sfields, aux_names, snap_fields
+        return call, cfields, aux_names, snap_fields
 
     return build_call
 
@@ -945,7 +1098,8 @@ def cast_flat_out(cfg, outs, sfields, with_dirty: bool = True):
 def make_pallas_tick(cfg: RaftConfig, tile_g: Optional[int] = None,
                      interpret: Optional[bool] = None,
                      ilp_subtiles: Optional[int] = None,
-                     fused_ticks: int = 1, aux_source: str = "staged"):
+                     fused_ticks: int = 1, aux_source: str = "staged",
+                     compute: str = "unpacked"):
     """Build tick(state, inject=None, fault_cmd=None[, rng]) -> state — same
     contract and same bits as ops.tick.make_tick(cfg), different compilation
     strategy. `ilp_subtiles` pins the sub-tile ILP count (make_pallas_core);
@@ -963,10 +1117,18 @@ def make_pallas_tick(cfg: RaftConfig, tile_g: Optional[int] = None,
     `aux_source` = "inkernel" (ISSUE 15, §17) draws every aux channel
     inside the kernel from the resident key planes — no make_aux /
     fused_launch_aux pre-pass. inject/fault_cmd are rejected on EVERY
-    inkernel path (per-tick driver inputs are a staged surface)."""
+    inkernel path (per-tick driver inputs are a staged surface).
+
+    `compute` = "packed" (ISSUE 16, §18) runs the phase lattice on packed
+    words: the wrapper packs the HOT planes entering the launch and
+    unpacks them (popcount identities) on exit, so the RaftState surface
+    — and the bits — are unchanged."""
     N, C, G = cfg.n_nodes, cfg.phys_capacity, cfg.n_groups
     if aux_source not in AUX_SOURCES:
         raise ValueError(f"unknown aux_source {aux_source!r}")
+    if compute not in COMPUTES:
+        raise ValueError(f"unknown compute {compute!r}")
+    pc = compute == "packed"
     inkernel = aux_source == "inkernel"
     default_rng: list = []  # derived lazily; wrappers always pass rng explicitly
 
@@ -975,11 +1137,12 @@ def make_pallas_tick(cfg: RaftConfig, tile_g: Optional[int] = None,
     if fused_ticks > 1:
         tile_g, ilp_subtiles, T_f = resolve_fused_geometry(
             cfg, interpret, tile_g, ilp_subtiles, fused_ticks,
-            aux_source=aux_source)
+            aux_source=aux_source, compute=compute)
         build_call_f = make_pallas_core(cfg, G, tile_g, interpret,
                                         subtiles=ilp_subtiles,
                                         fused_ticks=T_f,
-                                        aux_source=aux_source)
+                                        aux_source=aux_source,
+                                        compute=compute)
 
         def tick_fused(state, inject=None, fault_cmd=None, rng=None):
             assert inject is None and fault_cmd is None, (
@@ -993,6 +1156,8 @@ def make_pallas_tick(cfg: RaftConfig, tile_g: Optional[int] = None,
                 rng = default_rng[0]
             base, tkeys, bkeys, scen = tick_mod.split_rng(rng)
             flat = tick_mod.flatten_state(cfg, state)
+            if pc:
+                flat = flat_to_packed_compute(cfg, flat)
             if inkernel:
                 stat = inkernel_aux_statics(cfg, base, tkeys, bkeys, scen)
                 call, sfields, aux_names, _snaps = build_call_f(
@@ -1000,6 +1165,9 @@ def make_pallas_tick(cfg: RaftConfig, tile_g: Optional[int] = None,
                 outs = call(*(cast_flat_in(flat, {}, sfields, ())
                               + inkernel_aux_operands(stat, state.tick)))
                 s2, ov, _ = unpack_fused_outputs(outs, sfields, (), T_f)
+                if pc:
+                    s2 = packed_compute_to_flat(cfg, s2)
+                    sfields = tuple(s2)
                 s, _ = cast_flat_out(cfg, [s2[k] for k in sfields],
                                      sfields, with_dirty=False)
                 return RaftState(**tick_mod.unflatten_state(cfg, s),
@@ -1012,6 +1180,9 @@ def make_pallas_tick(cfg: RaftConfig, tile_g: Optional[int] = None,
                           + fused_aux_slabs(per, aux_names)
                           + [el_tab, b_tab]))
             s2, ov, _ = unpack_fused_outputs(outs, sfields, (), T_f)
+            if pc:
+                s2 = packed_compute_to_flat(cfg, s2)
+                sfields = tuple(s2)
             ov_sum = jnp.sum(ov)
             if not isinstance(ov_sum, jax.core.Tracer) \
                     and int(jax.device_get(ov_sum)):
@@ -1025,11 +1196,13 @@ def make_pallas_tick(cfg: RaftConfig, tile_g: Optional[int] = None,
 
         return tick_fused
     tile_g, ilp_subtiles = resolve_scan_geometry(
-        cfg, interpret, 1, tile_g, ilp_subtiles)
+        cfg, interpret, 1, tile_g, ilp_subtiles,
+        aux_source=aux_source, compute=compute)
 
     build_call = make_pallas_core(cfg, G, tile_g, interpret,
                                   subtiles=ilp_subtiles,
-                                  aux_source=aux_source)
+                                  aux_source=aux_source,
+                                  compute=compute)
 
     def tick(
         state: RaftState,
@@ -1049,6 +1222,8 @@ def make_pallas_tick(cfg: RaftConfig, tile_g: Optional[int] = None,
             rng = default_rng[0]
         base, tkeys, bkeys, scen = tick_mod.split_rng(rng)
         flat = tick_mod.flatten_state(cfg, state)
+        if pc:
+            flat = flat_to_packed_compute(cfg, flat)
         if inkernel:
             if inject is not None or fault_cmd is not None:
                 raise ValueError(
@@ -1064,6 +1239,11 @@ def make_pallas_tick(cfg: RaftConfig, tile_g: Optional[int] = None,
                 scen=scen)
             call, sfields, aux_names = build_call(flags)
             outs = call(*cast_flat_in(flat, aux, sfields, aux_names))
+        if pc:
+            sdict = packed_compute_to_flat(
+                cfg, dict(zip(sfields, outs[:len(sfields)])))
+            sfields = tuple(sdict)
+            outs = [sdict[k] for k in sfields] + [outs[-1]]
         s, el_dirty = cast_flat_out(cfg, outs, sfields)
         return tick_mod.finish_tick(
             cfg, tkeys, tick_mod.unflatten_state(cfg, s), el_dirty, state.tick)
@@ -1293,17 +1473,23 @@ def resolve_scan_geometry(cfg: RaftConfig,
                           interpret: Optional[bool] = None,
                           k_per_launch: int = 1,
                           tile_g: Optional[int] = None,
-                          ilp_subtiles: Optional[int] = None):
+                          ilp_subtiles: Optional[int] = None,
+                          aux_source: str = "staged",
+                          compute: str = "unpacked"):
     """The (tile_g, ilp_subtiles) a make_pallas_scan call with these same
     arguments resolves to — THE single copy of that resolution, so reporting
     surfaces (bench.py's `ilp_subtiles` field) read the geometry the
-    headline kernel actually runs with instead of re-deriving it."""
+    headline kernel actually runs with instead of re-deriving it.
+    `aux_source`/`compute` feed the VMEM tile model (default_tile): the
+    in-kernel aux path budgets no staged slabs, the packed-compute path
+    budgets word planes for the hot fields — both grow G per launch."""
     G = cfg.n_groups
     K = max(1, k_per_launch)
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
     if tile_g is None:
-        tile_g = default_tile(cfg, G, interpret, k_per_launch=K)
+        tile_g = default_tile(cfg, G, interpret, k_per_launch=K,
+                              aux_source=aux_source, compute=compute)
     if interpret and G % tile_g:
         tile_g = G
     if ilp_subtiles is None:
@@ -1320,7 +1506,8 @@ def resolve_fused_geometry(cfg: RaftConfig,
                            snap_rows: int = 0,
                            lanes: Optional[int] = None,
                            platform: Optional[str] = None,
-                           aux_source: str = "staged"):
+                           aux_source: str = "staged",
+                           compute: str = "unpacked"):
     """The (tile_g, ilp_subtiles, fused_ticks) a make_pallas_scan call with
     these arguments resolves to — the fused extension of
     resolve_scan_geometry, and like it THE single copy of the resolution
@@ -1358,7 +1545,8 @@ def resolve_fused_geometry(cfg: RaftConfig,
     if fused_ticks is None:
         try:
             base = tile_g if tile_g is not None else \
-                default_tile(cfg, G, interpret)
+                default_tile(cfg, G, interpret, aux_source=aux_source,
+                             compute=compute)
         except ValueError:
             base = None
         if base is not None and interpret and G % base:
@@ -1369,7 +1557,8 @@ def resolve_fused_geometry(cfg: RaftConfig,
     if T > 1:
         try:
             tg = tile_g if tile_g is not None else default_tile(
-                cfg, G, interpret, k_per_launch=T, snap_rows=snap_rows)
+                cfg, G, interpret, k_per_launch=T, snap_rows=snap_rows,
+                aux_source=aux_source, compute=compute)
             if interpret and G % tg:
                 tg = G
             k = ilp_subtiles if ilp_subtiles is not None else \
@@ -1381,11 +1570,14 @@ def resolve_fused_geometry(cfg: RaftConfig,
             T = 1
     if lanes is None:
         tg, k = resolve_scan_geometry(cfg, interpret, 1, tile_g,
-                                      ilp_subtiles)
+                                      ilp_subtiles,
+                                      aux_source=aux_source,
+                                      compute=compute)
         return tg, k, 1
     # lanes override (per-shard callers): T=1 geometry at the given width.
     if tile_g is None:
-        tile_g = default_tile(cfg, G, interpret)
+        tile_g = default_tile(cfg, G, interpret, aux_source=aux_source,
+                              compute=compute)
     if interpret and G % tile_g:
         tile_g = G
     if ilp_subtiles is None:
@@ -1405,7 +1597,8 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
                      fused_ticks: Optional[int] = None,
                      trace: bool = False,
                      layout: str = "wide",
-                     aux_source: str = "staged"):
+                     aux_source: str = "staged",
+                     compute: str = "unpacked"):
     """Multi-tick Pallas runner with a FLAT int32 scan carry.
 
     Scanning make_pallas_tick converts RaftState <-> the kernel's flat int32
@@ -1486,6 +1679,18 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
     (tests/test_inkernel_aux.py differential suite). Requires
     k_per_launch == 1 (the archival K-tick kernel stays staged-only).
 
+    `compute` = "packed" (ISSUE 16, §18) evaluates the phase lattice on
+    packed words INSIDE the kernel (make_pallas_core(compute="packed")):
+    the body converts the wide flat carry to the packed operand set at
+    each launch and back after it, so the between-launch carry — and
+    every observability path reading it (telemetry/monitor/trace,
+    §14 pack_fields) — is unchanged. Requires layout="packed" (running
+    the lattice packed while storing the carry wide would combine the
+    repack ALU of both layouts with the VMEM win of neither; the plan
+    layer enforces the same pairing) and k_per_launch == 1. Bit-identical
+    to "unpacked" by the §18 popcount identities
+    (tests/test_packed_compute.py differential suite).
+
     Returns run(state, rng) -> state (jitted; rng rides as an operand so the
     compilation is seed-independent, as everywhere else)."""
     import types
@@ -1500,6 +1705,19 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
         raise ValueError(f"unknown layout {layout!r}")
     if aux_source not in AUX_SOURCES:
         raise ValueError(f"unknown aux_source {aux_source!r}")
+    if compute not in COMPUTES:
+        raise ValueError(f"unknown compute {compute!r}")
+    pc = compute == "packed"
+    if pc and not packed:
+        raise ValueError(
+            "compute='packed' requires layout='packed': running the "
+            "lattice on packed words while the carry rests wide would "
+            "pay both layouts' repack ALU for neither's VMEM win "
+            "(autotune.apply_guards pairs them)")
+    if pc and K > 1:
+        raise ValueError(
+            "compute='packed' needs k_per_launch == 1 (the archival "
+            "K-tick kernel is an unpacked-compute surface)")
     inkernel = aux_source == "inkernel"
     if inkernel and K > 1:
         raise ValueError(
@@ -1545,7 +1763,7 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
         tile_g, ilp_subtiles, T_f = resolve_fused_geometry(
             cfg, interpret, tile_g, ilp_subtiles, fused_ticks,
             snap_rows=_snapshot_rows(cfg, snap_fields),
-            aux_source=aux_source)
+            aux_source=aux_source, compute=compute)
         if T_f > 1 and not jitted and not telemetry:
             if fused_ticks is not None:
                 raise ValueError(
@@ -1559,10 +1777,12 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
             # program (the fused VMEM model may have shrunk the tile).
             T_f = 1
             tile_g, ilp_subtiles = resolve_scan_geometry(
-                cfg, interpret, 1, tile_req, ilp_req)
+                cfg, interpret, 1, tile_req, ilp_req,
+                aux_source=aux_source, compute=compute)
     build_call = make_pallas_core(cfg, G, tile_g, interpret,
                                   subtiles=ilp_subtiles,
-                                  aux_source=aux_source)
+                                  aux_source=aux_source,
+                                  compute=compute)
     build_call_k = (make_pallas_core_k(cfg, G, tile_g, interpret, K,
                                        resets_bound=_resets_bound)
                     if K > 1 else None)
@@ -1571,7 +1791,8 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
                                      fused_ticks=T_f,
                                      resets_bound=_resets_bound,
                                      tick_states=snap_fields,
-                                     aux_source=aux_source)
+                                     aux_source=aux_source,
+                                     compute=compute)
                     if K == 1 and T_f > 1 else None)
     if K > 1 and not jitted:
         raise ValueError(
@@ -1640,11 +1861,15 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
 
         def body(carry, _):
             s, ovc, t, tel, mon = _carry_out(carry)
+            # §18: the carry stays WIDE between launches (telemetry/
+            # monitor/§14 pack_fields unchanged) — only the kernel
+            # operands cross in the packed-compute form.
+            sk = flat_to_packed_compute(cfg, s) if pc else s
             if inkernel:
                 # No make_aux pre-pass: the kernel draws its own aux from
                 # the resident planes; only the launch-tick row changes.
                 call, sfields, aux_names = build_call(flags_ik)
-                ins = [s[k] for k in sfields] \
+                ins = [sk[k] for k in sfields] \
                     + inkernel_aux_operands(stat, t)
             else:
                 # The flat carry holds the real pre-tick rows, so the shim
@@ -1655,10 +1880,12 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
                 aux, flags = tick_mod.make_aux(
                     cfg, base, tkeys, bkeys, shim, None, None, scen=scen)
                 call, sfields, aux_names = build_call(flags)
-                ins = [s[k] for k in sfields] + cast_aux_in(aux, aux_names)
+                ins = [sk[k] for k in sfields] + cast_aux_in(aux, aux_names)
             with telemetry_mod.engine_scope("pallas"):
                 outs = call(*ins)
             s2 = dict(zip(sfields, outs[:-1]))
+            if pc:
+                s2 = packed_compute_to_flat(cfg, s2)
             s2["el_left"] = tick_mod.materialize_el(
                 cfg, tkeys, s2, outs[-1] != 0)
             if tel is not None:
@@ -1707,24 +1934,27 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
             # outputs — same step functions as the 1-tick body, so their
             # carries are bit-equal to the unfused run.
             s, ovc, t, tel, mon = _carry_out(carry)
+            sk = flat_to_packed_compute(cfg, s) if pc else s
             if inkernel:
                 # No fused_launch_aux pre-pass and no draw tables: the
                 # T-loop draws every channel in-kernel (ov is structurally
                 # zero — live counters have no table window).
                 call, sfields_f, aux_names, snaps = build_call_f(flags_ik)
-                ins = [s[k] for k in sfields_f] \
+                ins = [sk[k] for k in sfields_f] \
                     + inkernel_aux_operands(stat, t)
             else:
                 per, flags, (el_tab, b_tab) = fused_launch_aux(
                     cfg, base, tkeys, bkeys, t, s["t_ctr"], s["b_ctr"],
                     T_f, resets_bound=_resets_bound, scen=scen)
                 call, sfields_f, aux_names, snaps = build_call_f(flags)
-                ins = [s[k] for k in sfields_f] \
+                ins = [sk[k] for k in sfields_f] \
                     + fused_aux_slabs(per, aux_names) + [el_tab, b_tab]
             with telemetry_mod.engine_scope("pallas-fused"):
                 outs = call(*ins)
             s2, ov, ticks_f = unpack_fused_outputs(
                 outs, sfields_f, snaps, T_f)
+            if pc:
+                s2 = packed_compute_to_flat(cfg, s2)
             tel, mon = fused_observe(cfg, s, ticks_f, tel, mon)
             ys = {"ov": jnp.sum(ov)}
             if trace:
@@ -1828,18 +2058,30 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
 
 
 def default_tile(cfg: RaftConfig, lanes: int, interpret: bool,
-                 k_per_launch: int = 1, snap_rows: int = 0) -> int:
+                 k_per_launch: int = 1, snap_rows: int = 0,
+                 aux_source: str = "staged",
+                 compute: str = "unpacked") -> int:
     """VMEM-model tile choice for `lanes` lane columns (raises if none fits).
     k_per_launch > 1 models the K-tick/fused-T kernels: K aux slabs plus
     the el/backoff draw tables replace the single-tick aux set. `snap_rows`
     adds the fused kernel's per-tick snapshot outputs (rows per tick,
     _snapshot_rows): plain stored output blocks, not lattice-live
     temporaries, so they are counted at 1/5 of the model's fitted
-    ~20 B/(row,lane) — i.e. at their ~4 B storage cost."""
+    ~20 B/(row,lane) — i.e. at their ~4 B storage cost.
+
+    `aux_source`="inkernel" (the r17-noted model fix): the staged per-tick
+    aux slabs, draw tables and the delay plane DON'T exist — only the
+    three resident key planes (inkernel_table_rows + 2*2N) and the
+    outputs ride in VMEM, so the model grants the larger tile the deleted
+    stream paid for. `compute`="packed" (§18): the nine hot planes
+    (7 node rows + responded/link_up pair grids) shrink to the four
+    packed word planes (3 + 3N rows) in BOTH directions — the ~2x
+    VMEM/group cut that feeds back into G per launch."""
     N, C = cfg.n_nodes, cfg.phys_capacity
     K = max(1, k_per_launch)
     if interpret:
         return min(lanes, 256)
+    inkernel = aux_source == "inkernel"
     # Rows across all in/out blocks: 2x state (in + aliased out) + worst-case aux
     # + el_dirty.
     n_2d = sum(1 for k in STATE_FIELDS
@@ -1848,16 +2090,36 @@ def default_tile(cfg: RaftConfig, lanes: int, interpret: bool,
     log_rows = 2 * 2 * N * C  # 2 log arrays, in + aliased out
     if cfg.log_dtype == "int16":
         log_rows //= 2  # i16 rows cost half the VMEM of the i32 model rows
-    aux_rows = K * (3 * N * N + 5 * N + 1) + N
-    if K > 1:
-        # el table N*rb*K + backoff table N*K rows + the overflow output.
-        rb = resets_per_tick_bound(N, cfg.uses_mailbox and cfg.delay_lo == 0)
-        aux_rows += K * N * (rb + 1) + N
-        aux_rows += -(-K * snap_rows // 5)  # snapshot outputs (see above)
-    rows = 2 * (n_2d * N + 4 * N * N) + log_rows + aux_rows
+    if inkernel:
+        # §17: no staged slabs, no draw tables, no per-tick aux at all —
+        # the resident planes [ktab, tkw, bkw] + el_dirty/overflow out.
+        aux_rows = inkernel_table_rows(cfg) + 4 * N + N
+        if K > 1:
+            aux_rows += -(-K * snap_rows // 5)  # snapshot outputs
+    else:
+        aux_rows = K * (3 * N * N + 5 * N + 1) + N
+        if K > 1:
+            # el table N*rb*K + backoff table N*K rows + the overflow output.
+            rb = resets_per_tick_bound(
+                N, cfg.uses_mailbox and cfg.delay_lo == 0)
+            aux_rows += K * N * (rb + 1) + N
+            aux_rows += -(-K * snap_rows // 5)  # snapshot outputs (see above)
+    if compute == "packed":
+        # §18 packed-domain compute: the hot planes cross HBM as words.
+        # Unpacked they cost 7N node rows + 2 pair grids (2N^2); packed,
+        # 3 ctrl words + 3 N-row word planes — hot_plane_rows() is the
+        # shared statement of both sides (bench reports the ratio).
+        state_rows = (n_2d - 7) * N + 2 * N * N \
+            + hot_plane_rows(cfg, "packed")
+    else:
+        state_rows = n_2d * N + 4 * N * N
+    rows = 2 * state_rows + log_rows + aux_rows
     if cfg.uses_mailbox:
-        # §10 mailbox: 13 pair-shaped state fields (in + aliased out) + delay aux.
-        rows += 2 * len(MAILBOX_FIELDS) * N * N + N * N
+        # §10 mailbox: 13 pair-shaped state fields (in + aliased out) + delay
+        # aux (the delay plane only exists on the staged path).
+        rows += 2 * len(MAILBOX_FIELDS) * N * N
+        if not inkernel:
+            rows += N * N
     t = pick_tile(lanes, rows)
     if t is None:
         if pick_tile(lanes) is None:

@@ -45,6 +45,9 @@ from raft_kotlin_tpu.models.state import (
     MAILBOX_FIELDS,
     SNAPSHOT_FIELDS,
     RaftState,
+    enter_packed_compute,
+    exit_packed_compute,
+    popcount32,
 )
 from raft_kotlin_tpu.utils import rng as rngmod
 from raft_kotlin_tpu.utils import telemetry as telemetry_mod
@@ -216,6 +219,17 @@ class BodyFlags:
     # delivery JUMPS next_index, breaking the known-delivery batched
     # engine's static row-window invariant.
     compact: bool = False
+    # §18 packed-DOMAIN compute (SEMANTICS.md §18): the vote-exchange set
+    # (responded/votes/responses) rides the lattice as two (N, G) int32
+    # words — responded_bits (bit p-1 of row c-1 = pair (c, p) exchanged
+    # this round) and vote_bits (granted subset) — and the phase-4 quorum
+    # compare becomes one popcount per word. `s` must then carry
+    # responded_bits/vote_bits INSTEAD of the three wide fields
+    # (models/state.enter_packed_compute). Every other field stays wide
+    # inside the lattice; engines pack the ctrl head and link plane only
+    # across their own storage boundary. Bit-equal to the wide program on
+    # every observable (the popcount identities, §18).
+    packed_compute: bool = False
 
 
 def phase_body(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
@@ -270,6 +284,7 @@ def _phase_lattice(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
     ring image lands in [0, C)."""
     N, C, maj = cfg.n_nodes, cfg.phys_capacity, cfg.majority
     G = s["term"].shape[-1]
+    pc = flags.packed_compute  # §18 packed-domain vote-exchange set
     # Probe-only phase ablation (scripts/probe_phase_cuts.py): compile the
     # lattice cut after phase k — output bits are then MEANINGLESS; used
     # exclusively for per-phase timing attribution on hardware. Read at trace
@@ -458,10 +473,11 @@ def _phase_lattice(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
     # in the view (el_armed/hb_armed/up) are only ever combined with boolean
     # algebra, never select-of-i1-values (Mosaic limits).
     _COLF = ("term", "voted_for", "role", "commit", "last_index", "phys_len",
-             "last_term", "el_armed", "round_state", "round_age", "votes",
-             "responses", "hb_armed", "hb_left", "up", "t_ctr", "rounds",
-             "cap_ov") + (SNAPSHOT_FIELDS if flags.compact else ())
-    _PAIRV = ("responded", "next_index", "match_index") + \
+             "last_term", "el_armed", "round_state", "round_age",
+             "hb_armed", "hb_left", "up", "t_ctr", "rounds", "cap_ov") \
+        + (("responded_bits", "vote_bits") if pc else ("votes", "responses")) \
+        + (SNAPSHOT_FIELDS if flags.compact else ())
+    _PAIRV = (() if pc else ("responded",)) + ("next_index", "match_index") + \
         (MAILBOX_FIELDS if flags.delay else ())
     view: dict = {}
 
@@ -505,6 +521,23 @@ def _phase_lattice(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
             view[name][pair(a, b)] = vals
             return
         s[name] = _set_row(s[name], pair(a, b), vals)
+
+    def orcol(name, n, bits):
+        # §18 packed-compute: OR `bits` into node n's packed word
+        # (columnar when the view is active, grid-row rebuild otherwise).
+        if name in view:
+            view[name][n - 1] = view[name][n - 1] | bits
+            return
+        s[name] = _set_row(s[name], n - 1, s[name][n - 1] | bits)
+
+    def responded_clear(c, p):
+        # "pair (c, p) has not exchanged this round". The §18 packed test
+        # reads bit p-1 of c's responded word; the wide test reads the
+        # per-pair plane — the same bit by the §14 layout, including the
+        # in-loop ordering (the packed OR is inline, like put_pair).
+        if pc:
+            return ((col("responded_bits", c) >> (p - 1)) & 1) == 0
+        return prow("responded", c, p) == 0
 
     # Read addressing. All three engine forms route through the same §15
     # translate-or-latch discipline when flags.compact: `idx` is a LOGICAL
@@ -820,13 +853,22 @@ def _phase_lattice(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
         s["last_index"] = jnp.where(rst, 0, s["last_index"])
         s["phys_len"] = jnp.where(rst, 0, s["phys_len"])
         s["round_state"] = jnp.where(rst, IDLE, s["round_state"])
-        for f in ("votes", "responses", "round_left", "round_age", "bo_left",
-                  "last_term"):
+        for f in (("round_left", "round_age", "bo_left", "last_term") if pc
+                  else ("votes", "responses", "round_left", "round_age",
+                        "bo_left", "last_term")):
             s[f] = jnp.where(rst, 0, s[f])
+        if pc:
+            # §18: one select per packed word wipes the whole exchange set
+            # (votes/responses are popcounts — popcount(0) = 0).
+            s["responded_bits"] = jnp.where(rst, 0, s["responded_bits"])
+            s["vote_bits"] = jnp.where(rst, 0, s["vote_bits"])
         # Pair grids are owned by their FIRST node index (candidate/leader).
         # Arithmetic selects: pair-shaped tensors never hold i1 (Mosaic limits).
-        keep = 1 - _rep_rows(rst.astype(s["responded"].dtype), N)
-        s["responded"] = s["responded"] * keep
+        keep = 1 - _rep_rows(
+            rst.astype(s["next_index"].dtype if pc
+                       else s["responded"].dtype), N)
+        if not pc:
+            s["responded"] = s["responded"] * keep
         s["next_index"] = s["next_index"] * keep
         s["match_index"] = s["match_index"] * keep
         s["hb_armed"] = s["hb_armed"] & ~rst
@@ -1026,9 +1068,16 @@ def _phase_lattice(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
     node_ids = jax.lax.broadcasted_iota(s["voted_for"].dtype, (N, G), 0) + 1
     s["term"] = s["term"] + init.astype(_I32)
     s["voted_for"] = jnp.where(init, node_ids, s["voted_for"])
-    s["votes"] = jnp.where(init, 0, s["votes"])
-    s["responses"] = jnp.where(init, 0, s["responses"])
-    s["responded"] = s["responded"] * (1 - _rep_rows(init.astype(s["responded"].dtype), N))
+    if pc:
+        # §18: round start clears the packed exchange words — the wide
+        # votes/responses/responded resets in one select each.
+        s["responded_bits"] = jnp.where(init, 0, s["responded_bits"])
+        s["vote_bits"] = jnp.where(init, 0, s["vote_bits"])
+    else:
+        s["votes"] = jnp.where(init, 0, s["votes"])
+        s["responses"] = jnp.where(init, 0, s["responses"])
+        s["responded"] = s["responded"] * (
+            1 - _rep_rows(init.astype(s["responded"].dtype), N))
     s["round_left"] = jnp.where(init, cfg.round_ticks, s["round_left"])
     s["round_age"] = jnp.where(init, 0, s["round_age"])
     s["round_state"] = jnp.where(init, ACTIVE, s["round_state"])
@@ -1119,10 +1168,24 @@ def _phase_lattice(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
         # after the pair loops. Masks are still built HERE, from live state
         # (the quirk-f compare reads c's term at this point in the order).
         tal = att & guard
-        put_pair("responded", c, p, tal, 1)
-        p3_resp[c].append(tal)
+        if pc:
+            # §18: the responded write is ONE inline OR of bit p-1 into
+            # c's packed word — the send guard and the τ=0 redelivery
+            # scan read it through responded_clear, so the in-loop
+            # ordering matches the wide put_pair exactly. No deferred
+            # response tally exists at all (responses ==
+            # popcount(responded_bits) at every phase boundary); the
+            # grant joins p3_vote as a pre-shifted bit for the balanced
+            # OR after the pair loops (each pair fires at most once per
+            # round — the send guard — so OR == the wide add on the
+            # popcount).
+            orcol("responded_bits", c, tal.astype(_I32) << (p - 1))
+        else:
+            put_pair("responded", c, p, tal, 1)
+            p3_resp[c].append(tal)
         p3_dem[c].append(tal & (resp_term > col("term", c)))  # quirk f
-        p3_vote[c].append(tal & granted)
+        p3_vote[c].append((tal & granted).astype(_I32) << (p - 1) if pc
+                          else (tal & granted))
 
     def vote_deliver(c, p, due=None):
         # §10 delivery: response leg evaluated at the delivery tick; either-end
@@ -1153,7 +1216,7 @@ def _phase_lattice(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
                 # delivery above) is the deep input and joins last.
                 att = (
                     (c_attempting & edge_ok(c, p))  # request leg at send
-                    & (prow("responded", c, p) == 0)
+                    & responded_clear(c, p)
                 )
                 put_pair("vq_term", c, p, att, col("term", c))
                 put_pair("vq_lli", c, p, att, lli_h[c - 1])
@@ -1164,7 +1227,7 @@ def _phase_lattice(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
                     vote_deliver(c, p)  # τ=0: the just-sent slot, same iteration
             else:
                 att = (
-                    (c_attempting & (prow("responded", c, p) == 0))
+                    (c_attempting & responded_clear(c, p))
                     & (edge_ok(c, p) & edge_ok(p, c))
                 )
                 # Request built from c's live state (RaftServer.kt:200-207);
@@ -1181,6 +1244,15 @@ def _phase_lattice(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
         if p3_dem[n2]:
             setcol("role", n2, _tree_reduce(jnp.logical_or, p3_dem[n2]),
                    FOLLOWER)
+        if pc:
+            # §18: the vote tally is a balanced OR of this node's pre-
+            # shifted grant bits (distinct bits — each pair fires at most
+            # once per round — so bitwise_or is associative/commutative
+            # AND exact against the wide add).
+            if p3_vote[n2]:
+                orcol("vote_bits", n2,
+                      _tree_reduce(jnp.bitwise_or, p3_vote[n2]))
+            continue
         for field, ms in (("responses", p3_resp[n2]), ("votes", p3_vote[n2])):
             if not ms:
                 continue
@@ -1201,9 +1273,17 @@ def _phase_lattice(cfg: RaftConfig, s: dict, aux: dict, flags: BodyFlags,
         return aux_dirty["m"]
     _ps.enter("p4")
     act = (s["round_state"] == ACTIVE) & up
-    concl = act & ((s["responses"] >= maj) | (s["round_left"] <= 0))
+    if pc:
+        # §18 quorum compare: one popcount per packed word replaces the
+        # N-way tallies (responses/votes ARE the popcounts of the
+        # exchange words — the invariant the packed domain rests on).
+        resp_n = popcount32(s["responded_bits"].astype(_I32))
+        vote_n = popcount32(s["vote_bits"].astype(_I32))
+    else:
+        resp_n, vote_n = s["responses"], s["votes"]
+    concl = act & ((resp_n >= maj) | (s["round_left"] <= 0))
     is_cand = s["role"] == CANDIDATE
-    win = concl & is_cand & (s["votes"] >= maj)
+    win = concl & is_cand & (vote_n >= maj)
     lose = concl & is_cand & ~win
     dem = concl & ~is_cand
     s["role"] = jnp.where(win, LEADER, s["role"])
@@ -2454,12 +2534,21 @@ def make_rng(cfg: RaftConfig):
 
 
 def make_tick(cfg: RaftConfig, batched: Optional[bool] = None,
-              sharded: bool = False):
+              sharded: bool = False, compute: str = "unpacked"):
     """Build tick(state, inject=None, fault_cmd=None[, rng]) -> state for a
     fixed config. `batched=False` forces the per-pair deep-log engine
     (BodyFlags.batched; used by sharded runs); `sharded=True` additionally
     selects the flat log layout inside it (BodyFlags.sharded — what
     parallel/mesh compiles per shard; exposed here for differential tests).
+
+    `compute="packed"` (SEMANTICS.md §18) is the XLA packed-COMPUTE twin:
+    the flat state crosses into the lattice through
+    models/state.enter_packed_compute (the vote-exchange set as packed
+    words) and back through exit_packed_compute, with
+    BodyFlags.packed_compute selecting the popcount-quorum program. The
+    external contract is unchanged (wide RaftState in/out, bit-equal
+    observables) — this twin exists so the Pallas packed-compute kernel is
+    differentially pinnable on CPU (tests/test_packed_compute.py).
 
     `inject` is an optional (G, N) int32 array of commands (-1 = none) delivered in
     phase 0 in addition to the cfg.cmd_period rule — the driver-level equivalent of the
@@ -2472,6 +2561,8 @@ def make_tick(cfg: RaftConfig, batched: Optional[bool] = None,
     through its jit boundary so the seed stays out of the compiled program
     (see make_rng), and then the default is never materialized.
     """
+    if compute not in ("unpacked", "packed"):
+        raise ValueError(f"unknown compute {compute!r}")
     default_rng: list = []
 
     def tick(
@@ -2497,7 +2588,18 @@ def make_tick(cfg: RaftConfig, batched: Optional[bool] = None,
         aux, flags = make_aux(cfg, base, tkeys, bkeys, state, inject, fault_cmd,
                               batched=batched, sharded=sharded, scen=scen)
         s = flatten_state(cfg, state)
+        if compute == "packed":
+            # §18 packed-compute twin: remember the flat dtypes the
+            # exchange set entered with so the exit restores them exactly
+            # (bit-equal to the wide program, whose lattice preserves
+            # entry dtypes).
+            wdt = {k: s[k].dtype for k in ("responded", "votes",
+                                           "responses")}
+            s = enter_packed_compute(cfg, s)
+            flags = dataclasses.replace(flags, packed_compute=True)
         el_dirty = phase_body(cfg, s, aux, flags)
+        if compute == "packed":
+            s = exit_packed_compute(cfg, s, dtypes=wdt)
         return finish_tick(cfg, tkeys, unflatten_state(cfg, s), el_dirty, state.tick)
 
     return tick
@@ -2506,7 +2608,7 @@ def make_tick(cfg: RaftConfig, batched: Optional[bool] = None,
 def make_run(cfg: RaftConfig, n_ticks: int, trace: bool = True, impl: str = "xla",
              batched: Optional[bool] = None, telemetry: bool = False,
              monitor: bool = False, rng=None, fused_ticks: int = 1,
-             layout: Optional[str] = None):
+             layout: Optional[str] = None, compute: Optional[str] = None):
     """jitted runner: state -> (state, trace) stepping n_ticks via lax.scan.
 
     trace is a dict of (T, N, G) arrays (role/term/commit/last_index/voted_for/rounds/
@@ -2554,6 +2656,14 @@ def make_run(cfg: RaftConfig, n_ticks: int, trace: bool = True, impl: str = "xla
     plan's layout under impl="auto" and means "wide" otherwise — an
     EXPLICIT "wide" always wins over the routed plan (it is the
     documented overflow remedy and must never be re-packed).
+
+    `compute` = "packed" (SEMANTICS.md §18) selects the packed-DOMAIN
+    lattice program: the per-tick function evaluates the vote-exchange
+    set on packed words (make_tick compute=... / the Pallas kernel's
+    packed carry). Orthogonal to `layout` (which packs the state AT REST
+    between ticks); bit-equal observables either way. The default None
+    adopts the plan's compute under impl="auto" and means "unpacked"
+    otherwise.
     """
     from raft_kotlin_tpu.models.state import (
         check_packed_ov, pack_state, unpack_state)
@@ -2572,19 +2682,24 @@ def make_run(cfg: RaftConfig, n_ticks: int, trace: bool = True, impl: str = "xla
             fused_ticks = plan["fused_ticks"]
         if layout is None:
             layout = plan.get("layout", "wide")
+        if compute is None:
+            compute = plan.get("compute", "unpacked")
     layout = layout or "wide"
+    compute = compute or "unpacked"
     packed = layout == "packed"
     if layout not in ("wide", "packed"):
         raise ValueError(f"unknown layout {layout!r}")
+    if compute not in ("unpacked", "packed"):
+        raise ValueError(f"unknown compute {compute!r}")
     T_f = max(1, fused_ticks)
     if trace:
         T_f = 1  # sticky fallback: per-tick traces need per-tick emission
     if impl == "pallas":
         from raft_kotlin_tpu.ops.pallas_tick import make_pallas_tick
 
-        tick_fn = make_pallas_tick(cfg)
+        tick_fn = make_pallas_tick(cfg, compute=compute)
     else:
-        tick_fn = make_tick(cfg, batched=batched)
+        tick_fn = make_tick(cfg, batched=batched, compute=compute)
     if rng is None:
         rng = make_rng(cfg)
 

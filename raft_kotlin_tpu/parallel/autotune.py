@@ -63,11 +63,15 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
 CACHE_PATH = os.path.join(REPO_ROOT, ".autotune_cache.json")
 
 PLAN_FIELDS = ("engine", "ilp_subtiles", "fused_ticks", "layout",
-               "sharding", "tile", "compaction", "aux_source")
+               "sharding", "tile", "compaction", "aux_source", "compute")
 REGIMES = ("shallow", "deep")
 DEEP_ENGINES = ("fc", "batched", "flat")
 LAYOUTS = ("wide", "packed")
 AUX_SOURCES = ("staged", "inkernel")
+# §18 packed-domain compute (ISSUE 16): "packed" runs the phase lattice
+# on packed words inside the megakernel. Requires layout="packed"
+# (apply_guards demotes otherwise) and is pinned "unpacked" on CPU.
+COMPUTES = ("unpacked", "packed")
 
 # The 128-lane vreg floor (ops/pallas_tick.make_pallas_core's hardware
 # assertion): a routed K must keep tile // K a multiple of 128.
@@ -207,11 +211,12 @@ def default_plan(key: dict) -> dict:
     if key["regime"] == "deep":
         return {"engine": "flat", "ilp_subtiles": 1, "fused_ticks": 1,
                 "layout": "wide", "sharding": "shard_map", "tile": None,
-                "compaction": "off", "aux_source": "staged"}
+                "compaction": "off", "aux_source": "staged",
+                "compute": "unpacked"}
     return {"engine": "pallas", "ilp_subtiles": 1, "fused_ticks": 1,
             "layout": "wide", "sharding": "shard_map",
             "tile": key["lanes"], "compaction": "off",
-            "aux_source": "staged"}
+            "aux_source": "staged", "compute": "unpacked"}
 
 
 def apply_guards(key: dict, plan: dict) -> dict:
@@ -243,6 +248,11 @@ def apply_guards(key: dict, plan: dict) -> dict:
     # dimension normalize to "staged" (the bit-proven legacy path; a
     # vetted inkernel round arms via scripts/probe_aux_stream.py --pin).
     plan.setdefault("aux_source", "staged")
+    # r18 migration contract: rows/caches predating the §18 compute
+    # dimension normalize to "unpacked" (the bit-proven legacy lattice;
+    # a vetted packed-compute round arms via
+    # scripts/probe_packed_compute.py --pin).
+    plan.setdefault("compute", "unpacked")
     if key["platform"] == "cpu":
         if key["regime"] == "deep":
             plan["engine"] = "flat"
@@ -252,7 +262,15 @@ def apply_guards(key: dict, plan: dict) -> dict:
         # CPU differential guard: the staged path is the byte-identity
         # reference the whole interpret-mode suite compares against.
         plan["aux_source"] = "staged"
+        # Same guard class for §18: the packed lattice trades per-tick
+        # repack ALU for VMEM the interpreter doesn't have.
+        plan["compute"] = "unpacked"
         return plan
+    if plan.get("compute") == "packed" and plan.get("layout") != "packed":
+        # §18 pairing: packed compute needs the packed carry layout
+        # (make_pallas_scan refuses the combination) — a row pinned
+        # inconsistently demotes to the always-correct lattice.
+        plan["compute"] = "unpacked"
     tile = plan.get("tile")
     k = int(plan.get("ilp_subtiles") or 1)
     if key["regime"] == "shallow" and tile:
@@ -451,7 +469,8 @@ def plan_for(cfg, mesh=None, platform: Optional[str] = None,
             plan, source = ({"engine": "flat", "ilp_subtiles": 1,
                              "fused_ticks": 1, "layout": "wide",
                              "sharding": "shard_map", "tile": None,
-                             "aux_source": "staged"},
+                             "aux_source": "staged",
+                             "compute": "unpacked"},
                             "guard")
         else:
             plan, source = resolve_plan(
@@ -462,8 +481,10 @@ def plan_for(cfg, mesh=None, platform: Optional[str] = None,
         plan = dict(plan)
         plan["sharding"] = "shard_map" if mesh is not None else "single"
         # The XLA/deep engines have no in-kernel draw path — aux stays
-        # staged regardless of what a (mis)pinned row says.
+        # staged regardless of what a (mis)pinned row says. Same for §18
+        # packed compute: a megakernel-interior dimension.
         plan["aux_source"] = "staged"
+        plan["compute"] = "unpacked"
         if cfg.uses_compaction:
             # §15 compaction dimension (r15): a config property, stamped
             # onto the plan. The fc engine has no ring-map support (its
@@ -492,7 +513,8 @@ def plan_for(cfg, mesh=None, platform: Optional[str] = None,
         plan = {"engine": "xla", "ilp_subtiles": 1, "fused_ticks": 1,
                 "layout": "wide", "compaction": "ring",
                 "sharding": "spmd" if mesh is not None else "single",
-                "tile": None, "aux_source": "staged"}
+                "tile": None, "aux_source": "staged",
+                "compute": "unpacked"}
         return (plan, "guard") if with_source else plan
     if not interpret:
         from raft_kotlin_tpu.ops.pallas_tick import (
@@ -513,6 +535,7 @@ def plan_for(cfg, mesh=None, platform: Optional[str] = None,
     source = "pinned" if engine == "pallas" else "guard"
     layout = "wide"
     aux_source = "staged"
+    compute = "unpacked"
     if engine == "pallas" and tile is not None:
         row_plan, source = resolve_plan(shallow_key(tile, platform=pclass),
                                         with_source=True)
@@ -521,22 +544,32 @@ def plan_for(cfg, mesh=None, platform: Optional[str] = None,
         # vetted inkernel measurement pins it (probe_aux_stream --pin);
         # CPU/interpret keys were already forced staged by apply_guards.
         aux_source = row_plan.get("aux_source", "staged")
-        if (aux_source == "inkernel" and cfg.scenario is not None
-                and cfg.scenario.needs_state):
-            # The first geometry pass assumed staged aux and took the
-            # leader-iso sticky T=1; the pinned inkernel row lifts that
-            # gate (ISSUE 15 satellite), so re-resolve at the real source.
+        # §18 compute rides the row the same way ("unpacked" until
+        # probe_packed_compute --pin); apply_guards already demoted any
+        # packed-compute row without the packed layout.
+        compute = row_plan.get("compute", "unpacked")
+        if ((aux_source == "inkernel" and cfg.scenario is not None
+                and cfg.scenario.needs_state)
+                or compute == "packed"):
+            # The first geometry pass assumed staged aux + unpacked
+            # compute. A pinned inkernel row lifts the leader-iso sticky
+            # T=1 gate (ISSUE 15), and a pinned packed-compute row
+            # shrinks the hot planes in the VMEM model (ISSUE 16, §18 —
+            # the larger G per launch the cut pays for) — re-resolve the
+            # geometry at the row's real sources. The row lookup itself
+            # is NOT redone: the plan keeps the first tile's row
+            # dimensions (no fixed-point iteration).
             tile, k, T = resolve_fused_geometry(
                 cfg, interpret=False,
                 snap_rows=_snapshot_rows(cfg, snaps),
                 lanes=lanes if mesh is not None else None,
                 platform=None if mesh is None else pclass,
-                aux_source="inkernel")
+                aux_source=aux_source, compute=compute)
     plan = {"engine": engine, "ilp_subtiles": int(k), "fused_ticks": int(T),
             "layout": layout, "compaction": "off",
             "sharding": ("shard_map" if engine == "pallas" else "spmd")
             if mesh is not None else "single", "tile": tile,
-            "aux_source": aux_source}
+            "aux_source": aux_source, "compute": compute}
     return (plan, source) if with_source else plan
 
 
@@ -563,8 +596,10 @@ def make_planned_run(cfg, n_ticks: int, mesh=None, telemetry: bool = False,
         cfg, mesh, telemetry=telemetry, monitor=monitor)
     plan.setdefault("layout", "wide")
     plan.setdefault("aux_source", "staged")
+    plan.setdefault("compute", "unpacked")
     layout = plan["layout"]
     aux_source = plan["aux_source"]
+    compute = plan["compute"]
     if cfg.uses_dyn_log:
         from raft_kotlin_tpu.ops.deep_cache import (
             make_deep_scan, make_sharded_deep_scan)
@@ -595,7 +630,8 @@ def make_planned_run(cfg, n_ticks: int, mesh=None, telemetry: bool = False,
                                if impl == "pallas" else None,
                                layout=layout,
                                aux_source=aux_source
-                               if impl == "pallas" else "staged")
+                               if impl == "pallas" else "staged",
+                               compute=compute)
         return run, plan
     if plan["engine"] == "pallas":
         from raft_kotlin_tpu.ops.pallas_tick import make_pallas_scan
@@ -604,13 +640,14 @@ def make_planned_run(cfg, n_ticks: int, mesh=None, telemetry: bool = False,
                                ilp_subtiles=plan["ilp_subtiles"],
                                fused_ticks=plan["fused_ticks"],
                                telemetry=telemetry, monitor=monitor,
-                               layout=layout, aux_source=aux_source)
+                               layout=layout, aux_source=aux_source,
+                               compute=compute)
         return run, plan
     from raft_kotlin_tpu.ops.tick import make_run
 
     run = make_run(cfg, n_ticks, trace=False, telemetry=telemetry,
                    monitor=monitor, fused_ticks=plan["fused_ticks"],
-                   layout=layout)
+                   layout=layout, compute=compute)
     return run, plan
 
 
@@ -706,36 +743,41 @@ def measure_shallow_key(key: dict, n_ticks: int = 20,
                 continue
             for L in LAYOUTS:
                 for A in AUX_SOURCES:
+                    for CM in COMPUTES:
+                        if CM == "packed" and L != "packed":
+                            continue  # §18 pairing (apply_guards)
 
-                    def gen(cfg_c, T=T, K=K, L=L, A=A):
-                        yield (lambda n: make_pallas_scan(
-                            cfg_c, n, tile_g=tile, interpret=False,
-                            jitted=False, telemetry=True, monitor=True,
-                            fused_ticks=T, ilp_subtiles=K, layout=L,
-                            aux_source=A)), \
-                            f"pallas-T{T}K{K}-{L}-{A}"
-                    try:
-                        ts, stats, _ = bench.measure(cfg, n_ticks, reps,
-                                                     gen)
-                        best = bench.median(ts)
-                        med = stats[ts.index(best)]
-                        if int(med.get("tel_fused_draw_overflow") or 0):
-                            continue  # clamped draws: invalid point
-                        if int(med.get("tel_packed_width_overflow") or 0):
-                            continue  # wrapped packs: invalid point
-                        timings[f"T{T}K{K}-{L}-{A}"] = round(
-                            n_ticks / best, 2)
-                    except Exception as e:
-                        print(f"autotune measure T{T}K{K}-{L}-{A} failed: "
-                              f"{str(e)[:160]}")
+                        def gen(cfg_c, T=T, K=K, L=L, A=A, CM=CM):
+                            yield (lambda n: make_pallas_scan(
+                                cfg_c, n, tile_g=tile, interpret=False,
+                                jitted=False, telemetry=True, monitor=True,
+                                fused_ticks=T, ilp_subtiles=K, layout=L,
+                                aux_source=A, compute=CM)), \
+                                f"pallas-T{T}K{K}-{L}-{A}-{CM}"
+                        try:
+                            ts, stats, _ = bench.measure(cfg, n_ticks,
+                                                         reps, gen)
+                            best = bench.median(ts)
+                            med = stats[ts.index(best)]
+                            if int(med.get("tel_fused_draw_overflow")
+                                   or 0):
+                                continue  # clamped draws: invalid point
+                            if int(med.get("tel_packed_width_overflow")
+                                   or 0):
+                                continue  # wrapped packs: invalid point
+                            timings[f"T{T}K{K}-{L}-{A}-{CM}"] = round(
+                                n_ticks / best, 2)
+                        except Exception as e:
+                            print(f"autotune measure T{T}K{K}-{L}-{A}-{CM}"
+                                  f" failed: {str(e)[:160]}")
     if not timings:
         raise RuntimeError(f"no shallow point measurable at {key}")
     winner = max(timings, key=timings.get)
-    tk, L, A = winner.split("-")
+    tk, L, A, CM = winner.split("-")
     T, K = (int(x) for x in tk[1:].split("K"))
     plan = {"engine": "pallas", "ilp_subtiles": K, "fused_ticks": T,
             "layout": L, "sharding": "shard_map", "tile": tile,
-            "aux_source": A}
+            "aux_source": A, "compute": CM}
     prov = {"source": f"autotune measure-on-first-use "
                       f"({jax.devices()[0].platform})",
             "measured": {"ticks_per_sec": timings, "ticks": n_ticks,
@@ -775,7 +817,9 @@ def audit_entries(entries=None, measure_fn: Optional[Callable] = None,
             and (plan.get("layout") or "wide") == (
                 e["plan"].get("layout") or "wide") \
             and (plan.get("aux_source") or "staged") == (
-                e["plan"].get("aux_source") or "staged")
+                e["plan"].get("aux_source") or "staged") \
+            and (plan.get("compute") or "unpacked") == (
+                e["plan"].get("compute") or "unpacked")
         out.append({"key": e["key"], "pinned": e["plan"], "measured": plan,
                     "provenance": prov, "match": match})
     return out

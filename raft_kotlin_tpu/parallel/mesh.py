@@ -314,7 +314,8 @@ def _make_shardmap_pallas_tick(cfg: RaftConfig, mesh: Mesh,
                                fused_ticks: Optional[int] = 1,
                                telemetry: bool = False,
                                monitor: bool = False,
-                               aux_source: str = "staged"):
+                               aux_source: str = "staged",
+                               compute: str = "unpacked"):
     """The Pallas megakernel applied per device shard via jax.shard_map.
 
     Division of labor mirrors ops/pallas_tick.make_pallas_tick: the RNG/aux
@@ -342,20 +343,34 @@ def _make_shardmap_pallas_tick(cfg: RaftConfig, mesh: Mesh,
     the unsharded run) and the make_aux / fused_launch_aux pre-passes
     disappear. Leader-isolation banks fuse on this path (the
     resolve_fused_geometry gate is aux_source-aware).
+
+    `compute` = "packed" (ISSUE 16, §18): the per-shard kernel evaluates
+    the phase lattice on packed peer/ctrl words — flat_to_packed_compute
+    / packed_compute_to_flat wrap each shard_map call exactly like the
+    single-device make_pallas_tick, OUTSIDE shard_map (elementwise over
+    the lanes axis, so shard-local under the partitioner; zero new
+    collectives). Packed word operands are lanes-minor rank-2 like every
+    other plane, so the lanes sharding specs apply unchanged.
     """
     from raft_kotlin_tpu.ops import tick as tick_mod
     from raft_kotlin_tpu.ops.pallas_tick import (
         _TILES,
+        COMPUTES,
         cast_flat_in,
         cast_flat_out,
         default_tile,
+        flat_to_packed_compute,
         inkernel_aux_operands,
         inkernel_aux_statics,
         make_pallas_core,
+        packed_compute_to_flat,
         route_ilp_subtiles,
     )
 
     inkernel = aux_source == "inkernel"
+    if compute not in COMPUTES:
+        raise ValueError(f"unknown compute {compute!r}")
+    pc = compute == "packed"
 
     N, G = cfg.n_nodes, cfg.n_groups
     n_dev = math.prod(mesh.devices.shape)
@@ -371,7 +386,8 @@ def _make_shardmap_pallas_tick(cfg: RaftConfig, mesh: Mesh,
             tile = math.gcd(g_local, tile) or 1
     else:
         try:
-            tile = default_tile(cfg, g_local, False)
+            tile = default_tile(cfg, g_local, False, aux_source=aux_source,
+                                compute=compute)
         except ValueError as e:
             raise ValueError(
                 f"sharded pallas needs the PER-DEVICE shard ({g_local} = "
@@ -402,18 +418,22 @@ def _make_shardmap_pallas_tick(cfg: RaftConfig, mesh: Mesh,
     tile_f, sub_k_f, T_f = resolve_fused_geometry(
         cfg, interpret, fused_ticks=fused_ticks,
         snap_rows=_snapshot_rows(cfg, snap_fields),
-        lanes=g_local, platform=platform, aux_source=aux_source)
+        lanes=g_local, platform=platform, aux_source=aux_source,
+        compute=compute)
     if T_f <= 1:
         snap_fields = ()
     if T_f > 1:
         build_call_f = make_pallas_core(cfg, g_local, tile_f, interpret,
                                         subtiles=sub_k_f, fused_ticks=T_f,
                                         tick_states=snap_fields,
-                                        aux_source=aux_source)
+                                        aux_source=aux_source,
+                                        compute=compute)
 
         def tick_fused(state: RaftState, rng):
             base, tkeys, bkeys, scen = tick_mod.split_rng(rng)
             flat = tick_mod.flatten_state(cfg, state)
+            if pc:
+                flat = flat_to_packed_compute(cfg, flat)
             if inkernel:
                 # Resident operands at GLOBAL G, sharded over lanes like
                 # everything else — no aux pre-pass, no draw tables.
@@ -444,6 +464,9 @@ def _make_shardmap_pallas_tick(cfg: RaftConfig, mesh: Mesh,
                 outs = shard_call(*ins)
             s2, ov, ticks_f = unpack_fused_outputs(
                 list(outs), sfields, snaps, T_f)
+            if pc:
+                s2 = packed_compute_to_flat(cfg, s2)
+                sfields = tuple(s2)
             s, _ = cast_flat_out(cfg, [s2[k] for k in sfields], sfields,
                                  with_dirty=False)
             new_state = RaftState(**tick_mod.unflatten_state(cfg, s),
@@ -454,11 +477,14 @@ def _make_shardmap_pallas_tick(cfg: RaftConfig, mesh: Mesh,
         return tick_fused
 
     build_call = make_pallas_core(cfg, g_local, tile, interpret,
-                                  subtiles=sub_k, aux_source=aux_source)
+                                  subtiles=sub_k, aux_source=aux_source,
+                                  compute=compute)
 
     def tick(state: RaftState, rng) -> RaftState:
         base, tkeys, bkeys, scen = tick_mod.split_rng(rng)
         flat = tick_mod.flatten_state(cfg, state)
+        if pc:
+            flat = flat_to_packed_compute(cfg, flat)
         if inkernel:
             stat = inkernel_aux_statics(cfg, base, tkeys, bkeys, scen)
             call, sfields, aux_names = build_call(tick_mod.make_flags(cfg))
@@ -480,6 +506,12 @@ def _make_shardmap_pallas_tick(cfg: RaftConfig, mesh: Mesh,
         )
         with telemetry_mod.engine_scope("shardmap-pallas"):
             outs = shard_call(*ins)
+        if pc:
+            outs = list(outs)
+            sdict = packed_compute_to_flat(
+                cfg, dict(zip(sfields, outs[:len(sfields)])))
+            sfields = tuple(sdict)
+            outs = [sdict[k] for k in sfields] + [outs[-1]]
         s, el_dirty = cast_flat_out(cfg, outs, sfields)
         return tick_mod.finish_tick(
             cfg, tkeys, tick_mod.unflatten_state(cfg, s), el_dirty, state.tick)
@@ -569,7 +601,8 @@ def make_sharded_run(cfg: RaftConfig, mesh: Mesh, n_ticks: int,
                      metrics_every: int = 0, impl: str = "xla",
                      telemetry: bool = False, monitor: bool = False,
                      fused_ticks: Optional[int] = None,
-                     layout: str = "wide", aux_source: str = "staged"):
+                     layout: str = "wide", aux_source: str = "staged",
+                     compute: str = "unpacked"):
     """Compile run(state [, inject]) -> (state, metrics) sharded over `mesh`.
 
     metrics: dict of cross-group reductions emitted every `metrics_every` ticks
@@ -622,6 +655,15 @@ def make_sharded_run(cfg: RaftConfig, mesh: Mesh, n_ticks: int,
     Sticky T=1 fallbacks above still apply, but the in-kernel path keeps
     its aux contract at any T (the fallback rebuild threads aux_source
     too).
+
+    `compute`="packed" (ISSUE 16, §18) evaluates the phase lattice on
+    packed peer/ctrl words inside the per-shard kernel (impl="pallas")
+    or the XLA packed-compute twin (impl="xla", non-deep) — bit-equal to
+    unpacked by construction. Requires layout="packed" (the §18 pairing:
+    packed compute only ships with the packed carry); the flat↔packed
+    conversions run OUTSIDE shard_map on lanes-minor planes, so the tick
+    stays collective-free. Deep-log (dyn) configs route through
+    _make_shardmap_xla_tick, which has no packed twin — refused loudly.
     """
     from raft_kotlin_tpu.models.state import (
         check_packed_ov, pack_state, unpack_state)
@@ -634,13 +676,24 @@ def make_sharded_run(cfg: RaftConfig, mesh: Mesh, n_ticks: int,
         raise ValueError(f"unknown aux_source {aux_source!r}")
     if aux_source == "inkernel" and impl != "pallas":
         raise ValueError("aux_source='inkernel' requires impl='pallas'")
+    if compute not in ("unpacked", "packed"):
+        raise ValueError(f"unknown compute {compute!r}")
+    if compute == "packed" and layout != "packed":
+        raise ValueError(
+            "compute='packed' requires layout='packed' (§18: packed-domain "
+            "compute only ships with the packed carry — autotune pairs them)")
+    if compute == "packed" and impl != "pallas" and cfg.uses_dyn_log:
+        raise ValueError(
+            "compute='packed' has no deep-log XLA shard twin; plans for "
+            "dyn-log configs are stamped compute='unpacked'")
 
     fused_block, T_f = None, 1
     if impl == "pallas":
         cand = _make_shardmap_pallas_tick(cfg, mesh, fused_ticks=fused_ticks,
                                           telemetry=telemetry,
                                           monitor=monitor,
-                                          aux_source=aux_source)
+                                          aux_source=aux_source,
+                                          compute=compute)
         T_f = getattr(cand, "fused_ticks", 1)
         if T_f > 1 and ((metrics_every and metrics_every % T_f)
                         or n_ticks < T_f):
@@ -650,10 +703,12 @@ def make_sharded_run(cfg: RaftConfig, mesh: Mesh, n_ticks: int,
         if T_f == 1:
             shardmap_tick = cand if getattr(cand, "fused_ticks", 1) == 1 \
                 else _make_shardmap_pallas_tick(cfg, mesh,
-                                                aux_source=aux_source)
+                                                aux_source=aux_source,
+                                                compute=compute)
         else:
             shardmap_tick = _make_shardmap_pallas_tick(cfg, mesh,
-                                                       aux_source=aux_source)
+                                                       aux_source=aux_source,
+                                                       compute=compute)
         tick_fn = lambda st, rng: shardmap_tick(st, rng)
     elif cfg.uses_dyn_log:
         # Deep-log (dyn) configs: phase_body per shard — the SPMD
@@ -666,7 +721,7 @@ def make_sharded_run(cfg: RaftConfig, mesh: Mesh, n_ticks: int,
         shardmap_tick = _make_shardmap_xla_tick(cfg, mesh)
         tick_fn = lambda st, rng: shardmap_tick(st, rng)
     else:
-        xla_tick = make_tick(cfg)
+        xla_tick = make_tick(cfg, compute=compute)
         tick_fn = lambda st, rng: xla_tick(st, rng=rng)
     sh = state_sharding(mesh, cfg)
     rep = NamedSharding(mesh, P())

@@ -1,0 +1,173 @@
+"""§18 compute-domain A/B: unpacked vs packed-domain lattice (ISSUE 16).
+
+The headline megakernel has two routed lattice domains (SEMANTICS.md
+§18): "unpacked" evaluates the phase lattice on wide (N, G) / (N·N, G)
+planes (§14 packing confined to the state at rest), "packed" keeps the
+vote-exchange set packed THROUGH the lattice — popcount quorum compares
+on N-bit peer masks, lane reads of the u32 ctrl-word stack, one
+unpack/repack per launch (bit-identical by the §18 pins). This probe
+runs BOTH domains through bench.measure — the SAME timing-trap-hardened
+harness the headline uses (distinct per-rep rng operands, in-region host
+materialization, medians) — on the bench stage-1 fault-soup shape, BOTH
+legs at layout="packed" (the §18 pairing: packed compute only ships
+with the packed carry, so the carry is held fixed and only the lattice
+domain varies), and emits per domain:
+
+- gsps + rep times of the recorder+monitor-on production runner
+  (make_pallas_scan, routed T — the exact headline rung);
+- the deterministic hot-plane VMEM model (ops/pallas_tick.
+  hot_plane_rows x 4 B x 2 directions — the vmem_per_group_* fields the
+  bench record publishes) and the modeled packed_compute_vs_unpacked
+  ratio (the round's >= 1.8x acceptance figure);
+- the lane tile default_tile grants each domain at the probed shape
+  (the freed rows converting into more groups per launch);
+- the measured packed-vs-unpacked speedup.
+
+--pin rewrites the probed tile's SHALLOW entry in the unified
+TUNING_TABLE (parallel/autotune.shallow_key) with the winning domain in
+the plan's `compute` dimension. Refused on CPU: interpreter timings
+cannot pin a hardware table (and the CPU guard pins "unpacked" anyway).
+
+  python scripts/probe_packed_compute.py [groups] [ticks] [--pin]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_compilation_cache_dir", os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
+def pin_table(cfg, compute: str, source: str) -> None:
+    """Pin the probed shape's shallow entry with the winning compute —
+    the full routed plan is re-resolved so the row stays internally
+    consistent, and a packed winner carries the REQUIRED layout pairing
+    (apply_guards demotes a packed-compute row whose layout is wide)."""
+    from raft_kotlin_tpu.parallel import autotune
+
+    plan = dict(autotune.plan_for(cfg, telemetry=True, monitor=True))
+    plan["compute"] = compute
+    if compute == "packed":
+        plan["layout"] = "packed"  # the §18 pairing the guard enforces
+    key = autotune.shallow_key(plan.get("tile") or cfg.n_groups,
+                               platform="tpu", dtype=cfg.log_dtype,
+                               mailbox=cfg.uses_mailbox)
+    by_key = {autotune.canonical_key(e["key"]): dict(e)
+              for e in autotune.TUNING_TABLE}
+    by_key[autotune.canonical_key(key)] = {
+        "key": key, "plan": plan, "provenance": {"source": source}}
+    autotune.pin_entries(list(by_key.values()))
+
+
+def main():
+    import bench
+    from raft_kotlin_tpu.ops.pallas_tick import (
+        _snapshot_rows, default_tile, fused_snapshot_fields,
+        hot_plane_rows, make_pallas_scan, resolve_fused_geometry)
+    from raft_kotlin_tpu.utils.config import RaftConfig
+
+    args = [a for a in sys.argv[1:] if a != "--pin"]
+    do_pin = "--pin" in sys.argv[1:]
+    on_accel = jax.default_backend() != "cpu"
+    groups = int(args[0]) if len(args) > 0 else (102_400 if on_accel else 256)
+    ticks = int(args[1]) if len(args) > 1 else (200 if on_accel else 10)
+    reps = int(os.environ.get("RAFT_PROBE_REPS", 3 if on_accel else 1))
+
+    # The bench stage-1 fault soup at the probed width — the shape whose
+    # TUNING_TABLE row a --pin rewrites.
+    cfg = RaftConfig(
+        n_groups=groups, n_nodes=5, log_capacity=32, cmd_period=10,
+        p_drop=0.25, p_crash=0.01, p_restart=0.08,
+        p_link_fail=0.02, p_link_heal=0.08, seed=0,
+    ).stressed(10)
+
+    # Both legs ride the PACKED carry — the §18 pairing holds the state
+    # encoding fixed so the A/B isolates the lattice domain.
+    layout = "packed"
+    aux = bench._headline_aux_source(cfg)
+    snaps = fused_snapshot_fields(cfg, telemetry=True, monitor=True)
+    snap_rows = _snapshot_rows(cfg, snaps)
+
+    def candidates(compute):
+        def gen(cfg_c):
+            yield (lambda n: make_pallas_scan(
+                cfg_c, n, interpret=not on_accel, jitted=False,
+                telemetry=True, monitor=True, layout=layout,
+                aux_source=aux, compute=compute)), f"pallas-{compute}"
+        return gen
+
+    points = {}
+    for dom in ("unpacked", "packed"):
+        _, _, T = resolve_fused_geometry(
+            cfg, interpret=not on_accel, snap_rows=snap_rows,
+            aux_source=aux, compute=dom)
+        point = {
+            "fused_ticks": T,
+            # The §18 VMEM model: hot-plane rows x 4 B i32 x 2 (aliased
+            # in/out) — the vmem_per_group_* fields bench publishes.
+            "vmem_per_group_hot": hot_plane_rows(cfg, dom) * 4 * 2,
+            # The lane tile the model grants this domain (more lanes =
+            # more groups per kernel launch — the freed rows at work).
+            "tile": default_tile(cfg, min(groups, cfg.n_groups), False,
+                                 snap_rows=snap_rows, aux_source=aux,
+                                 compute=dom),
+        }
+        try:
+            ts, _stats, impl = bench.measure(cfg, ticks, reps,
+                                             candidates(dom))
+            best = bench.median(ts)
+            point["impl"] = impl
+            point["gsps"] = round(groups * ticks / best, 1)
+            point["rep_times_s"] = [round(t, 4) for t in ts]
+        except Exception as e:
+            point["error"] = str(e)[:160]
+        points[dom] = point
+
+    up = points["unpacked"].get("gsps")
+    pp = points["packed"].get("gsps")
+    record = {
+        "probe": "packed_compute",
+        "platform": jax.devices()[0].platform,
+        "groups": groups,
+        "ticks": ticks,
+        "layout": layout,
+        "aux_source": aux,
+        "unpacked": points["unpacked"],
+        "packed": points["packed"],
+        "packed_vs_unpacked": (round(pp / up, 3) if up and pp else None),
+        # The modeled hot-plane ratio the bench tail publishes as
+        # packed_compute_vs_unpacked (the >= 1.8x acceptance figure).
+        "packed_compute_vs_unpacked": round(
+            hot_plane_rows(cfg, "unpacked") / hot_plane_rows(cfg, "packed"),
+            2),
+        "pinned": False,
+    }
+    winner = None
+    if up and pp:
+        winner = "packed" if pp >= up else "unpacked"
+        record["winner"] = winner
+    if do_pin and winner:
+        if not on_accel:
+            print("--pin refused: CPU interpreter timings cannot pin a "
+                  "hardware table", file=sys.stderr)
+        else:
+            src = (f"probe_packed_compute {time.strftime('%Y-%m-%d')}: "
+                   f"{winner} wins ({pp} vs {up} gsps unpacked, "
+                   f"G={groups}, T={points['packed']['fused_ticks']})")
+            pin_table(cfg, winner, src)
+            record["pinned"] = True
+    print(json.dumps(record), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -192,7 +192,14 @@ def load_record(path: str) -> Optional[dict]:
                   # amortized resident read) and the modeled
                   # staged/inkernel whole-tick ratio; the aux trajectory
                   # row + regression gate (check_aux) read these.
-                  "aux_bytes_per_tick", "aux_vs_staged"):
+                  "aux_bytes_per_tick", "aux_vs_staged",
+                  # r18 (ISSUE 16): the §18 hot-plane VMEM-per-group
+                  # model (unpacked vs packed lattice domain) and the
+                  # ratio the round's >=1.8x acceptance gate reads; the
+                  # VMEM trajectory row + regression gate
+                  # (check_compute) read these.
+                  "vmem_per_group_hot", "vmem_per_group_packed",
+                  "packed_compute_vs_unpacked"):
         v = parsed.get(field)
         if not isinstance(v, (int, float)):
             v = _extract_field(tail, field)
@@ -210,8 +217,12 @@ def load_record(path: str) -> Optional[dict]:
         # The aux-stream gate (ISSUE 15) vets the same way; its baseline
         # additionally filters on aux_source=inkernel (check_aux).
         vetted["aux_bytes_per_tick"] = gate_value("suspect")
+    if "vmem_per_group_packed" in aux_num:
+        # The packed-compute VMEM gate (ISSUE 16) vets the same way; its
+        # baseline additionally filters on compute=packed (check_compute).
+        vetted["vmem_per_group_packed"] = gate_value("suspect")
     aux_str: Dict[str, str] = {}
-    for field in ("aux_source",):
+    for field in ("aux_source", "compute"):
         v = parsed.get(field)
         if not isinstance(v, str):
             v = _extract_str_field(tail, field)
@@ -393,6 +404,38 @@ def check_aux(recs: List[dict],
     return []
 
 
+def check_compute(recs: List[dict],
+                  tol: float = REGRESSION_TOL) -> List[Tuple[str, float,
+                                                             float]]:
+    """[(label, latest, best prior)] when the LATEST round's hot-plane
+    VMEM-per-group model (vmem_per_group_packed) GREW more than `tol`
+    above the best (lowest) prior VETTED round that ran compute=packed
+    (ISSUE 16): the figure is deterministic accounting of the §18 packed
+    word planes (ops/pallas_tick.hot_plane_rows), so growth means either
+    a word plane was silently widened or the plan fell back to the wide
+    lattice — the regression the round existed to delete. The baseline
+    filters on compute=packed, so the gate arms itself only once a
+    vetted packed-compute round lands; unpacked-era rounds are published
+    in the trajectory but never enter the baseline."""
+    if len(recs) < 2:
+        return []
+    latest = recs[-1]
+    cur = latest.get("aux_num", {}).get("vmem_per_group_packed")
+    if cur is None:
+        return []
+    prior = [(r["aux_num"]["vmem_per_group_packed"], r["round"])
+             for r in recs[:-1]
+             if "vmem_per_group_packed" in r.get("aux_num", {})
+             and r.get("aux_str", {}).get("compute") == "packed"
+             and r["vetted"].get("vmem_per_group_packed")]
+    if not prior:
+        return []
+    best, best_round = min(prior)
+    if cur > (1.0 + tol) * best:
+        return [("vmem/group (hot)", cur, best)]
+    return []
+
+
 def check_violations(recs: List[dict]) -> List[Tuple[str, str]]:
     """[(leg label, verdict)] for every vetted invariant leg of the LATEST
     round whose verdict is not "clean" — the safety gate (ISSUE 6)."""
@@ -446,7 +489,13 @@ def main(argv=None) -> int:
             # r17 (ISSUE 15): the aux-stream byte term per routed source
             # (lower is better; the 2*state floor is the target).
             ("aux_bytes_per_tick", "aux bytes/tick",
-             "aux_bytes_per_tick", ",.0f")):
+             "aux_bytes_per_tick", ",.0f"),
+            # r18 (ISSUE 16): the hot-plane VMEM-per-group model at the
+            # routed compute domain (lower is better — the packed
+            # lattice's whole point; 680 B unpacked vs 144 B packed at
+            # the headline N=5).
+            ("vmem_per_group_packed", "vmem/group (hot)",
+             "vmem_per_group_packed", ",.0f")):
         if not any(field in r.get("aux_num", {}) for r in recs):
             continue
         row = [label.ljust(18)]
@@ -514,6 +563,13 @@ def main(argv=None) -> int:
               f"vetted inkernel round ({best:,.0f}) — the resident key "
               "tables widened or the plan fell back to the staged HBM "
               "stream (parallel/autotune.py aux_source)", file=sys.stderr)
+    compute_fails = check_compute(recs)
+    for label, cur, best in compute_fails:
+        print(f"PACKED COMPUTE REGRESSION: {label} r{latest:02d} = "
+              f"{cur:,.0f} is {100 * (cur / best - 1):.1f}% above the best "
+              f"prior vetted packed round ({best:,.0f}) — a §18 word plane "
+              "widened or the plan fell back to the wide lattice "
+              "(parallel/autotune.py compute)", file=sys.stderr)
     for field, _v in check_tuning_drift(recs):
         print(f"WARNING: tuning-table drift — r{latest:02d} {field} is "
               "false (the unified TUNING_TABLE disagrees with this "
@@ -530,7 +586,8 @@ def main(argv=None) -> int:
     for f, v in unvetted_bad:
         print(f"WARNING: {f} latched '{v}' on an UNVETTED (suspect) leg — "
               "not gating, but not clean either", file=sys.stderr)
-    if regs or viols or pod_fails or byte_fails or ring_fails or aux_fails:
+    if (regs or viols or pod_fails or byte_fails or ring_fails or aux_fails
+            or compute_fails):
         return 1
     clean_legs = sum(1 for f, v in latest_rec.get("inv", {}).items()
                      if v == "clean" and latest_rec["vetted"].get(f))

@@ -236,7 +236,7 @@ def test_plan_for_composition(no_cache):
     assert plan == {"engine": "flat", "ilp_subtiles": 1, "fused_ticks": 1,
                     "layout": "wide", "compaction": "off",
                     "sharding": "single", "tile": None,
-                    "aux_source": "staged"}
+                    "aux_source": "staged", "compute": "unpacked"}
     # τ=0 mailbox deep: flat is the ONLY valid engine — the caller-level
     # rule overrides any table entry (plan_for composes it in).
     mcfg = RaftConfig(n_groups=8, n_nodes=3, log_capacity=512, mailbox=True,
@@ -341,6 +341,42 @@ def test_planned_run_layout_bit_identity(no_cache):
     for f in ("term", "commit", "last_index", "role", "voted_for"):
         assert np.array_equal(np.asarray(getattr(end_w, f)),
                               np.asarray(getattr(end_p, f))), f
+
+
+def test_compute_dimension_migration(no_cache):
+    # r18 (ISSUE 16): plans carry a `compute` dimension (unpacked|packed —
+    # SEMANTICS.md §18) routed exactly like engine/T/K/layout/aux_source.
+    assert "compute" in autotune.PLAN_FIELDS
+    assert autotune.COMPUTES == ("unpacked", "packed")
+    key = autotune.shallow_key(512, platform="tpu")
+    # 1. LEGACY-DEFAULT MIGRATION: a plan with no compute entry (pre-r18
+    #    pinned rows, stale runtime caches) normalizes to "unpacked" and
+    #    the dimension changes NO other field of the r13..r17 lookups.
+    legacy = {"engine": "pallas", "ilp_subtiles": 4, "fused_ticks": 4,
+              "sharding": "shard_map", "tile": 512, "layout": "packed"}
+    assert autotune.apply_guards(key, dict(legacy))["compute"] == "unpacked"
+    assert autotune.default_plan(key)["compute"] == "unpacked"
+    # 2. PAIRING GUARD: packed compute requires the packed layout — a row
+    #    pinned compute=packed over a wide layout demotes to unpacked
+    #    (the §18 pairing), while the packed/packed pair survives intact.
+    mixed = autotune.apply_guards(
+        key, dict(legacy, layout="wide", compute="packed"))
+    assert mixed["compute"] == "unpacked"
+    paired = autotune.apply_guards(key, dict(legacy, compute="packed"))
+    assert (paired["layout"], paired["compute"]) == ("packed", "packed")
+    # 3. CPU guard: compute pins unpacked regardless of the row (the
+    #    packed domain trades unpack ALU for VMEM headroom the
+    #    interpreter doesn't have) — same class as K=1/T=1/wide.
+    cpu = autotune.apply_guards(autotune.shallow_key(512, platform="cpu"),
+                                dict(legacy, compute="packed"))
+    assert cpu["compute"] == "unpacked"
+    # 4. Deep rows stamp unpacked (no packed-compute deep twin), and
+    #    plan_for composes the dimension end to end on a CPU host.
+    dplan = autotune.resolve_plan(
+        autotune.deep_key(10_000, 13_312, platform="tpu"))
+    assert dplan.get("compute", "unpacked") == "unpacked"
+    scfg = RaftConfig(n_groups=512, n_nodes=3, log_capacity=8, seed=1)
+    assert autotune.plan_for(scfg)["compute"] == "unpacked"
 
 
 def test_audit_reports_drift(no_cache):

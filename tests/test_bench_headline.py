@@ -67,6 +67,9 @@ def _fake_record():
         "aux_source": "inkernel",
         "aux_bytes_per_tick": 4_915_200,
         "aux_vs_staged": 1.84,
+        "compute": "packed",
+        "vmem_per_group_packed": 144,
+        "packed_compute_vs_unpacked": 4.72,
         "suspect": False,
         # plus the long tail of fields that overflowed the driver window
         **{f"filler_{i}": [0.1234] * 8 for i in range(80)},
@@ -155,14 +158,23 @@ def test_compact_headline_is_last_line_and_complete():
     # floor under inkernel) read them from the authoritative tail.
     for k in ("aux_source", "aux_bytes_per_tick", "aux_vs_staged"):
         assert k in bench.COMPACT_EXTRA_FIELDS, k
+    # The r18 additions (ISSUE 16): the routed compute domain of the
+    # headline lattice, the packed hot-plane VMEM-per-group model and
+    # the unpacked/packed ratio — the round's acceptance gate (>= 1.8x
+    # at the headline config) and summarize_bench's VMEM-per-group
+    # trajectory row read them from the authoritative tail.
+    for k in ("compute", "vmem_per_group_packed",
+              "packed_compute_vs_unpacked"):
+        assert k in bench.COMPACT_EXTRA_FIELDS, k
     for k in bench.COMPACT_EXTRA_FIELDS:
         assert k in last, k
         assert last[k] == record[k], k
     # Small enough that the driver's tail window always captures it whole
-    # (the r15 compaction fields grew the line past the old 1200 bound; a
-    # violation status is ~30 chars longer per leg than "clean", so keep
-    # generous headroom under the multi-KB driver window).
-    assert len(lines[-1]) < 1500, lines[-1]
+    # (the r15 compaction fields grew the line past the old 1200 bound,
+    # the r18 compute fields past 1500; a violation status is ~30 chars
+    # longer per leg than "clean", so keep generous headroom under the
+    # multi-KB driver window).
+    assert len(lines[-1]) < 1800, lines[-1]
 
 
 def test_compact_headline_handles_missing_fields():

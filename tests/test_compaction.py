@@ -222,7 +222,8 @@ def test_plan_layer_compaction_dimension():
     assert shallow == {"engine": "xla", "ilp_subtiles": 1,
                       "fused_ticks": 1, "layout": "wide",
                       "compaction": "ring", "sharding": "single",
-                      "tile": None, "aux_source": "staged"}
+                      "tile": None, "aux_source": "staged",
+                      "compute": "unpacked"}
     assert plan_for(_off(deep), platform="tpu")["compaction"] == "off"
 
 

@@ -271,8 +271,11 @@ def test_leader_iso_fused_geometry_reachable_inkernel():
     # derived view. staged: a leader-isolation bank forces routed T
     # sticky to 1 and REFUSES a pinned T; inkernel: the same config
     # routes the exact geometry its scenario-free twin gets from the
-    # table (the fused VMEM model is unchanged — staged aux rows are the
-    # conservative bound).
+    # table AT aux_source="inkernel" (since the r18 VMEM-model fix the
+    # inkernel budget no longer carries the staged aux rows, so the two
+    # sources legitimately tile differently — the lift contract is that
+    # the SCENARIO is geometry-neutral under inkernel, not that the
+    # sources tile alike).
     cfg = dataclasses.replace(
         HET, n_groups=2048,
         scenario=ScenarioSpec(farm_seed=3, partitions=("leader",)))
@@ -286,7 +289,7 @@ def test_leader_iso_fused_geometry_reachable_inkernel():
                                     aux_source="inkernel")
     free = pt.resolve_fused_geometry(
         dataclasses.replace(cfg, scenario=None), interpret=False,
-        platform="tpu")
+        platform="tpu", aux_source="inkernel")
     assert got == free
     assert got[2] == pt.route_fused_ticks(got[0], "tpu") > 1
 

@@ -1,0 +1,371 @@
+"""Packed-domain compute differential suite (ISSUE 16 tentpole).
+
+§14 packed the state AT REST and unpacked at read — every handler still
+ran on wide (N, G) / (N, N, G) planes. §18 moves the phase lattice itself
+into the packed domain: under `compute="packed"` the kernel keeps the
+vote-exchange set packed across the launch — quorum tallies become
+popcount compares on N-bit peer masks (`responded_bits`/`vote_bits`),
+role/flag reads become lane extractions from the fused u32 ctrl-word
+stack, and the flat↔packed conversions run ONCE per launch instead of
+the wide planes riding every operand. `compute` is a routed plan
+dimension exactly like engine/T/K/layout/aux_source; cold/wide fields
+keep the §14 unpack-at-read path. These tests PIN the contract:
+
+- the packed-word helpers are exact (popcount32 vs a host popcount;
+  pack/unpack roundtrips on evolved states; the popcount identities
+  `responses == popcount(responded_bits)` / `votes == popcount(vote_bits)`
+  that make the packed tallies sufficient statistics at phase boundaries);
+- packed ≡ unpacked bit-for-bit on end states, per-tick traces, recorder
+  counters and monitor latches across the XLA twin (sync soup, mailbox
+  [1, 3], τ=0, int16 deep per-pair, §15 compaction W>0) and the Pallas
+  megakernel (T=1, fused T∈{2,4} × ILP K=2, aux_source="inkernel",
+  the 8-device sharded runner);
+- the guards fire loudly: packed compute requires the packed layout,
+  k_per_launch==1, and a known compute name;
+- the VMEM model: hot-plane rows drop >= 1.8x at the literal headline
+  config (pure arithmetic — ops/pallas_tick.hot_plane_rows), and the
+  default_tile budget converts the freed rows into a LARGER lane tile
+  (more groups per launch) at the headline config's fused+inkernel shape.
+
+Heavy cases (fused interpret builds, the sharded runner) are slow-tiered:
+each compiles a full interpret-mode kernel variant, the exact compile
+cost the tier-1 budget cannot absorb at every point.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from conftest import assert_states_equal
+
+from raft_kotlin_tpu.models.state import (
+    init_state,
+    pack_ctrl_words_i32,
+    pack_peer_word_i32,
+    popcount32,
+    synth_vote_bits,
+    unpack_ctrl_words_i32,
+    unpack_peer_word_i32,
+)
+from raft_kotlin_tpu.ops.tick import flatten_state, make_rng, make_run
+from raft_kotlin_tpu.utils.config import RaftConfig
+
+SOUP = RaftConfig(
+    n_groups=8, n_nodes=3, log_capacity=8, cmd_period=3,
+    p_drop=0.2, p_crash=0.02, p_restart=0.1, seed=11,
+).stressed(10)
+
+MAILBOX = RaftConfig(
+    n_groups=8, n_nodes=3, log_capacity=8, cmd_period=3,
+    p_drop=0.2, delay_lo=1, delay_hi=3, seed=7,
+).stressed(10)
+
+TAU0 = RaftConfig(
+    n_groups=8, n_nodes=3, log_capacity=8, cmd_period=3,
+    p_drop=0.2, mailbox=True, seed=3,
+).stressed(10)
+
+
+def _assert_same_run(build_unpacked, build_packed, require_activity=True):
+    """Run both builders; assert end states, traces, recorder counters and
+    monitor carries are bit-equal (the §18 compute-invariance contract)."""
+    r0 = build_unpacked()
+    r1 = build_packed()
+    if not isinstance(r0, tuple):
+        r0, r1 = (r0,), (r1,)
+    e0, e1 = r0[0], r1[0]
+    assert_states_equal(jax.device_get(e0), jax.device_get(e1))
+    for a, b in zip(r0[1:], r1[1:]):
+        assert type(a) is type(b)
+        if isinstance(a, dict):
+            assert set(a) == set(b)
+            for k in a:
+                assert np.array_equal(np.asarray(a[k]), np.asarray(b[k])), k
+        else:
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+    if require_activity:
+        assert int(np.max(np.asarray(e0.term))) > 0, "soup did nothing"
+    return r0
+
+
+# -- packed-word helpers -----------------------------------------------------
+
+def test_popcount32_exact():
+    # The SWAR popcount against the host's bit_count, over the full word
+    # range the §18 planes can hold (every word < 2^30: 3N ctrl bits,
+    # N-bit peer masks, N <= 10).
+    rng = np.random.default_rng(0)
+    words = rng.integers(0, 1 << 30, size=(4, 256), dtype=np.int64)
+    words = np.concatenate(
+        [words, np.array([[0, 1, (1 << 30) - 1, 0x15555555]] * 4).T.reshape(4, -1)],
+        axis=1).astype(np.int32)
+    got = np.asarray(popcount32(jax.numpy.asarray(words)))
+    want = np.vectorize(lambda v: int(v).bit_count())(words.astype(np.uint32))
+    assert np.array_equal(got, want.astype(np.int32))
+
+
+def test_peer_and_ctrl_word_roundtrip():
+    # Evolved state, not init: responded/link planes must be non-trivial.
+    cfg = SOUP
+    end, _ = make_run(cfg, 25, trace=False)(init_state(cfg))
+    flat = {k: np.asarray(v) for k, v in
+            flatten_state(cfg, jax.device_get(end)).items()}
+    N = cfg.n_nodes
+    for plane in ("responded", "link_up"):
+        bits = pack_peer_word_i32(jax.numpy.asarray(flat[plane]), N)
+        back = unpack_peer_word_i32(bits, N)
+        assert np.array_equal(np.asarray(back),
+                              (flat[plane] != 0).astype(np.int32)), plane
+        # popcount(responded_bits) IS the responses tally at the boundary.
+        if plane == "responded":
+            assert np.array_equal(np.asarray(popcount32(bits)),
+                                  np.asarray(flat["responses"]).astype(np.int32))
+    words = pack_ctrl_words_i32(*(jax.numpy.asarray(flat[k]) for k in
+                                  ("role", "round_state", "el_armed",
+                                   "hb_armed", "up")))
+    assert words.shape == (3, cfg.n_groups)
+    ctrl = unpack_ctrl_words_i32(words, N)
+    for k in ("role", "round_state"):
+        assert np.array_equal(np.asarray(ctrl[k]),
+                              np.asarray(flat[k]).astype(np.int32)), k
+    for k in ("el_armed", "hb_armed", "up"):
+        assert np.array_equal(np.asarray(ctrl[k]),
+                              (np.asarray(flat[k]) != 0).astype(np.int32)), k
+    # vote_bits is a SYNTHESIZED sufficient statistic: only its popcount
+    # is ever read, and it must reproduce the wide votes tally exactly.
+    rb = pack_peer_word_i32(jax.numpy.asarray(flat["responded"]), N)
+    vb = synth_vote_bits(rb, jax.numpy.asarray(flat["votes"]), N)
+    assert np.array_equal(np.asarray(popcount32(vb)),
+                          np.asarray(flat["votes"]).astype(np.int32))
+    # Synthesized grants live inside the responded mask (future grants
+    # can only come from still-clear responded bits).
+    assert not np.any(np.asarray(vb) & ~np.asarray(rb))
+
+
+def test_flat_packed_compute_roundtrip():
+    from raft_kotlin_tpu.ops.pallas_tick import (
+        HOT_FIELDS, PACKED_WORD_FIELDS, flat_to_packed_compute,
+        packed_compute_to_flat)
+
+    cfg = MAILBOX  # mailbox fields exercise the cold-plane passthrough
+    end, _ = make_run(cfg, 25, trace=False)(init_state(cfg))
+    flat = flatten_state(cfg, jax.device_get(end))
+    pk = flat_to_packed_compute(cfg, dict(flat))
+    assert not (set(HOT_FIELDS) & set(pk))
+    assert set(PACKED_WORD_FIELDS) <= set(pk)
+    back = packed_compute_to_flat(cfg, dict(pk))
+    assert set(back) == set(flat)
+    for k in flat:
+        a, b = np.asarray(flat[k]), np.asarray(back[k])
+        if k in ("el_armed", "hb_armed", "up", "responded", "link_up"):
+            assert np.array_equal(a != 0, b != 0), k  # bool planes as i32
+        else:
+            assert np.array_equal(a.astype(np.int32),
+                                  b.astype(np.int32)), k
+
+
+# -- guards ------------------------------------------------------------------
+
+def test_packed_compute_guards():
+    from raft_kotlin_tpu.ops.pallas_tick import make_pallas_scan
+
+    with pytest.raises(ValueError, match="layout='packed'"):
+        make_pallas_scan(SOUP, 4, interpret=True, compute="packed")
+    with pytest.raises(ValueError, match="k_per_launch"):
+        make_pallas_scan(SOUP, 4, interpret=True, k_per_launch=2,
+                         layout="packed", compute="packed")
+    with pytest.raises(ValueError, match="compute"):
+        make_pallas_scan(SOUP, 4, interpret=True, compute="sparse")
+    with pytest.raises(ValueError, match="compute"):
+        make_run(SOUP, 4, compute="sparse")
+
+
+def test_sharded_packed_compute_guard():
+    from raft_kotlin_tpu.parallel.mesh import make_mesh, make_sharded_run
+
+    mesh = make_mesh()
+    with pytest.raises(ValueError, match="layout='packed'"):
+        make_sharded_run(SOUP, mesh, 4, compute="packed")
+
+
+# -- XLA twin differentials --------------------------------------------------
+
+@pytest.mark.parametrize("cfg", [SOUP, MAILBOX, TAU0],
+                         ids=["sync", "mailbox13", "tau0"])
+def test_xla_packed_compute_equals_unpacked(cfg):
+    st = init_state(cfg)
+    _assert_same_run(
+        lambda: make_run(cfg, 25, trace=True, telemetry=True,
+                         monitor=True)(st),
+        lambda: make_run(cfg, 25, trace=True, telemetry=True,
+                         monitor=True, compute="packed")(st))
+
+
+def test_xla_packed_compute_composes_with_packed_layout():
+    # The two packed dimensions together: §14 packed carry AT REST plus
+    # §18 packed-domain lattice — the production pairing autotune routes.
+    st = init_state(SOUP)
+    _assert_same_run(
+        lambda: make_run(SOUP, 25, trace=True, telemetry=True)(st),
+        lambda: make_run(SOUP, 25, trace=True, telemetry=True,
+                         layout="packed", compute="packed")(st))
+
+
+def test_compaction_packed_compute_equals_unpacked():
+    # §15 compaction W>0: fold/install arithmetic is a COLD path (stays
+    # wide in-lattice) but runs downstream of packed role/quorum reads.
+    cfg = RaftConfig(n_groups=8, n_nodes=3, log_capacity=16, cmd_period=2,
+                     p_drop=0.1, compact_watermark=4, compact_chunk=4,
+                     seed=5).stressed(10)
+    st = init_state(cfg)
+    r = _assert_same_run(
+        lambda: make_run(cfg, 40, trace=True, telemetry=True,
+                         monitor=True)(st),
+        lambda: make_run(cfg, 40, trace=True, telemetry=True,
+                         monitor=True, compute="packed")(st))
+    assert int(np.max(np.asarray(r[0].snap_index))) >= 0
+
+
+@pytest.mark.slow
+def test_int16_deep_packed_compute_equals_unpacked():
+    # The deep band's CPU-feasible per-pair reference with int16 log
+    # storage: packed compute must survive narrow storage dtypes (the
+    # pack helpers widen internally). Slow tier: two deep compiles.
+    cfg = RaftConfig(n_groups=8, n_nodes=3, log_capacity=512,
+                     log_dtype="int16", cmd_period=2, p_drop=0.1,
+                     seed=5).stressed(10)
+    assert cfg.uses_dyn_log
+    st = init_state(cfg)
+    _assert_same_run(
+        lambda: make_run(cfg, 20, trace=True, batched=False)(st),
+        lambda: make_run(cfg, 20, trace=True, batched=False,
+                         compute="packed")(st))
+
+
+# -- Pallas megakernel differentials -----------------------------------------
+
+def test_pallas_packed_compute_equals_wide():
+    from raft_kotlin_tpu.ops.pallas_tick import make_pallas_scan
+
+    st, rng = init_state(SOUP), make_rng(SOUP)
+    _assert_same_run(
+        lambda: make_pallas_scan(SOUP, 21, interpret=True, trace=True,
+                                 telemetry=True, monitor=True)(st, rng),
+        lambda: make_pallas_scan(SOUP, 21, interpret=True, trace=True,
+                                 telemetry=True, monitor=True,
+                                 layout="packed",
+                                 compute="packed")(st, rng))
+
+
+@pytest.mark.slow
+def test_pallas_fused_ilp_packed_compute_equals_wide():
+    # Fused T=2 × ILP K=2: the packed carry crosses the fused T-loop, the
+    # ILP slab split, and the 1-tick-remainder path (n_ticks=21). Slow
+    # tier: compiles two fused interpret variants.
+    from raft_kotlin_tpu.ops.pallas_tick import make_pallas_scan
+
+    st, rng = init_state(SOUP), make_rng(SOUP)
+    _assert_same_run(
+        lambda: make_pallas_scan(SOUP, 21, interpret=True, fused_ticks=2,
+                                 ilp_subtiles=2, trace=True)(st, rng),
+        lambda: make_pallas_scan(SOUP, 21, interpret=True, fused_ticks=2,
+                                 ilp_subtiles=2, trace=True,
+                                 layout="packed",
+                                 compute="packed")(st, rng))
+
+
+@pytest.mark.slow
+def test_pallas_fused_inkernel_packed_compute_equals_wide():
+    # The full §17+§18 composition: fused T=4 with IN-KERNEL aux draws —
+    # the kernel draws randomness AND evaluates the lattice on packed
+    # words; the in-kernel scenario/role reads come from the wide
+    # in-lattice planes the per-launch unpack provides.
+    from raft_kotlin_tpu.ops.pallas_tick import make_pallas_scan
+
+    st, rng = init_state(MAILBOX), make_rng(MAILBOX)
+    _assert_same_run(
+        lambda: make_pallas_scan(MAILBOX, 20, interpret=True, fused_ticks=4,
+                                 aux_source="inkernel", trace=True)(st, rng),
+        lambda: make_pallas_scan(MAILBOX, 20, interpret=True, fused_ticks=4,
+                                 aux_source="inkernel", trace=True,
+                                 layout="packed",
+                                 compute="packed")(st, rng))
+
+
+@pytest.mark.slow
+def test_sharded_packed_compute_equals_wide():
+    # The 8-device sharded runner: flat↔packed conversions run OUTSIDE
+    # shard_map on lanes-minor planes (shard-local, collective-free);
+    # window metrics, recorder and monitor must be bit-equal. Slow tier:
+    # two sharded compiles.
+    from raft_kotlin_tpu.parallel.mesh import (
+        init_sharded, make_mesh, make_sharded_run)
+
+    cfg = dataclasses.replace(SOUP, n_groups=16)
+    mesh = make_mesh()
+    st = init_sharded(cfg, mesh)
+    _assert_same_run(
+        lambda: make_sharded_run(cfg, mesh, 20, metrics_every=5,
+                                 telemetry=True, monitor=True,
+                                 impl="pallas")(st),
+        lambda: make_sharded_run(cfg, mesh, 20, metrics_every=5,
+                                 telemetry=True, monitor=True,
+                                 impl="pallas", layout="packed",
+                                 compute="packed")(st))
+
+
+# -- the acceptance model ----------------------------------------------------
+
+def test_hot_plane_vmem_drops_at_least_1_8x():
+    # The round's acceptance criterion: modeled VMEM rows for the HOT
+    # planes (the vote-exchange set the lattice touches every tick) drop
+    # >= 1.8x under compute="packed" at the LITERAL headline config
+    # (N=5). Pure arithmetic — runs on any host.
+    from raft_kotlin_tpu.ops.pallas_tick import hot_plane_rows
+
+    cfg = RaftConfig(
+        n_groups=102_400, n_nodes=5, log_capacity=32, cmd_period=10,
+        p_drop=0.25, p_crash=0.01, p_restart=0.08,
+        p_link_fail=0.02, p_link_heal=0.08, seed=0,
+    ).stressed(10)
+    hu = hot_plane_rows(cfg, "unpacked")
+    hp = hot_plane_rows(cfg, "packed")
+    # The closed forms: 7N + 2N^2 wide rows vs 3 ctrl words + 3 peer-word
+    # planes (responded/link/vote bits) — 85 vs 18 at N=5.
+    assert (hu, hp) == (85, 18)
+    assert hu / hp >= 1.8, (hu, hp)
+    # N=3 (the differential configs) still clears the bar.
+    assert hot_plane_rows(SOUP, "unpacked") / \
+        hot_plane_rows(SOUP, "packed") >= 1.8
+
+
+def test_default_tile_grows_groups_per_launch():
+    # The freed rows are not just a number: the default_tile VMEM budget
+    # converts them into a LARGER lane tile — more groups per kernel
+    # launch — at the headline config's fused (T=2) inkernel shape. Also
+    # pins the satellite fix: aux_source="inkernel" stops budgeting the
+    # staged aux rows entirely.
+    from raft_kotlin_tpu.ops.pallas_tick import (
+        _snapshot_rows, default_tile, fused_snapshot_fields)
+
+    cfg = RaftConfig(
+        n_groups=32_768, n_nodes=5, log_capacity=32, cmd_period=10,
+        p_drop=0.25, p_crash=0.01, p_restart=0.08,
+        p_link_fail=0.02, p_link_heal=0.08, seed=0,
+    ).stressed(10)
+    sr = _snapshot_rows(cfg, fused_snapshot_fields(cfg, telemetry=True,
+                                                   monitor=True))
+    t_base = default_tile(cfg, cfg.n_groups, False, k_per_launch=2,
+                          snap_rows=sr, aux_source="inkernel")
+    t_pc = default_tile(cfg, cfg.n_groups, False, k_per_launch=2,
+                        snap_rows=sr, aux_source="inkernel",
+                        compute="packed")
+    assert t_pc > t_base, (t_base, t_pc)
+    assert (t_base, t_pc) == (256, 512)
+    # Unpacked compute never tiles SMALLER than the legacy model did
+    # (the satellite fix only ever frees rows).
+    t_staged = default_tile(cfg, cfg.n_groups, False, k_per_launch=2,
+                            snap_rows=sr)
+    assert t_base >= t_staged

@@ -210,6 +210,56 @@ def test_ring_residency_gate(tmp_path):
     assert sb.main([str(tmp_path / "BENCH_r*.json")]) == 1
 
 
+def test_packed_compute_gate(tmp_path):
+    # ISSUE 16 satellite: once a vetted round runs compute=packed and
+    # publishes the hot-plane VMEM-per-group model (vmem_per_group_packed
+    # — deterministic §18 word accounting), a later round whose figure
+    # GREW >10% gates exit-1 (a word plane was silently widened or the
+    # plan fell back to the wide lattice); the gate stays unarmed while
+    # no vetted packed-compute round exists, and unpacked-era rounds
+    # never enter the baseline.
+    sb = _mod()
+
+    def art(n, vmem=None, compute="packed", suspect="false"):
+        rec = {"ticks_per_sec": 400.0, "suspect": False}
+        if vmem is not None:
+            rec["compute"] = compute
+            rec["vmem_per_group_packed"] = vmem
+            rec["packed_compute_vs_unpacked"] = 4.72
+        tail = json.dumps(rec) + "\n"
+        tail = tail.replace('"suspect": false', f'"suspect": {suspect}')
+        return {"n": n, "rc": 0, "tail": tail, "parsed": None}
+
+    # No prior packed-compute round -> unarmed, clean exit.
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(art(1)))
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(art(2, vmem=144)))
+    assert sb.check_compute(sb.load_all(str(tmp_path / "BENCH_r*.json"))) \
+        == []
+    assert sb.main([str(tmp_path / "BENCH_r*.json")]) == 0
+    # Latest round's hot-plane model grew 67% above the vetted prior
+    # packed round -> gate.
+    (tmp_path / "BENCH_r03.json").write_text(json.dumps(art(3, vmem=240)))
+    recs = sb.load_all(str(tmp_path / "BENCH_r*.json"))
+    fails = sb.check_compute(recs)
+    assert len(fails) == 1 and fails[0][1] == 240
+    assert sb.main([str(tmp_path / "BENCH_r*.json")]) == 1
+    # Shrinking (or equal) VMEM never gates — lower is better.
+    (tmp_path / "BENCH_r03.json").write_text(json.dumps(art(3, vmem=144)))
+    assert sb.main([str(tmp_path / "BENCH_r*.json")]) == 0
+    # An UNPACKED prior round must not arm the baseline (its figure is
+    # published in the trajectory but is not a packed-lattice bound).
+    (tmp_path / "BENCH_r02.json").write_text(
+        json.dumps(art(2, vmem=100, compute="unpacked")))
+    (tmp_path / "BENCH_r03.json").write_text(json.dumps(art(3, vmem=240)))
+    assert sb.check_compute(
+        sb.load_all(str(tmp_path / "BENCH_r*.json"))) == []
+    # A SUSPECT prior packed round must not arm the baseline either.
+    (tmp_path / "BENCH_r02.json").write_text(
+        json.dumps(art(2, vmem=100, suspect="true")))
+    assert sb.check_compute(
+        sb.load_all(str(tmp_path / "BENCH_r*.json"))) == []
+
+
 def test_fuzz_violation_gate(tmp_path):
     # ISSUE 9 satellite: a non-clean fuzz-farm verdict on the latest
     # vetted round gates exit-1 exactly like the classical inv legs.
