@@ -315,7 +315,19 @@ COMPACT_EXTRA_FIELDS = ("deeplog_parity_rate", "deeplog_ov_fallback",
                         # VMEM-per-group trajectory row read these from
                         # the authoritative tail.
                         "compute", "vmem_per_group_packed",
-                        "packed_compute_vs_unpacked")
+                        "packed_compute_vs_unpacked",
+                        # r19 (ISSUE 17): the §19 continuous scheduler —
+                        # measured farm_util at the heterogeneous-lifetime
+                        # mix, the modeled static drain-tail baseline, the
+                        # retire/admit rate, the §9.3 histogram occupancy
+                        # and the leg's Figure-3 verdict — the round's
+                        # acceptance gate (util >= 0.95 where static
+                        # < 0.7) and summarize_bench's farm_util
+                        # trajectory + regression rows read these from
+                        # the authoritative tail.
+                        "farm_util", "static_farm_util",
+                        "universe_retire_per_sec", "timing_hist_nonzero",
+                        "continuous_inv_status")
 
 # Flight-recorder counters published verbatim from the headline run's
 # median rep (stats tel_* keys — utils/telemetry.TELEMETRY_FIELDS).
@@ -1732,6 +1744,55 @@ def main() -> None:
     except Exception as e:
         print(f"fuzz smoke leg failed: {str(e)[:300]}", file=sys.stderr)
 
+    # Continuous-farm leg (ISSUE 17): the §19 scheduler at a
+    # heterogeneous-lifetime mix — lifetimes in [40, 400] against
+    # 10-tick segments, so a static batch would idle retired lanes for
+    # the drain tail while the continuous farm re-admits them in place.
+    # Publishes measured farm_util (useful lane-ticks / total), the
+    # modeled static-batch baseline at the SAME sampled mix
+    # (api/fuzz.static_drain_util — drain-tail arithmetic, a model like
+    # every post-r05 perf figure on this box: ROUND19.md), the
+    # retire/admit throughput, the §9.3 timing-histogram occupancy
+    # evidence, and the Figure-3 verdict (gated like every safety leg).
+    farm_util = None
+    static_farm_util = None
+    universe_retire_per_sec = None
+    timing_hist_nonzero = None
+    continuous_inv_status = None
+    continuous_universe_ticks = None
+    continuous_universes_retired = None
+    continuous_corpus = None
+    try:
+        from raft_kotlin_tpu.api import fuzz as fuzz_mod
+        from raft_kotlin_tpu.utils.telemetry import trace_span
+
+        cont_g = int(os.environ.get("RAFT_BENCH_CONT_GROUPS", 256))
+        cont_t = int(os.environ.get("RAFT_BENCH_CONT_SEGMENT", 10))
+        cont_s = int(os.environ.get("RAFT_BENCH_CONT_SEGMENTS", 60))
+        cont_cfg = fuzz_mod.continuous_config(cont_g)
+        with trace_span("bench/continuous"):
+            t0 = time.perf_counter()
+            cf = fuzz_mod.continuous_farm(cont_cfg, cont_t, cont_s,
+                                          verbose=False)
+            cont_elapsed = time.perf_counter() - t0
+        farm_util = cf["farm_util"]
+        static_farm_util = fuzz_mod.static_drain_util(cont_cfg)
+        universe_retire_per_sec = cf["universes_retired"] / cont_elapsed
+        timing_hist_nonzero = int(
+            sum(1 for v in cf["hist_downtime"] if v)
+            + sum(1 for v in cf["hist_elect"] if v))
+        continuous_inv_status = cf["inv_status"]
+        continuous_universe_ticks = cf["universe_ticks"]
+        continuous_universes_retired = cf["universes_retired"]
+        continuous_corpus = cf["corpus_hash"]
+        for rec in cf["records"]:
+            print(f"CONTINUOUS VIOLATION: {rec['status']} universe="
+                  f"{rec['universe_id']} segment={rec['segment']}",
+                  file=sys.stderr)
+    except Exception as e:
+        print(f"continuous farm leg failed: {str(e)[:300]}",
+              file=sys.stderr)
+
     # Compaction leg (ISSUE 12): the §15 bounded-window proof — a
     # monitored + recorded run of 4x log_capacity ticks at a
     # bounded-window config (positions MUST outgrow the ring), publishing
@@ -2068,6 +2129,21 @@ def main() -> None:
             "taint_restart_universes"),
         "fuzz_taint_unsafe_universes": fuzz_coverage.get(
             "taint_unsafe_universes"),
+        # Continuous-farm leg (ISSUE 17): the §19 scheduler's measured
+        # lane utilization at the heterogeneous-lifetime mix vs the
+        # modeled static-batch drain-tail baseline at the SAME sampled
+        # lifetimes, the retire/admit throughput, the §9.3 histogram
+        # occupancy (nonzero bins across both on-device histograms — the
+        # "timing channel actually measured something" evidence), and
+        # the Figure-3 verdict (gated: summarize_bench INV_LEGS).
+        "farm_util": farm_util,
+        "static_farm_util": static_farm_util,
+        "universe_retire_per_sec": universe_retire_per_sec,
+        "timing_hist_nonzero": timing_hist_nonzero,
+        "continuous_inv_status": continuous_inv_status,
+        "continuous_universe_ticks": continuous_universe_ticks,
+        "continuous_universes_retired": continuous_universes_retired,
+        "continuous_corpus_hash": continuous_corpus,
         # Compaction leg (ISSUE 12): the §15 bounded-window run's
         # Figure-3 verdict across the truncation boundary, the snapshot
         # counters, flat-memory evidence (window high-water vs the ring,

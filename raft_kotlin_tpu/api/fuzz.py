@@ -67,25 +67,29 @@ def _cpu_batched_guard(cfg: RaftConfig) -> Optional[bool]:
                      and jax.default_backend() == "cpu") else None
 
 
-def _monitor_shardings(mesh, n_groups: int, n_ticks: int):
+def _monitor_shardings(mesh, n_groups: int, n_ticks: int,
+                       timing: bool = False, sched: bool = False):
     """NamedShardings for the RAW per-group monitor carry under `mesh`:
     the (G,)-BY-CONTRACT keys (PER_GROUP_KEYS stress counters + the taint
-    masks) place on the groups axis like the state arrays; scalars, the
-    history ring and the latch replicate. Keyed by NAME, not by shape —
-    a shape rule would mis-shard the (W,) ring whenever n_groups happened
-    to equal the window count. (The rng operand's placement stays in
-    mesh.rng_shardings, where shape IS the contract: bank channels are
-    (G,) by construction. These were the two single-device assumptions
-    the r13 pod work removed.)"""
+    masks + every §19 grp_* scheduler/timing row) place on the groups axis
+    like the state arrays; scalars, the history ring, the latch and the
+    (B,) timing histograms replicate (integer sums are order-independent,
+    so the psum'd histogram is bit-equal to single-device). Keyed by NAME,
+    not by shape — a shape rule would mis-shard the (W,) ring whenever
+    n_groups happened to equal the window count. (The rng operand's
+    placement stays in mesh.rng_shardings, where shape IS the contract:
+    bank channels are (G,) by construction. These were the two
+    single-device assumptions the r13 pod work removed.)"""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     rep = NamedSharding(mesh, P())
     lanes = NamedSharding(mesh, P(("dcn", "ici")))
-    per_group = set(telemetry_mod.PER_GROUP_KEYS) | {
-        "taint_restart", "taint_unsafe"}
     mon0 = jax.eval_shape(
         lambda: telemetry_mod.monitor_init(n_groups, n_ticks,
-                                           per_group=True))
+                                           per_group=True, timing=timing,
+                                           sched=sched))
+    per_group = {k for k in mon0
+                 if k.startswith("grp_") or k.startswith("taint_")}
     for k in per_group:
         assert mon0[k].shape == (n_groups,), k  # the (G,) contract itself
     return {k: (lanes if k in per_group else rep) for k in mon0}
@@ -215,6 +219,347 @@ def run_fuzz_batch(cfg: RaftConfig, n_ticks: int,
         "universe": uni,
         "coverage": cov,
     }
+
+
+# -- the §19 continuous universe scheduler -----------------------------------
+
+def make_continuous_runner(cfg: RaftConfig, segment_ticks: int,
+                           mutator: Optional[Callable] = None, mesh=None):
+    """run(state, uids, reset, seeds) -> (end_state, telemetry, RAW monitor
+    carry) — one SEGMENT of the §19 continuous farm (SEMANTICS.md §19).
+
+    Universe identity is operand-only (r17): the scenario bank rides the
+    rng operand keyed by `uids`, so between segments the admission loop
+    swaps retired lanes' bank rows (make_rng(cfg, uids=...)) and passes the
+    retire mask as `reset` — inside the jit, reset lanes FOLD back to
+    init_state(cfg, scen=bank) under a per-leaf where on the groups axis
+    while surviving lanes carry their state bits forward untouched. No
+    recompile (bank values are runtime operands), static shapes, zero
+    drain tail. The global tick scalar resets only when EVERY lane resets
+    (all-retire boundaries reproduce a fresh static batch bit-for-bit —
+    the §19 equality theorem; partial admissions join the global clock,
+    still byte-deterministic and replayable from the admission log).
+
+    The monitor carry runs per_group + timing + sched: the §19 retirement
+    predicate latches grp_retire_age in the scan, and the downtime /
+    election-latency histograms accumulate on-device (one readback per
+    segment). `seeds` re-seeds the cross-segment carry rows (taints +
+    telemetry.SCHED_SEED_KEYS), cleared under `reset`; the bank's "life"
+    row installs grp_life each segment. `mesh` shards lanes exactly like
+    make_batch_runner (bit-identical — tests/test_scheduler.py)."""
+    from raft_kotlin_tpu.models.state import init_state
+    from raft_kotlin_tpu.ops.tick import make_rng, make_tick, split_rng
+
+    spec = cfg.scenario
+    assert spec is not None, "continuous scheduling needs cfg.scenario"
+    G = cfg.n_groups
+    quiesce = spec.quiesce_ticks
+
+    if mesh is None:
+        tick = make_tick(cfg, batched=_cpu_batched_guard(cfg))
+        tick_fn = lambda s, rng: tick(s, rng=rng)
+        jit_kw = {}
+        place_rng = jax.jit(lambda u: make_rng(cfg, uids=u))
+        mk_state = lambda: init_state(cfg)
+    else:
+        import math as _math
+
+        from raft_kotlin_tpu.parallel import mesh as mesh_mod
+
+        n_dev = _math.prod(mesh.devices.shape)
+        assert cfg.n_groups % n_dev == 0, "pad_groups first"
+        if cfg.uses_dyn_log:
+            smt = mesh_mod._make_shardmap_xla_tick(cfg, mesh)
+            tick_fn = lambda s, rng: smt(s, rng)
+        else:
+            tick = make_tick(cfg)
+            tick_fn = lambda s, rng: tick(s, rng=rng)
+        sh = mesh_mod.state_sharding(mesh, cfg)
+        rng_sh = mesh_mod.rng_shardings(cfg, mesh)
+        rep = jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec())
+        lanes_sh = jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec(("dcn", "ici")))
+        mon_sh = _monitor_shardings(mesh, cfg.n_groups, segment_ticks,
+                                    timing=True, sched=True)
+        seeds_sh = {k: lanes_sh for k in
+                    ("taint_restart", "taint_unsafe")
+                    + telemetry_mod.SCHED_SEED_KEYS}
+        jit_kw = {"in_shardings": (sh, rng_sh, lanes_sh, seeds_sh),
+                  "out_shardings": (sh, rep, mon_sh)}
+        place_rng = jax.jit(lambda u: make_rng(cfg, uids=u),
+                            out_shardings=rng_sh)
+        mk_state = lambda: mesh_mod.init_sharded(cfg, mesh)
+
+    @functools.partial(jax.jit, **jit_kw)
+    def run(st, rng, reset, seeds):
+        scen = split_rng(rng)[3]
+        fresh = init_state(cfg, scen=scen)
+
+        def fold(f, c):
+            if f.ndim == 0:
+                return c  # the tick scalar — handled below
+            r = reset.reshape((1,) * (f.ndim - 1) + (G,))
+            return jnp.where(r, f, c)
+
+        st = jax.tree_util.tree_map(fold, fresh, st)
+        st = st.replace(tick=jnp.where(jnp.all(reset),
+                                       jnp.zeros((), _I32), st.tick))
+
+        def body(carry, _):
+            s, tel, mon = carry
+            s2 = tick_fn(s, rng)
+            if mutator is not None:
+                s2 = mutator(s2, s.tick)
+            tel = telemetry_mod.telemetry_step(s, s2, tel)
+            mon = telemetry_mod.monitor_step(s, s2, mon)
+            return (s2, tel, mon), None
+
+        tel0 = telemetry_mod.telemetry_zeros()
+        mon0 = dict(telemetry_mod.monitor_init(
+            G, segment_ticks, per_group=True, timing=True, sched=True,
+            quiesce_ticks=quiesce))
+        zb = jnp.zeros((G,), bool)
+        zi = jnp.zeros((G,), _I32)
+        mon0["taint_restart"] = jnp.where(reset, zb, seeds["taint_restart"])
+        mon0["taint_unsafe"] = jnp.where(reset, zb, seeds["taint_unsafe"])
+        for k in telemetry_mod.SCHED_SEED_KEYS:
+            mon0[k] = jnp.where(reset, zi, seeds[k])
+        mon0["grp_life"] = scen.get("life", zi)
+        (end, tel, mon), _ = jax.lax.scan(body, (st, tel0, mon0), None,
+                                          length=segment_ticks)
+        return end, tel, mon
+
+    def zero_seeds():
+        zb = jnp.zeros((G,), bool)
+        zi = jnp.zeros((G,), _I32)
+        return {"taint_restart": zb, "taint_unsafe": zb,
+                **{k: zi for k in telemetry_mod.SCHED_SEED_KEYS}}
+
+    def call(state=None, uids=None, reset=None, seeds=None):
+        st = state if state is not None else mk_state()
+        if uids is None:
+            uids = spec.universe_base + np.arange(G, dtype=np.int32)
+        rng = place_rng(jnp.asarray(uids, _I32))
+        if reset is None:
+            reset = jnp.ones((G,), bool)
+        if seeds is None:
+            seeds = zero_seeds()
+        return run(st, rng, jnp.asarray(reset, bool), seeds)
+
+    return call
+
+
+def static_drain_util(cfg: RaftConfig) -> float:
+    """Modeled lane utilization of the STATIC-batch baseline at cfg's
+    sampled lifetime mix: a static batch must run every lane to the
+    longest lifetime in the batch (the drain tail), so
+    useful/total = sum(life) / (G * max(life)). Arithmetic over the same
+    bank rows the continuous run installs — a model, not a measurement
+    (and on this box even the measured side is CPU-hosted: ROUND19.md)."""
+    from raft_kotlin_tpu.models.oracle import scenario_bank_np
+
+    spec = cfg.scenario
+    assert spec is not None and spec.life_hi > 0, (
+        "static_drain_util needs a lifetime channel (scenario.life_hi > 0)")
+    life = np.asarray(scenario_bank_np(cfg)["life"], np.float64)
+    return float(life.sum() / (life.size * life.max()))
+
+
+def continuous_corpus_hash(records, admit_log, farm_seed, groups: int,
+                           segments: int, segment_ticks: int) -> str:
+    """The §19 corpus hash: the canonical violation records PLUS the
+    ordered retire/admit log — equal farm inputs => equal retire/admit
+    ORDER => equal hash (the admission sequence is part of the corpus
+    bytes, as §19 requires)."""
+    payload = json.dumps(
+        {"schema": CORPUS_SCHEMA + "+cont", "farm_seed": farm_seed,
+         "groups": groups, "segments": segments,
+         "segment_ticks": segment_ticks, "admits": admit_log,
+         "records": corpus_lines(records)},
+        sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+
+def continuous_farm(cfg: RaftConfig, segment_ticks: int, segments: int,
+                    out_path: Optional[str] = None, verbose: bool = False,
+                    mutator: Optional[Callable] = None, mesh=None) -> dict:
+    """The §19 standing farm: run `segments` segments of `segment_ticks`
+    through make_continuous_runner, retiring and re-admitting lanes
+    between segments so every lane stays hot (no drain tail). Per segment:
+    ONE readback (monitor summary + universe/scheduler/timing stats),
+    then host-side admission — each retired lane gets universe_id =
+    universe_base + next_serial in lane order, its bank row re-sampled by
+    the next segment's rng operand, its state folded to init under the
+    reset mask. Deterministic end to end: the retire/admit order itself is
+    hashed (continuous_corpus_hash).
+
+    farm_util accounting: a retired lane's ticks AFTER its retirement age
+    (it keeps ticking until the segment boundary) are the only waste, so
+    farm_util = 1 - sum(age_end - retire_age) / total lane-ticks. The
+    static baseline for the same mix is static_drain_util's drain-tail
+    model.
+
+    Violations: the latching lane retires via the predicate's violation
+    arm and is re-admitted like any other; the latch coordinate is
+    recorded as a continuous-mode artifact (segment + segment-relative
+    tick + universe_id — no auto-shrink: shrink_violation assumes static
+    batches; replay = rerun the farm, which is deterministic)."""
+    spec = cfg.scenario
+    assert spec is not None, "continuous_farm needs cfg.scenario"
+    G = cfg.n_groups
+    runner = make_continuous_runner(cfg, segment_ticks, mutator=mutator,
+                                    mesh=mesh)
+    uids = spec.universe_base + np.arange(G, dtype=np.int64)
+    next_serial = G
+    state, seeds = None, None
+    reset = np.ones((G,), bool)
+    admit_log: list = []
+    records: list = []
+    statuses: list = []
+    status = "clean"
+    retired_total, wasted = 0, 0
+    tel_total: dict = {}
+    cov_total = {"fault_universes": 0, "election_universes": 0,
+                 "taint_restart_universes": 0, "taint_unsafe_universes": 0,
+                 "violation_universes": 0}
+    bins = telemetry_mod.TIMING_BINS
+    hist_down = np.zeros(bins, np.int64)
+    hist_elect = np.zeros(bins, np.int64)
+    down_ticks = 0
+    for seg in range(segments):
+        state, tel, mon = runner(state=state, uids=uids, reset=reset,
+                                 seeds=seeds)
+        summ = telemetry_mod.summarize_monitor(mon)
+        uni = telemetry_mod.universe_stats(mon)
+        sch = telemetry_mod.sched_stats(mon)
+        statuses.append(summ["inv_status"])
+        for k, v in telemetry_mod.summarize_telemetry(tel).items():
+            tel_total[k] = tel_total.get(k, 0) + v
+        cov_total["fault_universes"] += int(
+            np.sum(uni["grp_fault_events"] > 0))
+        cov_total["election_universes"] += int(
+            np.sum(uni["grp_elections"] > 0))
+        cov_total["taint_restart_universes"] += int(
+            np.sum(uni["taint_restart"]))
+        cov_total["taint_unsafe_universes"] += int(
+            np.sum(uni["taint_unsafe"]))
+        cov_total["violation_universes"] += int(
+            np.sum(uni["grp_violations"] > 0))
+        hist_down += sch["hist_downtime"].astype(np.int64)
+        hist_elect += sch["hist_elect"].astype(np.int64)
+        down_ticks += int(sch["down_ticks"])
+        retire_age = sch["grp_retire_age"]
+        age_end = sch["grp_age"]
+        retired = retire_age >= 0
+        wasted += int(np.sum(np.where(retired, age_end - retire_age, 0)))
+        if summ["latch"] is not None:
+            g = int(summ["latch"]["group"])
+            art = {
+                "schema": CORPUS_SCHEMA + "+cont",
+                "farm_seed": spec.farm_seed,
+                "universe_id": int(uids[g]),
+                "universe": _continuous_universe_params(cfg, int(uids[g])),
+                "segment": seg,
+                "tick": int(summ["latch"]["tick"]),
+                "group": g,
+                "invariant": summ["latch"]["invariant"],
+                "invariant_id": int(summ["latch"]["invariant_id"]),
+                "status": summ["inv_status"],
+                "mutated": mutator is not None,
+            }
+            records.append(art)
+            if status == "clean":
+                status = summ["inv_status"]
+            if verbose:
+                print(f"LATCH: {summ['inv_status']} in segment {seg} "
+                      f"(universe {int(uids[g])})")
+        lanes = np.nonzero(retired)[0]
+        for lane in lanes:
+            new_uid = spec.universe_base + next_serial
+            admit_log.append([seg, int(lane), int(uids[lane]),
+                              int(new_uid)])
+            uids[lane] = new_uid
+            next_serial += 1
+        retired_total += len(lanes)
+        reset = retired.copy()
+        seeds = {k: mon[k] for k in ("taint_restart", "taint_unsafe")
+                 + telemetry_mod.SCHED_SEED_KEYS}
+        if verbose:
+            print(f"segment {seg}: inv={summ['inv_status']} "
+                  f"retired={len(lanes)} serial={next_serial}")
+    total = G * segment_ticks * segments
+    useful = total - wasted
+    result = {
+        "schema": CORPUS_SCHEMA + "+cont",
+        "farm_seed": spec.farm_seed,
+        "groups": G,
+        "segments": segments,
+        "segment_ticks": segment_ticks,
+        "universe_ticks": total,
+        "useful_ticks": useful,
+        "wasted_ticks": wasted,
+        "farm_util": useful / total if total else 0.0,
+        "universes_admitted": G + retired_total,
+        "universes_retired": retired_total,
+        "inv_status": status,
+        "statuses": statuses,
+        "violations": len(records),
+        "records": records,
+        "admit_log": admit_log,
+        "coverage": cov_total,
+        "telemetry": tel_total,
+        "hist_downtime": hist_down.tolist(),
+        "hist_elect": hist_elect.tolist(),
+        "down_ticks": down_ticks,
+        "corpus_hash": continuous_corpus_hash(
+            records, admit_log, spec.farm_seed, G, segments, segment_ticks),
+    }
+    if out_path is not None:
+        with open(out_path, "w") as f:
+            for line in corpus_lines(records):
+                f.write(line + "\n")
+    return result
+
+
+def _continuous_universe_params(cfg: RaftConfig, uid: int) -> dict:
+    """The host-readable bank row of ONE universe id (the continuous
+    artifact's `universe` field): sample a 1-group bank at
+    universe_base = uid — identical values to the lane's rows, because
+    draws are keyed by (farm_seed, kind, universe_id) only."""
+    spec = cfg.scenario
+    if spec is None:
+        return {}
+    c1 = dataclasses.replace(
+        cfg, n_groups=1,
+        scenario=dataclasses.replace(spec, universe_base=uid))
+    from raft_kotlin_tpu.models.oracle import scenario_bank_np
+
+    return {k: int(v[0]) for k, v in scenario_bank_np(c1).items()}
+
+
+def churn_life_spec(farm_seed: int = 31, life_lo: int = 40,
+                    life_hi: int = 400,
+                    quiesce_ticks: int = 0) -> ScenarioSpec:
+    """§19 heterogeneous-lifetime universe family: the smoke fault mix
+    plus per-group lifetimes and randomized election-timeout windows —
+    the continuous scheduler's headline mix (bench's farm_util leg) and
+    the §9.3 observatory's spread channel."""
+    return ScenarioSpec(
+        farm_seed=farm_seed, drop_max=0.25, crash_max=0.02,
+        restart_max=0.2, timeout_windows=True,
+        life_lo=life_lo, life_hi=life_hi, quiesce_ticks=quiesce_ticks)
+
+
+def continuous_config(groups: int, farm_seed: int = 31, seed: int = 9,
+                      life_lo: int = 40, life_hi: int = 400,
+                      quiesce_ticks: int = 0) -> RaftConfig:
+    """The §19 continuous-farm batch config over churn_life_spec."""
+    return RaftConfig(n_groups=groups, n_nodes=3, log_capacity=32,
+                      cmd_period=5, seed=seed,
+                      scenario=churn_life_spec(
+                          farm_seed, life_lo=life_lo, life_hi=life_hi,
+                          quiesce_ticks=quiesce_ticks)).stressed(10)
 
 
 # -- auto-shrinking ----------------------------------------------------------

@@ -432,6 +432,11 @@ class OracleGroup:
         # Scenario bank rows for THIS group (SEMANTICS.md §12): partition
         # programs are evaluated inside tick() (leader isolation reads the
         # pre-phase-F roles); the fault/delay channels ride the mask fns.
+        if cfg.scenario is not None and cfg.scenario.timeout_windows:
+            raise NotImplementedError(
+                "per-group election-timeout windows (§19 timeout_windows) "
+                "are XLA-engine-only: the oracle's timeout draws bake the "
+                "scalar cfg.el_lo/el_hi window")
         self._scen = scenario_bank_np(cfg) if cfg.scenario is not None \
             else None
 

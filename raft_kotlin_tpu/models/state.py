@@ -213,7 +213,7 @@ def assert_narrow_bounds(cfg: RaftConfig) -> None:
         "round_ticks/hb_ticks < 32768")
 
 
-def init_state(cfg: RaftConfig) -> RaftState:
+def init_state(cfg: RaftConfig, scen: Optional[dict] = None) -> RaftState:
     # Log planes allocate PHYSICAL rows (§16): ring_capacity when set,
     # log_capacity otherwise. Position-valued fields stay logical.
     G, N, C = cfg.n_groups, cfg.n_nodes, cfg.phys_capacity
@@ -232,8 +232,21 @@ def init_state(cfg: RaftConfig) -> RaftState:
     base = rngmod.base_key(cfg.seed)
     # Boot draw: every node arms its election timer with counter 0 (t_ctr becomes 1).
     # Drawn in the canonical (G, N) shape (SEMANTICS.md §4), then transposed.
+    # Under §19 timeout windows the bounds come from the scenario bank
+    # (per-group [el_lo, el_hi] rows, broadcast over nodes); `scen` lets a
+    # caller that already holds the bank (the continuous runner's rng
+    # operand) reuse it, otherwise it is sampled here — same bits either
+    # way, since the bank is a pure function of (farm_seed, universe_id).
+    sp = cfg.scenario
+    if scen is None and sp is not None and sp.timeout_windows \
+            and not sp.degenerate:
+        scen = rngmod.sample_scenario_bank(cfg)
+    if scen is not None and "el_lo" in scen:
+        el_bounds = (scen["el_lo"][:, None], scen["el_hi"][:, None])
+    else:
+        el_bounds = (cfg.el_lo, cfg.el_hi)
     el_left = rngmod.draw_uniform_grid(
-        base, rngmod.KIND_TIMEOUT, zi(G, N), cfg.el_lo, cfg.el_hi
+        base, rngmod.KIND_TIMEOUT, zi(G, N), *el_bounds
     ).T.astype(jnp.int16)
     return RaftState(
         term=zi(N, G),

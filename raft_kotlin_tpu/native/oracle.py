@@ -200,6 +200,11 @@ def _tick_masks(cfg: RaftConfig, t0: int, T: int) -> Dict[str, Optional[np.ndarr
     ticks = jnp.arange(t0, t0 + T, dtype=jnp.int32)
     scen = {}
     if cfg.scenario is not None:
+        if cfg.scenario.timeout_windows:
+            raise NotImplementedError(
+                "per-group election-timeout windows (§19 timeout_windows) "
+                "are XLA-engine-only: the native engine's timeout tables "
+                "bake the scalar cfg.el_lo/el_hi window")
         from raft_kotlin_tpu.models.oracle import scenario_bank_np
 
         scen = scenario_bank_np(cfg)

@@ -343,6 +343,20 @@ def inkernel_table_rows(cfg: RaftConfig) -> int:
     return 4 + len(rngmod.scen_layout(cfg))
 
 
+def reject_timeout_windows(cfg: RaftConfig) -> None:
+    """Per-group election-timeout windows (§19 scenario.timeout_windows)
+    are XLA-engine-only for now: every Pallas el-draw site (boot tables,
+    phase-F redraw, deferred §7 materialization) bakes the scalar
+    cfg.el_lo/el_hi window, so running such a bank here would silently
+    draw the wrong bits. The kernel-twin draw primitives already take
+    array bounds (kt_draw_uniform/kt_randint — bit-pinned in
+    tests/test_scheduler.py), so lighting this up is plumbing, not math."""
+    if cfg.scenario is not None and cfg.scenario.timeout_windows:
+        raise NotImplementedError(
+            "scenario.timeout_windows (§19) is not wired into the Pallas "
+            "engines yet — run the XLA engine (the continuous farm path)")
+
+
 def inkernel_aux_statics(cfg: RaftConfig, base, tkeys, bkeys, scen) -> dict:
     """The launch-invariant halves of the inkernel operands, computed ONCE
     per run from the rng operand (trivial bitcasts/stacks — runtime values,
@@ -1124,6 +1138,7 @@ def make_pallas_tick(cfg: RaftConfig, tile_g: Optional[int] = None,
     unpacks them (popcount identities) on exit, so the RaftState surface
     — and the bits — are unchanged."""
     N, C, G = cfg.n_nodes, cfg.phys_capacity, cfg.n_groups
+    reject_timeout_windows(cfg)
     if aux_source not in AUX_SOURCES:
         raise ValueError(f"unknown aux_source {aux_source!r}")
     if compute not in COMPUTES:
@@ -1697,6 +1712,8 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
 
     from raft_kotlin_tpu.models import state as state_mod
     from raft_kotlin_tpu.utils import telemetry as telemetry_mod
+
+    reject_timeout_windows(cfg)
 
     N, G = cfg.n_nodes, cfg.n_groups
     K = max(1, k_per_launch)

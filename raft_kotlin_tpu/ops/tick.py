@@ -2432,7 +2432,7 @@ def make_aux(cfg: RaftConfig, base, tkeys, bkeys, state: RaftState,
             restart_m = restart_m | (fault_cmd.T == 2)
         aux["crash_m"], aux["restart_m"] = crash_m, restart_m
         aux["el_draw_f"] = rngmod.draw_uniform_keyed(
-            tkeys, state.t_ctr, cfg.el_lo, cfg.el_hi).astype(jnp.int16)
+            tkeys, state.t_ctr, *el_bounds(cfg, scen)).astype(jnp.int16)
     if flags.links:
         aux["link_fail"] = rngmod.event_mask(
             base, rngmod.KIND_LINK_FAIL, t, (G, N, N), cfg.p_link_fail,
@@ -2488,22 +2488,36 @@ def unflatten_state(cfg: RaftConfig, s: dict) -> dict:
     return out
 
 
-def materialize_el(cfg: RaftConfig, tkeys, s: dict, el_dirty):
+def el_bounds(cfg: RaftConfig, scen):
+    """The election-timeout bounds every engine draws against: the scalar
+    config window, or — under §19 timeout_windows — the bank's per-group
+    [el_lo, el_hi] rows broadcast over the (N, G) counter grids. One copy,
+    so the boot draw, the phase-F restart redraw and the deferred §7
+    materialization can never disagree on the window."""
+    if scen and "el_lo" in scen:
+        return scen["el_lo"][None, :], scen["el_hi"][None, :]
+    return cfg.el_lo, cfg.el_hi
+
+
+def materialize_el(cfg: RaftConfig, tkeys, s: dict, el_dirty,
+                   scen: Optional[dict] = None):
     """The SEMANTICS.md §7 deferred election draw: el_left for dirty nodes is
     the counted draw at t_ctr - 1 (the last counter the tick consumed).
     Shared by finish_tick and the flat-carry Pallas runner so the deferral
     formula lives in exactly one place."""
-    d = rngmod.draw_uniform_keyed(tkeys, s["t_ctr"] - 1, cfg.el_lo, cfg.el_hi)
+    d = rngmod.draw_uniform_keyed(tkeys, s["t_ctr"] - 1,
+                                  *el_bounds(cfg, scen))
     return jnp.where(el_dirty, d.astype(s["el_left"].dtype), s["el_left"])
 
 
-def finish_tick(cfg: RaftConfig, tkeys, s: dict, el_dirty, t):
+def finish_tick(cfg: RaftConfig, tkeys, s: dict, el_dirty, t,
+                scen: Optional[dict] = None):
     """Materialize the deferred election draws and bump the tick counter."""
-    s["el_left"] = materialize_el(cfg, tkeys, s, el_dirty)
+    s["el_left"] = materialize_el(cfg, tkeys, s, el_dirty, scen=scen)
     return RaftState(**s, tick=t + 1)
 
 
-def make_rng(cfg: RaftConfig):
+def make_rng(cfg: RaftConfig, uids=None):
     """The per-simulation RNG operands: (base key, timeout key grid, backoff key
     grid[, scenario bank]). When cfg.scenario is set, the per-group
     ScenarioBank (utils/rng.sample_scenario_bank — keyed by the spec's
@@ -2511,7 +2525,10 @@ def make_rng(cfg: RaftConfig):
     element, reaching every engine's make_aux through the existing rng
     operand plumbing: bank VALUES are runtime operands, so same-spec-shape
     configs share one compilation. Classical configs keep the 3-tuple
-    (split_rng normalizes).
+    (split_rng normalizes). `uids` overrides the bank's universe-id row
+    (the §19 continuous scheduler's admission hook — see
+    sample_scenario_bank); bank values stay runtime operands, so
+    admissions never recompile.
 
     Static key prefixes are computed once per simulation (rng.grid_keys):
     the per-draw cost inside the tick drops to fold_in(counter) + randint.
@@ -2529,7 +2546,8 @@ def make_rng(cfg: RaftConfig):
     tkeys = rngmod.grid_keys(base, rngmod.KIND_TIMEOUT, cfg.n_groups, N).T
     bkeys = rngmod.grid_keys(base, rngmod.KIND_BACKOFF, cfg.n_groups, N).T
     if cfg.scenario is not None:
-        return base, tkeys, bkeys, rngmod.sample_scenario_bank(cfg)
+        return base, tkeys, bkeys, rngmod.sample_scenario_bank(cfg, uids=uids)
+    assert uids is None, "universe ids need cfg.scenario"
     return base, tkeys, bkeys
 
 
@@ -2600,7 +2618,8 @@ def make_tick(cfg: RaftConfig, batched: Optional[bool] = None,
         el_dirty = phase_body(cfg, s, aux, flags)
         if compute == "packed":
             s = exit_packed_compute(cfg, s, dtypes=wdt)
-        return finish_tick(cfg, tkeys, unflatten_state(cfg, s), el_dirty, state.tick)
+        return finish_tick(cfg, tkeys, unflatten_state(cfg, s), el_dirty,
+                           state.tick, scen=scen)
 
     return tick
 

@@ -81,6 +81,21 @@ class ScenarioSpec:
     # in EVERY group, which a bounded §15 ring needs to stay
     # capacity-clean at any group count.
     warmup_down: int = 0
+    # §19 continuous-scheduler channels (SEMANTICS.md §19):
+    # - timeout_windows: sample a per-group election-timeout window
+    #   [el_lo, el_hi] nested inside the config's window (the §9.3 timing
+    #   observatory's spread channel). Engines that bake scalar el bounds
+    #   (Pallas, oracle, native) refuse such banks loudly.
+    # - life_lo/life_hi: per-group lifetime in ticks — the horizon-reached
+    #   arm of the retirement predicate (life_hi = 0 disables).
+    # - quiesce_ticks: retire a group after this many consecutive calm
+    #   ticks (live leader, no election activity, no fault transitions);
+    #   0 disables. Static (not sampled): part of the retire predicate
+    #   compiled into the monitor carry, not a bank channel.
+    timeout_windows: bool = False
+    life_lo: int = 0
+    life_hi: int = 0
+    quiesce_ticks: int = 0
 
     def __post_init__(self):
         # Coerce to tuple so a list argument cannot build an unhashable
@@ -105,6 +120,19 @@ class ScenarioSpec:
             raise ValueError(
                 "warmup_down is a scheduled fault program — it cannot ride "
                 "a degenerate (scalar-anchor) spec")
+        if not (0 <= self.life_lo <= self.life_hi):
+            raise ValueError(
+                f"need 0 <= life_lo <= life_hi, got "
+                f"{self.life_lo}/{self.life_hi}")
+        if self.life_hi > 0 and self.life_lo < 1:
+            raise ValueError("life_lo must be >= 1 when lifetimes are on")
+        if self.quiesce_ticks < 0:
+            raise ValueError(
+                f"quiesce_ticks must be >= 0, got {self.quiesce_ticks}")
+        if self.degenerate and (self.timeout_windows or self.life_hi > 0):
+            raise ValueError(
+                "timeout_windows/lifetimes are sampled channels — they "
+                "cannot ride a degenerate (scalar-anchor) spec")
 
     @property
     def has_faults(self) -> bool:
@@ -276,6 +304,10 @@ class RaftConfig:
                     f"(delay_lo < delay_hi), got {self.delay_lo}/{self.delay_hi}")
             if s.partitions and self.n_nodes < 2:
                 raise ValueError("partition programs need n_nodes >= 2")
+            if s.timeout_windows and not self.el_lo < self.el_hi:
+                raise ValueError(
+                    "scenario.timeout_windows needs a real election window "
+                    f"(el_lo < el_hi), got {self.el_lo}/{self.el_hi}")
 
     @property
     def uses_mailbox(self) -> bool:

@@ -46,6 +46,9 @@ SCEN_KIND_PART_DST = 42
 SCEN_KIND_PART_PERIOD = 43
 SCEN_KIND_PART_DUTY = 44
 SCEN_KIND_PART_PHASE = 45
+SCEN_KIND_EL_LO = 46
+SCEN_KIND_EL_HI = 47
+SCEN_KIND_LIFE = 48
 
 # Event probabilities live in a 23-bit integer domain: jax's f32 uniform is
 # exactly (bits >> 9) * 2^-23, so `bernoulli(key, p) == (bits(key) >> 9) <
@@ -87,15 +90,22 @@ def draw_uniform(base: jax.Array, kind, g, n, ctr, lo: int, hi: int) -> jax.Arra
 
 
 def draw_uniform_grid(
-    base: jax.Array, kind: int, ctrs: jax.Array, lo: int, hi: int
+    base: jax.Array, kind: int, ctrs: jax.Array, lo, hi
 ) -> jax.Array:
     """Vectorized draws over a (G, N) counter grid; element [g, i] equals
-    draw_uniform(base, kind, g, n=i+1, ctrs[g, i], lo, hi) exactly."""
+    draw_uniform(base, kind, g, n=i+1, ctrs[g, i], lo, hi) exactly. Bounds
+    may be Python ints or arrays broadcastable to ctrs.shape (per-group
+    timeout windows pass (G, 1)); randint's bit stream depends only on the
+    bound VALUES, so array bounds equal to a scalar reproduce the scalar
+    path exactly (same precedent as delay_mask's per-group windows)."""
     G, N = ctrs.shape
     g_idx = jnp.arange(G, dtype=jnp.int32)[:, None].repeat(N, axis=1)
     n_idx = jnp.arange(1, N + 1, dtype=jnp.int32)[None, :].repeat(G, axis=0)
-    f = lambda g, n, c: draw_uniform(base, kind, g, n, c, lo, hi)
-    return jax.vmap(jax.vmap(f))(g_idx, n_idx, ctrs)
+    lo = jnp.broadcast_to(jnp.asarray(lo, jnp.int32), ctrs.shape)
+    hi = jnp.broadcast_to(jnp.asarray(hi, jnp.int32), ctrs.shape)
+    f = lambda g, n, c, a, b: jax.random.randint(
+        _key(base, kind, g, n, c), (), a, b + 1, dtype=jnp.int32)
+    return jax.vmap(jax.vmap(f))(g_idx, n_idx, ctrs, lo, hi)
 
 
 def grid_keys(base: jax.Array, kind: int, G: int, N: int) -> jax.Array:
@@ -114,16 +124,21 @@ def grid_keys(base: jax.Array, kind: int, G: int, N: int) -> jax.Array:
     return jax.vmap(lambda g: jax.vmap(lambda n: f(g, n))(n_idx))(g_idx)
 
 
-def draw_uniform_keyed(keys: jax.Array, ctrs: jax.Array, lo: int, hi: int) -> jax.Array:
+def draw_uniform_keyed(keys: jax.Array, ctrs: jax.Array, lo, hi) -> jax.Array:
     """Inclusive-uniform draws from precomputed static-prefix keys (see grid_keys);
     element [..] == draw_uniform(base, kind, g, n, ctrs[..], lo, hi) exactly.
-    Shape-polymorphic: keys and ctrs must have equal shapes."""
-    f = lambda k, c: jax.random.randint(
-        jax.random.fold_in(k, c), (), lo, hi + 1, dtype=jnp.int32
+    Shape-polymorphic: keys and ctrs must have equal shapes. Bounds may be
+    ints or arrays broadcastable to ctrs.shape (per-group timeout windows) —
+    randint's bits depend only on the bound VALUES, so an array bound equal
+    to the scalar is bit-identical to the scalar path."""
+    lo = jnp.broadcast_to(jnp.asarray(lo, jnp.int32), ctrs.shape)
+    hi = jnp.broadcast_to(jnp.asarray(hi, jnp.int32), ctrs.shape)
+    f = lambda k, c, a, b: jax.random.randint(
+        jax.random.fold_in(k, c), (), a, b + 1, dtype=jnp.int32
     )
     for _ in range(ctrs.ndim):
         f = jax.vmap(f)
-    return f(keys, ctrs)
+    return f(keys, ctrs, lo, hi)
 
 
 def draw_uniform_counters(
@@ -242,12 +257,20 @@ def _scen_draw(fkey, kind: int, uids, lo, hi):
     return jax.vmap(f)(uids, lo, hi)
 
 
-def sample_scenario_bank(cfg) -> dict:
+def sample_scenario_bank(cfg, uids=None) -> dict:
     """The ScenarioBank for `cfg` (cfg.scenario must be set): a dict of
     (n_groups,) int32 arrays — see the key table above. Pure jnp (traceable;
     ops/tick.make_rng computes it into the rng operand). Channel keys are
     PRESENT iff the channel is active, and that presence is what compiles
     the corresponding engine paths in (ops/tick.make_flags reads the spec).
+
+    `uids` optionally overrides the default universe-id row
+    (universe_base + arange(G)) with an explicit (G,) int32 array — the
+    continuous scheduler's admission hook (SEMANTICS.md §19): a retired
+    lane's bank row is re-sampled under a fresh serial while every other
+    row keeps its id, and because draws are keyed by (farm_seed, kind,
+    universe_id) only, the surviving rows are bit-identical to the static
+    batch that would have held them.
 
     degenerate=True builds the bank from the config's own scalar fault
     fields instead of sampling — all groups identical, every active scalar
@@ -267,7 +290,11 @@ def sample_scenario_bank(cfg) -> dict:
             bank["delay_hi"] = jnp.full((G,), cfg.delay_hi, jnp.int32)
         return bank
     fkey = jax.random.key(spec.farm_seed)
-    uids = spec.universe_base + jnp.arange(G, dtype=jnp.int32)
+    if uids is None:
+        uids = spec.universe_base + jnp.arange(G, dtype=jnp.int32)
+    else:
+        uids = jnp.asarray(uids, jnp.int32)
+        assert uids.shape == (G,), uids.shape
     for key, (mx_name, _scalar, kind) in THRESHOLD_CHANNELS.items():
         mx = getattr(spec, mx_name)
         if mx > 0:
@@ -301,6 +328,20 @@ def sample_scenario_bank(cfg) -> dict:
                                        1, period)
         bank["part_phase"] = _scen_draw(fkey, SCEN_KIND_PART_PHASE, uids,
                                         0, period - 1)
+    if spec.timeout_windows:
+        # Per-group randomized election-timeout windows (§19): each
+        # universe gets its own [el_lo, el_hi] sub-range of the config's
+        # window — lo uniform over the full window, hi uniform over
+        # [lo, cfg.el_hi] (same nesting as the delay windows above).
+        lo = _scen_draw(fkey, SCEN_KIND_EL_LO, uids, cfg.el_lo, cfg.el_hi)
+        bank["el_lo"] = lo
+        bank["el_hi"] = _scen_draw(fkey, SCEN_KIND_EL_HI, uids,
+                                   lo, cfg.el_hi)
+    if spec.life_hi > 0:
+        # Per-group lifetime (ticks until horizon-reached retirement) —
+        # the continuous scheduler's heterogeneous-lifetime channel.
+        bank["life"] = _scen_draw(fkey, SCEN_KIND_LIFE, uids,
+                                  spec.life_lo, spec.life_hi)
     return bank
 
 
@@ -375,6 +416,10 @@ def scen_layout(cfg) -> tuple:
         keys += ["delay_lo", "delay_hi"]
     if spec.partitions:
         keys += list(PARTITION_KEYS)
+    if spec.timeout_windows:
+        keys += ["el_lo", "el_hi"]
+    if spec.life_hi > 0:
+        keys += ["life"]
     return tuple(keys)
 
 

@@ -84,7 +84,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from raft_kotlin_tpu.constants import LEADER
+from raft_kotlin_tpu.constants import CANDIDATE, LEADER
 
 _I32 = jnp.int32
 
@@ -387,18 +387,24 @@ def monitor_ring_stride(n_ticks: int, windows: int = MONITOR_WINDOWS) -> int:
 
 
 def monitor_init(n_groups: int, n_ticks: int, enabled: bool = True,
-                 per_group: bool = False) -> Optional[Dict[str, jax.Array]]:
+                 per_group: bool = False, timing: bool = False,
+                 sched: bool = False, quiesce_ticks: int = 0
+                 ) -> Optional[Dict[str, jax.Array]]:
     """THE runner-side monitor-carry constructor: a fresh carry with the
     ring stride tiling an n_ticks run, or None when the runner's monitor
     flag is off — one copy of the idiom every engine's scan builder uses,
     so the carry's construction can never drift between engines.
     `per_group=True` adds the PER_GROUP_KEYS stress counters (the fuzzing
     farm's universe-ranking channel — reduced in the carry alongside the
-    history ring, zero per-tick host traffic)."""
+    history ring, zero per-tick host traffic). `timing=True` adds the §19
+    downtime/election-latency histogram channel; `sched=True` the §19
+    retirement-predicate channel with quiescence horizon `quiesce_ticks`
+    (both per-group — see monitor_zeros)."""
     if not enabled:
         return None
     return monitor_zeros(n_groups, monitor_ring_stride(n_ticks),
-                         per_group=per_group)
+                         per_group=per_group, timing=timing, sched=sched,
+                         quiesce_ticks=quiesce_ticks)
 
 
 # Per-group (universe) stress counters, carried when monitor_zeros(
@@ -410,12 +416,41 @@ def monitor_init(n_groups: int, n_ticks: int, enabled: bool = True,
 # meets a view without it (a fused-snapshot path misconfiguration).
 PER_GROUP_KEYS = ("grp_elections", "grp_fault_events", "grp_violations")
 
+# §19 timing-observatory channel (timing=True): fixed-bin int32 histograms
+# accumulated IN the carry — same transport contract as the history ring
+# (static shapes, integer sums, one readback; order-independent, so a
+# sharded run's psum'd histogram is bit-equal to single-device). Bins are
+# width-1 tick counts with the last bin absorbing overflow. hist_downtime
+# bins completed leaderless runs at the tick leadership returns;
+# hist_elect bins the candidate-active sub-run of the same outage (the
+# §9.3 election-latency figure); down_ticks totals leaderless group-ticks.
+TIMING_BINS = 64
+TIMING_KEYS = ("hist_downtime", "hist_elect", "down_ticks",
+               "grp_down_run", "grp_elect_run")
+
+# §19 continuous-scheduler channel (sched=True): the per-group retirement
+# predicate evaluated in the carry. grp_retire_age latches the group's age
+# at FIRST retirement (-1 = live; sticky), the (G,) retire mask the
+# admission loop reads is simply grp_retire_age >= 0. Arms: violation this
+# tick / lifetime horizon reached (grp_life, 0 = unbounded — installed
+# from the bank's "life" row by the runner) / quiescence (sched_quiesce
+# consecutive calm ticks: live leader, no election activity, no fault
+# transitions; 0 disables).
+SCHED_KEYS = ("grp_age", "grp_life", "grp_calm", "grp_retire_age",
+              "sched_quiesce")
+# The carry rows the admission loop re-seeds across segment boundaries
+# (cleared under the reset mask, carried elsewhere).
+SCHED_SEED_KEYS = ("grp_age", "grp_calm", "grp_down_run", "grp_elect_run")
+
 
 def monitor_zeros(n_groups: int, ring_stride: int = 1,
                   windows: int = MONITOR_WINDOWS,
-                  per_group: bool = False) -> Dict[str, jax.Array]:
+                  per_group: bool = False, timing: bool = False,
+                  sched: bool = False, quiesce_ticks: int = 0,
+                  bins: int = TIMING_BINS) -> Dict[str, jax.Array]:
     """A fresh monitor carry. `ring_stride` is baked in as a () int32 so
-    summarize_monitor can decode the ring without out-of-band metadata."""
+    summarize_monitor can decode the ring without out-of-band metadata.
+    `timing`/`sched` add the §19 channels (see TIMING_KEYS/SCHED_KEYS)."""
     neg1 = jnp.full((), -1, _I32)
     out = {
         "tick": jnp.zeros((), _I32),
@@ -434,6 +469,18 @@ def monitor_zeros(n_groups: int, ring_stride: int = 1,
     if per_group:
         for k in PER_GROUP_KEYS:
             out[k] = jnp.zeros((n_groups,), _I32)
+    if timing:
+        out["hist_downtime"] = jnp.zeros((bins,), _I32)
+        out["hist_elect"] = jnp.zeros((bins,), _I32)
+        out["down_ticks"] = jnp.zeros((), _I32)
+        out["grp_down_run"] = jnp.zeros((n_groups,), _I32)
+        out["grp_elect_run"] = jnp.zeros((n_groups,), _I32)
+    if sched:
+        out["grp_age"] = jnp.zeros((n_groups,), _I32)
+        out["grp_life"] = jnp.zeros((n_groups,), _I32)
+        out["grp_calm"] = jnp.zeros((n_groups,), _I32)
+        out["grp_retire_age"] = jnp.full((n_groups,), -1, _I32)
+        out["sched_quiesce"] = jnp.full((), int(quiesce_ticks), _I32)
     return out
 
 
@@ -713,6 +760,64 @@ def monitor_step_arrays(prev: dict, cur: dict, mon: Dict[str, jax.Array]
         out["grp_elections"] = mon["grp_elections"] + jnp.sum(
             r_c.astype(_I32) - r_p.astype(_I32), axis=0)
 
+    if "grp_down_run" in mon or "grp_age" in mon:
+        # §19 leadership view shared by the timing and scheduler channels:
+        # does the group have a live leader POST-tick?
+        lead_c = jnp.any((cur["role"] == LEADER) & (cur["up"] != 0), axis=0)
+
+    if "grp_down_run" in mon:
+        # §19 timing observatory: run-length counters advance per tick; a
+        # completed run bins into the carry-resident histogram ON the tick
+        # leadership returns (that tick itself is not leaderless). Exactly
+        # recomputable from a (T, N, G) role/up trace —
+        # tests/test_scheduler.py pins the recomputation bit-for-bit.
+        down_run = mon["grp_down_run"]
+        elect_run = mon["grp_elect_run"]
+        B = mon["hist_downtime"].shape[0]
+        rec = lead_c & (down_run > 0)
+
+        def bump(hist, lengths, mask):
+            slot = jnp.clip(lengths, 0, B - 1)
+            hits = (lax.iota(_I32, B)[:, None] == slot[None, :]) \
+                & mask[None, :]
+            return hist + jnp.sum(hits.astype(_I32), axis=1)
+
+        out["hist_downtime"] = bump(mon["hist_downtime"], down_run, rec)
+        out["hist_elect"] = bump(mon["hist_elect"], elect_run,
+                                 rec & (elect_run > 0))
+        out["down_ticks"] = mon["down_ticks"] + _s(~lead_c)
+        cand = jnp.any((cur["role"] == CANDIDATE) & (cur["up"] != 0),
+                       axis=0)
+        out["grp_down_run"] = jnp.where(lead_c, 0, down_run + 1)
+        out["grp_elect_run"] = jnp.where(lead_c, 0,
+                                         elect_run + cand.astype(_I32))
+
+    if "grp_age" in mon:
+        # §19 retirement predicate: latch the group's age at the first
+        # tick any arm fires — violation / lifetime horizon / quiescence.
+        # Sticky; the admission loop folds retired lanes back to
+        # init_state between segments (api/fuzz.make_continuous_runner).
+        age = mon["grp_age"] + 1
+        v_any = jnp.any(V, axis=0)
+        r_p, r_c = prev.get("rounds"), cur.get("rounds")
+        if r_p is None or r_c is None:
+            raise ValueError(
+                "the §19 scheduler channel needs `rounds` in the step "
+                "views (monitor_view/monitor_flat_view supply it; a fused "
+                "snapshot set does not — run the farm on a full-state "
+                "engine)")
+        d_rounds = jnp.sum(r_c.astype(_I32) - r_p.astype(_I32), axis=0)
+        d_fault = jnp.sum(
+            ((prev["up"] != 0) != (cur["up"] != 0)).astype(_I32), axis=0)
+        calm = jnp.where(lead_c & (d_rounds == 0) & (d_fault == 0),
+                         mon["grp_calm"] + 1, 0)
+        life, q = mon["grp_life"], mon["sched_quiesce"]
+        done = v_any | ((life > 0) & (age >= life)) \
+            | ((q > 0) & (calm >= q))
+        out["grp_retire_age"] = jnp.where(
+            done & (mon["grp_retire_age"] < 0), age, mon["grp_retire_age"])
+        out["grp_age"], out["grp_calm"] = age, calm
+
     # First-violation latch: within the tick, lexicographic (group, inv)
     # via one masked min over key = group * N_INVARIANTS + inv; across
     # ticks the scan order makes the first latching tick earliest.
@@ -811,6 +916,18 @@ def universe_stats(mon: Dict[str, jax.Array]) -> dict:
     keys = [k for k in PER_GROUP_KEYS if k in mon]
     host = jax.device_get({k: mon[k] for k in keys + [
         k for k in ("taint_restart", "taint_unsafe") if k in mon]})
+    return {k: np.asarray(v) for k, v in host.items()}
+
+
+def sched_stats(mon: Dict[str, jax.Array]) -> dict:
+    """Host materialization of the §19 scheduler/timing channels of a RAW
+    carry (TIMING_KEYS + SCHED_KEYS, whichever are present) — the
+    admission loop's per-segment readback (api/fuzz.continuous_farm). One
+    batched device_get; arrays come back as numpy."""
+    import numpy as np
+
+    keys = [k for k in TIMING_KEYS + SCHED_KEYS if k in mon]
+    host = jax.device_get({k: mon[k] for k in keys})
     return {k: np.asarray(v) for k, v in host.items()}
 
 

@@ -92,6 +92,23 @@ def main() -> int:
                     "family whose committed prefix keeps pace in every "
                     "group, which a standing --soak needs to stay "
                     "capacity-clean")
+    ap.add_argument("--continuous", type=int, default=0, metavar="SEGMENTS",
+                    help="§19 continuous-scheduler mode: run SEGMENTS "
+                    "segments of --segment ticks over --universes standing "
+                    "lanes, retiring/re-admitting universes in place "
+                    "between segments (no drain tail; farm_util in the "
+                    "summary). Enables per-universe lifetimes (--life) and "
+                    "randomized election-timeout windows")
+    ap.add_argument("--segment", type=int, default=0,
+                    help="ticks per continuous segment (0 = --ticks)")
+    ap.add_argument("--life", type=int, nargs=2, default=(40, 400),
+                    metavar=("LO", "HI"),
+                    help="per-universe lifetime window in ticks "
+                    "(continuous mode; retire at age >= life)")
+    ap.add_argument("--quiesce", type=int, default=0, metavar="TICKS",
+                    help="retire a universe after TICKS calm ticks "
+                    "(stable live leader, no round progress, no fault "
+                    "transitions; 0 = off)")
     ap.add_argument("--out", default=None, help="JSONL corpus path")
     ap.add_argument("--json", action="store_true",
                     help="print the full summary as JSON")
@@ -119,6 +136,11 @@ def main() -> int:
         link_heal_max=args.link_heal_max,
         delay_windows=delay_lo < delay_hi, partitions=parts,
         warmup_down=args.warmup)
+    if args.continuous:
+        life_lo, life_hi = args.life
+        spec = dataclasses.replace(
+            spec, timeout_windows=True, life_lo=life_lo, life_hi=life_hi,
+            quiesce_ticks=args.quiesce)
     batch = args.batch or args.universes
     cw, cc = args.compact if args.compact else (0, 8)
     cfg = RaftConfig(
@@ -156,6 +178,30 @@ def main() -> int:
                   f" cap_exhausted_groups={res['cap_exhausted_groups']}")
         return 0 if (res["inv_status"] == "clean"
                      and res["cap_exhausted_groups"] == 0) else 1
+
+    if args.continuous:
+        # §19 continuous scheduler: a standing batch, retired/re-admitted
+        # in place — every lane hot, one readback per segment.
+        res = fuzz.continuous_farm(
+            cfg, args.segment or args.ticks, args.continuous,
+            out_path=args.out, verbose=not args.json, mesh=mesh)
+        if args.json:
+            print(json.dumps(res, sort_keys=True))
+        else:
+            print(f"continuous {res['segments']} segments x "
+                  f"{res['segment_ticks']} ticks x {res['groups']} lanes "
+                  f"-> {res['universe_ticks']} universe-ticks")
+            print(f"inv_status={res['inv_status']} "
+                  f"violations={res['violations']} "
+                  f"universes_retired={res['universes_retired']} "
+                  f"universes_admitted={res['universes_admitted']} "
+                  f"farm_util={res['farm_util']:.4f} "
+                  f"corpus_hash={res['corpus_hash']}")
+            print("coverage:", json.dumps(res["coverage"], sort_keys=True))
+            for r in res["records"]:
+                print(f"  artifact: {r['status']} "
+                      f"universe={r['universe_id']} segment={r['segment']}")
+        return 0 if res["inv_status"] == "clean" else 1
 
     res = fuzz.fuzz_farm(cfg, args.ticks, universes=args.universes,
                          batch_groups=batch, out_path=args.out,

@@ -98,6 +98,11 @@ INV_LEGS = (
     # translate (mod C_phys) broke a Figure-3 property the full-window
     # round can't reach.
     ("compaction_ring_inv_status", "ring inv", "suspect"),
+    # r19 (ISSUE 17): the §19 continuous-scheduler leg — a latched
+    # violation in ANY standing lane across the retire/admit segments
+    # gates exactly like the static fuzz batch (the artifact coordinate
+    # is in that run's stderr; replay = rerun the deterministic farm).
+    ("continuous_inv_status", "continuous inv", "suspect"),
 )
 
 # Boolean audit fields (r13): pod_dryrun marks the virtual-device
@@ -199,7 +204,14 @@ def load_record(path: str) -> Optional[dict]:
                   # VMEM trajectory row + regression gate
                   # (check_compute) read these.
                   "vmem_per_group_hot", "vmem_per_group_packed",
-                  "packed_compute_vs_unpacked"):
+                  "packed_compute_vs_unpacked",
+                  # r19 (ISSUE 17): the §19 continuous-scheduler figures —
+                  # measured farm_util (higher is better; the regression
+                  # gate, check_farm_util), the modeled static drain-tail
+                  # baseline it beats, the retire/admit rate and the §9.3
+                  # histogram occupancy (trajectory evidence only).
+                  "farm_util", "static_farm_util",
+                  "universe_retire_per_sec", "timing_hist_nonzero"):
         v = parsed.get(field)
         if not isinstance(v, (int, float)):
             v = _extract_field(tail, field)
@@ -221,6 +233,10 @@ def load_record(path: str) -> Optional[dict]:
         # The packed-compute VMEM gate (ISSUE 16) vets the same way; its
         # baseline additionally filters on compute=packed (check_compute).
         vetted["vmem_per_group_packed"] = gate_value("suspect")
+    if "farm_util" in aux_num:
+        # The continuous-scheduler utilization gate (ISSUE 17) vets the
+        # same way — it arms once the first vetted continuous round lands.
+        vetted["farm_util"] = gate_value("suspect")
     aux_str: Dict[str, str] = {}
     for field in ("aux_source", "compute"):
         v = parsed.get(field)
@@ -436,6 +452,38 @@ def check_compute(recs: List[dict],
     return []
 
 
+def check_farm_util(recs: List[dict],
+                    tol: float = REGRESSION_TOL) -> List[Tuple[str, float,
+                                                               float]]:
+    """[(label, latest, best prior)] when the LATEST round's continuous
+    farm_util FELL more than `tol` below the best (highest) prior VETTED
+    round that published it (ISSUE 17): farm_util is deterministic
+    lane-tick accounting of the §19 retire/admit loop at the pinned
+    heterogeneous-lifetime mix, so a drop means retired lanes started
+    idling — the drain tail the scheduler exists to delete creeping back
+    (a broken retirement predicate, a stalled admission loop, or a
+    lifetime-mix change that must be justified in the round doc). Unlike
+    the byte gates this one is HIGHER-is-better. Arms itself only once a
+    vetted continuous round lands; earlier rounds are skipped, never
+    guessed."""
+    if len(recs) < 2:
+        return []
+    latest = recs[-1]
+    cur = latest.get("aux_num", {}).get("farm_util")
+    if cur is None:
+        return []
+    prior = [(r["aux_num"]["farm_util"], r["round"])
+             for r in recs[:-1]
+             if "farm_util" in r.get("aux_num", {})
+             and r["vetted"].get("farm_util")]
+    if not prior:
+        return []
+    best, best_round = max(prior)
+    if cur < (1.0 - tol) * best:
+        return [("farm util", cur, best)]
+    return []
+
+
 def check_violations(recs: List[dict]) -> List[Tuple[str, str]]:
     """[(leg label, verdict)] for every vetted invariant leg of the LATEST
     round whose verdict is not "clean" — the safety gate (ISSUE 6)."""
@@ -495,7 +543,13 @@ def main(argv=None) -> int:
             # lattice's whole point; 680 B unpacked vs 144 B packed at
             # the headline N=5).
             ("vmem_per_group_packed", "vmem/group (hot)",
-             "vmem_per_group_packed", ",.0f")):
+             "vmem_per_group_packed", ",.0f"),
+            # r19 (ISSUE 17): the §19 continuous-scheduler utilization
+            # (HIGHER is better — its own gate, check_farm_util, flags a
+            # drop; the static drain-tail model rides alongside as the
+            # baseline it must keep beating).
+            ("farm_util", "farm util", "farm_util", ",.3f"),
+            ("static_farm_util", "static farm util", "farm_util", ",.3f")):
         if not any(field in r.get("aux_num", {}) for r in recs):
             continue
         row = [label.ljust(18)]
@@ -570,6 +624,14 @@ def main(argv=None) -> int:
               f"prior vetted packed round ({best:,.0f}) — a §18 word plane "
               "widened or the plan fell back to the wide lattice "
               "(parallel/autotune.py compute)", file=sys.stderr)
+    util_fails = check_farm_util(recs)
+    for label, cur, best in util_fails:
+        print(f"FARM UTILIZATION REGRESSION: {label} r{latest:02d} = "
+              f"{cur:,.3f} is {100 * (1 - cur / best):.1f}% below the best "
+              f"prior vetted continuous round ({best:,.3f}) — retired "
+              "lanes are idling again (the §19 retirement predicate or "
+              "the admission loop in api/fuzz.continuous_farm)",
+              file=sys.stderr)
     for field, _v in check_tuning_drift(recs):
         print(f"WARNING: tuning-table drift — r{latest:02d} {field} is "
               "false (the unified TUNING_TABLE disagrees with this "
@@ -587,7 +649,7 @@ def main(argv=None) -> int:
         print(f"WARNING: {f} latched '{v}' on an UNVETTED (suspect) leg — "
               "not gating, but not clean either", file=sys.stderr)
     if (regs or viols or pod_fails or byte_fails or ring_fails or aux_fails
-            or compute_fails):
+            or compute_fails or util_fails):
         return 1
     clean_legs = sum(1 for f, v in latest_rec.get("inv", {}).items()
                      if v == "clean" and latest_rec["vetted"].get(f))

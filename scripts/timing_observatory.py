@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""§9.3 election-timing observatory (ISSUE 17).
+
+The paper's §9.3 question — how does the election-timeout randomization
+window trade availability (downtime after leader loss) against election
+latency? — answered with the continuous scheduler's measurement channel:
+per-group randomized [el_lo, el_hi] windows sampled from the scenario
+bank (utils/rng SCEN_KIND_EL_LO/EL_HI), a crash/restart churn mix that
+keeps killing leaders, and the §19 on-device histograms. Each swept
+spread is ONE runner call: the downtime and election-latency histograms
+accumulate in the monitor scan carry ((B,) int32, same transport as the
+history ring) and come back in a single readback — millions of
+universe-ticks per data point for one device round trip.
+
+Output: TIMING_r<NN>.json at the repo root — per-spread downtime /
+election-latency CDFs plus the monitor verdict (the sweep only counts
+with every point clean). Deterministic: reruns produce identical
+histograms (the §12 replay contract; the bank is keyed by
+(farm_seed, kind, universe_id) only).
+
+Example (the checked-in artifact's arguments):
+  python scripts/timing_observatory.py --groups 512 --ticks 500
+
+Exit status: 0 clean sweep, 1 any point latched a violation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SCHEMA = "raft-timing-v1"
+
+
+def run_point(spread: int, groups: int, ticks: int, farm_seed: int,
+              el_base: int, stress: int) -> dict:
+    """One observatory data point: `groups` universes x `ticks` ticks at
+    randomization window [el_base, el_base + spread] (post-stress ticks),
+    histograms accumulated on-device, ONE readback."""
+    from raft_kotlin_tpu.api import fuzz
+    from raft_kotlin_tpu.utils import telemetry
+    from raft_kotlin_tpu.utils.config import RaftConfig, ScenarioSpec
+
+    # Leader-killing churn, no message drops: downtime runs measure the
+    # election machinery, not delivery loss.
+    spec = ScenarioSpec(farm_seed=farm_seed, crash_max=0.02,
+                        restart_max=0.2, timeout_windows=True)
+    cfg = RaftConfig(
+        n_groups=groups, n_nodes=3, log_capacity=32, cmd_period=5,
+        seed=9, el_lo=el_base * stress,
+        el_hi=(el_base + spread) * stress,
+        scenario=spec).stressed(stress)
+
+    runner = fuzz.make_continuous_runner(cfg, ticks)
+    _, _, mon = runner()
+    summ = telemetry.summarize_monitor(mon)
+    sch = telemetry.sched_stats(mon)
+    uticks = groups * ticks
+    return {
+        "spread": spread,
+        "el_lo": cfg.el_lo,
+        "el_hi": cfg.el_hi,
+        "universe_ticks": uticks,
+        "inv_status": summ["inv_status"],
+        "down_ticks": int(sch["down_ticks"]),
+        "downtime_frac": int(sch["down_ticks"]) / uticks,
+        "hist_downtime": sch["hist_downtime"].tolist(),
+        "hist_elect": sch["hist_elect"].tolist(),
+        "cdf_downtime": cdf_quantiles(sch["hist_downtime"]),
+        "cdf_elect": cdf_quantiles(sch["hist_elect"]),
+    }
+
+
+def cdf_quantiles(hist, qs=(0.5, 0.9, 0.99)) -> dict:
+    """p50/p90/p99 of a width-1-bin (B,) histogram (bin B-1 clamps the
+    overflow tail, so quantiles landing there report >= B-1)."""
+    import numpy as np
+
+    h = np.asarray(hist, np.int64)
+    total = int(h.sum())
+    if total == 0:
+        return {"count": 0}
+    c = np.cumsum(h)
+    out = {"count": total}
+    for q in qs:
+        out[f"p{int(q * 100)}"] = int(np.searchsorted(c, q * total))
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="§9.3 election-timing observatory")
+    ap.add_argument("--groups", type=int, default=512)
+    ap.add_argument("--ticks", type=int, default=500)
+    ap.add_argument("--spreads", type=int, nargs="+",
+                    default=[1, 3, 10, 30],
+                    help="el-window widths to sweep (post-stress ticks)")
+    ap.add_argument("--el-base", type=int, default=20,
+                    help="window lower bound (post-stress ticks)")
+    ap.add_argument("--farm-seed", type=int, default=93)
+    ap.add_argument("--stress", type=int, default=10)
+    ap.add_argument("--out", default=None,
+                    help="artifact path (default TIMING_r<NN>.json at the "
+                    "repo root, NN = next free)")
+    args = ap.parse_args()
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = args.out
+    if out is None:
+        n = 1
+        while os.path.exists(os.path.join(root, f"TIMING_r{n:02d}.json")):
+            n += 1
+        out = os.path.join(root, f"TIMING_r{n:02d}.json")
+
+    points = []
+    for spread in args.spreads:
+        p = run_point(spread, args.groups, args.ticks, args.farm_seed,
+                      args.el_base, args.stress)
+        points.append(p)
+        print(f"spread={spread:3d} inv={p['inv_status']} "
+              f"downtime_frac={p['downtime_frac']:.4f} "
+              f"elect p50/p90/p99="
+              f"{p['cdf_elect'].get('p50', '-')}/"
+              f"{p['cdf_elect'].get('p90', '-')}/"
+              f"{p['cdf_elect'].get('p99', '-')} "
+              f"(n={p['cdf_elect']['count']})")
+
+    clean = all(p["inv_status"] == "clean" for p in points)
+    artifact = {
+        "schema": SCHEMA,
+        "groups": args.groups,
+        "ticks": args.ticks,
+        "el_base": args.el_base,
+        "farm_seed": args.farm_seed,
+        "stress": args.stress,
+        "universe_ticks_total": sum(p["universe_ticks"] for p in points),
+        "clean": clean,
+        "points": points,
+    }
+    with open(out, "w") as f:
+        json.dump(artifact, f, sort_keys=True, indent=1)
+    print(f"wrote {out}: {artifact['universe_ticks_total']} universe-ticks,"
+          f" clean={clean}")
+    return 0 if clean else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -70,6 +70,11 @@ def _fake_record():
         "compute": "packed",
         "vmem_per_group_packed": 144,
         "packed_compute_vs_unpacked": 4.72,
+        "farm_util": 0.982,
+        "static_farm_util": 0.553,
+        "universe_retire_per_sec": 312.4,
+        "timing_hist_nonzero": 41,
+        "continuous_inv_status": "clean",
         "suspect": False,
         # plus the long tail of fields that overflowed the driver window
         **{f"filler_{i}": [0.1234] * 8 for i in range(80)},
@@ -165,6 +170,16 @@ def test_compact_headline_is_last_line_and_complete():
     # trajectory row read them from the authoritative tail.
     for k in ("compute", "vmem_per_group_packed",
               "packed_compute_vs_unpacked"):
+        assert k in bench.COMPACT_EXTRA_FIELDS, k
+    # The r19 additions (ISSUE 17): the §19 continuous scheduler's
+    # measured farm_util, the modeled static drain-tail baseline at the
+    # same sampled lifetime mix, the retire/admit rate, the §9.3
+    # histogram occupancy and the leg's Figure-3 verdict — the round's
+    # acceptance gate (util >= 0.95 where static < 0.7, clean verdict)
+    # and summarize_bench's farm_util trajectory/regression rows read
+    # them from the authoritative tail.
+    for k in ("farm_util", "static_farm_util", "universe_retire_per_sec",
+              "timing_hist_nonzero", "continuous_inv_status"):
         assert k in bench.COMPACT_EXTRA_FIELDS, k
     for k in bench.COMPACT_EXTRA_FIELDS:
         assert k in last, k

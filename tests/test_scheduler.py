@@ -1,0 +1,331 @@
+"""§19 continuous universe scheduler (ISSUE 17).
+
+The contracts that make the retire/admit loop trustworthy:
+- the EQUALITY THEOREM: with every lifetime pinned to the segment length,
+  all lanes retire at every boundary and continuous segment k is
+  BIT-IDENTICAL to static fuzz batch k at universe_base + k*G — end
+  state, telemetry, and every shared monitor key — single-device AND
+  sharded over the 8-virtual-device mesh;
+- corpus byte-determinism with heterogeneous lifetimes: mid-run
+  retirements happen, and two runs produce the same corpus hash and the
+  same retire/admit log (the admission ORDER is part of the bytes);
+- the timeout-spread kernel twins: array-bounds draws are bit-identical
+  to the scalar-bounds draws they generalize (the delay-window precedent,
+  SEMANTICS.md §12), and the bank's nested-window invariant holds;
+- the §9.3 histograms are EXACTLY recomputable from a (T, N, G) trace of
+  the same run — on-device accumulation adds no approximation;
+- the retirement predicate's arms (lifetime, quiescence, violation) each
+  latch grp_retire_age at the right age;
+- engines that bake scalar election bounds (Pallas megakernel, group
+  oracle, native oracle) REFUSE timeout-windows configs loudly.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from conftest import assert_states_equal
+
+from raft_kotlin_tpu.api import fuzz as fuzz_mod
+from raft_kotlin_tpu.constants import CANDIDATE, LEADER
+from raft_kotlin_tpu.utils import rng as rngmod
+from raft_kotlin_tpu.utils import telemetry
+from raft_kotlin_tpu.utils.config import RaftConfig, ScenarioSpec
+
+
+def _assert_trees_equal(a, b):
+    la = jax.tree_util.tree_leaves_with_path(a)
+    lb = jax.tree_util.tree_leaves_with_path(b)
+    assert len(la) == len(lb)
+    for (path, x), (_, y) in zip(la, lb):
+        assert np.array_equal(np.asarray(x), np.asarray(y)), path
+
+
+# ---------------------------------------------------------------------------
+# The equality theorem.
+
+def _static_batch(cfg, k, n_ticks):
+    """Static fuzz batch k: the same universes the continuous farm's
+    segment k admits when every lifetime equals the segment length."""
+    spec = cfg.scenario
+    ck = dataclasses.replace(cfg, scenario=dataclasses.replace(
+        spec, universe_base=spec.universe_base + k * cfg.n_groups))
+    return fuzz_mod.make_batch_runner(ck, n_ticks)()
+
+
+def test_continuous_equals_static_batches():
+    # life_lo = life_hi = segment_ticks => every lane retires at every
+    # boundary => segment k IS static batch k, bit for bit.
+    t_seg = 12
+    cfg = fuzz_mod.continuous_config(16, life_lo=t_seg, life_hi=t_seg)
+    spec = cfg.scenario
+    runner = fuzz_mod.make_continuous_runner(cfg, t_seg)
+
+    st, tel, mon = runner()
+    st_s, tel_s, mon_s = _static_batch(cfg, 0, t_seg)
+    _assert_trees_equal(jax.device_get(st), jax.device_get(st_s))
+    _assert_trees_equal(jax.device_get(tel), jax.device_get(tel_s))
+    h, hs = jax.device_get(mon), jax.device_get(mon_s)
+    for k in hs:  # timing/sched keys only ADD; shared keys bit-equal
+        assert np.array_equal(np.asarray(h[k]), np.asarray(hs[k])), k
+    # every lane retired exactly at its lifetime
+    sch = telemetry.sched_stats(mon)
+    assert np.all(sch["grp_retire_age"] == t_seg)
+
+    # segment 1: full reset, shifted ids — static batch 1.
+    seeds = {k: mon[k] for k in ("taint_restart", "taint_unsafe")
+             + telemetry.SCHED_SEED_KEYS}
+    st2, tel2, mon2 = runner(
+        state=st, uids=spec.universe_base + 16 + np.arange(16),
+        reset=np.ones(16, bool), seeds=seeds)
+    st_s1, tel_s1, mon_s1 = _static_batch(cfg, 1, t_seg)
+    _assert_trees_equal(jax.device_get(st2), jax.device_get(st_s1))
+    _assert_trees_equal(jax.device_get(tel2), jax.device_get(tel_s1))
+    h2, hs1 = jax.device_get(mon2), jax.device_get(mon_s1)
+    for k in hs1:
+        assert np.array_equal(np.asarray(h2[k]), np.asarray(hs1[k])), k
+
+
+@pytest.mark.slow
+def test_continuous_farm_sharded_matches_single_device():
+    # The whole farm loop — retire/admit decisions, corpus hash,
+    # admission log, on-device histograms — sharded over the 8-virtual-
+    # device mesh == single-device, bit for bit (int sums are
+    # order-independent, so the replicated (B,) histograms come back
+    # identical too).
+    from raft_kotlin_tpu.parallel import mesh as mesh_mod
+
+    cfg = fuzz_mod.continuous_config(16, life_lo=8, life_hi=40)
+    r8 = fuzz_mod.continuous_farm(cfg, 10, 4, mesh=mesh_mod.make_mesh())
+    r1 = fuzz_mod.continuous_farm(cfg, 10, 4)
+    assert r8["corpus_hash"] == r1["corpus_hash"]
+    assert r8["admit_log"] == r1["admit_log"]
+    assert r8["hist_downtime"] == r1["hist_downtime"]
+    assert r8["hist_elect"] == r1["hist_elect"]
+    assert r8["farm_util"] == r1["farm_util"]
+    assert r8["universes_retired"] == r1["universes_retired"] > 0
+
+
+# ---------------------------------------------------------------------------
+# Corpus determinism with real mid-run retirements.
+
+def test_corpus_deterministic_with_heterogeneous_retirement():
+    cfg = fuzz_mod.continuous_config(16, life_lo=8, life_hi=40)
+    r1 = fuzz_mod.continuous_farm(cfg, 10, 5)
+    r2 = fuzz_mod.continuous_farm(cfg, 10, 5)
+    # retirements actually happened mid-run (not only at full boundaries)
+    assert r1["universes_retired"] > 0
+    assert any(a[0] > 0 for a in r1["admit_log"])
+    assert r1["corpus_hash"] == r2["corpus_hash"]
+    assert r1["admit_log"] == r2["admit_log"]
+    assert r1["statuses"] == r2["statuses"]
+    # the accounting identity
+    assert r1["useful_ticks"] + r1["wasted_ticks"] == r1["universe_ticks"]
+    assert 0.0 < r1["farm_util"] <= 1.0
+    assert r1["universes_admitted"] == 16 + r1["universes_retired"]
+
+
+def test_admit_log_is_part_of_corpus_bytes():
+    # Same records, different admission order => different hash.
+    h1 = fuzz_mod.continuous_corpus_hash([], [[0, 1, 1, 16]], 31, 16, 2, 10)
+    h2 = fuzz_mod.continuous_corpus_hash([], [[0, 2, 2, 16]], 31, 16, 2, 10)
+    h3 = fuzz_mod.continuous_corpus_hash([], [[0, 1, 1, 16]], 31, 16, 2, 10)
+    assert h1 != h2 and h1 == h3
+
+
+def test_violation_retires_lane_and_records_artifact():
+    cfg = fuzz_mod.continuous_config(16, life_lo=8, life_hi=40)
+    mut = fuzz_mod.twin_leader_mutator(cfg, tick=7, group=3)
+    res = fuzz_mod.continuous_farm(cfg, 10, 2, mutator=mut)
+    assert res["inv_status"].startswith("election_safety")
+    assert res["violations"] == 1
+    rec = res["records"][0]
+    assert (rec["segment"], rec["group"], rec["tick"]) == (0, 3, 7)
+    assert rec["universe_id"] == cfg.scenario.universe_base + 3
+    assert rec["mutated"] is True
+    # the latching lane went through the violation arm: retired in
+    # segment 0 and re-admitted with a fresh serial
+    assert any(a[0] == 0 and a[1] == 3 for a in res["admit_log"])
+
+
+# ---------------------------------------------------------------------------
+# Timeout-spread kernel twins + bank windows.
+
+def test_array_bounds_draws_match_scalar_bounds():
+    # The §19 generalization is conservative: array bounds equal to the
+    # scalar bounds are BIT-IDENTICAL draws (the delay-window precedent).
+    base = jax.random.PRNGKey(7)
+    keys = jax.random.split(jax.random.PRNGKey(3), 24).reshape(4, 6, 2)
+    ctrs = jnp.arange(24, dtype=jnp.int32).reshape(4, 6)
+    s = rngmod.draw_uniform_keyed(keys, ctrs, 5, 17)
+    a = rngmod.draw_uniform_keyed(keys, ctrs, jnp.full((4, 6), 5, jnp.int32),
+                                  jnp.full((4, 6), 17, jnp.int32))
+    assert np.array_equal(np.asarray(s), np.asarray(a))
+
+    sg = rngmod.draw_uniform_grid(base, 3, ctrs, 5, 17)
+    ag = rngmod.draw_uniform_grid(base, 3, ctrs,
+                                  jnp.full((4, 6), 5, jnp.int32),
+                                  jnp.full((4, 6), 17, jnp.int32))
+    assert np.array_equal(np.asarray(sg), np.asarray(ag))
+
+
+def test_per_group_bounds_respected():
+    # Heterogeneous bounds: every draw lands inside ITS group's window.
+    keys = jax.random.split(jax.random.PRNGKey(11), 32).reshape(32, 2)
+    ctrs = jnp.arange(32, dtype=jnp.int32)
+    lo = jnp.arange(32, dtype=jnp.int32) % 7 + 2
+    hi = lo + (jnp.arange(32, dtype=jnp.int32) % 5)
+    d = np.asarray(rngmod.draw_uniform_keyed(keys, ctrs, lo, hi))
+    assert np.all(d >= np.asarray(lo)) and np.all(d <= np.asarray(hi))
+
+
+def test_bank_timeout_windows_nested_and_keyed_by_uid():
+    cfg = fuzz_mod.continuous_config(32)
+    scen = jax.device_get(rngmod.sample_scenario_bank(cfg))
+    lo, hi = scen["el_lo"], scen["el_hi"]
+    assert np.all((lo >= cfg.el_lo) & (lo <= cfg.el_hi))
+    assert np.all((hi >= lo) & (hi <= cfg.el_hi))
+    assert np.any(lo != lo[0]) or np.any(hi != hi[0])  # actually varies
+    life = scen["life"]
+    assert np.all((life >= cfg.scenario.life_lo)
+                  & (life <= cfg.scenario.life_hi))
+    # keyed by universe_id only: an explicit uids override matching a
+    # shifted universe_base reproduces the same rows
+    shifted = dataclasses.replace(cfg, scenario=dataclasses.replace(
+        cfg.scenario, universe_base=cfg.scenario.universe_base + 5))
+    a = jax.device_get(rngmod.sample_scenario_bank(
+        cfg, uids=jnp.arange(32, dtype=jnp.int32)
+        + cfg.scenario.universe_base + 5))
+    b = jax.device_get(rngmod.sample_scenario_bank(shifted))
+    for k in b:
+        assert np.array_equal(a[k], b[k]), k
+    # layout tail: the §19 channels ride the bank layout in order
+    assert rngmod.scen_layout(cfg)[-3:] == ("el_lo", "el_hi", "life")
+
+
+def test_boot_timeouts_within_per_group_windows():
+    from raft_kotlin_tpu.models.state import init_state
+
+    cfg = fuzz_mod.continuous_config(32)
+    scen = rngmod.sample_scenario_bank(cfg)
+    st = jax.device_get(init_state(cfg, scen=scen))
+    lo = np.asarray(jax.device_get(scen["el_lo"]))[None, :]
+    hi = np.asarray(jax.device_get(scen["el_hi"]))[None, :]
+    el = np.asarray(st.el_left, np.int64)
+    assert np.all((el >= lo) & (el <= hi))
+
+
+# ---------------------------------------------------------------------------
+# The §9.3 histograms are exactly recomputable from a trace.
+
+def test_histograms_match_trace_recomputation():
+    from raft_kotlin_tpu.ops.tick import make_run
+
+    t_seg = 48
+    cfg = fuzz_mod.continuous_config(24)
+    runner = fuzz_mod.make_continuous_runner(cfg, t_seg)
+    _, _, mon = runner()
+    sch = telemetry.sched_stats(mon)
+
+    run = make_run(cfg, t_seg, trace=True)
+    from raft_kotlin_tpu.models.state import init_state
+
+    _, trace = run(init_state(cfg))[:2]
+    role = np.asarray(jax.device_get(trace["role"]))  # (T, N, G) post-tick
+    up = np.asarray(jax.device_get(trace["up"])) != 0
+    lead = np.any((role == LEADER) & up, axis=1)      # (T, G)
+    cand = np.any((role == CANDIDATE) & up, axis=1)
+
+    B = telemetry.TIMING_BINS
+    G = cfg.n_groups
+    hist_down = np.zeros(B, np.int64)
+    hist_elect = np.zeros(B, np.int64)
+    down_run = np.zeros(G, np.int64)
+    elect_run = np.zeros(G, np.int64)
+    down_ticks = 0
+    for t in range(t_seg):
+        rec = lead[t] & (down_run > 0)
+        for g in np.nonzero(rec)[0]:
+            hist_down[min(down_run[g], B - 1)] += 1
+            if elect_run[g] > 0:
+                hist_elect[min(elect_run[g], B - 1)] += 1
+        down_ticks += int(np.sum(~lead[t]))
+        down_run = np.where(lead[t], 0, down_run + 1)
+        elect_run = np.where(lead[t], 0, elect_run + cand[t])
+
+    assert np.array_equal(sch["hist_downtime"].astype(np.int64), hist_down)
+    assert np.array_equal(sch["hist_elect"].astype(np.int64), hist_elect)
+    assert int(sch["down_ticks"]) == down_ticks
+    assert hist_down.sum() > 0  # churn actually completed downtime runs
+
+
+# ---------------------------------------------------------------------------
+# The retirement predicate's arms.
+
+def test_lifetime_arm_latches_at_life():
+    t_seg = 20
+    cfg = fuzz_mod.continuous_config(16, life_lo=7, life_hi=7)
+    _, _, mon = fuzz_mod.make_continuous_runner(cfg, t_seg)()
+    sch = telemetry.sched_stats(mon)
+    assert np.all(sch["grp_retire_age"] == 7)  # latched, not overwritten
+    assert np.all(sch["grp_age"] == t_seg)
+    assert np.all(sch["grp_life"] == 7)
+
+
+def test_quiescence_arm_retires_calm_groups():
+    # Faultless + no client traffic: once a leader stands and election
+    # rounds stop advancing, calm accumulates and the quiescence arm
+    # fires. (With faults or traffic the arm stays silent — that's the
+    # point: only universes with nothing left to explore retire early.)
+    spec = ScenarioSpec(farm_seed=5, timeout_windows=True, quiesce_ticks=4)
+    cfg = RaftConfig(n_groups=16, n_nodes=3, log_capacity=16,
+                     seed=9, scenario=spec).stressed(10)
+    _, _, mon = fuzz_mod.make_continuous_runner(cfg, 80)()
+    sch = telemetry.sched_stats(mon)
+    assert np.sum(sch["grp_retire_age"] >= 0) == 16  # all went quiet
+    assert np.all(sch["grp_retire_age"][sch["grp_retire_age"] >= 0] > 4)
+    assert int(sch["sched_quiesce"]) == 4
+
+
+def test_no_arms_no_retirement():
+    # timeout windows alone (no lifetimes, no quiescence, clean run):
+    # nothing retires, ages just accumulate.
+    cfg = fuzz_mod.continuous_config(16, life_lo=0, life_hi=0)
+    _, _, mon = fuzz_mod.make_continuous_runner(cfg, 15)()
+    sch = telemetry.sched_stats(mon)
+    assert np.all(sch["grp_retire_age"] == -1)
+    assert np.all(sch["grp_life"] == 0)
+
+
+# ---------------------------------------------------------------------------
+# Scalar-bounds engines refuse timeout-windows configs loudly.
+
+def test_scalar_bound_engines_reject_timeout_windows():
+    cfg = fuzz_mod.continuous_config(8)
+
+    from raft_kotlin_tpu.ops import pallas_tick
+
+    with pytest.raises(NotImplementedError):
+        pallas_tick.reject_timeout_windows(cfg)
+
+    from raft_kotlin_tpu.models import oracle as group_oracle
+
+    with pytest.raises(NotImplementedError):
+        group_oracle.OracleGroup(cfg, 0)
+
+    from raft_kotlin_tpu.native import oracle as native_oracle
+
+    with pytest.raises(NotImplementedError):
+        native_oracle._tick_masks(cfg, 0, 2)
+
+
+def test_static_drain_util_model():
+    cfg = fuzz_mod.continuous_config(64)
+    u = fuzz_mod.static_drain_util(cfg)
+    life = np.asarray(jax.device_get(
+        rngmod.sample_scenario_bank(cfg)["life"]), np.float64)
+    assert u == pytest.approx(float(life.sum() / (life.size * life.max())))
+    assert 0.0 < u < 1.0
