@@ -327,7 +327,20 @@ COMPACT_EXTRA_FIELDS = ("deeplog_parity_rate", "deeplog_ov_fallback",
                         # the authoritative tail.
                         "farm_util", "static_farm_util",
                         "universe_retire_per_sec", "timing_hist_nonzero",
-                        "continuous_inv_status")
+                        "continuous_inv_status",
+                        # r20 (ISSUE 19): the §20 serving leg — applied-
+                        # command + served-read wall throughput, the
+                        # submit->commit latency percentiles from the
+                        # carry-resident histograms, the apply-phase byte
+                        # model and the applied<=commit verdict — the
+                        # round's acceptance gate (serving_inv_status
+                        # clean + fields present) and summarize_bench's
+                        # serving trajectory + regression rows read these
+                        # from the authoritative tail.
+                        "client_commands_per_sec", "reads_per_sec",
+                        "apply_bytes_per_tick", "submit_commit_p50",
+                        "submit_commit_p99", "submit_commit_p999",
+                        "serving_inv_status")
 
 # Flight-recorder counters published verbatim from the headline run's
 # median rep (stats tel_* keys — utils/telemetry.TELEMETRY_FIELDS).
@@ -426,6 +439,74 @@ def scan_runner(tick_fn, telemetry: bool = False, monitor: bool = False,
             return out
         return run
     return build
+
+
+def serving_runner(cfg, serving_gen: bool = True):
+    """builder(n_ticks) -> SELF-TIMED runner for the §20 serving scan
+    (SEMANTICS.md §20, ISSUE 19): the per-tick XLA lattice with the
+    device-resident client generator riding phase 0's inject operand and
+    the end-of-tick apply/read phases in the scan carry — reduced INSIDE
+    the jit to serving scalars plus the two latency histograms, with the
+    canonical host-side percentile extraction (ops/serving.hist_percentile)
+    inside the timed region like every other host materialization.
+
+    Self-timed (measure()'s self_timed contract) because the serving carry
+    is a dict WITHOUT the monitor's latch_tick key — _norm_run_result would
+    misfile it as telemetry — and because the percentiles come from (64,)
+    histograms, not () scalars."""
+    from raft_kotlin_tpu.ops import serving as serving_mod
+    from raft_kotlin_tpu.ops import tick as tick_mod
+    from raft_kotlin_tpu.utils import rng as rngmod
+
+    tick_fn = tick_mod.make_tick(cfg)
+
+    def build(n_ticks):
+        @jax.jit
+        def run(st, rng):
+            base_k, _tk, _bk, scen_b = tick_mod.split_rng(rng)
+            kw = rngmod.kt_key_words(base_k)
+
+            def body(carry, _):
+                s, srv = carry
+                inj = None
+                if serving_gen:
+                    inj = serving_mod.gen_inject(cfg, kw[0], kw[1],
+                                                 srv["tick"], scen=scen_b)
+                s2 = tick_fn(s, inject=inj, rng=rng) if inj is not None \
+                    else tick_fn(s, rng=rng)
+                srv = serving_mod.serving_step(
+                    cfg, serving_mod.serving_view(s2), srv, kw=kw,
+                    scen=scen_b)
+                return (s2, srv), None
+
+            (end, srv), _ = jax.lax.scan(
+                body, (st, serving_mod.serving_init(cfg)), None,
+                length=n_ticks)
+            out = {"rounds": jnp.sum(end.rounds)}
+            out.update(serving_mod.serving_scalars(srv))
+            out["hist_commit"] = srv["hist_commit"]
+            out["hist_read"] = srv["hist_read"]
+            return out
+
+        def run_state(st, rng, summarize=None):
+            vals = jax.device_get(run(st, rng))
+            hc, hr = vals.pop("hist_commit"), vals.pop("hist_read")
+            out = {k: int(v) for k, v in vals.items()}
+            for name, h in (("submit_commit", hc), ("read", hr)):
+                for tag, p in (("p50", .50), ("p99", .99), ("p999", .999)):
+                    out[f"{name}_{tag}"] = serving_mod.hist_percentile(h, p)
+            return out
+
+        run_state.self_timed = True
+        return run_state
+
+    return build
+
+
+def serving_candidates(cfg):
+    # ONE rung: serving_gen rides the inject operand, XLA engine only
+    # (make_run enforces the same restriction).
+    yield serving_runner(cfg), "xla+serving"
 
 
 def _headline_layout(cfg):
@@ -1793,6 +1874,51 @@ def main() -> None:
         print(f"continuous farm leg failed: {str(e)[:300]}",
               file=sys.stderr)
 
+    # Serving leg (ISSUE 19): the §20 serving path under device-resident
+    # client load — the XLA lattice with serving.gen_inject riding phase
+    # 0's inject operand, the applied-KV fold + log-free read gating in
+    # the scan carry, and the submit->commit / read latency histograms
+    # read back once. Publishes applied-command and served-read wall
+    # throughput from the MEDIAN rep (measure()'s rep discipline — the
+    # self-timed serving_runner keeps the per-rep distinct rng and the
+    # in-region host materialization), the latency percentiles, the
+    # deterministic apply-phase byte model, and the Figure-3-style
+    # applied<=commit verdict (gated by scripts/summarize_bench.py like
+    # every safety leg).
+    serving_stats = {}
+    serving_inv_status = None
+    client_commands_per_sec = None
+    reads_per_sec = None
+    apply_bytes_per_tick = None
+    try:
+        from raft_kotlin_tpu.ops import serving as serving_mod
+        from raft_kotlin_tpu.utils.telemetry import trace_span
+
+        srv_g = int(os.environ.get("RAFT_BENCH_SERVE_GROUPS", 256))
+        srv_ticks = int(os.environ.get("RAFT_BENCH_SERVE_TICKS",
+                                       400 if on_accel else 120))
+        srv_cfg = RaftConfig(
+            n_groups=srv_g, n_nodes=3, log_capacity=64, seed=11,
+            cmd_period=3, p_drop=0.15, serve_slots=8, apply_chunk=2,
+            read_batch=2).stressed(10)
+        with trace_span("bench/serving"):
+            sts, sstats, _impl_s = measure(srv_cfg, srv_ticks, reps,
+                                           serving_candidates)
+        srv_best = median(sts)
+        sst = sstats[sts.index(srv_best)]
+        serving_stats = sst
+        client_commands_per_sec = round(sst["srv_applied_total"] / srv_best, 1)
+        reads_per_sec = round(sst["srv_reads_ok"] / srv_best, 1)
+        # Deterministic accounting (a model, like every post-r05 perf
+        # figure on this box): per tick the apply phase gathers A log
+        # words, rewrites both (S, G) KV planes, and updates the
+        # digest/cursor/total scalars — per group, in i32 bytes.
+        apply_bytes_per_tick = srv_g * 4 * (
+            srv_cfg.apply_chunk + 2 * srv_cfg.serve_slots + 3)
+        serving_inv_status = serving_mod.serving_status(sst)
+    except Exception as e:
+        print(f"serving leg failed: {str(e)[:300]}", file=sys.stderr)
+
     # Compaction leg (ISSUE 12): the §15 bounded-window proof — a
     # monitored + recorded run of 4x log_capacity ticks at a
     # bounded-window config (positions MUST outgrow the ring), publishing
@@ -2144,6 +2270,26 @@ def main() -> None:
         "continuous_universe_ticks": continuous_universe_ticks,
         "continuous_universes_retired": continuous_universes_retired,
         "continuous_corpus_hash": continuous_corpus,
+        # Serving leg (ISSUE 19): the §20 serving path — applied-command
+        # and served-read wall throughput of the median rep, the
+        # submit->commit and read latency percentiles from the
+        # carry-resident histograms, the deterministic apply-phase byte
+        # model, and the applied<=commit verdict (gated: summarize_bench
+        # INV_LEGS). serving_* raw scalars ride the full record for the
+        # trajectory rows.
+        "client_commands_per_sec": client_commands_per_sec,
+        "reads_per_sec": reads_per_sec,
+        "apply_bytes_per_tick": apply_bytes_per_tick,
+        "submit_commit_p50": serving_stats.get("submit_commit_p50"),
+        "submit_commit_p99": serving_stats.get("submit_commit_p99"),
+        "submit_commit_p999": serving_stats.get("submit_commit_p999"),
+        "read_p50": serving_stats.get("read_p50"),
+        "read_p99": serving_stats.get("read_p99"),
+        "read_p999": serving_stats.get("read_p999"),
+        "serving_inv_status": serving_inv_status,
+        "serving_applied_total": serving_stats.get("srv_applied_total"),
+        "serving_reads_ok": serving_stats.get("srv_reads_ok"),
+        "serving_snap_jumps": serving_stats.get("srv_snap_jumps"),
         # Compaction leg (ISSUE 12): the §15 bounded-window run's
         # Figure-3 verdict across the truncation boundary, the snapshot
         # counters, flat-memory evidence (window high-water vs the ring,

@@ -18,6 +18,19 @@ simulation; routes are addressed by (group, node):
     GET /{g}/{n}/crash, /{g}/{n}/restart   -> queue a §9 fault event on (g, n)
     GET /step/{k}                          -> advance k ticks (manual-clock mode)
 
+Serving configs (cfg.serve_slots > 0, SEMANTICS.md §20) add the applied-KV
+verbs — GETs routed onto the applied state machine rather than the raw log:
+
+    GET /{g}/kv                            -> whole applied store of group g
+    GET /{g}/kv/{slot}                     -> raw (stale-ok) applied read
+    GET /{g}/read/{slot}                   -> log-free linearizable read; 503
+                                              when no confirmed leader under
+                                              cfg.read_path (retry next tick)
+    GET /serving                           -> §20 stats: invariant status,
+                                              totals, latency percentiles
+
+On serve_slots=0 configs these routes return 400 (serving path disabled).
+
 With tick_hz > 0 a daemon thread advances the simulation in wall-clock time (the
 reference's real-time behavior: 1 tick = 100 ms at tick_hz=10); with tick_hz=0 the
 clock only moves via /step/{k}, which is what tests use.
@@ -40,6 +53,9 @@ _ROUTE_CMD = re.compile(r"^/(\d+)/(\d+)/cmd/([^/]+)$")
 _ROUTE_STATUS = re.compile(r"^/(\d+)/(\d+)/status$")
 _ROUTE_FAULT = re.compile(r"^/(\d+)/(\d+)/(crash|restart)$")
 _ROUTE_STEP = re.compile(r"^/step/(\d+)$")
+_ROUTE_KV_DUMP = re.compile(r"^/(\d+)/kv/?$")
+_ROUTE_KV_GET = re.compile(r"^/(\d+)/kv/(\d+)$")
+_ROUTE_READ = re.compile(r"^/(\d+)/read/(\d+)$")
 
 MAX_STEP_PER_REQUEST = 100_000
 
@@ -140,6 +156,28 @@ class RaftHTTPServer:
                         g, n, verb = int(m[1]), int(m[2]), m[3]
                         getattr(sim, verb)(g, n)
                         return self._send(200, f"Server {n} {verb} queued")
+                    m = _ROUTE_KV_GET.match(self.path)
+                    if m:
+                        g, s = int(m[1]), int(m[2])
+                        return self._send(200, json.dumps(sim.kv_get(g, s)),
+                                          "application/json")
+                    m = _ROUTE_KV_DUMP.match(self.path)
+                    if m:
+                        g = int(m[1])
+                        return self._send(200, json.dumps(sim.kv_dump(g)),
+                                          "application/json")
+                    m = _ROUTE_READ.match(self.path)
+                    if m:
+                        g, s = int(m[1]), int(m[2])
+                        out = sim.read(g, s)
+                        # A read that cannot be served THIS tick is not an
+                        # error — it is the §20 queue saying "retry": 503.
+                        code = 200 if out["ok"] else 503
+                        return self._send(code, json.dumps(out),
+                                          "application/json")
+                    if self.path in ("/serving", "/serving/"):
+                        return self._send(200, json.dumps(sim.serving_stats()),
+                                          "application/json")
                     m = _ROUTE_STEP.match(self.path)
                     if m:
                         k = int(m[1])

@@ -13,6 +13,13 @@ can drive deep simulations too (VERDICT r5 weak #6).
 Injected commands are queued host-side and delivered in phase 0 of the NEXT tick via
 the kernel's `inject` argument (ops/tick.py) — the discretized equivalent of an HTTP
 write landing between protocol events.
+
+Serving configs (cfg.serve_slots > 0, SEMANTICS.md §20) additionally carry
+the applied KV state machine: step() advances the serving carry on every
+post-tick state, `kv_get`/`kv_dump` read the applied store, and `read` is
+the log-free linearizable read (served only under the config's read_path
+leadership-confirmation rule; a blocked read returns ok=False and the
+caller retries — the HTTP layer maps that to 503).
 """
 
 from __future__ import annotations
@@ -110,6 +117,23 @@ class Simulator:
                 impl = "xla"
                 self._tick = jax.jit(make_tick(cfg))
         self.impl = impl
+        # §20 serving carry: advanced after every tick; None for serve_slots=0
+        # configs (the serving path compiles out entirely).
+        from raft_kotlin_tpu.ops import serving as serving_mod
+
+        self._srv = serving_mod.serving_init(cfg)
+        if self._srv is not None:
+            from raft_kotlin_tpu.ops.tick import split_rng
+            from raft_kotlin_tpu.utils import rng as rngmod
+
+            def _sstep(state, srv, rng):
+                base, _tk, _bk, scen = split_rng(rng)
+                kw = rngmod.kt_key_words(base)
+                return serving_mod.serving_step(
+                    cfg, serving_mod.serving_view(state), srv, kw=kw,
+                    scen=scen)
+
+            self._srv_step = jax.jit(_sstep)
         # Pending phase-0 injections for the next tick: {(g, n): cmd_id} — last write
         # wins per (group, node), like back-to-back HTTP posts within one tick window.
         self._pending: Dict[Tuple[int, int], int] = {}
@@ -203,6 +227,9 @@ class Simulator:
                     fault_cmd = jnp.asarray(arr)
                 self._state = self._tick(self._state, inject, fault_cmd,
                                          rng=self._rng)
+                if self._srv is not None:
+                    self._srv = self._srv_step(self._state, self._srv,
+                                               self._rng)
 
     # -- introspection --------------------------------------------------------
 
@@ -250,6 +277,90 @@ class Simulator:
             for g in range(ng)
         }
 
+    # -- §20 serving: applied KV store + log-free linearizable reads ----------
+
+    def _check_serving(self) -> None:
+        if self._srv is None:
+            raise IndexError(
+                "serving path disabled (cfg.serve_slots == 0): construct the "
+                "Simulator with a serve_slots > 0 config to get the applied "
+                "KV store")
+
+    def _check_slot(self, slot: int) -> None:
+        if not (0 <= slot < self.cfg.serve_slots):
+            raise IndexError(
+                f"slot {slot} out of range [0, {self.cfg.serve_slots})")
+
+    def kv_get(self, group: int, slot: int) -> dict:
+        """Applied-store read of one (group, slot): value + monotone version.
+        This is the RAW applied view — no leadership check — i.e. a stale read
+        in Raft terms. Use read() for the linearizable verb."""
+        self._check_serving()
+        self._check_addr(group, 1)
+        self._check_slot(slot)
+        with self._lock:
+            val = int(self._srv["kv_val"][slot, group])
+            ver = int(self._srv["kv_ver"][slot, group])
+        return {"group": group, "slot": slot, "value": val, "version": ver,
+                "command": self.command_name(val)}
+
+    def kv_dump(self, group: int) -> dict:
+        """Whole applied store of one group in ONE lock hold / device read."""
+        self._check_serving()
+        self._check_addr(group, 1)
+        with self._lock:
+            vals = np.asarray(self._srv["kv_val"][:, group])
+            vers = np.asarray(self._srv["kv_ver"][:, group])
+            applied = int(self._srv["applied"][group])
+        return {
+            "group": group,
+            "applied": applied,
+            "slots": [{"slot": s, "value": int(vals[s]), "version": int(vers[s])}
+                      for s in range(self.cfg.serve_slots)],
+        }
+
+    def read(self, group: int, slot: int) -> dict:
+        """Log-free linearizable read (SEMANTICS.md §20): served only when the
+        group has a confirmed leader under cfg.read_path — readindex needs a
+        live LEADER, lease additionally needs its heartbeat lease armed
+        (hb_armed). Returns ok=False when the read cannot be served this tick
+        (election in progress / lease lapsed); the caller retries after the
+        next tick, exactly like the in-carry read queue."""
+        self._check_serving()
+        self._check_addr(group, 1)
+        self._check_slot(slot)
+        from raft_kotlin_tpu.ops.serving import READ_L0
+
+        with self._lock:
+            st = self._state
+            lead = (np.asarray(st.role[:, group]) == LEADER) & (
+                np.asarray(st.up[:, group]) != 0)
+            if self.cfg.read_path == "lease":
+                lead = lead & (np.asarray(st.hb_armed[:, group]) != 0)
+            ok = bool(lead.any())
+            out = {"group": group, "slot": slot, "ok": ok,
+                   "read_path": self.cfg.read_path,
+                   "latency_ticks": READ_L0[self.cfg.read_path]}
+            if ok:
+                val = int(self._srv["kv_val"][slot, group])
+                out["value"] = val
+                out["version"] = int(self._srv["kv_ver"][slot, group])
+                out["command"] = self.command_name(val)
+        return out
+
+    def serving_stats(self) -> dict:
+        """§20 serving summary: invariant status, applied/read totals, and the
+        submit→commit / read latency percentiles from the carry histograms."""
+        self._check_serving()
+        from raft_kotlin_tpu.ops.serving import summarize_serving
+
+        with self._lock:
+            out = summarize_serving(self._srv)
+        # JSON-friendly: the (64,) histograms come back as numpy arrays.
+        out["hist_commit"] = [int(v) for v in out["hist_commit"]]
+        out["hist_read"] = [int(v) for v in out["hist_read"]]
+        return out
+
     # -- persistence (state arrays + the host-side vocabulary) ---------------
 
     def save(self, path: str) -> None:
@@ -259,7 +370,8 @@ class Simulator:
 
         with self._lock:
             checkpoint.save(path, self._state, self.cfg,
-                            extra={"vocab": self._rvocab})
+                            extra={"vocab": self._rvocab},
+                            serving=self._srv)
 
     @classmethod
     def restore(cls, path: str) -> "Simulator":
@@ -267,6 +379,9 @@ class Simulator:
 
         state, cfg, extra = checkpoint.load_with_extra(path)
         sim = cls(cfg, state=state)
+        srv = checkpoint.load_serving(path)
+        if srv is not None:
+            sim._srv = srv
         for word in extra.get("vocab", []):
             sim.intern(word)
         return sim

@@ -993,6 +993,151 @@ class OracleGroup:
         return out
 
 
+class OracleServing:
+    """§20 serving twin: the applied KV state machine + log-free reads over
+    a list of per-group oracles, in plain Python ints (SEMANTICS.md §20 —
+    the independent check of ops/serving.serving_step that needs no trace:
+    it reads the oracles' POST-tick node state directly, so it covers
+    fault/compaction runs fold_from_trace cannot).
+
+    step(groups) advances one tick over the G OracleGroup instances (all
+    stepped to the same tick_count); snapshot() returns the carry keyed
+    like ops/serving.SERVING_KEYS (numpy/int, digests re-signed to int32
+    via fold_digest_py)."""
+
+    def __init__(self, cfg: RaftConfig):
+        from raft_kotlin_tpu.ops.serving import (
+            READ_L0, SERVING_BINS, serving_enabled)
+
+        if not serving_enabled(cfg):
+            raise ValueError("OracleServing needs cfg.serve_slots > 0")
+        self.cfg = cfg
+        G, S = cfg.n_groups, cfg.serve_slots
+        self.t = 0
+        self.applied = [0] * G
+        self.dg = [0] * G          # signed-int32 fold (fold_digest_py)
+        self.rdg = [0] * G
+        self.kv_val = [[0] * G for _ in range(S)]
+        self.kv_ver = [[0] * G for _ in range(S)]
+        self.applied_total = 0
+        self.snap_jumps = 0
+        self.reads_ok = 0
+        self.q = [0] * G
+        self.age = [0] * G
+        self.hist_commit = [0] * SERVING_BINS
+        self.hist_read = [0] * SERVING_BINS
+        self.serve_viol = [0] * G
+        self.viol_tick = -1
+        self._B = SERVING_BINS
+        self._L0 = READ_L0[cfg.read_path]
+        self._scen = scenario_bank_np(cfg) if cfg.scenario is not None \
+            else None
+        base = rngmod.base_key(cfg.seed)
+        import jax
+
+        self._kw = tuple(int(x) for x in
+                         jax.device_get(rngmod.kt_key_words(base)))
+
+    def step(self, groups: list) -> None:
+        from raft_kotlin_tpu.models.state import fold_digest_py
+
+        cfg = self.cfg
+        S, A, C = cfg.serve_slots, cfg.apply_chunk, cfg.phys_capacity
+        B, t = self._B, self.t
+        for g, grp in enumerate(groups):
+            cms = [n.commit for n in grp.nodes]
+            F = max(cms)
+            src = grp.nodes[cms.index(F)]  # first max — argmax tie rule
+            if F < self.applied[g]:
+                self.serve_viol[g] = 1
+                if self.viol_tick < 0:
+                    self.viol_tick = t
+            if cfg.uses_compaction and src.snap_index > self.applied[g]:
+                self.dg[g] = src.snap_digest
+                self.snap_jumps += src.snap_index - self.applied[g]
+                self.applied[g] = src.snap_index
+            want = min(max(F - self.applied[g], 0), A)
+            phys = src.log.cmds
+            for j in range(want):
+                row = (self.applied[g] + j) % C
+                # Physical-plane read like the kernel's: unwritten rows
+                # are 0, truncated rows retain stale bits.
+                cv = phys[row] if row < len(phys) else 0
+                self.dg[g] = fold_digest_py(self.dg[g], cv)
+                self.kv_val[cv % S][g] = cv
+                self.kv_ver[cv % S][g] += 1
+                self.hist_commit[min(max(t - cv, 0), B - 1)] += 1
+            self.applied[g] += want
+            self.applied_total += want
+        # -- read phase (same conservative-aggregate rule as the kernel) --
+        if self._scen is not None and "client_read" in self._scen:
+            R = [int(x) for x in self._scen["client_read"]]
+        else:
+            R = [cfg.read_batch] * len(groups)
+        lease = cfg.read_path == "lease"
+        for g, grp in enumerate(groups):
+            ok = any(n.role == LEADER and n.up and (n.hb_armed or not lease)
+                     for n in grp.nodes)
+            if ok:
+                self.hist_read[min(self._L0, B - 1)] += R[g]
+                if self.q[g] > 0:
+                    self.hist_read[min(self._L0 + self.age[g], B - 1)] \
+                        += self.q[g]
+                self.reads_ok += R[g] + self.q[g]
+                if R[g] > 0:
+                    self.rdg[g] = fold_digest_py(self.rdg[g],
+                                                 self._read_val(g, t))
+                self.q[g] = 0
+                self.age[g] = 0
+            else:
+                self.q[g] += R[g]
+                self.age[g] = self.age[g] + 1 if self.q[g] > 0 else 0
+        self.t = t + 1
+
+    def _read_val(self, g: int, t: int) -> int:
+        """The tick's drawn-key value for group g — the §17 twin draw the
+        kernel's read-digest fold uses, evaluated eagerly (fold_from_trace
+        pattern)."""
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.cfg
+        k0, k1 = (np.int32(self._kw[0]), np.int32(self._kw[1]))
+        e0, e1 = rngmod.kt_event_key(k0, k1, rngmod.KIND_READ, np.int32(t))
+        h0, h1 = rngmod.kt_fold(e0, e1, 0)
+        s0, s1 = rngmod.kt_fold(e0, e1, 1)
+        gi = jnp.asarray(g, jnp.int32)
+        hot = False
+        if self._scen is not None and "client_hot" in self._scen:
+            hotp = int(self._scen["client_hot"][g])
+            thresh = hotp * 8388 + (hotp * 608) // 1000
+            hot = int(jax.device_get(rngmod.kt_bits23(
+                jnp.asarray(h0), jnp.asarray(h1), gi))) < thresh
+        slot = 0 if hot else int(jax.device_get(rngmod.kt_randint(
+            jnp.asarray(s0), jnp.asarray(s1), gi, 0,
+            jnp.asarray(cfg.serve_slots, jnp.int32))))
+        return self.kv_val[slot][g]
+
+    def snapshot(self) -> dict:
+        return {
+            "tick": self.t,
+            "kv_val": np.asarray(self.kv_val, np.int64),
+            "kv_ver": np.asarray(self.kv_ver, np.int64),
+            "applied": np.asarray(self.applied, np.int64),
+            "apply_digest": np.asarray(self.dg, np.int64),
+            "read_digest": np.asarray(self.rdg, np.int64),
+            "applied_total": self.applied_total,
+            "snap_jumps": self.snap_jumps,
+            "reads_ok": self.reads_ok,
+            "grp_read_q": np.asarray(self.q, np.int64),
+            "grp_read_age": np.asarray(self.age, np.int64),
+            "hist_commit": np.asarray(self.hist_commit, np.int64),
+            "hist_read": np.asarray(self.hist_read, np.int64),
+            "serve_viol": np.asarray(self.serve_viol, np.int64),
+            "viol_tick": self.viol_tick,
+        }
+
+
 def predraw(cfg: RaftConfig, groups=None, k: int | None = None):
     """Pre-draw k randoms per (group, node, kind) via the canonical derivation, so the
     oracle's inner loop is JAX-free. Returns {g: [node0 {kind: array}, ...]}."""

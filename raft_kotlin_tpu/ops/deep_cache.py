@@ -56,6 +56,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from raft_kotlin_tpu.utils import rng as rngmod
 from raft_kotlin_tpu.utils import telemetry as telemetry_mod
 
 _I32 = jnp.int32
@@ -183,7 +184,8 @@ def refill_all(cfg, state) -> dict:
 
 def make_deep_scan(cfg, n_ticks: int, return_state: bool = False,
                    telemetry: bool = False, monitor: bool = False,
-                   trace: bool = False, layout: str = "wide"):
+                   trace: bool = False, layout: str = "wide",
+                   serving: bool = False):
     """Multi-tick runner for the frontier-cached deep engine.
 
     run(state, rng[, summarize]) executes n_ticks through the fcache tick
@@ -226,6 +228,11 @@ def make_deep_scan(cfg, n_ticks: int, return_state: bool = False,
     packed = layout == "packed"
     if layout not in ("wide", "packed"):
         raise ValueError(f"unknown layout {layout!r}")
+    if serving:
+        from raft_kotlin_tpu.ops import serving as serving_mod
+
+        if not serving_mod.serving_enabled(cfg):
+            raise ValueError("serving needs cfg.serve_slots > 0")
 
     def fc_tick(state, fc, rng):
         base, tkeys, bkeys, scen = tick_mod.split_rng(rng)
@@ -243,8 +250,14 @@ def make_deep_scan(cfg, n_ticks: int, return_state: bool = False,
 
     def scan_of(tick_fn, with_fc, with_trace=False):
         def run(st, fc, rng):
+            if serving:
+                base_k, _tk, _bk, scen_b = tick_mod.split_rng(rng)
+                srv_kw = rngmod.kt_key_words(base_k)
+            else:
+                srv_kw = scen_b = None
+
             def body(carry, _):
-                s, f, acc, ova, tel, mon = carry
+                s, f, acc, ova, tel, mon, srv = carry
                 w = unpack_state(cfg, s) if packed else s
                 if with_fc:
                     s2, f2, ov = tick_fn(w, f, rng)
@@ -257,23 +270,28 @@ def make_deep_scan(cfg, n_ticks: int, return_state: bool = False,
                     tel = telemetry_mod.telemetry_step(w, s2, tel, ov=ov_t)
                 if mon is not None:
                     mon = telemetry_mod.monitor_step(w, s2, mon)
+                if srv is not None:
+                    srv = serving_mod.serving_step(
+                        cfg, serving_mod.serving_view(s2), srv, kw=srv_kw,
+                        scen=scen_b)
                 acc = acc + jnp.sum(s2.log_cmd[:, 0, :].astype(_I32))
                 y = _trace_row(s2) if with_trace else None
                 nxt = pack_state(cfg, s2, ov=s.ov) if packed else s2
-                return (nxt, f2, acc, ova, tel, mon), y
+                return (nxt, f2, acc, ova, tel, mon, srv), y
 
             tel0 = telemetry_mod.telemetry_zeros() if telemetry else None
             mon0 = telemetry_mod.monitor_init(cfg.n_groups, n_ticks,
                                               monitor)
+            srv0 = serving_mod.serving_init(cfg) if serving else None
             st0 = pack_state(cfg, st) if packed else st
             carry0 = (st0, fc, jnp.zeros((), _I32), jnp.zeros((), bool),
-                      tel0, mon0)
-            (end, _, acc, ova, tel, mon), ys = jax.lax.scan(
+                      tel0, mon0, srv0)
+            (end, _, acc, ova, tel, mon, srv), ys = jax.lax.scan(
                 body, carry0, None, length=n_ticks)
             pov = jnp.any(end.ov != 0) if packed else jnp.zeros((), _I32)
             if packed:
                 end = unpack_state(cfg, end)
-            return end, acc, ova, tel, mon, ys, pov
+            return end, acc, ova, tel, mon, srv, ys, pov
         return run
 
     fc_scan = scan_of(fc_tick, True)
@@ -294,19 +312,20 @@ def make_deep_scan(cfg, n_ticks: int, return_state: bool = False,
         refill_t = jax.jit(lambda s: refill_all(cfg, s))
 
         def run_trace(st, rng):
-            _, _, ova, _tel, _mon, ys, pov = jfc_t(st, rng, refill_t(st))
+            _, _, ova, _tel, _mon, _srv, ys, pov = jfc_t(
+                st, rng, refill_t(st))
             ov = bool(jax.device_get(ova))
             if ov:
-                _, _, _, _tel, _mon, ys, pov = jplain_t(st, rng)
+                _, _, _, _tel, _mon, _srv, ys, pov = jplain_t(st, rng)
             if packed:
                 check_packed_ov(pov)
             return jax.device_get(ys), ov
 
         return run_trace
 
-    def reductions(end, acc, ova, tel, mon, ys, pov, summarize):
+    def reductions(end, acc, ova, tel, mon, srv, ys, pov, summarize):
         out = _reduction(end, acc, ova.astype(_I32), summarize, tel=tel,
-                         mon=mon)
+                         mon=mon, srv=srv)
         if packed:
             out["packed_ov"] = pov.astype(_I32)
         return out
@@ -320,15 +339,19 @@ def make_deep_scan(cfg, n_ticks: int, return_state: bool = False,
         jplain_s = jax.jit(lambda s, r: plain_scan(s, None, r))
 
         def run_state(st, rng):
-            end, _, ova, _tel, mon, _ys, pov = jfc_s(st, rng, refill_jit(st))
+            end, _, ova, _tel, mon, srv, _ys, pov = jfc_s(
+                st, rng, refill_jit(st))
             ov = bool(jax.device_get(ova))
             if ov:
-                end, _, _, _tel, mon, _ys, pov = jplain_s(st, rng)
+                end, _, _, _tel, mon, srv, _ys, pov = jplain_s(st, rng)
             if packed:
                 check_packed_ov(pov)
+            out = (end, ov)
             if monitor:
-                return end, ov, telemetry_mod.monitor_finalize(mon)
-            return end, ov
+                out = out + (telemetry_mod.monitor_finalize(mon),)
+            if serving:
+                out = out + (srv,)
+            return out
 
         return run_state
 
@@ -370,7 +393,7 @@ def make_deep_scan(cfg, n_ticks: int, return_state: bool = False,
     return run
 
 
-def _reduction(end, acc, ov, summarize, tel=None, mon=None):
+def _reduction(end, acc, ov, summarize, tel=None, mon=None, srv=None):
     """THE bench reduction contract (rounds / livepin / ov keys +
     summarize extras + optional tel_* flight-recorder counters + optional
     inv_* monitor scalars) — one copy, shared by every runner here so the
@@ -380,6 +403,9 @@ def _reduction(end, acc, ov, summarize, tel=None, mon=None):
         out.update({f"tel_{k}": v for k, v in tel.items()})
     if mon is not None:
         out.update(telemetry_mod.monitor_scalars(mon))
+    if srv is not None:
+        from raft_kotlin_tpu.ops import serving as serving_mod
+        out.update(serving_mod.serving_scalars(srv))
     if summarize is not None:
         out.update(summarize(end))
     return out

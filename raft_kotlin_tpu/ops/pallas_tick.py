@@ -234,8 +234,8 @@ FUSED_TRACE_FIELDS = ("role", "term", "commit", "last_index")
 
 
 def fused_snapshot_fields(cfg: RaftConfig, telemetry: bool = False,
-                          monitor: bool = False, trace: bool = False
-                          ) -> tuple:
+                          monitor: bool = False, trace: bool = False,
+                          serving: bool = False) -> tuple:
     """The ordered state-field set a fused launch must snapshot per tick so
     the requested observers (recorder / monitor / differential trace) can
     replay the T per-tick transitions between launches. Ordered canonically
@@ -256,6 +256,16 @@ def fused_snapshot_fields(cfg: RaftConfig, telemetry: bool = False,
         want += list(MONITOR_STATE_FIELDS)
         if cfg.uses_compaction:
             want += list(MONITOR_COMPACT_FIELDS)
+    if serving:
+        # §20: a strict subset of the monitor's set (the serving step's
+        # replay reads role/up/commit/hb_armed/log_cmd + the §15 snapshot
+        # planes), so serving+monitor costs no extra snapshot rows.
+        from raft_kotlin_tpu.ops.serving import (
+            SERVING_COMPACT_FIELDS, SERVING_STATE_FIELDS)
+
+        want += list(SERVING_STATE_FIELDS)
+        if cfg.uses_compaction:
+            want += list(SERVING_COMPACT_FIELDS)
     if (telemetry or monitor) and cfg.uses_mailbox:
         want += list(TELEMETRY_MAILBOX_FIELDS)
     order = {k: i for i, k in enumerate(
@@ -1613,7 +1623,8 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
                      trace: bool = False,
                      layout: str = "wide",
                      aux_source: str = "staged",
-                     compute: str = "unpacked"):
+                     compute: str = "unpacked",
+                     serving: bool = False):
     """Multi-tick Pallas runner with a FLAT int32 scan carry.
 
     Scanning make_pallas_tick converts RaftState <-> the kernel's flat int32
@@ -1750,11 +1761,16 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
             "runner embeds in the caller's jit, so the width-overflow "
             "latch's only surfaced channel is the flight recorder "
             "(packed_width_overflow)")
-    if (telemetry or monitor or trace) and K > 1:
+    if (telemetry or monitor or trace or serving) and K > 1:
         raise ValueError(
-            "telemetry/monitor/trace need k_per_launch == 1: the K-tick "
-            "kernel exposes no per-tick state between launches (archival "
-            "path; the production fused path is fused_ticks)")
+            "telemetry/monitor/trace/serving need k_per_launch == 1: the "
+            "K-tick kernel exposes no per-tick state between launches "
+            "(archival path; the production fused path is fused_ticks)")
+    if serving:
+        from raft_kotlin_tpu.ops import serving as serving_mod
+
+        if not serving_mod.serving_enabled(cfg):
+            raise ValueError("serving needs cfg.serve_slots > 0")
     if K > 1 and fused_ticks not in (None, 1):
         raise ValueError(
             "k_per_launch (the archival K-tick kernel) and fused_ticks "
@@ -1776,7 +1792,8 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
     else:
         tile_req, ilp_req = tile_g, ilp_subtiles  # caller's pins, if any
         snap_fields = fused_snapshot_fields(
-            cfg, telemetry=telemetry, monitor=monitor, trace=trace)
+            cfg, telemetry=telemetry, monitor=monitor, trace=trace,
+            serving=serving)
         tile_g, ilp_subtiles, T_f = resolve_fused_geometry(
             cfg, interpret, tile_g, ilp_subtiles, fused_ticks,
             snap_rows=_snapshot_rows(cfg, snap_fields),
@@ -1849,21 +1866,22 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
                 s[k] = s[k].reshape(N * C_log, G)
         return s
 
-    def _carry_in(s, ovc, t, tel, mon):
+    def _carry_in(s, ovc, t, tel, mon, srv):
         if not packed:
-            return (s, t, tel, mon)
+            return (s, t, tel, mon, srv)
         p, ov2 = _pack_flat(s)
-        return (p, ovc | ov2, t, tel, mon)
+        return (p, ovc | ov2, t, tel, mon, srv)
 
     def _carry_out(carry):
         if not packed:
-            s, t, tel, mon = carry
-            return s, jnp.zeros((), bool), t, tel, mon
-        p, ovc, t, tel, mon = carry
-        return _unpack_flat(p), ovc, t, tel, mon
+            s, t, tel, mon, srv = carry
+            return s, jnp.zeros((), bool), t, tel, mon, srv
+        p, ovc, t, tel, mon, srv = carry
+        return _unpack_flat(p), ovc, t, tel, mon, srv
 
     def run(state: RaftState, rng):
         base, tkeys, bkeys, scen = tick_mod.split_rng(rng)
+        srv_kw = rngmod.kt_key_words(base) if serving else None
         # The inkernel resident operands: computed ONCE per run from the
         # rng operand (bitcasts + stacks — runtime values, so the
         # compilation stays seed-independent like everywhere else).
@@ -1877,7 +1895,7 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
                 flat[k] = flat[k].astype(_I32)
 
         def body(carry, _):
-            s, ovc, t, tel, mon = _carry_out(carry)
+            s, ovc, t, tel, mon, srv = _carry_out(carry)
             # §18: the carry stays WIDE between launches (telemetry/
             # monitor/§14 pack_fields unchanged) — only the kernel
             # operands cross in the packed-compute form.
@@ -1918,11 +1936,18 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
                 mon = telemetry_mod.monitor_step_arrays(
                     telemetry_mod.monitor_flat_view(s, N),
                     telemetry_mod.monitor_flat_view(s2, N), mon)
+            if srv is not None:
+                # §20 serving on the flat carry: plain XLA on the post-
+                # launch kernel-form state, kernel untouched (same
+                # contract as the recorder/monitor above).
+                srv = serving_mod.serving_step(
+                    cfg, serving_mod.serving_flat_view(s2, N), srv,
+                    kw=srv_kw, scen=scen)
             ys = ({f: s2[f] for f in FUSED_TRACE_FIELDS} if trace else None)
-            return _carry_in(s2, ovc, t + 1, tel, mon), ys
+            return _carry_in(s2, ovc, t + 1, tel, mon, srv), ys
 
         def body_k(carry, _):
-            s, t, tel, mon = carry  # tel/mon None here (K > 1 rejected)
+            s, t, tel, mon, _srv = carry  # tel/mon None (K > 1 rejected)
             per, flags = [], None
             for k in range(K):
                 shim = types.SimpleNamespace(
@@ -1940,8 +1965,8 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
             outs = call(*([s[k] for k in sfields_k] + slabs
                           + [el_tab, b_tab]))
             # Last output = the launch's (N, G) draw-table overflow counts.
-            return ((dict(zip(sfields_k, outs[:-1])), t + K, tel, mon),
-                    jnp.sum(outs[-1]))
+            return ((dict(zip(sfields_k, outs[:-1])), t + K, tel, mon,
+                     _srv), jnp.sum(outs[-1]))
 
         def body_f(carry, _):
             # One fused-T launch (ISSUE 7): T phase lattices inside one
@@ -1950,7 +1975,7 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
             # replay the T per-tick transitions from the kernel's snapshot
             # outputs — same step functions as the 1-tick body, so their
             # carries are bit-equal to the unfused run.
-            s, ovc, t, tel, mon = _carry_out(carry)
+            s, ovc, t, tel, mon, srv = _carry_out(carry)
             sk = flat_to_packed_compute(cfg, s) if pc else s
             if inkernel:
                 # No fused_launch_aux pre-pass and no draw tables: the
@@ -1973,16 +1998,25 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
             if pc:
                 s2 = packed_compute_to_flat(cfg, s2)
             tel, mon = fused_observe(cfg, s, ticks_f, tel, mon)
+            if srv is not None:
+                # §20 serving replay over the T snapshots — the same
+                # serving_step the 1-tick body calls, so the carry is
+                # bit-equal to the unfused run by construction.
+                for cur in ticks_f:
+                    srv = serving_mod.serving_step(
+                        cfg, serving_mod.serving_flat_view(cur, N), srv,
+                        kw=srv_kw, scen=scen)
             ys = {"ov": jnp.sum(ov)}
             if trace:
                 ys["trace"] = {f: jnp.stack([p[f] for p in ticks_f])
                                for f in FUSED_TRACE_FIELDS}
-            return _carry_in(s2, ovc, t + T_f, tel, mon), ys
+            return _carry_in(s2, ovc, t + T_f, tel, mon, srv), ys
 
         tel0 = telemetry_mod.telemetry_zeros() if telemetry else None
         mon0 = telemetry_mod.monitor_init(G, n_ticks, monitor)
+        srv0 = serving_mod.serving_init(cfg) if serving else None
         flat_t = _carry_in(flat, jnp.zeros((G,), bool), state.tick, tel0,
-                           mon0)
+                           mon0, srv0)
         ov_total = jnp.zeros((), _I32)
         traces = []
         if K > 1 and n_launch:
@@ -1998,7 +2032,7 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
             flat_t, ys = jax.lax.scan(body, flat_t, None, length=rem)
             if trace:
                 traces.append(ys)
-        flat, pov_lanes, t, tel, mon = _carry_out(flat_t)
+        flat, pov_lanes, t, tel, mon, srv = _carry_out(flat_t)
         # One scalar reduction of the (G,) per-group latch, at scan exit.
         pov = jnp.any(pov_lanes) if packed else pov_lanes
         s, _ = cast_flat_out(cfg, [flat[k] for k in sfields], sfields,
@@ -2022,6 +2056,8 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
             out = out + (tel,)
         if monitor:
             out = out + (telemetry_mod.monitor_finalize(mon),)
+        if serving:
+            out = out + (srv,)
         if T_f > 1 and jitted:
             out = out + (ov_total,)  # stripped by the checked() wrapper
         if packed and jitted:

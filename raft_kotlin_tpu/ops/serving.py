@@ -1,0 +1,612 @@
+"""§20 serving path: the applied KV state machine, log-free reads and
+client-latency histograms (SEMANTICS.md §20 — ISSUE 19).
+
+The reference's entire client surface (RaftServer.kt's HTTP POST/GET) sits
+ON TOP of consensus: commands enter the log, and once committed they are
+APPLIED to a state machine whose contents clients read back. This module is
+that layer, vectorized groups-minor like everything else:
+
+* **Applied KV store** — a fixed-slot `(S, G)` int32 value plane plus an
+  `(S, G)` write-version plane per group (`cfg.serve_slots` = S). An
+  end-of-tick apply phase folds the committed prefix forward at most
+  `cfg.apply_chunk` entries per tick: entry at logical position p lands in
+  slot `cmd % S`, and the running `apply_digest` advances by the SAME
+  wrapping-int32 fold as the §15 snapshot digest (`fold_digest`,
+  DIGEST_MULT) — so a node's snap_digest IS the apply digest of its folded
+  prefix, and r15 snapshots/InstallSnapshot ship real applied state. When
+  the apply cursor falls behind the source node's snapshot base (§15), the
+  cursor fast-forwards by installing snap_digest directly (the
+  InstallSnapshot rule on the state machine); skipped entries are counted
+  in `snap_jumps`.
+
+* **Latency histograms in the carry** — the periodic/injected workloads
+  store THE SUBMIT TICK as the command value (ops/tick phase 0 /
+  cfg.cmd_period), so submit→apply latency is exactly
+  `apply_tick - cmd_value`: binned into a carry-resident (64,) int32
+  histogram (`hist_commit`) with bin 63 absorbing overflow — the §19
+  TIMING_KEYS transport contract (static shapes, order-independent integer
+  sums, one readback; a sharded run's summed histogram is bit-equal to
+  single-device). `hist_read` bins read latency under the same contract.
+
+* **Log-free reads (§6.4/§8, Ongaro & Ousterhout 2014)** — a read never
+  touches the log; it needs only a leadership-confirmation round:
+  `read_path="readindex"` serves when the group has a live leader, at a
+  2-tick confirmation latency (commit-frontier confirmation via a
+  heartbeat round); `read_path="lease"` serves when a live leader holds an
+  armed heartbeat lease, at 1 tick. Blocked ticks queue the batch
+  (`grp_read_q`) and age it (`grp_read_age`); when leadership returns the
+  whole queue serves at `L0 + age-of-oldest` (the conservative aggregate
+  rule — ONE bin per flush, exactly recomputable from a (T, N, G)
+  role/up trace). Served reads fold one drawn key's current value into
+  `read_digest` per group per tick — the §17 kernel-twin threefry draws
+  (KIND_READ channel, hot-slot skew from the scenario bank's client_hot
+  row) keyed so the device evaluation and the host recomputation
+  (`fold_from_trace`) produce identical bits.
+
+* **Device-resident load generation** — `gen_inject` derives a (G, N)
+  phase-0 inject plane from the base key's §17 twin words at
+  (KIND_CLIENT, tick): per group, `client_rate` writers (scenario-bank
+  row; default 1) each target a uniformly drawn node with command value =
+  the tick. Generation happens INSIDE the scan body (zero HBM aux
+  traffic); `host_stream` evaluates the identical function eagerly on the
+  host, and `make_queued_run` feeds such a precomputed stream through a
+  double-buffered chunked scan — the device-generator ≡ host-queue
+  bit-equality theorem (tests/test_serving.py).
+
+The serving carry (`srv`) is a sibling of the §11 monitor carry: a dict of
+fixed-shape int32 arrays threaded through every engine's scan, advanced by
+`serving_step` on the POST-tick state view, bit-neutral to protocol state.
+It runs in plain XLA in every engine (the fused Pallas path replays its
+staged per-tick snapshots, exactly like the monitor) — the Mosaic-interior
+embedding is a routed-but-unpinned follow-up (the `read_path` plan
+dimension; scripts/probe_serving.py --pin).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from raft_kotlin_tpu.constants import LEADER as _LEADER
+from raft_kotlin_tpu.models.state import DIGEST_MULT
+from raft_kotlin_tpu.utils import rng as rngmod
+from raft_kotlin_tpu.utils.config import RaftConfig
+
+_I32 = jnp.int32
+
+# Histogram bin count — same transport contract as telemetry.TIMING_BINS
+# (width-1 tick bins, bin SERVING_BINS-1 absorbs overflow).
+SERVING_BINS = 64
+
+# Leadership-confirmation latency of each read path, in ticks: read-index
+# needs a commit-frontier confirmation round (heartbeat out + ack back),
+# lease reads serve locally under an armed heartbeat lease.
+READ_L0 = {"readindex": 2, "lease": 1}
+
+# The canonical carry keys (checkpoint v9 iterates this order; shapes for
+# G groups, S slots, B = SERVING_BINS — all int32).
+SERVING_KEYS = (
+    "tick",            # ()    post-tick count (== state.tick after the step)
+    "kv_val",          # (S,G) applied value plane (slot = cmd % S)
+    "kv_ver",          # (S,G) per-slot write count (0 = never written)
+    "applied",         # (G,)  apply cursor: logical prefix length applied
+    "apply_digest",    # (G,)  §15 fold of every applied cmd (DIGEST_MULT)
+    "read_digest",     # (G,)  fold of served drawn-key values
+    "applied_total",   # ()    total entries applied across groups
+    "snap_jumps",      # ()    entries skipped by InstallSnapshot fast-fwd
+    "reads_ok",        # ()    total reads served
+    "grp_read_q",      # (G,)  queued (blocked) read count
+    "grp_read_age",    # (G,)  ticks the oldest queued batch has waited
+    "hist_commit",     # (B,)  submit→apply latency histogram
+    "hist_read",       # (B,)  read-service latency histogram
+    "serve_viol",      # (G,)  sticky latch: commit frontier < apply cursor
+    "viol_tick",       # ()    first-violation tick (-1 = clean)
+)
+
+
+def serving_enabled(cfg: RaftConfig) -> bool:
+    """Whether `cfg` compiles the serving path in (S > 0). S == 0 configs
+    compile it OUT entirely — the migration-equality contract."""
+    return getattr(cfg, "serve_slots", 0) > 0
+
+
+def serving_zeros(n_groups: int, slots: int,
+                  bins: int = SERVING_BINS) -> Dict[str, jax.Array]:
+    """A fresh serving carry (see SERVING_KEYS for shapes/semantics)."""
+    G, S = int(n_groups), int(slots)
+    return {
+        "tick": jnp.zeros((), _I32),
+        "kv_val": jnp.zeros((S, G), _I32),
+        "kv_ver": jnp.zeros((S, G), _I32),
+        "applied": jnp.zeros((G,), _I32),
+        "apply_digest": jnp.zeros((G,), _I32),
+        "read_digest": jnp.zeros((G,), _I32),
+        "applied_total": jnp.zeros((), _I32),
+        "snap_jumps": jnp.zeros((), _I32),
+        "reads_ok": jnp.zeros((), _I32),
+        "grp_read_q": jnp.zeros((G,), _I32),
+        "grp_read_age": jnp.zeros((G,), _I32),
+        "hist_commit": jnp.zeros((bins,), _I32),
+        "hist_read": jnp.zeros((bins,), _I32),
+        "serve_viol": jnp.zeros((G,), _I32),
+        "viol_tick": jnp.full((), -1, _I32),
+    }
+
+
+def serving_init(cfg: RaftConfig, enabled: bool = True
+                 ) -> Optional[Dict[str, jax.Array]]:
+    """THE runner-side serving-carry constructor (the monitor_init twin):
+    a fresh carry, or None when serving is off for this config/runner."""
+    if not enabled or not serving_enabled(cfg):
+        return None
+    return serving_zeros(cfg.n_groups, cfg.serve_slots)
+
+
+# The state fields serving_step reads — a SUBSET of the monitor's staged
+# fused-snapshot set (MONITOR_STATE_FIELDS / MONITOR_COMPACT_FIELDS), so
+# fused launches that snapshot for serving reuse the monitor's transport.
+SERVING_STATE_FIELDS = ("role", "up", "commit", "hb_armed", "log_cmd")
+SERVING_COMPACT_FIELDS = ("snap_index", "snap_digest")
+
+
+def serving_flat_view(flat: dict, n_nodes: int) -> dict:
+    """The serving view of the flat rank-2 kernel layout (log_cmd
+    (N*C, G) -> (N, C, G)) — the Pallas flat-carry runner's form."""
+    N = n_nodes
+    v = {k: flat[k] for k in SERVING_STATE_FIELDS if k != "log_cmd"}
+    a = flat["log_cmd"]
+    v["log_cmd"] = a.reshape(N, -1, a.shape[-1])
+    for k in SERVING_COMPACT_FIELDS:
+        v[k] = flat.get(k)
+    return v
+
+
+def serving_view(state) -> dict:
+    """The serving view of a RaftState: exactly the fields serving_step
+    reads, all present in the monitor's staged fused-snapshot set too
+    (MONITOR_STATE_FIELDS + MONITOR_COMPACT_FIELDS), so every engine can
+    feed the step from views it already materializes."""
+    v = {k: getattr(state, k) for k in
+         ("role", "up", "commit", "hb_armed", "log_cmd")}
+    for k in ("snap_index", "snap_digest"):
+        v[k] = getattr(state, k, None)
+    return v
+
+
+def _bump(hist: jax.Array, slot: jax.Array, count: jax.Array) -> jax.Array:
+    """hist[slot_g] += count_g for each group g — the §19 one-hot bump
+    (order-independent int sums; slot already clipped)."""
+    B = hist.shape[0]
+    hits = (lax.iota(_I32, B)[:, None] == slot[None, :]).astype(_I32)
+    return hist + jnp.sum(hits * count[None, :], axis=1)
+
+
+def serving_step(cfg: RaftConfig, view: dict, srv: Dict[str, jax.Array],
+                 kw=None, scen: Optional[dict] = None
+                 ) -> Dict[str, jax.Array]:
+    """One serving step on the POST-tick state `view` (serving_view /
+    monitor_view / the fused snapshot replay form — log_cmd (N, C, G)).
+    Returns the advanced carry (a new dict; inputs untouched).
+
+    `kw` is the base key's §17 twin words (k0, k1) from rng.kt_key_words —
+    needed only for the read-digest draws; None skips the drawn-key fold
+    (read gating/latency still run). `scen` is the scenario bank (client_*
+    rows ride it when the spec carries them)."""
+    S, A = cfg.serve_slots, cfg.apply_chunk
+    C = cfg.phys_capacity
+    B = srv["hist_commit"].shape[0]
+    G = srv["applied"].shape[0]
+    t = srv["tick"]
+    out = dict(srv)
+
+    # -- apply phase: fold the committed prefix into the KV planes --------
+    cm = view["commit"].astype(_I32)                     # (N, G)
+    F = jnp.max(cm, axis=0)                              # group frontier
+    src = jnp.argmax(cm, axis=0)                         # its holder
+    # A node's own commit never exceeds its own matched prefix, so src's
+    # log contains every entry the cursor will read this tick; committed
+    # prefixes agree across holders (Log Matching), so holder choice is
+    # value-neutral.
+    lc_src = jnp.take_along_axis(
+        view["log_cmd"].astype(_I32), src[None, None, :], axis=0)[0]  # (C,G)
+    applied = srv["applied"]
+    dg = srv["apply_digest"]
+    kv_val, kv_ver = srv["kv_val"], srv["kv_ver"]
+
+    # Safety latch: a frontier BELOW the cursor means a committed entry
+    # vanished — never legal; sticky, with a first-violation tick.
+    bad = F < applied
+    out["serve_viol"] = srv["serve_viol"] | bad.astype(_I32)
+    newly = (srv["viol_tick"] < 0) & jnp.any(bad)
+    out["viol_tick"] = jnp.where(newly, t, srv["viol_tick"])
+
+    # §15 InstallSnapshot on the state machine: if src has folded past the
+    # cursor, the skipped entries exist only as src's snap_digest — and the
+    # apply fold IS the snapshot fold, so installing it fast-forwards the
+    # cursor exactly. Per-key granularity of the skipped span is lost
+    # (counted in snap_jumps), matching a real snapshot install.
+    si = view.get("snap_index")
+    if si is not None:
+        base = jnp.take_along_axis(si.astype(_I32), src[None, :], axis=0)[0]
+        sdg = jnp.take_along_axis(
+            view["snap_digest"].astype(_I32), src[None, :], axis=0)[0]
+        jump = base > applied
+        dg = jnp.where(jump, sdg, dg)
+        out["snap_jumps"] = srv["snap_jumps"] + jnp.sum(
+            jnp.where(jump, base - applied, 0))
+        applied = jnp.maximum(applied, base)
+
+    want = jnp.clip(F - applied, 0, A)                   # (G,)
+    slot_iota = lax.broadcasted_iota(_I32, (S, G), 0)
+    hist_c = srv["hist_commit"]
+    for j in range(A):
+        active = jnp.asarray(j, _I32) < want             # (G,) bool
+        # Physical row of logical position p: p % C (ring base = the §15
+        # snapshot index; identity for static logs, where p < C always).
+        row = jnp.remainder(applied + j, C)
+        cv = jnp.take_along_axis(lc_src, row[None, :], axis=0)[0]
+        dg = jnp.where(active, dg * jnp.asarray(DIGEST_MULT, _I32) + cv, dg)
+        hot = (slot_iota == jnp.remainder(cv, S)[None, :]) & active[None, :]
+        kv_val = jnp.where(hot, cv[None, :], kv_val)
+        kv_ver = kv_ver + hot.astype(_I32)
+        # Tick-valued workloads (cmd_period / gen_inject / the Simulator's
+        # tick-stamped POSTs) make t - cv the exact submit→apply latency;
+        # foreign values just clip into the edge bins.
+        lat = jnp.clip(t - cv, 0, B - 1)
+        hist_c = _bump(hist_c, lat, active.astype(_I32))
+    out["applied"] = applied + want
+    out["apply_digest"] = dg
+    out["kv_val"], out["kv_ver"] = kv_val, kv_ver
+    out["applied_total"] = srv["applied_total"] + jnp.sum(want)
+    out["hist_commit"] = hist_c
+
+    # -- read phase: log-free reads under leadership confirmation --------
+    if scen is not None and "client_read" in scen:
+        R = scen["client_read"].astype(_I32)             # (G,) batch size
+    else:
+        R = jnp.full((G,), cfg.read_batch, _I32)
+    lease = cfg.read_path == "lease"
+    L0 = READ_L0[cfg.read_path]
+    lead = (view["role"].astype(_I32) == _LEADER) & (view["up"] != 0)
+    if lease:
+        ok = jnp.any(lead & (view["hb_armed"] != 0), axis=0)
+    else:
+        ok = jnp.any(lead, axis=0)
+    q, age = srv["grp_read_q"], srv["grp_read_age"]
+    hist_r = srv["hist_read"]
+    served_now = jnp.where(ok, R, 0)
+    # Fresh batch at the protocol floor L0; the flushed queue at
+    # L0 + age-of-oldest (the conservative aggregate rule — see module
+    # docstring; exactly recomputable from a role/up trace).
+    hist_r = _bump(hist_r, jnp.full((G,), min(L0, B - 1), _I32), served_now)
+    flushed = jnp.where(ok, q, 0)
+    hist_r = _bump(hist_r, jnp.clip(L0 + age, 0, B - 1), flushed)
+    out["reads_ok"] = srv["reads_ok"] + jnp.sum(served_now) \
+        + jnp.sum(flushed)
+    out["grp_read_q"] = jnp.where(ok, 0, q + R)
+    out["grp_read_age"] = jnp.where(
+        ok, 0, jnp.where(q > 0, age + 1, jnp.where(R > 0, 1, 0)))
+    out["hist_read"] = hist_r
+
+    # Served drawn-key fold: one key per group per served tick, drawn on
+    # the §17 twin lattice at (KIND_READ, t) — hot-slot skew from the
+    # bank's client_hot permille row (threshold arithmetic exact in i32:
+    # hot * 2^23 // 1000 == hot * 8388 + hot * 608 // 1000).
+    if kw is not None:
+        k0, k1 = kw
+        e0, e1 = rngmod.kt_event_key(k0, k1, rngmod.KIND_READ, t)
+        h0, h1 = rngmod.kt_fold(e0, e1, 0)
+        s0, s1 = rngmod.kt_fold(e0, e1, 1)
+        gidx = lax.iota(_I32, G)
+        if scen is not None and "client_hot" in scen:
+            hotp = scen["client_hot"].astype(_I32)
+            thresh = hotp * jnp.asarray(8388, _I32) \
+                + (hotp * jnp.asarray(608, _I32)) // 1000
+            hotm = rngmod.kt_bits23(h0, h1, gidx) < thresh
+        else:
+            hotm = jnp.zeros((G,), bool)
+        slot_r = jnp.where(
+            hotm, 0, rngmod.kt_randint(s0, s1, gidx, 0, jnp.asarray(S, _I32)))
+        val_r = jnp.take_along_axis(kv_val, slot_r[None, :], axis=0)[0]
+        fold = ok & (R > 0)
+        out["read_digest"] = jnp.where(
+            fold, srv["read_digest"] * jnp.asarray(DIGEST_MULT, _I32) + val_r,
+            srv["read_digest"])
+
+    out["tick"] = t + 1
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Device-resident load generation (§20) + the host-queue twin.
+
+
+def gen_inject(cfg: RaftConfig, k0, k1, t, scen: Optional[dict] = None
+               ) -> jax.Array:
+    """The (G, N) phase-0 inject plane for tick `t`, derived entirely from
+    the base key's §17 twin words — per group, `client_rate` writers (bank
+    row; default 1/tick) each target a uniformly drawn node, command value
+    = t (the submit-tick identity the latency histograms rely on).
+    Evaluates identically inside a scan body (device generator) and
+    eagerly on the host (host_stream) — the bit-equality contract."""
+    N, G = cfg.n_nodes, cfg.n_groups
+    spec = cfg.scenario
+    w_max = min(N, max(1, spec.client_rate_max if spec is not None else 1))
+    if scen is not None and "client_rate" in scen:
+        rate = jnp.minimum(scen["client_rate"].astype(_I32), N)
+    else:
+        rate = jnp.ones((G,), _I32)
+    t = jnp.asarray(t, _I32)
+    e0, e1 = rngmod.kt_event_key(k0, k1, rngmod.KIND_CLIENT, t)
+    n0, n1 = rngmod.kt_fold(e0, e1, 2)
+    gidx = lax.iota(_I32, G)
+    inj = jnp.full((G, N), -1, _I32)
+    for j in range(w_max):
+        nd = rngmod.kt_randint(n0, n1, gidx * w_max + j, 0,
+                               jnp.asarray(N, _I32))          # (G,)
+        m = jnp.asarray(j, _I32) < rate
+        oh = lax.iota(_I32, N)[None, :] == nd[:, None]        # (G, N)
+        inj = jnp.where(oh & m[:, None], t, inj)
+    return inj
+
+
+def host_stream(cfg: RaftConfig, n_ticks: int, t0: int = 0,
+                scen: Optional[dict] = None) -> np.ndarray:
+    """The host-side twin of the device generator: the (T, G, N) inject
+    stream for ticks [t0, t0 + n_ticks), evaluated eagerly through the
+    SAME gen_inject — what make_queued_run's host fill loop produces."""
+    base = rngmod.base_key(cfg.seed)
+    k0, k1 = rngmod.kt_key_words(base)
+    rows = [gen_inject(cfg, k0, k1, t0 + i, scen=scen)
+            for i in range(n_ticks)]
+    return np.asarray(jax.device_get(jnp.stack(rows)))
+
+
+def make_queued_run(cfg: RaftConfig, n_ticks: int, chunk: int = 16):
+    """The host-fed ingestion path: a jitted chunked scan whose xs is a
+    (chunk, G, N) inject buffer, double-buffered on the host — while the
+    device drains chunk k (async dispatch), the host fills buffer k+1.
+    Returns run(state, fill_fn) -> (end_state, srv, stats); fill_fn(t0, n)
+    must return the (n, G, N) int32 inject stream for ticks [t0, t0+n)
+    (serving.host_stream partial-applied, or any external workload).
+    stats reports the fill/compute overlap: fill_hidden_frac is the
+    fraction of host fill time hidden behind device execution."""
+    import time
+
+    from raft_kotlin_tpu.ops.tick import make_rng, make_tick, split_rng
+
+    if not serving_enabled(cfg):
+        raise ValueError("make_queued_run needs cfg.serve_slots > 0")
+    tick_fn = make_tick(cfg)
+    rng = make_rng(cfg)
+    n_chunks, rem = divmod(int(n_ticks), int(chunk))
+    sizes = [chunk] * n_chunks + ([rem] if rem else [])
+
+    @jax.jit
+    def run_chunk(st, srv, rng, xs):
+        base, _tk, _bk, scen = split_rng(rng)
+        kw = rngmod.kt_key_words(base)
+
+        def body(carry, inj):
+            st, srv = carry
+            st2 = tick_fn(st, inject=inj, rng=rng)
+            srv2 = serving_step(cfg, serving_view(st2), srv, kw=kw,
+                                scen=scen)
+            return (st2, srv2), None
+
+        (st, srv), _ = lax.scan(body, (st, srv), xs)
+        return st, srv
+
+    def run(state, fill_fn):
+        srv = serving_zeros(cfg.n_groups, cfg.serve_slots)
+        t0 = 0
+        fill_s = hidden_s = 0.0
+        nxt = fill_fn(0, sizes[0]) if sizes else None
+        for i, n in enumerate(sizes):
+            buf, nxt = nxt, None
+            state, srv = run_chunk(state, srv, rng,
+                                   jnp.asarray(buf, _I32))
+            # Device is (asynchronously) draining chunk i: fill i+1 NOW,
+            # then block on the in-flight result — fill time that fits
+            # under the device time is hidden.
+            if i + 1 < len(sizes):
+                f0 = time.perf_counter()
+                nxt = fill_fn(t0 + n, sizes[i + 1])
+                f1 = time.perf_counter()
+                fill_s += f1 - f0
+                jax.block_until_ready(state.term)
+                # If the device was still draining chunk i when the fill
+                # finished (we then blocked a measurable time), the whole
+                # fill ran under device execution — hidden.
+                if time.perf_counter() - f1 > 1e-5:
+                    hidden_s += f1 - f0
+            t0 += n
+        jax.block_until_ready(state.term)
+        stats = {"fill_s": fill_s,
+                 "fill_hidden_frac": (hidden_s / fill_s) if fill_s else 1.0}
+        return state, srv, stats
+
+    return run
+
+
+# ---------------------------------------------------------------------------
+# Host recomputation + scalar/summary forms.
+
+
+def fold_from_trace(cfg: RaftConfig, commit_tr: np.ndarray,
+                    end_log_cmd: np.ndarray,
+                    role_tr: Optional[np.ndarray] = None,
+                    up_tr: Optional[np.ndarray] = None,
+                    scen: Optional[dict] = None) -> dict:
+    """Exact host recomputation of the serving carry from a (T, N, G)
+    trace — the §19 recomputability contract. `commit_tr` is the per-tick
+    post-tick commit trace, `end_log_cmd` the END state's (N, C, G)
+    log_cmd (committed prefixes are never truncated, so the end log of
+    each tick's frontier holder contains every applied value; requires a
+    no-compaction config, where positions are stable rows). `role_tr`/
+    `up_tr` add the read-index read channel (role/up ride every
+    make_run trace; the lease path needs hb_armed and is pinned
+    differentially instead). Returns numpy arrays keyed like the carry.
+
+    The read-digest fold additionally needs the §17 twin draws — evaluated
+    here eagerly via the same kt_* functions the device used."""
+    if cfg.uses_compaction:
+        raise ValueError("fold_from_trace needs stable log rows "
+                         "(no-compaction config)")
+    T, N, G = commit_tr.shape
+    S, A, C = cfg.serve_slots, cfg.apply_chunk, cfg.phys_capacity
+    B = SERVING_BINS
+    cm = np.asarray(commit_tr, np.int64)
+    lc = np.asarray(end_log_cmd, np.int64)
+    applied = np.zeros(G, np.int64)
+    dg = np.zeros(G, np.int64)
+    kv_val = np.zeros((S, G), np.int64)
+    kv_ver = np.zeros((S, G), np.int64)
+    hist_c = np.zeros(B, np.int64)
+    hist_r = np.zeros(B, np.int64)
+    reads_ok = 0
+    rdg = np.zeros(G, np.int64)
+    q = np.zeros(G, np.int64)
+    age = np.zeros(G, np.int64)
+    applied_total = 0
+
+    do_reads = role_tr is not None and up_tr is not None
+    if scen is not None and "client_read" in scen:
+        R = np.asarray(jax.device_get(scen["client_read"]), np.int64)
+    else:
+        R = np.full(G, cfg.read_batch, np.int64)
+    L0 = READ_L0[cfg.read_path]
+    if do_reads and cfg.read_path != "readindex":
+        raise ValueError("trace recompute covers read_path='readindex' "
+                        "(lease needs hb_armed, absent from run traces)")
+    base = rngmod.base_key(cfg.seed)
+    k0, k1 = (int(x) for x in jax.device_get(rngmod.kt_key_words(base)))
+
+    for t in range(T):
+        F = cm[t].max(axis=0)
+        src = cm[t].argmax(axis=0)
+        want = np.clip(F - applied, 0, A)
+        for g in range(G):
+            for j in range(int(want[g])):
+                p = int(applied[g]) + j
+                cv = int(lc[src[g], p % C, g])
+                dg[g] = (dg[g] * DIGEST_MULT + cv) & 0xFFFFFFFF
+                kv_val[cv % S, g] = cv
+                kv_ver[cv % S, g] += 1
+                hist_c[min(max(t - cv, 0), B - 1)] += 1
+        applied_total += int(want.sum())
+        applied = applied + want
+        if do_reads:
+            lead = (np.asarray(role_tr[t], np.int64) == 2) \
+                & (np.asarray(up_tr[t], np.int64) != 0)
+            ok = lead.any(axis=0)
+            served_now = np.where(ok, R, 0)
+            hist_r[min(L0, B - 1)] += int(served_now.sum())
+            for g in range(G):
+                if ok[g] and q[g] > 0:
+                    hist_r[min(L0 + int(age[g]), B - 1)] += int(q[g])
+            reads_ok += int(served_now.sum()) \
+                + int(np.where(ok, q, 0).sum())
+            # Drawn-key fold (device-identical bits via the kt twins).
+            e0, e1 = rngmod.kt_event_key(np.int32(k0), np.int32(k1),
+                                         rngmod.KIND_READ, np.int32(t))
+            h0, h1 = rngmod.kt_fold(e0, e1, 0)
+            s0, s1 = rngmod.kt_fold(e0, e1, 1)
+            gidx = np.arange(G, dtype=np.int32)
+            if scen is not None and "client_hot" in scen:
+                hotp = np.asarray(jax.device_get(scen["client_hot"]),
+                                  np.int64)
+                thresh = hotp * 8388 + (hotp * 608) // 1000
+                hotm = np.asarray(jax.device_get(
+                    rngmod.kt_bits23(jnp.asarray(h0), jnp.asarray(h1),
+                                     jnp.asarray(gidx)))) < thresh
+            else:
+                hotm = np.zeros(G, bool)
+            slot_r = np.asarray(jax.device_get(rngmod.kt_randint(
+                jnp.asarray(s0), jnp.asarray(s1), jnp.asarray(gidx),
+                0, jnp.asarray(S, jnp.int32))), np.int64)
+            slot_r = np.where(hotm, 0, slot_r)
+            for g in range(G):
+                if ok[g] and R[g] > 0:
+                    rdg[g] = (rdg[g] * DIGEST_MULT
+                              + int(kv_val[slot_r[g], g])) & 0xFFFFFFFF
+            q = np.where(ok, 0, q + R)
+            age = np.where(ok, 0, np.where(q > 0, age + 1,
+                                           np.where(R > 0, 1, 0)))
+
+    def sign32(a):
+        a = np.asarray(a, np.int64) & 0xFFFFFFFF
+        return (a - ((a >= (1 << 31)) * (1 << 32))).astype(np.int64)
+
+    return {
+        "applied": applied, "apply_digest": sign32(dg),
+        "read_digest": sign32(rdg), "kv_val": kv_val, "kv_ver": kv_ver,
+        "applied_total": applied_total, "reads_ok": reads_ok,
+        "hist_commit": hist_c, "hist_read": hist_r,
+    }
+
+
+def hist_percentile(hist, p: float) -> int:
+    """The p-quantile BIN (in ticks) of a (B,) count histogram: the first
+    bin whose cumulative count reaches p * total (total 0 -> 0)."""
+    h = np.asarray(jax.device_get(hist), np.int64)
+    total = int(h.sum())
+    if total == 0:
+        return 0
+    cum = np.cumsum(h)
+    return int(np.searchsorted(cum, p * total, side="left"))
+
+
+def serving_scalars(srv: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+    """The carry as FLAT () int32 scalars under the srv_ prefix — the form
+    that rides bench.measure's stats dicts (the monitor_scalars twin)."""
+    return {
+        "srv_applied_total": srv["applied_total"],
+        "srv_reads_ok": srv["reads_ok"],
+        "srv_snap_jumps": srv["snap_jumps"],
+        "srv_viol_groups": jnp.sum((srv["serve_viol"] != 0).astype(_I32)),
+        "srv_viol_tick": srv["viol_tick"],
+        "srv_hist_commit_n": jnp.sum(srv["hist_commit"]),
+        "srv_hist_read_n": jnp.sum(srv["hist_read"]),
+    }
+
+
+def serving_status(stats: Optional[dict]) -> Optional[str]:
+    """The compact serving_inv_status string from serving_scalars output
+    (host ints): "clean", or "applied-ahead@t<tick>" when the frontier
+    ever regressed below the apply cursor. None when the leg ran
+    serving-off."""
+    if not stats or "srv_viol_tick" not in stats:
+        return None
+    t = int(stats["srv_viol_tick"])
+    if t < 0:
+        return "clean"
+    return f"applied-ahead@t{t}"
+
+
+def summarize_serving(srv: Dict[str, jax.Array]) -> dict:
+    """Host materialization of a serving carry — ONE batched device_get:
+    totals, the violation latch, and p50/p99/p999 of both histograms."""
+    host = jax.device_get(srv)
+    stats = {k: int(np.asarray(host[k])) if np.asarray(host[k]).ndim == 0
+             else np.asarray(host[k]) for k in host}
+    hc, hr = stats["hist_commit"], stats["hist_read"]
+    return {
+        "status": serving_status(
+            {"srv_viol_tick": stats["viol_tick"]}),
+        "applied_total": stats["applied_total"],
+        "reads_ok": stats["reads_ok"],
+        "snap_jumps": stats["snap_jumps"],
+        "submit_commit_p50": hist_percentile(hc, 0.50),
+        "submit_commit_p99": hist_percentile(hc, 0.99),
+        "submit_commit_p999": hist_percentile(hc, 0.999),
+        "read_p50": hist_percentile(hr, 0.50),
+        "read_p99": hist_percentile(hr, 0.99),
+        "read_p999": hist_percentile(hr, 0.999),
+        "hist_commit": hc,
+        "hist_read": hr,
+    }

@@ -2627,7 +2627,8 @@ def make_tick(cfg: RaftConfig, batched: Optional[bool] = None,
 def make_run(cfg: RaftConfig, n_ticks: int, trace: bool = True, impl: str = "xla",
              batched: Optional[bool] = None, telemetry: bool = False,
              monitor: bool = False, rng=None, fused_ticks: int = 1,
-             layout: Optional[str] = None, compute: Optional[str] = None):
+             layout: Optional[str] = None, compute: Optional[str] = None,
+             serving: bool = False, serving_gen: bool = False):
     """jitted runner: state -> (state, trace) stepping n_ticks via lax.scan.
 
     trace is a dict of (T, N, G) arrays (role/term/commit/last_index/voted_for/rounds/
@@ -2676,6 +2677,15 @@ def make_run(cfg: RaftConfig, n_ticks: int, trace: bool = True, impl: str = "xla
     EXPLICIT "wide" always wins over the routed plan (it is the
     documented overflow remedy and must never be re-packed).
 
+    `serving` = True (SEMANTICS.md §20; needs cfg.serve_slots > 0) threads
+    the scan-carry serving state (ops/serving.py — applied KV planes,
+    latency histograms, read gating) advanced on every post-tick state
+    exactly like the monitor; the return grows a trailing serving carry.
+    `serving_gen` = True additionally feeds each tick the device-resident
+    §20 client inject stream (serving.gen_inject — XLA engine only; the
+    generator rides phase 0's inject operand, which the Pallas megakernel
+    does not take).
+
     `compute` = "packed" (SEMANTICS.md §18) selects the packed-DOMAIN
     lattice program: the per-tick function evaluates the vote-exchange
     set on packed words (make_tick compute=... / the Pallas kernel's
@@ -2721,23 +2731,45 @@ def make_run(cfg: RaftConfig, n_ticks: int, trace: bool = True, impl: str = "xla
         tick_fn = make_tick(cfg, batched=batched, compute=compute)
     if rng is None:
         rng = make_rng(cfg)
+    if serving or serving_gen:
+        from raft_kotlin_tpu.ops import serving as serving_mod
+
+        if not serving_mod.serving_enabled(cfg):
+            raise ValueError("serving/serving_gen need cfg.serve_slots > 0")
+        if serving_gen and impl != "xla":
+            raise ValueError("serving_gen rides phase 0's inject operand "
+                             "— XLA engine only")
 
     @jax.jit
     def run(st, rng):
         if packed:
             st = pack_state(cfg, st)
+        if serving or serving_gen:
+            base_k, _tk, _bk, scen_b = split_rng(rng)
+            kw = rngmod.kt_key_words(base_k)
 
         def one(carry):
-            st, tel, mon = carry
+            st, tel, mon, srv = carry
             wide = unpack_state(cfg, st) if packed else st
+            inj = None
+            if serving_gen:
+                inj = serving_mod.gen_inject(cfg, kw[0], kw[1],
+                                             srv["tick"], scen=scen_b)
             with telemetry_mod.engine_scope(impl):
-                st2 = tick_fn(wide, rng=rng)
+                st2 = tick_fn(wide, inject=inj, rng=rng) if inj is not None \
+                    else tick_fn(wide, rng=rng)
             if telemetry:
                 tel = telemetry_mod.telemetry_step(wide, st2, tel)
             if monitor:
                 mon = telemetry_mod.monitor_step(wide, st2, mon)
+            if serving:
+                srv = serving_mod.serving_step(
+                    cfg, serving_mod.serving_view(st2), srv, kw=kw,
+                    scen=scen_b)
+            elif serving_gen:
+                srv = dict(srv, tick=srv["tick"] + 1)
             nxt = pack_state(cfg, st2, ov=st.ov) if packed else st2
-            return (nxt, tel, mon), st2
+            return (nxt, tel, mon, srv), st2
 
         def body(carry, _):
             carry, st2 = one(carry)
@@ -2767,7 +2799,13 @@ def make_run(cfg: RaftConfig, n_ticks: int, trace: bool = True, impl: str = "xla
 
         tel0 = telemetry_mod.telemetry_zeros() if telemetry else None
         mon0 = telemetry_mod.monitor_init(cfg.n_groups, n_ticks, monitor)
-        carry = (st, tel0, mon0)
+        if serving:
+            srv0 = serving_mod.serving_init(cfg)
+        elif serving_gen:
+            srv0 = {"tick": jnp.zeros((), _I32)}
+        else:
+            srv0 = None
+        carry = (st, tel0, mon0, srv0)
         if T_f > 1:
             n_block, rem = divmod(n_ticks, T_f)
             carry, ys = lax.scan(block, carry, None, length=n_block)
@@ -2775,7 +2813,7 @@ def make_run(cfg: RaftConfig, n_ticks: int, trace: bool = True, impl: str = "xla
                 carry, _ = lax.scan(body, carry, None, length=rem)
         else:
             carry, ys = lax.scan(body, carry, None, length=n_ticks)
-        end, tel, mon = carry
+        end, tel, mon, srv = carry
         # One scalar reduction of the (G,) per-group latch, at scan exit
         # (never per tick — the sharded runs' collective-freedom hinges
         # on the carry staying lane-shaped).
@@ -2787,6 +2825,8 @@ def make_run(cfg: RaftConfig, n_ticks: int, trace: bool = True, impl: str = "xla
             out = out + (tel,)
         if monitor:
             out = out + (telemetry_mod.monitor_finalize(mon),)
+        if serving:
+            out = out + (srv,)
         return out + (pov,) if packed else out
 
     # rng rides the jit boundary as an operand (seed-independent program).

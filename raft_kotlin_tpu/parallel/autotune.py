@@ -63,11 +63,19 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
 CACHE_PATH = os.path.join(REPO_ROOT, ".autotune_cache.json")
 
 PLAN_FIELDS = ("engine", "ilp_subtiles", "fused_ticks", "layout",
-               "sharding", "tile", "compaction", "aux_source", "compute")
+               "sharding", "tile", "compaction", "aux_source", "compute",
+               "read_path")
 REGIMES = ("shallow", "deep")
 DEEP_ENGINES = ("fc", "batched", "flat")
 LAYOUTS = ("wide", "packed")
 AUX_SOURCES = ("staged", "inkernel")
+# §20 log-free read confirmation (ISSUE 19): "readindex" confirms
+# leadership via a heartbeat round (+2 ticks), "lease" serves inside the
+# armed heartbeat lease (+1 tick). Routed for serving legs only (bench/
+# probe_serving build their configs from it — serving_step itself always
+# reads cfg.read_path); pinned "readindex" on CPU, and "lease" arms only
+# via a vetted probe_serving --pin round.
+READ_PATHS = ("readindex", "lease")
 # §18 packed-domain compute (ISSUE 16): "packed" runs the phase lattice
 # on packed words inside the megakernel. Requires layout="packed"
 # (apply_guards demotes otherwise) and is pinned "unpacked" on CPU.
@@ -212,11 +220,12 @@ def default_plan(key: dict) -> dict:
         return {"engine": "flat", "ilp_subtiles": 1, "fused_ticks": 1,
                 "layout": "wide", "sharding": "shard_map", "tile": None,
                 "compaction": "off", "aux_source": "staged",
-                "compute": "unpacked"}
+                "compute": "unpacked", "read_path": "readindex"}
     return {"engine": "pallas", "ilp_subtiles": 1, "fused_ticks": 1,
             "layout": "wide", "sharding": "shard_map",
             "tile": key["lanes"], "compaction": "off",
-            "aux_source": "staged", "compute": "unpacked"}
+            "aux_source": "staged", "compute": "unpacked",
+            "read_path": "readindex"}
 
 
 def apply_guards(key: dict, plan: dict) -> dict:
@@ -253,6 +262,11 @@ def apply_guards(key: dict, plan: dict) -> dict:
     # a vetted packed-compute round arms via
     # scripts/probe_packed_compute.py --pin).
     plan.setdefault("compute", "unpacked")
+    # r20 migration contract: rows/caches predating the §20 read_path
+    # dimension normalize to "readindex" (the conservative confirmation
+    # round; a vetted lease round arms via scripts/probe_serving.py
+    # --pin).
+    plan.setdefault("read_path", "readindex")
     if key["platform"] == "cpu":
         if key["regime"] == "deep":
             plan["engine"] = "flat"
@@ -265,6 +279,10 @@ def apply_guards(key: dict, plan: dict) -> dict:
         # Same guard class for §18: the packed lattice trades per-tick
         # repack ALU for VMEM the interpreter doesn't have.
         plan["compute"] = "unpacked"
+        # §20 guard: the readindex confirmation round is the oracle-
+        # proven reference gate for the CPU differential suite; lease
+        # timing is a measured property, never a CPU default.
+        plan["read_path"] = "readindex"
         return plan
     if plan.get("compute") == "packed" and plan.get("layout") != "packed":
         # §18 pairing: packed compute needs the packed carry layout
@@ -470,7 +488,8 @@ def plan_for(cfg, mesh=None, platform: Optional[str] = None,
                              "fused_ticks": 1, "layout": "wide",
                              "sharding": "shard_map", "tile": None,
                              "aux_source": "staged",
-                             "compute": "unpacked"},
+                             "compute": "unpacked",
+                             "read_path": "readindex"},
                             "guard")
         else:
             plan, source = resolve_plan(
@@ -482,9 +501,11 @@ def plan_for(cfg, mesh=None, platform: Optional[str] = None,
         plan["sharding"] = "shard_map" if mesh is not None else "single"
         # The XLA/deep engines have no in-kernel draw path — aux stays
         # staged regardless of what a (mis)pinned row says. Same for §18
-        # packed compute: a megakernel-interior dimension.
+        # packed compute: a megakernel-interior dimension. §20 serving
+        # on deep engines keeps the conservative confirmation round.
         plan["aux_source"] = "staged"
         plan["compute"] = "unpacked"
+        plan.setdefault("read_path", "readindex")
         if cfg.uses_compaction:
             # §15 compaction dimension (r15): a config property, stamped
             # onto the plan. The fc engine has no ring-map support (its
@@ -514,7 +535,7 @@ def plan_for(cfg, mesh=None, platform: Optional[str] = None,
                 "layout": "wide", "compaction": "ring",
                 "sharding": "spmd" if mesh is not None else "single",
                 "tile": None, "aux_source": "staged",
-                "compute": "unpacked"}
+                "compute": "unpacked", "read_path": "readindex"}
         return (plan, "guard") if with_source else plan
     if not interpret:
         from raft_kotlin_tpu.ops.pallas_tick import (
@@ -536,6 +557,7 @@ def plan_for(cfg, mesh=None, platform: Optional[str] = None,
     layout = "wide"
     aux_source = "staged"
     compute = "unpacked"
+    read_path = "readindex"
     if engine == "pallas" and tile is not None:
         row_plan, source = resolve_plan(shallow_key(tile, platform=pclass),
                                         with_source=True)
@@ -548,6 +570,10 @@ def plan_for(cfg, mesh=None, platform: Optional[str] = None,
         # probe_packed_compute --pin); apply_guards already demoted any
         # packed-compute row without the packed layout.
         compute = row_plan.get("compute", "unpacked")
+        # §20 read_path rides the row too ("readindex" until a vetted
+        # probe_serving --pin round arms the lease) — advisory for the
+        # serving legs; the kernel itself reads cfg.read_path.
+        read_path = row_plan.get("read_path", "readindex")
         if ((aux_source == "inkernel" and cfg.scenario is not None
                 and cfg.scenario.needs_state)
                 or compute == "packed"):
@@ -569,7 +595,8 @@ def plan_for(cfg, mesh=None, platform: Optional[str] = None,
             "layout": layout, "compaction": "off",
             "sharding": ("shard_map" if engine == "pallas" else "spmd")
             if mesh is not None else "single", "tile": tile,
-            "aux_source": aux_source, "compute": compute}
+            "aux_source": aux_source, "compute": compute,
+            "read_path": read_path}
     return (plan, source) if with_source else plan
 
 

@@ -602,7 +602,7 @@ def make_sharded_run(cfg: RaftConfig, mesh: Mesh, n_ticks: int,
                      telemetry: bool = False, monitor: bool = False,
                      fused_ticks: Optional[int] = None,
                      layout: str = "wide", aux_source: str = "staged",
-                     compute: str = "unpacked"):
+                     compute: str = "unpacked", serving: bool = False):
     """Compile run(state [, inject]) -> (state, metrics) sharded over `mesh`.
 
     metrics: dict of cross-group reductions emitted every `metrics_every` ticks
@@ -656,6 +656,17 @@ def make_sharded_run(cfg: RaftConfig, mesh: Mesh, n_ticks: int,
     its aux contract at any T (the fallback rebuild threads aux_source
     too).
 
+    `serving`=True (SEMANTICS.md §20; needs cfg.serve_slots > 0) threads
+    the scan-carry serving state (ops/serving.py — applied KV planes,
+    latency histograms, read gating) through the run, advanced on the
+    globally-sharded post-tick states OUTSIDE shard_map exactly like the
+    monitor; the return grows a trailing serving carry (replicated out).
+    The per-group planes stay shard-local; only the latency-histogram
+    bumps join the observers' collective class, and those are
+    order-independent int sums — the histograms are BIT-IDENTICAL to the
+    single-device run. Fused T-blocks take the sticky T=1 fallback under
+    serving (the per-tick apply fold needs per-tick states).
+
     `compute`="packed" (ISSUE 16, §18) evaluates the phase lattice on
     packed peer/ctrl words inside the per-shard kernel (impl="pallas")
     or the XLA packed-compute twin (impl="xla", non-deep) — bit-equal to
@@ -667,7 +678,9 @@ def make_sharded_run(cfg: RaftConfig, mesh: Mesh, n_ticks: int,
     """
     from raft_kotlin_tpu.models.state import (
         check_packed_ov, pack_state, unpack_state)
-    from raft_kotlin_tpu.ops.tick import flatten_state, make_rng
+    from raft_kotlin_tpu.ops.tick import flatten_state, make_rng, split_rng
+    from raft_kotlin_tpu.ops import serving as serving_mod
+    from raft_kotlin_tpu.utils import rng as rngmod
 
     packed = layout == "packed"
     if layout not in ("wide", "packed"):
@@ -686,6 +699,8 @@ def make_sharded_run(cfg: RaftConfig, mesh: Mesh, n_ticks: int,
         raise ValueError(
             "compute='packed' has no deep-log XLA shard twin; plans for "
             "dyn-log configs are stamped compute='unpacked'")
+    if serving and not serving_mod.serving_enabled(cfg):
+        raise ValueError("serving needs cfg.serve_slots > 0")
 
     fused_block, T_f = None, 1
     if impl == "pallas":
@@ -696,8 +711,12 @@ def make_sharded_run(cfg: RaftConfig, mesh: Mesh, n_ticks: int,
                                           compute=compute)
         T_f = getattr(cand, "fused_ticks", 1)
         if T_f > 1 and ((metrics_every and metrics_every % T_f)
-                        or n_ticks < T_f):
-            T_f = 1  # sticky fallback: windows/run must tile into T-blocks
+                        or n_ticks < T_f or serving):
+            # sticky fallback: windows/run must tile into T-blocks; the
+            # §20 serving fold needs per-tick states (replaying fused
+            # snapshots here would also need SERVING_STATE_FIELDS staged
+            # through the shard-map kernel — per-tick launches instead).
+            T_f = 1
         elif T_f > 1:
             fused_block = cand
         if T_f == 1:
@@ -761,7 +780,7 @@ def make_sharded_run(cfg: RaftConfig, mesh: Mesh, n_ticks: int,
     def _wide(st):
         return unpack_state(cfg, st) if packed else st
 
-    def _pack(st, ms, tel, mon):
+    def _pack(st, ms, tel, mon, srv=None):
         # One scalar reduction of the (G,) per-group latch, at scan exit
         # only — the per-tick carry stays lane-shaped/shard-local, so the
         # packed sharded tick adds NO per-tick collective.
@@ -772,6 +791,8 @@ def make_sharded_run(cfg: RaftConfig, mesh: Mesh, n_ticks: int,
             out = out + (tel,)
         if monitor:
             out = out + (telemetry_mod.monitor_finalize(mon),)
+        if serving:
+            out = out + (srv,)
         if packed:
             out = out + (pov,)
         return out
@@ -779,38 +800,50 @@ def make_sharded_run(cfg: RaftConfig, mesh: Mesh, n_ticks: int,
     def run(st, rng):
         if packed:
             st = pack_state(cfg, st)
+        if serving:
+            base_k, _tk, _bk, scen_b = split_rng(rng)
+            srv_kw = rngmod.kt_key_words(base_k)
+        else:
+            srv_kw = scen_b = None
 
         def one(carry, _):
-            s, tel, mon = carry
+            s, tel, mon, srv = carry
             w = _wide(s)
             s2 = tick_fn(w, rng)
             if tel is not None:
                 tel = telemetry_mod.telemetry_step(w, s2, tel)
             if mon is not None:
                 mon = telemetry_mod.monitor_step(w, s2, mon)
+            if srv is not None:
+                srv = serving_mod.serving_step(
+                    cfg, serving_mod.serving_view(s2), srv, kw=srv_kw,
+                    scen=scen_b)
             nxt = pack_state(cfg, s2, ov=s.ov) if packed else s2
-            return (nxt, tel, mon), None
+            return (nxt, tel, mon, srv), None
 
         tel0 = telemetry_mod.telemetry_zeros() if telemetry else None
         mon0 = telemetry_mod.monitor_init(cfg.n_groups, n_ticks, monitor)
+        srv0 = serving_mod.serving_init(cfg) if serving else None
         if not metrics_every:
-            (st, tel, mon), _ = jax.lax.scan(one, (st, tel0, mon0), None,
-                                             length=n_ticks)
-            return _pack(st, None, tel, mon)
+            (st, tel, mon, srv), _ = jax.lax.scan(
+                one, (st, tel0, mon0, srv0), None, length=n_ticks)
+            return _pack(st, None, tel, mon, srv)
 
         def win(carry, _):
-            st, tel, mon = carry
+            st, tel, mon, srv = carry
             rounds0 = _rounds_sum(_wide(st))
-            (st, tel, mon), _ = jax.lax.scan(one, (st, tel, mon), None,
-                                             length=metrics_every)
-            return (st, tel, mon), window_metrics(_wide(st), rounds0)
+            (st, tel, mon, srv), _ = jax.lax.scan(
+                one, (st, tel, mon, srv), None, length=metrics_every)
+            return (st, tel, mon, srv), window_metrics(_wide(st), rounds0)
 
-        (st, tel, mon), ms = jax.lax.scan(win, (st, tel0, mon0), None,
-                                          length=n_ticks // metrics_every)
+        (st, tel, mon, srv), ms = jax.lax.scan(
+            win, (st, tel0, mon0, srv0), None,
+            length=n_ticks // metrics_every)
         if n_ticks % metrics_every:
-            (st, tel, mon), _ = jax.lax.scan(one, (st, tel, mon), None,
-                                             length=n_ticks % metrics_every)
-        return _pack(st, ms, tel, mon)
+            (st, tel, mon, srv), _ = jax.lax.scan(
+                one, (st, tel, mon, srv), None,
+                length=n_ticks % metrics_every)
+        return _pack(st, ms, tel, mon, srv)
 
     def run_fused(st, rng):
         # The fused-T variant (ISSUE 7): full T-blocks through the fused
@@ -883,6 +916,7 @@ def make_sharded_run(cfg: RaftConfig, mesh: Mesh, n_ticks: int,
     out_sh = ((sh, rep if metrics_every else None)
               + ((rep,) if telemetry else ())
               + ((rep,) if monitor else ())
+              + ((rep,) if serving else ())
               + ((rep,) if packed else ()))
     if T_f > 1:
         jitted_f = jax.jit(run_fused, in_shardings=(sh, rng_sh),

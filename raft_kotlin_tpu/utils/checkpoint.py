@@ -40,7 +40,7 @@ from raft_kotlin_tpu.utils.config import RaftConfig, config_from_dict
 _HEADER_KEY = "__raft_config_json__"
 _EXTRA_KEY = "__raft_extra_json__"
 _VERSION_KEY = "__raft_ckpt_version__"
-_VERSION = 8  # v2: +up/+link_up fault-model fields; v3: groups-minor array layout;
+_VERSION = 9  # v2: +up/+link_up fault-model fields; v3: groups-minor array layout;
               # v4: optional §10 mailbox arrays (present iff cfg.uses_mailbox);
               # v5: +last_term lastLogTerm cache (derived from the log on load
               # of older checkpoints); v6: narrowed int16 storage for
@@ -56,7 +56,33 @@ _VERSION = 8  # v2: +up/+link_up fault-model fields; v3: groups-minor array layo
               # window [snap_index, phys_len) onto a DIFFERENT ring_capacity
               # (_resize_ring_window; expect_cfg may differ in ring_capacity
               # only). No array format change — v7 compaction checkpoints
-              # (ring_capacity None, phys == C) resize-load the same way.
+              # (ring_capacity None, phys == C) resize-load the same way;
+              # v9: optional §20 serving carry (ops/serving.SERVING_KEYS
+              # under the __srv__ prefix — applied-KV planes, read
+              # queue/lease fields, latency histograms), saved when the run
+              # passes its carry to save()/save_sharded() and read back via
+              # load_serving(). Older versions (and serving-off saves)
+              # zero-fill for serving configs; None otherwise.
+
+
+_SRV_PREFIX = "__srv__"
+# Serving-carry arrays whose LAST axis is the groups axis (sharded saves
+# slice these per shard; everything else — the tick/total scalars and the
+# (B,) histograms — replicates into every shard file like the tick scalar).
+_SRV_GROUPED = ("kv_val", "kv_ver", "applied", "apply_digest",
+                "read_digest", "grp_read_q", "grp_read_age", "serve_viol")
+
+
+def _serving_host(serving: dict) -> dict:
+    """The carry as host numpy in canonical SERVING_KEYS order, validated
+    complete (a partial carry must never become a checkpoint)."""
+    from raft_kotlin_tpu.ops.serving import SERVING_KEYS
+
+    host = jax.device_get(serving)
+    missing = [k for k in SERVING_KEYS if k not in host]
+    if missing:
+        raise ValueError(f"serving carry is missing keys {missing}")
+    return {k: np.asarray(host[k]) for k in SERVING_KEYS}
 
 
 def _canon_dtypes(arrays: dict, cfg: RaftConfig) -> dict:
@@ -154,9 +180,14 @@ def _apply_layout(state: RaftState, cfg: RaftConfig, layout: str):
     return pack_state(cfg, state)
 
 
-def save(path: str, state: RaftState, cfg: RaftConfig, extra: Optional[dict] = None) -> None:
+def save(path: str, state: RaftState, cfg: RaftConfig,
+         extra: Optional[dict] = None,
+         serving: Optional[dict] = None) -> None:
     """Atomically write `state` (+ config header) to `path` (.npz).
     Accepts either layout; always stores wide (_normalize_wide).
+    `serving` (v9) is a §20 serving carry to store alongside the state
+    (ops/serving SERVING_KEYS, __srv__-prefixed); read back via
+    load_serving().
 
     Sharded arrays are gathered to host first (np.asarray on a fully-addressable
     array concatenates its shards); multi-host checkpointing of non-addressable
@@ -168,6 +199,9 @@ def save(path: str, state: RaftState, cfg: RaftConfig, extra: Optional[dict] = N
         for f in dataclasses.fields(state)
         if getattr(state, f.name) is not None  # §10 mailbox fields may be absent
     }
+    if serving is not None:
+        arrays.update({_SRV_PREFIX + k: v
+                       for k, v in _serving_host(serving).items()})
     arrays[_HEADER_KEY] = np.frombuffer(
         json.dumps(dataclasses.asdict(cfg)).encode(), dtype=np.uint8
     )
@@ -218,7 +252,8 @@ def load_with_extra(
 
 
 def save_sharded(dirpath: str, state: RaftState, cfg: RaftConfig,
-                 extra: Optional[dict] = None) -> None:
+                 extra: Optional[dict] = None,
+                 serving: Optional[dict] = None) -> None:
     """Checkpoint a SHARDED state without ever materializing a full array on the
     host: one .npz per device shard (each holding that device's slice of every
     field) plus a manifest. This is the config-5-scale path — `save()` gathers
@@ -231,9 +266,13 @@ def save_sharded(dirpath: str, state: RaftState, cfg: RaftConfig,
     case: same total groups, any divisor count), or assemble unsharded.
     Accepts either state layout; always stores wide (_normalize_wide — the
     unpack is elementwise, so a sharded packed state unpacks shard-locally
-    without gathering).
+    without gathering). `serving` (v9) stores the §20 carry: groups-axis
+    planes sliced per shard, global scalars/histograms replicated into
+    every shard file (the tick-scalar pattern); the carry is tiny, so the
+    host materialization it takes is noise next to the log planes.
     """
     state = _normalize_wide(state, cfg)
+    srv_host = _serving_host(serving) if serving is not None else None
     fields = [
         f.name for f in dataclasses.fields(state)
         if getattr(state, f.name) is not None
@@ -267,6 +306,10 @@ def save_sharded(dirpath: str, state: RaftState, cfg: RaftConfig,
                      if span(s.index)[0] == lo]
             assert local, f"field {name} has no shard at groups offset {lo}"
             arrays[name] = np.asarray(local[0].data)
+        if srv_host is not None:
+            for k, a in srv_host.items():
+                arrays[_SRV_PREFIX + k] = \
+                    a[..., lo:hi] if k in _SRV_GROUPED else a
         fname = f"shard_g{lo:012d}.npz"
         tmp = os.path.join(dirpath, "." + fname + ".tmp")
         with open(tmp, "wb") as f:
@@ -277,6 +320,7 @@ def save_sharded(dirpath: str, state: RaftState, cfg: RaftConfig,
             "version": _VERSION,
             "cfg": dataclasses.asdict(cfg),
             "extra": extra or {},
+            "serving": srv_host is not None,
             "n_shards": len(global_spans),
             "offsets": [[lo, hi] for lo, hi in global_spans],
             "fields": fields,
@@ -306,7 +350,7 @@ def load_sharded(
     with open(os.path.join(dirpath, "manifest.json")) as f:
         manifest = json.load(f)
     version = int(manifest.get("version", 0))
-    if version not in (4, 5, 6, 7, _VERSION):
+    if version not in (4, 5, 6, 7, 8, _VERSION):
         # The sharded layout first existed at v4 — fail loudly on
         # future/corrupt manifests, mirroring _load_impl's gate.
         raise ValueError(
@@ -431,10 +475,58 @@ def load_sharded(
     return _apply_layout(RaftState(**fields), cfg_out, layout), cfg_out
 
 
+def load_serving(path: str):
+    """The §20 serving carry stored alongside a checkpoint (v9). `path` is
+    a save() .npz file or a save_sharded() directory. Returns the carry as
+    saved (int32 jax arrays keyed by SERVING_KEYS); a ZERO carry when the
+    checkpoint predates v9 or was saved without one but its config serves
+    (cfg.serve_slots > 0 — the zero-fill rule: the apply cursor restarts
+    at 0 and refolds, which the digest fold makes bit-convergent); None
+    for non-serving configs."""
+    import jax.numpy as jnp
+
+    from raft_kotlin_tpu.ops.serving import (
+        SERVING_KEYS, serving_enabled, serving_zeros)
+
+    if os.path.isdir(path):
+        with open(os.path.join(path, "manifest.json")) as f:
+            manifest = json.load(f)
+        cfg = config_from_dict(manifest["cfg"])
+        if not serving_enabled(cfg):
+            return None
+        if not manifest.get("serving", False):
+            return serving_zeros(cfg.n_groups, cfg.serve_slots)
+        spans = manifest["offsets"]
+        shard = {}
+        grouped: dict = {k: [] for k in _SRV_GROUPED}
+        for k_idx, (lo, _hi) in enumerate(spans):
+            fname = f"shard_g{lo:012d}.npz"
+            with np.load(os.path.join(path, fname)) as z:
+                for key in SERVING_KEYS:
+                    a = z[_SRV_PREFIX + key]
+                    if key in _SRV_GROUPED:
+                        grouped[key].append(a)
+                    elif k_idx == 0:  # replicated — any shard file's copy
+                        shard[key] = a
+        for key, parts in grouped.items():
+            shard[key] = np.concatenate(parts, axis=-1)
+        return {k: jnp.asarray(shard[k], jnp.int32) for k in SERVING_KEYS}
+
+    with np.load(path) as z:
+        cfg = config_from_dict(
+            json.loads(bytes(z[_HEADER_KEY].tobytes()).decode()))
+        if not serving_enabled(cfg):
+            return None
+        if _SRV_PREFIX + "tick" not in z:
+            return serving_zeros(cfg.n_groups, cfg.serve_slots)
+        return {k: jnp.asarray(z[_SRV_PREFIX + k], jnp.int32)
+                for k in SERVING_KEYS}
+
+
 def _load_impl(path, expect_cfg, sharding):
     with np.load(path) as z:
         version = int(z[_VERSION_KEY])
-        if version not in (1, 2, 3, 4, 5, 6, 7, _VERSION):
+        if version not in (1, 2, 3, 4, 5, 6, 7, 8, _VERSION):
             raise ValueError(
                 f"checkpoint version {version} not supported (can load 1-{_VERSION})")
         cfg_dict = json.loads(bytes(z[_HEADER_KEY].tobytes()).decode())

@@ -96,6 +96,22 @@ class ScenarioSpec:
     life_lo: int = 0
     life_hi: int = 0
     quiesce_ticks: int = 0
+    # §20 client-stream channels (SEMANTICS.md §20): the serving path's
+    # device-resident load generator samples per-group workload shape —
+    # write rate, read rate, and key skew — as bank rows, evaluated via
+    # the §17 kernel-twin draws (bit-identical in-scan and host-eager;
+    # the device-generator ≡ host-queue equality theorem rides on it).
+    # - client_rate_max: per-group writes/tick drawn uniform in
+    #   [1, client_rate_max] (0 disables the channel; the run then uses
+    #   the classical cmd_period workload).
+    # - client_read_max: per-group reads/tick drawn uniform in
+    #   [1, client_read_max] (0 disables; cfg.read_batch applies).
+    # - client_hot_max: per-group hot-key weight in permille, drawn
+    #   uniform in [0, client_hot_max] — the drawn fraction of reads and
+    #   writes lands on slot 0, the rest uniform over the KV slots.
+    client_rate_max: int = 0
+    client_read_max: int = 0
+    client_hot_max: int = 0
 
     def __post_init__(self):
         # Coerce to tuple so a list argument cannot build an unhashable
@@ -133,6 +149,17 @@ class ScenarioSpec:
             raise ValueError(
                 "timeout_windows/lifetimes are sampled channels — they "
                 "cannot ride a degenerate (scalar-anchor) spec")
+        for ch in ("client_rate_max", "client_read_max", "client_hot_max"):
+            if getattr(self, ch) < 0:
+                raise ValueError(f"{ch} must be >= 0, got {getattr(self, ch)}")
+        if self.client_hot_max > 1000:
+            raise ValueError(
+                f"client_hot_max is permille, must be <= 1000, got "
+                f"{self.client_hot_max}")
+        if self.degenerate and self.has_clients:
+            raise ValueError(
+                "client-stream channels are sampled — they cannot ride a "
+                "degenerate (scalar-anchor) spec")
 
     @property
     def has_faults(self) -> bool:
@@ -155,6 +182,13 @@ class ScenarioSpec:
         isolation) — engines that precompute aux ahead of state (the fused
         Pallas kernel) cannot run such banks and fall back."""
         return (not self.degenerate) and ("leader" in self.partitions)
+
+    @property
+    def has_clients(self) -> bool:
+        """Whether the bank carries §20 client-stream channels (the
+        serving path's device-resident load generator)."""
+        return (self.client_rate_max > 0 or self.client_read_max > 0
+                or self.client_hot_max > 0)
 
 
 def config_from_dict(d: dict) -> "RaftConfig":
@@ -254,6 +288,28 @@ class RaftConfig:
     # log_capacity — the bit-identical r15 program.
     ring_capacity: Optional[int] = None
 
+    # §20 serving path (SEMANTICS.md §20). serve_slots S > 0 enables the
+    # applied KV state machine: a fixed-slot (S, G) store folded from the
+    # committed prefix as an end-of-tick apply phase (slot = cmd mod S),
+    # advanced as a carry-resident observer in every engine — bit-neutral
+    # to the protocol state, exactly like the recorder/monitor. S = 0
+    # (default) compiles the subsystem OUT: the pre-§20 program,
+    # bit-identical (the migration-equality contract every dimension
+    # follows).
+    serve_slots: int = 0
+    # Apply-phase budget: at most apply_chunk committed entries fold into
+    # the KV store per group per tick (fixed iteration count — the same
+    # bounded-progress shape as §15 compact_chunk).
+    apply_chunk: int = 4
+    # Log-free linearizable reads (Raft §6.4 / §8): read_batch reads per
+    # group per tick when no bank read channel overrides it; read_path
+    # picks the confirmation rule — "readindex" (commit-frontier
+    # confirmation, served at a live leader: +2 ticks submit→serve) or
+    # "lease" (heartbeat-lease read at an armed leader: +1 tick). The
+    # read path is a routed plan dimension (parallel/autotune.py).
+    read_batch: int = 0
+    read_path: str = "readindex"
+
     seed: int = 0
 
     # Per-group scenario heterogeneity (the fuzzing-farm bank, SEMANTICS.md
@@ -296,7 +352,25 @@ class RaftConfig:
                     f"ring_capacity {self.ring_capacity} must be <= "
                     f"log_capacity {self.log_capacity} (the physical window "
                     "bounds storage, never extends it)")
+        if self.serve_slots < 0:
+            raise ValueError(
+                f"serve_slots must be >= 0, got {self.serve_slots}")
+        if self.serve_slots > 0:
+            if self.apply_chunk < 1:
+                raise ValueError(
+                    f"apply_chunk must be >= 1, got {self.apply_chunk}")
+            if self.read_batch < 0:
+                raise ValueError(
+                    f"read_batch must be >= 0, got {self.read_batch}")
+            if self.read_path not in ("readindex", "lease"):
+                raise ValueError(
+                    f"read_path must be readindex or lease, got "
+                    f"{self.read_path!r}")
         s = self.scenario
+        if s is not None and s.has_clients and self.serve_slots <= 0:
+            raise ValueError(
+                "client-stream channels need serve_slots > 0 — the "
+                "generated commands must have an applied store to land in")
         if s is not None and not s.degenerate:
             if s.delay_windows and not self.delay_lo < self.delay_hi:
                 raise ValueError(
@@ -322,6 +396,14 @@ class RaftConfig:
         exchanges, the end-of-tick fold phase. False (W = 0) compiles the
         bit-identical pre-§15 program — THE migration-equality switch."""
         return self.compact_watermark > 0
+
+    @property
+    def uses_serving(self) -> bool:
+        """Whether the §20 serving path is compiled in: the applied KV
+        store, the read path, the client-latency histograms, and (when the
+        bank carries client channels) the device-resident load generator.
+        False (S = 0) compiles the bit-identical pre-§20 program."""
+        return self.serve_slots > 0
 
     @property
     def known_delivery(self) -> bool:

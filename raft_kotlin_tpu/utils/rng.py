@@ -25,6 +25,13 @@ KIND_RESTART = 4
 KIND_LINK_FAIL = 5
 KIND_LINK_HEAL = 6
 KIND_DELAY = 7
+# §20 serving path (SEMANTICS.md §20): per-tick client draws. CLIENT keys
+# the generated write commands' slot choices, READ keys the read-path key
+# choices — both evaluated via the kernel-twin primitives below (kt_*), so
+# the in-scan generator and a host-eager recompute produce identical bits
+# (the device-generator ≡ host-queue equality theorem).
+KIND_CLIENT = 8
+KIND_READ = 9
 
 # Scenario-bank sampling kinds (SEMANTICS.md §12): one counted-threefry
 # stream per channel, keyed by (farm_seed, channel kind, universe_id) — a
@@ -49,6 +56,11 @@ SCEN_KIND_PART_PHASE = 45
 SCEN_KIND_EL_LO = 46
 SCEN_KIND_EL_HI = 47
 SCEN_KIND_LIFE = 48
+# §20 client-stream channels (the serving path's load-generator shape —
+# per-group writes/tick, reads/tick, and hot-key weight in permille).
+SCEN_KIND_CLIENT_RATE = 49
+SCEN_KIND_CLIENT_READ = 50
+SCEN_KIND_CLIENT_HOT = 51
 
 # Event probabilities live in a 23-bit integer domain: jax's f32 uniform is
 # exactly (bits >> 9) * 2^-23, so `bernoulli(key, p) == (bits(key) >> 9) <
@@ -342,6 +354,17 @@ def sample_scenario_bank(cfg, uids=None) -> dict:
         # the continuous scheduler's heterogeneous-lifetime channel.
         bank["life"] = _scen_draw(fkey, SCEN_KIND_LIFE, uids,
                                   spec.life_lo, spec.life_hi)
+    # §20 client-stream channels (the serving load generator's per-group
+    # workload shape — ops/serving.py reads these rows).
+    if spec.client_rate_max > 0:
+        bank["client_rate"] = _scen_draw(fkey, SCEN_KIND_CLIENT_RATE, uids,
+                                         1, spec.client_rate_max)
+    if spec.client_read_max > 0:
+        bank["client_read"] = _scen_draw(fkey, SCEN_KIND_CLIENT_READ, uids,
+                                         1, spec.client_read_max)
+    if spec.client_hot_max > 0:
+        bank["client_hot"] = _scen_draw(fkey, SCEN_KIND_CLIENT_HOT, uids,
+                                        0, spec.client_hot_max)
     return bank
 
 
@@ -420,6 +443,12 @@ def scen_layout(cfg) -> tuple:
         keys += ["el_lo", "el_hi"]
     if spec.life_hi > 0:
         keys += ["life"]
+    if spec.client_rate_max > 0:
+        keys += ["client_rate"]
+    if spec.client_read_max > 0:
+        keys += ["client_read"]
+    if spec.client_hot_max > 0:
+        keys += ["client_hot"]
     return tuple(keys)
 
 

@@ -1,0 +1,164 @@
+"""§20 serving-path sweep: load rate x key skew x read mix (ISSUE 19).
+
+The serving path (SEMANTICS.md §20) has one routed choice — the log-free
+read confirmation rule, `read_path` — and a workload envelope set by the
+client-stream channels (utils/config.ScenarioSpec: client_rate_max
+writes/tick, client_read_max reads/tick, client_hot_max permille hot-key
+skew). This probe runs a grid of workload points through bench.measure —
+the SAME timing-trap-hardened harness the bench serving leg uses
+(bench.serving_runner: distinct per-rep rng operands, in-region host
+materialization, medians) — under BOTH read paths, and emits per point:
+
+- applied-command and served-read wall throughput of the median rep;
+- the submit->commit and read latency percentiles from the
+  carry-resident histograms (p50/p99/p999 in ticks);
+- the applied<=commit verdict (a non-clean point disqualifies its read
+  path from pinning — safety first, throughput second).
+
+--pin rewrites the bench shallow headline tile's entry in the unified
+TUNING_TABLE (parallel/autotune.shallow_key) with the winning read path
+in the plan's `read_path` dimension (the winner must be clean at EVERY
+probed point; ties prefer "readindex", the conservative confirmation
+round). Refused on CPU: interpreter timings cannot pin a hardware table
+(and the CPU guard pins "readindex" anyway — parallel/autotune.
+apply_guards).
+
+  python scripts/probe_serving.py [groups] [ticks] [--pin]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_compilation_cache_dir", os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+# (label, client_rate_max, client_read_max, client_hot_max) — the sweep:
+# a light and a heavy write rate, a read-heavy mix, and a skewed-key
+# point (900 permille of traffic on the hot slot).
+POINTS = (
+    ("base", 1, 2, 0),
+    ("write-heavy", 4, 2, 0),
+    ("read-heavy", 1, 8, 0),
+    ("skewed", 2, 4, 900),
+)
+
+
+def pin_table(cfg, read_path: str, source: str) -> None:
+    """Pin the bench shallow headline tile's entry with the winning read
+    path — the full routed plan is re-resolved so the row stays
+    internally consistent (the r13 pin convention every probe follows)."""
+    from raft_kotlin_tpu.parallel import autotune
+
+    plan = dict(autotune.plan_for(cfg, telemetry=True, monitor=True))
+    plan["read_path"] = read_path
+    key = autotune.shallow_key(plan.get("tile") or cfg.n_groups,
+                               platform="tpu", dtype=cfg.log_dtype,
+                               mailbox=cfg.uses_mailbox)
+    by_key = {autotune.canonical_key(e["key"]): dict(e)
+              for e in autotune.TUNING_TABLE}
+    by_key[autotune.canonical_key(key)] = {
+        "key": key, "plan": plan, "provenance": {"source": source}}
+    autotune.pin_entries(list(by_key.values()))
+
+
+def main():
+    import bench
+    from raft_kotlin_tpu.ops import serving as serving_mod
+    from raft_kotlin_tpu.utils.config import RaftConfig, ScenarioSpec
+
+    args = [a for a in sys.argv[1:] if a != "--pin"]
+    do_pin = "--pin" in sys.argv[1:]
+    on_accel = jax.default_backend() != "cpu"
+    groups = int(args[0]) if len(args) > 0 else (4_096 if on_accel else 64)
+    ticks = int(args[1]) if len(args) > 1 else (400 if on_accel else 80)
+    reps = int(os.environ.get("RAFT_PROBE_REPS", 3 if on_accel else 1))
+
+    results = {}
+    for read_path in ("readindex", "lease"):
+        rows = {}
+        for label, rate, reads, hot in POINTS:
+            cfg = RaftConfig(
+                n_groups=groups, n_nodes=3, log_capacity=64, seed=11,
+                cmd_period=3, p_drop=0.15, serve_slots=8, apply_chunk=2,
+                read_batch=2, read_path=read_path,
+                scenario=ScenarioSpec(farm_seed=11, client_rate_max=rate,
+                                      client_read_max=reads,
+                                      client_hot_max=hot),
+            ).stressed(10)
+            point = {"client_rate_max": rate, "client_read_max": reads,
+                     "client_hot_max": hot}
+            try:
+                ts, stats, _impl = bench.measure(
+                    cfg, ticks, reps, bench.serving_candidates)
+                best = bench.median(ts)
+                sst = stats[ts.index(best)]
+                point.update({
+                    "client_commands_per_sec": round(
+                        sst["srv_applied_total"] / best, 1),
+                    "reads_per_sec": round(sst["srv_reads_ok"] / best, 1),
+                    "submit_commit_p50": sst["submit_commit_p50"],
+                    "submit_commit_p99": sst["submit_commit_p99"],
+                    "submit_commit_p999": sst["submit_commit_p999"],
+                    "read_p50": sst["read_p50"],
+                    "read_p99": sst["read_p99"],
+                    "read_p999": sst["read_p999"],
+                    "status": serving_mod.serving_status(sst),
+                    "rep_times_s": [round(t, 4) for t in ts],
+                })
+            except Exception as e:
+                point["error"] = str(e)[:160]
+            rows[label] = point
+        results[read_path] = rows
+
+    def clean_reads(path):
+        rows = results[path]
+        if any("error" in p or p.get("status") != "clean"
+               for p in rows.values()):
+            return None
+        return sum(p["reads_per_sec"] for p in rows.values())
+
+    ri, le = clean_reads("readindex"), clean_reads("lease")
+    # Ties (and any non-clean lease point) keep the conservative
+    # confirmation round — lease must EARN its shorter path.
+    winner = None
+    if ri is not None:
+        winner = "lease" if (le is not None and le > ri) else "readindex"
+    record = {
+        "probe": "serving",
+        "platform": jax.devices()[0].platform,
+        "groups": groups,
+        "ticks": ticks,
+        "readindex": results["readindex"],
+        "lease": results["lease"],
+        "winner": winner,
+        "pinned": False,
+    }
+    if do_pin and winner:
+        if not on_accel:
+            print("--pin refused: CPU interpreter timings cannot pin a "
+                  "hardware table", file=sys.stderr)
+        else:
+            bench_cfg = RaftConfig(
+                n_groups=groups, n_nodes=5, log_capacity=32, cmd_period=10,
+                p_drop=0.25, p_crash=0.01, p_restart=0.08,
+                p_link_fail=0.02, p_link_heal=0.08, seed=0).stressed(10)
+            src = (f"probe_serving {time.strftime('%Y-%m-%d')}: {winner} "
+                   f"wins ({le} vs {ri} reads/s readindex, G={groups}, "
+                   f"clean at all {len(POINTS)} points)")
+            pin_table(bench_cfg, winner, src)
+            record["pinned"] = True
+    print(json.dumps(record), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -103,6 +103,12 @@ INV_LEGS = (
     # gates exactly like the static fuzz batch (the artifact coordinate
     # is in that run's stderr; replay = rerun the deterministic farm).
     ("continuous_inv_status", "continuous inv", "suspect"),
+    # r20 (ISSUE 19): the §20 serving leg — a non-clean verdict means
+    # the applied frontier overtook the commit frontier in some group
+    # (applied-ahead@t<tick>): the one state-machine-safety property the
+    # apply fold adds on top of Figure 3, gated exactly like the
+    # protocol legs.
+    ("serving_inv_status", "serving inv", "suspect"),
 )
 
 # Boolean audit fields (r13): pod_dryrun marks the virtual-device
@@ -211,7 +217,16 @@ def load_record(path: str) -> Optional[dict]:
                   # baseline it beats, the retire/admit rate and the §9.3
                   # histogram occupancy (trajectory evidence only).
                   "farm_util", "static_farm_util",
-                  "universe_retire_per_sec", "timing_hist_nonzero"):
+                  "universe_retire_per_sec", "timing_hist_nonzero",
+                  # r20 (ISSUE 19): the §20 serving-leg figures —
+                  # applied-command and served-read wall throughput
+                  # (higher is better; the regression gate,
+                  # check_serving), the submit->commit latency
+                  # percentiles and the apply-phase byte model
+                  # (trajectory evidence).
+                  "client_commands_per_sec", "reads_per_sec",
+                  "apply_bytes_per_tick", "submit_commit_p50",
+                  "submit_commit_p99", "submit_commit_p999"):
         v = parsed.get(field)
         if not isinstance(v, (int, float)):
             v = _extract_field(tail, field)
@@ -237,6 +252,10 @@ def load_record(path: str) -> Optional[dict]:
         # The continuous-scheduler utilization gate (ISSUE 17) vets the
         # same way — it arms once the first vetted continuous round lands.
         vetted["farm_util"] = gate_value("suspect")
+    if "client_commands_per_sec" in aux_num:
+        # The serving-throughput gate (ISSUE 19) vets the same way — it
+        # arms once the first vetted serving round lands.
+        vetted["client_commands_per_sec"] = gate_value("suspect")
     aux_str: Dict[str, str] = {}
     for field in ("aux_source", "compute"):
         v = parsed.get(field)
@@ -484,6 +503,36 @@ def check_farm_util(recs: List[dict],
     return []
 
 
+def check_serving(recs: List[dict],
+                  tol: float = REGRESSION_TOL) -> List[Tuple[str, float,
+                                                             float]]:
+    """[(label, latest, best prior)] when the LATEST round's serving
+    throughput (client_commands_per_sec; ISSUE 19) FELL more than `tol`
+    below the best (highest) prior VETTED round that published it: the
+    §20 serving leg runs a pinned config (groups/pacing/slots fixed by
+    env defaults), so a drop means the apply fold, the device generator
+    or the read gating got slower — or commits themselves regressed
+    under client load. HIGHER-is-better like check_farm_util. Arms
+    itself only once a vetted serving round lands; earlier rounds are
+    skipped, never guessed."""
+    if len(recs) < 2:
+        return []
+    latest = recs[-1]
+    cur = latest.get("aux_num", {}).get("client_commands_per_sec")
+    if cur is None:
+        return []
+    prior = [(r["aux_num"]["client_commands_per_sec"], r["round"])
+             for r in recs[:-1]
+             if "client_commands_per_sec" in r.get("aux_num", {})
+             and r["vetted"].get("client_commands_per_sec")]
+    if not prior:
+        return []
+    best, best_round = max(prior)
+    if cur < (1.0 - tol) * best:
+        return [("serving cmds/s", cur, best)]
+    return []
+
+
 def check_violations(recs: List[dict]) -> List[Tuple[str, str]]:
     """[(leg label, verdict)] for every vetted invariant leg of the LATEST
     round whose verdict is not "clean" — the safety gate (ISSUE 6)."""
@@ -549,7 +598,18 @@ def main(argv=None) -> int:
             # drop; the static drain-tail model rides alongside as the
             # baseline it must keep beating).
             ("farm_util", "farm util", "farm_util", ",.3f"),
-            ("static_farm_util", "static farm util", "farm_util", ",.3f")):
+            ("static_farm_util", "static farm util", "farm_util", ",.3f"),
+            # r20 (ISSUE 19): the §20 serving trajectory — applied-
+            # command and served-read wall throughput (HIGHER is better;
+            # check_serving gates the command rate) and the
+            # submit->commit p99 in ticks (latency evidence, not gated:
+            # it is a property of the pinned fault mix, not the code).
+            ("client_commands_per_sec", "serving cmds/s",
+             "client_commands_per_sec", ",.1f"),
+            ("reads_per_sec", "serving reads/s",
+             "client_commands_per_sec", ",.1f"),
+            ("submit_commit_p99", "submit-commit p99",
+             "client_commands_per_sec", ",.0f")):
         if not any(field in r.get("aux_num", {}) for r in recs):
             continue
         row = [label.ljust(18)]
@@ -632,6 +692,13 @@ def main(argv=None) -> int:
               "lanes are idling again (the §19 retirement predicate or "
               "the admission loop in api/fuzz.continuous_farm)",
               file=sys.stderr)
+    serving_fails = check_serving(recs)
+    for label, cur, best in serving_fails:
+        print(f"SERVING THROUGHPUT REGRESSION: {label} r{latest:02d} = "
+              f"{cur:,.1f} is {100 * (1 - cur / best):.1f}% below the best "
+              f"prior vetted serving round ({best:,.1f}) — the §20 apply "
+              "fold, device generator or read gating got slower at the "
+              "pinned serving config (ops/serving.py)", file=sys.stderr)
     for field, _v in check_tuning_drift(recs):
         print(f"WARNING: tuning-table drift — r{latest:02d} {field} is "
               "false (the unified TUNING_TABLE disagrees with this "
@@ -649,7 +716,7 @@ def main(argv=None) -> int:
         print(f"WARNING: {f} latched '{v}' on an UNVETTED (suspect) leg — "
               "not gating, but not clean either", file=sys.stderr)
     if (regs or viols or pod_fails or byte_fails or ring_fails or aux_fails
-            or compute_fails or util_fails):
+            or compute_fails or util_fails or serving_fails):
         return 1
     clean_legs = sum(1 for f, v in latest_rec.get("inv", {}).items()
                      if v == "clean" and latest_rec["vetted"].get(f))

@@ -236,7 +236,8 @@ def test_plan_for_composition(no_cache):
     assert plan == {"engine": "flat", "ilp_subtiles": 1, "fused_ticks": 1,
                     "layout": "wide", "compaction": "off",
                     "sharding": "single", "tile": None,
-                    "aux_source": "staged", "compute": "unpacked"}
+                    "aux_source": "staged", "compute": "unpacked",
+                    "read_path": "readindex"}
     # τ=0 mailbox deep: flat is the ONLY valid engine — the caller-level
     # rule overrides any table entry (plan_for composes it in).
     mcfg = RaftConfig(n_groups=8, n_nodes=3, log_capacity=512, mailbox=True,

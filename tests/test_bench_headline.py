@@ -75,6 +75,13 @@ def _fake_record():
         "universe_retire_per_sec": 312.4,
         "timing_hist_nonzero": 41,
         "continuous_inv_status": "clean",
+        "client_commands_per_sec": 4182.3,
+        "reads_per_sec": 46_920.0,
+        "apply_bytes_per_tick": 21_504,
+        "submit_commit_p50": 26,
+        "submit_commit_p99": 45,
+        "submit_commit_p999": 48,
+        "serving_inv_status": "clean",
         "suspect": False,
         # plus the long tail of fields that overflowed the driver window
         **{f"filler_{i}": [0.1234] * 8 for i in range(80)},
@@ -181,15 +188,27 @@ def test_compact_headline_is_last_line_and_complete():
     for k in ("farm_util", "static_farm_util", "universe_retire_per_sec",
               "timing_hist_nonzero", "continuous_inv_status"):
         assert k in bench.COMPACT_EXTRA_FIELDS, k
+    # The r20 additions (ISSUE 19): the §20 serving leg's applied-command
+    # and served-read wall throughput, the submit->commit latency
+    # percentiles from the carry-resident histograms, the apply-phase
+    # byte model and the applied<=commit verdict — the round's
+    # acceptance gate (fields present, clean verdict) and
+    # summarize_bench's serving trajectory/regression rows read them
+    # from the authoritative tail.
+    for k in ("client_commands_per_sec", "reads_per_sec",
+              "apply_bytes_per_tick", "submit_commit_p50",
+              "submit_commit_p99", "submit_commit_p999",
+              "serving_inv_status"):
+        assert k in bench.COMPACT_EXTRA_FIELDS, k
     for k in bench.COMPACT_EXTRA_FIELDS:
         assert k in last, k
         assert last[k] == record[k], k
     # Small enough that the driver's tail window always captures it whole
     # (the r15 compaction fields grew the line past the old 1200 bound,
-    # the r18 compute fields past 1500; a violation status is ~30 chars
-    # longer per leg than "clean", so keep generous headroom under the
-    # multi-KB driver window).
-    assert len(lines[-1]) < 1800, lines[-1]
+    # the r18 compute fields past 1500, the r20 serving fields past 1800;
+    # a violation status is ~30 chars longer per leg than "clean", so
+    # keep generous headroom under the multi-KB driver window).
+    assert len(lines[-1]) < 2100, lines[-1]
 
 
 def test_compact_headline_handles_missing_fields():

@@ -223,7 +223,7 @@ def test_plan_layer_compaction_dimension():
                       "fused_ticks": 1, "layout": "wide",
                       "compaction": "ring", "sharding": "single",
                       "tile": None, "aux_source": "staged",
-                      "compute": "unpacked"}
+                      "compute": "unpacked", "read_path": "readindex"}
     assert plan_for(_off(deep), platform="tpu")["compaction"] == "off"
 
 

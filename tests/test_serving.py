@@ -1,0 +1,421 @@
+"""§20 serving path (SEMANTICS.md §20, ISSUE 19): the applied KV state
+machine, the device-resident client generator and the log-free read
+channel must agree bit-for-bit across every engine and against two
+independent host twins.
+
+Theorems covered (each a distinct failure surface):
+
+- XLA trace recompute: the device serving carry equals serving.
+  fold_from_trace run over the (T, N, G) commit/role/up traces + end log
+  — the §19 recomputability contract extended to §20.
+- Device generator ≡ host queue: make_run(serving_gen=True) equals
+  make_queued_run fed by serving.host_stream — the same kt-twin draws
+  evaluated in-scan vs eagerly on the host.
+- Pallas megakernel parity (interpret mode): the flat-carry serving step
+  (T=1 and the fused-T snapshot replay) equals the XLA scan.
+- Deep fcache parity: make_deep_scan(serving=True) equals the XLA run on
+  a deep (C=256) config, in both return_state and reduction modes.
+- Sharded bit-equality: make_sharded_run(serving=True) on the 8-virtual-
+  device mesh equals the single-device run on every carry key INCLUDING
+  the latency histograms (cross-device sums of lane-sharded counts).
+- OracleServing twin: the plain-Python per-node oracle reproduces the
+  vectorized carry exactly — no trace, covers fault runs.
+- Checkpoint v9: the serving carry survives save/load on the single-file,
+  packed-layout and sharded paths; serving-off saves load as zero-fill.
+- Read gating: read-index reads are served only under a visible leader
+  (queued reads flush with aged latency); the lease path serves at its
+  shorter confirmation latency.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from raft_kotlin_tpu.models.state import init_state
+from raft_kotlin_tpu.ops import serving as serving_mod
+from raft_kotlin_tpu.ops.serving import (
+    READ_L0,
+    SERVING_KEYS,
+    fold_from_trace,
+    hist_percentile,
+    host_stream,
+    make_queued_run,
+)
+from raft_kotlin_tpu.ops.tick import make_rng, make_run
+from raft_kotlin_tpu.utils.config import RaftConfig, ScenarioSpec
+
+
+def srv_cfg(**kw):
+    """The known-good serving base config (p_drop > 0 so commits flow)."""
+    base = dict(n_groups=8, n_nodes=3, log_capacity=64, seed=11,
+                cmd_period=3, p_drop=0.15, serve_slots=8, apply_chunk=2,
+                read_batch=2)
+    base.update(kw)
+    return RaftConfig(**base).stressed(10)
+
+
+def assert_serving_equal(a, b, keys=SERVING_KEYS):
+    """Bit-equality over serving carries (device dicts or numpy dicts)."""
+    for k in keys:
+        av = np.asarray(jax.device_get(a[k]), np.int64)
+        bv = np.asarray(jax.device_get(b[k]), np.int64)
+        assert np.array_equal(av, bv), (k, av, bv)
+
+
+def run_serving(cfg, n_ticks, **kw):
+    out = make_run(cfg, n_ticks, serving=True, **kw)(init_state(cfg))
+    return out[0], out[1], out[-1]  # (end, ys, srv)
+
+
+# ---------------------------------------------------------------------------
+# Host recomputation (the §19 contract extended to §20).
+
+
+def test_xla_serving_matches_trace_recompute():
+    cfg = srv_cfg()
+    T = 120
+    end, tr, srv = run_serving(cfg, T, trace=True)
+    ref = fold_from_trace(
+        cfg,
+        np.asarray(jax.device_get(tr["commit"])),
+        np.asarray(jax.device_get(end.log_cmd)),
+        role_tr=np.asarray(jax.device_get(tr["role"])),
+        up_tr=np.asarray(jax.device_get(tr["up"])),
+    )
+    assert_serving_equal(srv, ref, keys=tuple(ref))
+    # The run actually exercised the path (not a vacuous zero-equality).
+    assert int(ref["applied_total"]) > 0 and int(ref["reads_ok"]) > 0
+    assert serving_mod.summarize_serving(srv)["status"] == "clean"
+
+
+def test_trace_recompute_with_scenario_channels():
+    # Client channels perturb read batch + hot skew; the fold must follow
+    # the same scenario bank the device drew from.
+    cfg = srv_cfg(scenario=ScenarioSpec(farm_seed=11, client_rate_max=2,
+                                        client_read_max=4,
+                                        client_hot_max=700))
+    from raft_kotlin_tpu.utils import rng as rngmod
+
+    T = 90
+    scen = rngmod.sample_scenario_bank(cfg)
+    end, tr, srv = run_serving(cfg, T, trace=True)
+    ref = fold_from_trace(
+        cfg,
+        np.asarray(jax.device_get(tr["commit"])),
+        np.asarray(jax.device_get(end.log_cmd)),
+        role_tr=np.asarray(jax.device_get(tr["role"])),
+        up_tr=np.asarray(jax.device_get(tr["up"])),
+        scen=scen,
+    )
+    assert_serving_equal(srv, ref, keys=tuple(ref))
+    assert int(ref["reads_ok"]) > 0
+
+
+# ---------------------------------------------------------------------------
+# Device generator ≡ host-fed queue.
+
+
+def test_device_gen_matches_host_queue():
+    cfg = srv_cfg(scenario=ScenarioSpec(farm_seed=11, client_rate_max=2,
+                                        client_read_max=3,
+                                        client_hot_max=400))
+    from raft_kotlin_tpu.utils import rng as rngmod
+
+    T = 80
+    end_d, _ys, srv_d = run_serving(cfg, T, trace=False, serving_gen=True)
+
+    # The same scenario bank the device unpacks from its rng operand —
+    # the host twin must draw per-group client rates from it too.
+    stream = host_stream(cfg, T, scen=rngmod.sample_scenario_bank(cfg))
+    assert stream.shape == (T, cfg.n_groups, cfg.n_nodes)
+
+    def fill(t0, n):
+        return stream[t0:t0 + n]
+
+    end_q, srv_q, stats = make_queued_run(cfg, T, chunk=16)(
+        init_state(cfg), fill)
+    assert_serving_equal(srv_d, srv_q)
+    assert np.array_equal(np.asarray(jax.device_get(end_d.log_cmd)),
+                          np.asarray(jax.device_get(end_q.log_cmd)))
+    assert 0.0 <= stats["fill_hidden_frac"] <= 1.0
+    assert int(jax.device_get(srv_d["applied_total"])) > 0
+
+
+def test_gen_inject_host_device_bit_equal():
+    # The generator itself, in-jit vs eager: same (G, N) planes per tick.
+    cfg = srv_cfg(scenario=ScenarioSpec(farm_seed=11, client_rate_max=3))
+    from raft_kotlin_tpu.utils import rng as rngmod
+
+    kw = rngmod.kt_key_words(rngmod.base_key(cfg.seed))
+    scen = rngmod.sample_scenario_bank(cfg)
+
+    @jax.jit
+    def dev(t):
+        return serving_mod.gen_inject(cfg, kw[0], kw[1], t, scen=scen)
+
+    for t in (0, 1, 7, 63):
+        a = np.asarray(jax.device_get(dev(jnp.asarray(t, jnp.int32))))
+        b = np.asarray(jax.device_get(
+            serving_mod.gen_inject(cfg, kw[0], kw[1], t, scen=scen)))
+        assert np.array_equal(a, b), t
+        # Command value IS the submit tick (the latency identity).
+        assert set(np.unique(a)) <= {-1, t}
+
+
+# ---------------------------------------------------------------------------
+# Engine parity: Pallas megakernel, deep fcache, sharded mesh.
+
+
+@pytest.mark.slow
+def test_pallas_serving_matches_xla():
+    from raft_kotlin_tpu.ops.pallas_tick import make_pallas_scan
+
+    cfg = srv_cfg(log_capacity=16)
+    T = 40
+    _end_x, _ys, srv_x = run_serving(cfg, T, trace=False)
+    end_p, srv_p = make_pallas_scan(cfg, T, interpret=True, serving=True)(
+        init_state(cfg), make_rng(cfg))
+    assert_serving_equal(srv_x, srv_p)
+    assert int(jax.device_get(srv_p["applied_total"])) > 0
+
+
+@pytest.mark.slow
+def test_pallas_fused_serving_matches_xla():
+    # Fused-T launches replay serving over the per-tick snapshots — the
+    # carry must not skip ticks.
+    from raft_kotlin_tpu.ops.pallas_tick import make_pallas_scan
+
+    cfg = srv_cfg(log_capacity=16)
+    T = 24
+    _end_x, _ys, srv_x = run_serving(cfg, T, trace=False)
+    end_f, srv_f = make_pallas_scan(cfg, T, interpret=True, serving=True,
+                                    fused_ticks=4)(
+        init_state(cfg), make_rng(cfg))
+    assert_serving_equal(srv_x, srv_f)
+
+
+@pytest.mark.slow
+def test_deep_serving_matches_xla():
+    from raft_kotlin_tpu.ops import deep_cache
+
+    cfg = srv_cfg(n_groups=4, log_capacity=256, cmd_period=3, p_drop=0.2,
+                  seed=41)
+    T = 40
+    rng = make_rng(cfg)
+    end_x, _ys, srv_x = run_serving(cfg, T, trace=False, rng=rng)
+    end_d, _ov, srv_d = deep_cache.make_deep_scan(
+        cfg, T, return_state=True, serving=True)(init_state(cfg), rng)
+    assert_serving_equal(srv_x, srv_d)
+    assert np.array_equal(np.asarray(jax.device_get(end_x.log_cmd)),
+                          np.asarray(jax.device_get(end_d.log_cmd)))
+    # Reduction mode merges the scalar serving keys into the dict.
+    vals = deep_cache.make_deep_scan(cfg, T, serving=True)(
+        init_state(cfg), rng)
+    assert int(vals["srv_applied_total"]) == int(
+        jax.device_get(srv_x["applied_total"]))
+    assert int(vals["srv_reads_ok"]) == int(
+        jax.device_get(srv_x["reads_ok"]))
+
+
+def test_sharded_serving_bit_equal():
+    from raft_kotlin_tpu.parallel.mesh import (
+        init_sharded, make_mesh, make_sharded_run)
+
+    mesh = make_mesh()
+    cfg = srv_cfg(n_groups=16, log_capacity=16)
+    T = 80
+    _ref_end, _ys, srv_ref = run_serving(cfg, T, trace=False)
+    sh_end, _metrics, srv_sh = make_sharded_run(cfg, mesh, T, serving=True)(
+        init_sharded(cfg, mesh))
+    # EVERY key — including the histograms, which cross devices as sums
+    # of lane-sharded counts (the ISSUE 19 acceptance criterion).
+    assert_serving_equal(srv_ref, srv_sh)
+    assert int(jax.device_get(srv_sh["applied_total"])) > 0
+    assert int(jax.device_get(jnp.sum(srv_sh["hist_commit"]))) == \
+        int(jax.device_get(srv_sh["applied_total"]))
+
+
+# ---------------------------------------------------------------------------
+# The plain-Python oracle twin (no trace needed — covers fault runs).
+# Slow tier: the scalar per-tick loop is the heaviest test in this file and
+# fold_from_trace exactness already pins the device carry in the fast tier.
+
+
+@pytest.mark.slow
+def test_oracle_serving_twin():
+    from raft_kotlin_tpu.models.oracle import (
+        OracleGroup, OracleServing, make_edge_ok_fn, make_faults_fn,
+        predraw)
+
+    cfg = srv_cfg()
+    T = 120
+    _end, _ys, srv = run_serving(cfg, T, trace=False)
+
+    draws = predraw(cfg)
+    grps = [OracleGroup(cfg, group=g, draws=draws[g])
+            for g in range(cfg.n_groups)]
+    eo = [make_edge_ok_fn(cfg, g) for g in range(cfg.n_groups)]
+    ff = [make_faults_fn(cfg, g) for g in range(cfg.n_groups)]
+    tw = OracleServing(cfg)
+    for t in range(T):
+        for g, grp in enumerate(grps):
+            grp.tick(eo[g](t) if eo[g] else None,
+                     ff[g](t) if ff[g] else None)
+        tw.step(grps)
+    snap = tw.snapshot()
+    assert_serving_equal(srv, snap)
+    assert snap["viol_tick"] == -1
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint v9.
+
+
+def test_checkpoint_v9_roundtrip(tmp_path):
+    from raft_kotlin_tpu.utils import checkpoint as ck
+
+    cfg = srv_cfg()
+    end, _ys, srv = run_serving(cfg, 60, trace=False)
+    p = str(tmp_path / "v9.npz")
+    ck.save(p, end, cfg, serving=srv)
+    srv2 = ck.load_serving(p)
+    assert srv2 is not None
+    assert_serving_equal(srv, srv2)
+    st2, cfg2 = ck.load(p)
+    assert np.array_equal(np.asarray(jax.device_get(end.log_cmd)),
+                          np.asarray(st2.log_cmd))
+
+    # Serving-off save of a serving config: loads as the zero carry (the
+    # migration-equality contract — old checkpoints keep loading).
+    p0 = str(tmp_path / "v9_off.npz")
+    ck.save(p0, end, cfg)
+    srv0 = ck.load_serving(p0)
+    assert srv0 is not None
+    assert int(srv0["tick"]) == 0 and int(srv0["applied_total"]) == 0
+
+    # Non-serving config: the channel stays absent entirely.
+    cfg_ns = RaftConfig(n_groups=4, n_nodes=3, log_capacity=8,
+                        seed=3).stressed(10)
+    end_ns, _ = make_run(cfg_ns, 10, trace=False)(init_state(cfg_ns))
+    pn = str(tmp_path / "v9_ns.npz")
+    ck.save(pn, end_ns, cfg_ns)
+    assert ck.load_serving(pn) is None
+
+
+def test_checkpoint_v9_packed_layout(tmp_path):
+    from raft_kotlin_tpu.models.state import pack_state
+    from raft_kotlin_tpu.utils import checkpoint as ck
+
+    cfg = srv_cfg()
+    end, _ys, srv = run_serving(cfg, 60, trace=False)
+    p = str(tmp_path / "v9_packed.npz")
+    ck.save(p, pack_state(cfg, end), cfg, serving=srv)
+    srv2 = ck.load_serving(p)
+    assert_serving_equal(srv, srv2)
+    st2, _cfg2 = ck.load(p, layout="packed")
+    assert np.array_equal(np.asarray(jax.device_get(end.log_cmd)),
+                          np.asarray(st2.log_cmd))
+
+
+def test_checkpoint_v9_sharded(tmp_path):
+    from raft_kotlin_tpu.parallel.mesh import (
+        init_sharded, make_mesh, make_sharded_run)
+    from raft_kotlin_tpu.utils import checkpoint as ck
+
+    mesh = make_mesh()
+    cfg = srv_cfg(n_groups=16, log_capacity=16)
+    sh_end, _metrics, srv_sh = make_sharded_run(cfg, mesh, 40, serving=True)(
+        init_sharded(cfg, mesh))
+    d = str(tmp_path / "v9_sharded")
+    ck.save_sharded(d, sh_end, cfg, serving=srv_sh)
+    srv2 = ck.load_serving(d)
+    assert_serving_equal(srv_sh, srv2)
+    st2, _cfg2 = ck.load_sharded(d, mesh=mesh)
+    assert np.array_equal(np.asarray(jax.device_get(sh_end.log_cmd)),
+                          np.asarray(jax.device_get(st2.log_cmd)))
+    # Sharded serving-off save: zero-fill on load, same as single-file.
+    d0 = str(tmp_path / "v9_sharded_off")
+    ck.save_sharded(d0, sh_end, cfg)
+    srv0 = ck.load_serving(d0)
+    assert srv0 is not None and int(srv0["applied_total"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# Read gating semantics.
+
+
+def test_readindex_gates_reads_under_churn():
+    # Link churn (no crashes): per-node commits stay monotone, so the
+    # frontier never regresses and the latch must stay clean while
+    # leadership still comes and goes.
+    cfg = srv_cfg(p_link_fail=0.05, p_link_heal=0.1)
+    T = 150
+    end, tr, srv = run_serving(cfg, T, trace=True)
+    s = {k: np.asarray(jax.device_get(v)) for k, v in srv.items()}
+    assert int(s["viol_tick"]) == -1 and not s["serve_viol"].any()
+    assert int(s["reads_ok"]) > 0
+    L0 = READ_L0["readindex"]
+    hist = s["hist_read"]
+    # No read ever reports below the confirmation-round floor...
+    assert hist[:L0].sum() == 0
+    # ...and under crash churn some groups were leaderless on some ticks,
+    # so queued reads flushed with AGED latency (> L0 bins occupied).
+    leaderless = (~(((jax.device_get(tr["role"]) == 2)
+                     & (jax.device_get(tr["up"]) != 0)).any(axis=1))).sum()
+    assert leaderless > 0
+    assert hist[L0 + 1:].sum() > 0
+    # Exactness under churn too: the fold follows the same gating.
+    ref = fold_from_trace(
+        cfg,
+        np.asarray(jax.device_get(tr["commit"])),
+        np.asarray(jax.device_get(end.log_cmd)),
+        role_tr=np.asarray(jax.device_get(tr["role"])),
+        up_tr=np.asarray(jax.device_get(tr["up"])),
+    )
+    assert_serving_equal(srv, ref, keys=tuple(ref))
+
+
+def test_viol_latch_trips_on_crash_regression():
+    # The reference persists NOTHING (§9 quirk: restart zeroes commit),
+    # so when the frontier holder crashes the group's visible frontier
+    # CAN regress below the apply cursor — exactly the applied-ahead
+    # state the sticky latch exists to flag. A crashy run must trip it
+    # with a recorded first-violation tick, and the status string must
+    # surface it (the bench serving leg gates on this).
+    cfg = srv_cfg(p_crash=0.03, p_restart=0.1)
+    _end, _ys, srv = run_serving(cfg, 150, trace=False)
+    s = {k: np.asarray(jax.device_get(v)) for k, v in srv.items()}
+    assert int(s["viol_tick"]) >= 0 and s["serve_viol"].any()
+    status = serving_mod.summarize_serving(srv)["status"]
+    assert status == f"applied-ahead@t{int(s['viol_tick'])}"
+
+
+def test_lease_read_path():
+    cfg = srv_cfg(read_path="lease")
+    T = 120
+    _end, _ys, srv = run_serving(cfg, T, trace=False)
+    s = {k: np.asarray(jax.device_get(v)) for k, v in srv.items()}
+    assert int(s["viol_tick"]) == -1
+    assert int(s["reads_ok"]) > 0
+    # Lease serves at its shorter confirmation latency: bin L0=1 carries
+    # the unqueued reads, nothing below it.
+    assert s["hist_read"][0] == 0 and s["hist_read"][1] > 0
+    # Against the same workload, lease never serves MORE reads than
+    # read-index allows at +1 tick of latency budget — it is the stricter
+    # gate (leader AND armed lease vs leader alone).
+    _e2, _y2, srv_ri = run_serving(srv_cfg(), T, trace=False)
+    assert int(s["reads_ok"]) <= int(
+        jax.device_get(srv_ri["reads_ok"]))
+
+
+def test_hist_percentile():
+    h = np.zeros(64, np.int64)
+    h[2] = 50
+    h[10] = 49
+    h[63] = 1
+    assert hist_percentile(h, 0.50) == 2
+    assert hist_percentile(h, 0.99) == 10
+    assert hist_percentile(h, 0.999) == 63
+    assert hist_percentile(np.zeros(64, np.int64), 0.99) == 0
