@@ -340,7 +340,18 @@ COMPACT_EXTRA_FIELDS = ("deeplog_parity_rate", "deeplog_ov_fallback",
                         "client_commands_per_sec", "reads_per_sec",
                         "apply_bytes_per_tick", "submit_commit_p50",
                         "submit_commit_p99", "submit_commit_p999",
-                        "serving_inv_status")
+                        "serving_inv_status",
+                        # r21 (ISSUE 20): the §21 streaming ops plane —
+                        # the continuous leg's SLO verdict (clean /
+                        # breach:<dim>@seg<k>, gated like every
+                        # inv_status), proof the series ring sampled
+                        # (nonzero decoded cells), the loud event-ring
+                        # drop counter, and the measured rings-on vs
+                        # rings-off overhead fraction — summarize_bench's
+                        # SLO row and ops-overhead trajectory row read
+                        # these from the authoritative tail.
+                        "slo_status", "series_ring_nonzero",
+                        "events_dropped", "ops_overhead_frac")
 
 # Flight-recorder counters published verbatim from the headline run's
 # median rep (stats tel_* keys — utils/telemetry.TELEMETRY_FIELDS).
@@ -421,8 +432,12 @@ def scan_runner(tick_fn, telemetry: bool = False, monitor: bool = False,
                 nxt = pack_state(cfg, s2, ov=s.ov) if packed else s2
                 return (nxt, acc, tel, mon), None
             tel0 = telemetry_mod.telemetry_zeros() if telemetry else None
+            # §21: a cfg carrying series_windows/event_capacity threads
+            # the ops-plane rings through the TIMED monitor carry — the
+            # probe_telemetry overhead leg measures exactly this shape.
             mon0 = telemetry_mod.monitor_init(
-                st.term.shape[-1], n_ticks, monitor)
+                st.term.shape[-1], n_ticks, monitor,
+                **telemetry_mod.ops_kw(cfg))
             (end, acc, tel, mon), _ = jax.lax.scan(
                 body, (st, jnp.zeros((), jnp.int32), tel0, mon0), None,
                 length=n_ticks)
@@ -1843,19 +1858,51 @@ def main() -> None:
     continuous_universe_ticks = None
     continuous_universes_retired = None
     continuous_corpus = None
+    slo_status = None
+    series_ring_nonzero = None
+    events_dropped = None
+    ops_overhead_frac = None
     try:
         from raft_kotlin_tpu.api import fuzz as fuzz_mod
+        from raft_kotlin_tpu.api import opsplane as opsplane_mod
+        from raft_kotlin_tpu.utils import telemetry as telemetry_mod
         from raft_kotlin_tpu.utils.telemetry import trace_span
 
         cont_g = int(os.environ.get("RAFT_BENCH_CONT_GROUPS", 256))
         cont_t = int(os.environ.get("RAFT_BENCH_CONT_SEGMENT", 10))
         cont_s = int(os.environ.get("RAFT_BENCH_CONT_SEGMENTS", 60))
         cont_cfg = fuzz_mod.continuous_config(cont_g)
+        # r21: rings-OFF timed run first (the pre-§21 carry), then the
+        # SAME farm with the §21 series + event rings and an SLO gate —
+        # identical bits by the observer contract (the corpus hash below
+        # is asserted equal), so the elapsed-time ratio IS the measured
+        # ops-plane overhead on the continuous path.
         with trace_span("bench/continuous"):
             t0 = time.perf_counter()
-            cf = fuzz_mod.continuous_farm(cont_cfg, cont_t, cont_s,
-                                          verbose=False)
+            cf_plain = fuzz_mod.continuous_farm(cont_cfg, cont_t, cont_s,
+                                                verbose=False)
+            plain_elapsed = time.perf_counter() - t0
+        ops_cfg = dataclasses.replace(cont_cfg, series_windows=16,
+                                      event_capacity=512)
+        # Loose operational bounds: wiring proof, not a perf assertion —
+        # a CPU-hosted farm must still come out clean (ROUND21.md).
+        slo = opsplane_mod.SLOSpec(downtime_frac_max=0.98,
+                                   farm_util_min=0.25, budget_frac=0.5)
+        with trace_span("bench/continuous_ops"):
+            t0 = time.perf_counter()
+            cf = fuzz_mod.continuous_farm(ops_cfg, cont_t, cont_s,
+                                          verbose=False, slo=slo)
             cont_elapsed = time.perf_counter() - t0
+        assert cf["corpus_hash"] == cf_plain["corpus_hash"], \
+            "§21 rings changed the farm's bits (corpus hash mismatch)"
+        ops_overhead_frac = round(cont_elapsed / plain_elapsed - 1.0, 4)
+        slo_status = cf["slo_status"]
+        events_dropped = cf["events_dropped"]
+        idents = {name: ident for name, _c, ident
+                  in telemetry_mod.SERIES_CHANNELS}
+        series_ring_nonzero = int(sum(
+            1 for w in (cf["series"] or {}).get("windows", [])
+            for name, v in w.items() if v != idents[name]))
         farm_util = cf["farm_util"]
         static_farm_util = fuzz_mod.static_drain_util(cont_cfg)
         universe_retire_per_sec = cf["universes_retired"] / cont_elapsed
@@ -2270,6 +2317,16 @@ def main() -> None:
         "continuous_universe_ticks": continuous_universe_ticks,
         "continuous_universes_retired": continuous_universes_retired,
         "continuous_corpus_hash": continuous_corpus,
+        # §21 ops plane (ISSUE 20): the continuous leg's SLO verdict
+        # (gated: summarize_bench INV_LEGS by the clean/non-clean
+        # shape), proof the series ring sampled (decoded cells away from
+        # their channel identities), the loud event-ring drop counter,
+        # and the measured rings-on/rings-off elapsed ratio on the
+        # bit-identical farm pair (corpus hashes asserted equal above).
+        "slo_status": slo_status,
+        "series_ring_nonzero": series_ring_nonzero,
+        "events_dropped": events_dropped,
+        "ops_overhead_frac": ops_overhead_frac,
         # Serving leg (ISSUE 19): the §20 serving path — applied-command
         # and served-read wall throughput of the median rep, the
         # submit->commit and read latency percentiles from the

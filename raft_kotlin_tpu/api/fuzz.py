@@ -68,7 +68,9 @@ def _cpu_batched_guard(cfg: RaftConfig) -> Optional[bool]:
 
 
 def _monitor_shardings(mesh, n_groups: int, n_ticks: int,
-                       timing: bool = False, sched: bool = False):
+                       timing: bool = False, sched: bool = False,
+                       series: int = 0, series_stride: int = 0,
+                       events: int = 0):
     """NamedShardings for the RAW per-group monitor carry under `mesh`:
     the (G,)-BY-CONTRACT keys (PER_GROUP_KEYS stress counters + the taint
     masks + every §19 grp_* scheduler/timing row) place on the groups axis
@@ -87,7 +89,13 @@ def _monitor_shardings(mesh, n_groups: int, n_ticks: int,
     mon0 = jax.eval_shape(
         lambda: telemetry_mod.monitor_init(n_groups, n_ticks,
                                            per_group=True, timing=timing,
-                                           sched=sched))
+                                           sched=sched, series=series,
+                                           series_stride=series_stride,
+                                           events=events))
+    # The §21 series/event rings replicate by the same name rule: none of
+    # their keys carries the grp_/taint_ prefix, and their integer sums /
+    # extrema / cursor scatters are group-order-independent, so the
+    # replicated fold is bit-equal to single-device.
     per_group = {k for k in mon0
                  if k.startswith("grp_") or k.startswith("taint_")}
     for k in per_group:
@@ -141,7 +149,8 @@ def make_batch_runner(cfg: RaftConfig, n_ticks: int,
         rng_sh = mesh_mod.rng_shardings(cfg, mesh)
         rep = jax.sharding.NamedSharding(
             mesh, jax.sharding.PartitionSpec())
-        mon_sh = _monitor_shardings(mesh, cfg.n_groups, n_ticks)
+        mon_sh = _monitor_shardings(mesh, cfg.n_groups, n_ticks,
+                                    **telemetry_mod.ops_kw(cfg))
         jit_kw = {"in_shardings": (sh, rng_sh),
                   "out_shardings": (sh, rep, mon_sh)}
         # Computed straight into placement (init_sharded's pattern).
@@ -168,7 +177,8 @@ def make_batch_runner(cfg: RaftConfig, n_ticks: int,
 
         tel0 = telemetry_mod.telemetry_zeros()
         mon0 = telemetry_mod.monitor_init(cfg.n_groups, n_ticks,
-                                          per_group=True)
+                                          per_group=True,
+                                          **telemetry_mod.ops_kw(cfg))
         # Seed the sticky quirk-taint masks (soak_run carries them across
         # checkpoint-rotated segments — a mid-run segment boundary must
         # not forget that a group restarted in an earlier segment).
@@ -248,12 +258,20 @@ def make_continuous_runner(cfg: RaftConfig, segment_ticks: int,
     row installs grp_life each segment. `mesh` shards lanes exactly like
     make_batch_runner (bit-identical — tests/test_scheduler.py)."""
     from raft_kotlin_tpu.models.state import init_state
+    from raft_kotlin_tpu.ops import serving as serving_mod
     from raft_kotlin_tpu.ops.tick import make_rng, make_tick, split_rng
+    from raft_kotlin_tpu.utils import rng as rngmod
 
     spec = cfg.scenario
     assert spec is not None, "continuous scheduling needs cfg.scenario"
     G = cfg.n_groups
     quiesce = spec.quiesce_ticks
+    # §20/§21: serving rides the continuous farm when the config compiles
+    # it in — the carry becomes a 5th operand threaded ACROSS segments
+    # (histograms/totals are farm-global accumulators), with the per-lane
+    # rows (SERVING_LANE_KEYS) folded back to init under the reset mask
+    # exactly like the state leaves.
+    uses_srv = serving_mod.serving_enabled(cfg)
 
     if mesh is None:
         tick = make_tick(cfg, batched=_cpu_batched_guard(cfg))
@@ -281,19 +299,36 @@ def make_continuous_runner(cfg: RaftConfig, segment_ticks: int,
         lanes_sh = jax.sharding.NamedSharding(
             mesh, jax.sharding.PartitionSpec(("dcn", "ici")))
         mon_sh = _monitor_shardings(mesh, cfg.n_groups, segment_ticks,
-                                    timing=True, sched=True)
+                                    timing=True, sched=True,
+                                    **telemetry_mod.ops_kw(cfg))
         seeds_sh = {k: lanes_sh for k in
                     ("taint_restart", "taint_unsafe")
                     + telemetry_mod.SCHED_SEED_KEYS}
-        jit_kw = {"in_shardings": (sh, rng_sh, lanes_sh, seeds_sh),
-                  "out_shardings": (sh, rep, mon_sh)}
+        in_sh = (sh, rng_sh, lanes_sh, seeds_sh)
+        out_sh = (sh, rep, mon_sh)
+        if uses_srv:
+            # Serving-carry placement by NAME (the _monitor_shardings
+            # discipline): lane rows shard their trailing (G,) axis;
+            # histograms, totals and the latch replicate.
+            srv_shapes = jax.eval_shape(
+                lambda: serving_mod.serving_zeros(G, cfg.serve_slots))
+            srv_sh = {}
+            for k, v in srv_shapes.items():
+                if k in serving_mod.SERVING_LANE_KEYS:
+                    axes = (None,) * (v.ndim - 1) + (("dcn", "ici"),)
+                    srv_sh[k] = jax.sharding.NamedSharding(
+                        mesh, jax.sharding.PartitionSpec(*axes))
+                else:
+                    srv_sh[k] = rep
+            in_sh = in_sh + (srv_sh,)
+            out_sh = out_sh + (srv_sh,)
+        jit_kw = {"in_shardings": in_sh, "out_shardings": out_sh}
         place_rng = jax.jit(lambda u: make_rng(cfg, uids=u),
                             out_shardings=rng_sh)
         mk_state = lambda: mesh_mod.init_sharded(cfg, mesh)
 
-    @functools.partial(jax.jit, **jit_kw)
-    def run(st, rng, reset, seeds):
-        scen = split_rng(rng)[3]
+    def _run(st, rng, reset, seeds, srv):
+        base_k, _tk, _bk, scen = split_rng(rng)
         fresh = init_state(cfg, scen=scen)
 
         def fold(f, c):
@@ -305,20 +340,33 @@ def make_continuous_runner(cfg: RaftConfig, segment_ticks: int,
         st = jax.tree_util.tree_map(fold, fresh, st)
         st = st.replace(tick=jnp.where(jnp.all(reset),
                                        jnp.zeros((), _I32), st.tick))
+        if uses_srv:
+            srv_kw = rngmod.kt_key_words(base_k)
+            fresh_srv = serving_mod.serving_init(cfg)
+            srv = {k: (fold(fresh_srv[k], v)
+                       if k in serving_mod.SERVING_LANE_KEYS else v)
+                   for k, v in srv.items()}
 
         def body(carry, _):
-            s, tel, mon = carry
+            s, tel, mon, srv = carry
             s2 = tick_fn(s, rng)
             if mutator is not None:
                 s2 = mutator(s2, s.tick)
             tel = telemetry_mod.telemetry_step(s, s2, tel)
-            mon = telemetry_mod.monitor_step(s, s2, mon)
-            return (s2, tel, mon), None
+            srv_prev = srv
+            if uses_srv:
+                srv = serving_mod.serving_step(
+                    cfg, serving_mod.serving_view(s2), srv, kw=srv_kw,
+                    scen=scen)
+            mon = telemetry_mod.monitor_step(s, s2, mon,
+                                             srv_prev=srv_prev,
+                                             srv_cur=srv)
+            return (s2, tel, mon, srv), None
 
         tel0 = telemetry_mod.telemetry_zeros()
         mon0 = dict(telemetry_mod.monitor_init(
             G, segment_ticks, per_group=True, timing=True, sched=True,
-            quiesce_ticks=quiesce))
+            quiesce_ticks=quiesce, **telemetry_mod.ops_kw(cfg)))
         zb = jnp.zeros((G,), bool)
         zi = jnp.zeros((G,), _I32)
         mon0["taint_restart"] = jnp.where(reset, zb, seeds["taint_restart"])
@@ -326,9 +374,21 @@ def make_continuous_runner(cfg: RaftConfig, segment_ticks: int,
         for k in telemetry_mod.SCHED_SEED_KEYS:
             mon0[k] = jnp.where(reset, zi, seeds[k])
         mon0["grp_life"] = scen.get("life", zi)
-        (end, tel, mon), _ = jax.lax.scan(body, (st, tel0, mon0), None,
-                                          length=segment_ticks)
+        (end, tel, mon, srv), _ = jax.lax.scan(
+            body, (st, tel0, mon0, srv), None, length=segment_ticks)
+        if uses_srv:
+            return end, tel, mon, srv
         return end, tel, mon
+
+    # The jit signature is 4-arg or 5-arg by CONFIG, never a None operand
+    # threaded through shardings — serving-off farms keep the exact
+    # pre-§21 program.
+    if uses_srv:
+        run = functools.partial(jax.jit, **jit_kw)(_run)
+    else:
+        run = functools.partial(jax.jit, **jit_kw)(
+            lambda st, rng, reset, seeds: _run(st, rng, reset, seeds,
+                                               None))
 
     def zero_seeds():
         zb = jnp.zeros((G,), bool)
@@ -336,7 +396,7 @@ def make_continuous_runner(cfg: RaftConfig, segment_ticks: int,
         return {"taint_restart": zb, "taint_unsafe": zb,
                 **{k: zi for k in telemetry_mod.SCHED_SEED_KEYS}}
 
-    def call(state=None, uids=None, reset=None, seeds=None):
+    def call(state=None, uids=None, reset=None, seeds=None, srv=None):
         st = state if state is not None else mk_state()
         if uids is None:
             uids = spec.universe_base + np.arange(G, dtype=np.int32)
@@ -345,7 +405,11 @@ def make_continuous_runner(cfg: RaftConfig, segment_ticks: int,
             reset = jnp.ones((G,), bool)
         if seeds is None:
             seeds = zero_seeds()
-        return run(st, rng, jnp.asarray(reset, bool), seeds)
+        if not uses_srv:
+            return run(st, rng, jnp.asarray(reset, bool), seeds)
+        if srv is None:
+            srv = serving_mod.serving_init(cfg)
+        return run(st, rng, jnp.asarray(reset, bool), seeds, srv)
 
     return call
 
@@ -383,7 +447,8 @@ def continuous_corpus_hash(records, admit_log, farm_seed, groups: int,
 
 def continuous_farm(cfg: RaftConfig, segment_ticks: int, segments: int,
                     out_path: Optional[str] = None, verbose: bool = False,
-                    mutator: Optional[Callable] = None, mesh=None) -> dict:
+                    mutator: Optional[Callable] = None, mesh=None,
+                    slo=None, publish: Optional[Callable] = None) -> dict:
     """The §19 standing farm: run `segments` segments of `segment_ticks`
     through make_continuous_runner, retiring and re-admitting lanes
     between segments so every lane stays hot (no drain tail). Per segment:
@@ -404,15 +469,33 @@ def continuous_farm(cfg: RaftConfig, segment_ticks: int, segments: int,
     arm and is re-admitted like any other; the latch coordinate is
     recorded as a continuous-mode artifact (segment + segment-relative
     tick + universe_id — no auto-shrink: shrink_violation assumes static
-    batches; replay = rerun the farm, which is deterministic)."""
+    batches; replay = rerun the farm, which is deterministic).
+
+    §21 ops plane: `slo` (an opsplane.SLOSpec) gates the per-segment
+    metrics — downtime_frac / election_p90 from the fresh-per-segment
+    monitor carry, read_p99 from the serving histogram DELTA (the serving
+    carry threads across segments, so per-segment = cur - prev host
+    copies), farm_util from the retire-age waste — through error-budget
+    burn (opsplane.SLOBurn); result grows slo_status/slo_burn. `publish`
+    (e.g. opsplane.OpsPlane.update) receives one host snapshot dict per
+    segment, built from the SAME readback set the loop already
+    materializes — zero extra device syncs for the scrape surface."""
+    from raft_kotlin_tpu.api import opsplane as opsplane_mod
+    from raft_kotlin_tpu.ops import serving as serving_mod
+
     spec = cfg.scenario
     assert spec is not None, "continuous_farm needs cfg.scenario"
     G = cfg.n_groups
+    uses_srv = serving_mod.serving_enabled(cfg)
     runner = make_continuous_runner(cfg, segment_ticks, mutator=mutator,
                                     mesh=mesh)
     uids = spec.universe_base + np.arange(G, dtype=np.int64)
     next_serial = G
-    state, seeds = None, None
+    state, seeds, srv = None, None, None
+    burn = opsplane_mod.SLOBurn(slo) if slo is not None else None
+    prev_hist_read = np.zeros(serving_mod.SERVING_BINS, np.int64)
+    events_dropped_total = 0
+    last_series, last_events = None, None
     reset = np.ones((G,), bool)
     admit_log: list = []
     records: list = []
@@ -428,8 +511,12 @@ def continuous_farm(cfg: RaftConfig, segment_ticks: int, segments: int,
     hist_elect = np.zeros(bins, np.int64)
     down_ticks = 0
     for seg in range(segments):
-        state, tel, mon = runner(state=state, uids=uids, reset=reset,
-                                 seeds=seeds)
+        out = runner(state=state, uids=uids, reset=reset, seeds=seeds,
+                     srv=srv)
+        if uses_srv:
+            state, tel, mon, srv = out
+        else:
+            state, tel, mon = out
         summ = telemetry_mod.summarize_monitor(mon)
         uni = telemetry_mod.universe_stats(mon)
         sch = telemetry_mod.sched_stats(mon)
@@ -452,7 +539,8 @@ def continuous_farm(cfg: RaftConfig, segment_ticks: int, segments: int,
         retire_age = sch["grp_retire_age"]
         age_end = sch["grp_age"]
         retired = retire_age >= 0
-        wasted += int(np.sum(np.where(retired, age_end - retire_age, 0)))
+        wasted_seg = int(np.sum(np.where(retired, age_end - retire_age, 0)))
+        wasted += wasted_seg
         if summ["latch"] is not None:
             g = int(summ["latch"]["group"])
             art = {
@@ -485,6 +573,63 @@ def continuous_farm(cfg: RaftConfig, segment_ticks: int, segments: int,
         reset = retired.copy()
         seeds = {k: mon[k] for k in ("taint_restart", "taint_unsafe")
                  + telemetry_mod.SCHED_SEED_KEYS}
+        # §21 per-segment metrics for the SLO gate and the scrape
+        # snapshot — every value below is a host read of arrays the loop
+        # already pulled (summ/sch/uni), or of the serving carry that
+        # call() returns anyway. The monitor carry is rebuilt fresh each
+        # segment, so sch values are per-segment directly; the serving
+        # histograms thread ACROSS segments, so per-segment = delta
+        # against the previous host copy.
+        seg_lane_ticks = G * segment_ticks
+        metrics = {
+            "downtime_frac": int(sch["down_ticks"]) / seg_lane_ticks,
+            "election_p90": serving_mod.hist_percentile(
+                sch["hist_elect"], 0.90),
+            "farm_util": 1.0 - wasted_seg / seg_lane_ticks,
+            "read_p99": None,
+        }
+        if uses_srv:
+            cur_hist = np.asarray(jax.device_get(srv["hist_read"]),
+                                  np.int64)
+            delta = cur_hist - prev_hist_read
+            # A segment with zero completed reads has NO latency sample —
+            # report None (SLOSpec: absent metric cannot violate), not a
+            # fake p99 of 0.
+            metrics["read_p99"] = (
+                serving_mod.hist_percentile(delta, 0.99)
+                if int(delta.sum()) > 0 else None)
+            prev_hist_read = cur_hist
+        if burn is not None:
+            burn.observe(metrics)
+        events_dropped_total += int(summ.get("events_dropped", 0))
+        last_series = summ.get("series", last_series)
+        seg_events = list(summ.get("events") or [])
+        # Host-side admission is part of the segment's story: append the
+        # admit rows as synthetic events (kind_id -1 — not a device ring
+        # kind) so /events and render_events show the full narrative.
+        for row in admit_log[len(admit_log) - len(lanes):]:
+            seg_events.append({"kind": "admit", "kind_id": -1,
+                               "tick": segment_ticks - 1,
+                               "group": row[1], "arg": row[3]})
+        last_events = seg_events if seg_events else last_events
+        if publish is not None:
+            publish({
+                "segment": seg,
+                "ticks_total": (seg + 1) * seg_lane_ticks,
+                "universes_admitted": G + retired_total,
+                "universes_retired": retired_total,
+                "events_dropped": events_dropped_total,
+                "farm_util": metrics["farm_util"],
+                "downtime_frac": metrics["downtime_frac"],
+                "election_p90": metrics["election_p90"],
+                "read_p99": metrics["read_p99"],
+                "inv_status": status,
+                "slo_status": burn.status if burn is not None else "clean",
+                "slo_burn": burn.burn if burn is not None else 0.0,
+                "telemetry": dict(tel_total),
+                "series": summ.get("series"),
+                "events": seg_events,
+            })
         if verbose:
             print(f"segment {seg}: inv={summ['inv_status']} "
                   f"retired={len(lanes)} serial={next_serial}")
@@ -512,6 +657,13 @@ def continuous_farm(cfg: RaftConfig, segment_ticks: int, segments: int,
         "hist_downtime": hist_down.tolist(),
         "hist_elect": hist_elect.tolist(),
         "down_ticks": down_ticks,
+        "slo_status": burn.status if burn is not None else "clean",
+        "slo_burn": burn.as_dict() if burn is not None else None,
+        "serving": (serving_mod.summarize_serving(srv)
+                    if uses_srv else None),
+        "events_dropped": events_dropped_total,
+        "series": last_series,
+        "events": last_events,
         "corpus_hash": continuous_corpus_hash(
             records, admit_log, spec.farm_seed, G, segments, segment_ticks),
     }

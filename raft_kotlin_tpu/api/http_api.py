@@ -31,6 +31,24 @@ verbs — GETs routed onto the applied state machine rather than the raw log:
 
 On serve_slots=0 configs these routes return 400 (serving path disabled).
 
+The §21 ops plane adds the scrape surface (SEMANTICS.md §21):
+
+    GET /metrics                           -> Prometheus text exposition; from
+                                              the `ops` snapshot holder when one
+                                              is attached (farm mode), else from
+                                              sim.metrics_snapshot()
+    GET /events                            -> the last published segment's
+                                              decoded event-ring JSON (farm mode)
+    GET /healthz                           -> 200 ok / 503 on a latched
+                                              invariant or breached SLO
+
+`RaftHTTPServer(sim, ..., ops=OpsPlane())` attaches a farm's snapshot
+holder; `sim=None` runs the server in FARM MODE — only the three scrape
+routes respond (the farm owns the device; there is no simulator to
+address). Scrapes never touch the device either way: farm mode reads the
+snapshot continuous_farm already published, sim mode reads host-side
+state/serving copies under the simulator lock.
+
 With tick_hz > 0 a daemon thread advances the simulation in wall-clock time (the
 reference's real-time behavior: 1 tick = 100 ms at tick_hz=10); with tick_hz=0 the
 clock only moves via /step/{k}, which is what tests use.
@@ -46,6 +64,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, unquote
 
+from raft_kotlin_tpu.api import opsplane as opsplane_mod
 from raft_kotlin_tpu.api.simulator import Simulator
 
 _ROUTE_LOG = re.compile(r"^/(\d+)/(\d+)/?$")
@@ -63,9 +82,14 @@ MAX_STEP_PER_REQUEST = 100_000
 class RaftHTTPServer:
     """Own the ThreadingHTTPServer + optional tick thread; `with` or start()/stop()."""
 
-    def __init__(self, sim: Simulator, port: int = 7000, tick_hz: float = 0.0):
+    def __init__(self, sim: Optional[Simulator], port: int = 7000,
+                 tick_hz: float = 0.0, ops=None):
         self.sim = sim
+        self.ops = ops  # opsplane.OpsPlane (farm mode) or None
         self.tick_hz = tick_hz
+        if sim is None and ops is None:
+            raise ValueError("RaftHTTPServer needs a Simulator, an "
+                             "OpsPlane snapshot holder, or both")
         self._stop = threading.Event()
         self._tick_thread: Optional[threading.Thread] = None
 
@@ -86,6 +110,44 @@ class RaftHTTPServer:
             def do_GET(self):
                 sim = outer.sim
                 try:
+                    # §21 scrape surface — served before the simulator
+                    # routes so farm mode (sim=None) can answer them.
+                    if self.path in ("/metrics", "/metrics/"):
+                        if outer.ops is not None:
+                            return self._send(
+                                200, outer.ops.prometheus_text(),
+                                "text/plain; version=0.0.4")
+                        if sim is not None:
+                            return self._send(
+                                200, opsplane_mod.prometheus_text(
+                                    sim.metrics_snapshot()),
+                                "text/plain; version=0.0.4")
+                    if self.path in ("/events", "/events/"):
+                        if outer.ops is not None:
+                            return self._send(200, outer.ops.events_json(),
+                                              "application/json")
+                        return self._send(
+                            404, "events need an attached ops plane "
+                                 "(farm mode / ops=OpsPlane())")
+                    if self.path in ("/healthz", "/healthz/"):
+                        if outer.ops is not None:
+                            code, body = outer.ops.healthz()
+                            return self._send(code, json.dumps(body),
+                                              "application/json")
+                        snap = sim.metrics_snapshot()
+                        bad = snap.get("inv_status", "clean") != "clean"
+                        return self._send(
+                            503 if bad else 200,
+                            json.dumps({
+                                "status": "unhealthy" if bad else "ok",
+                                "inv_status": snap.get("inv_status",
+                                                       "clean"),
+                                "tick": snap.get("ticks_total"),
+                            }), "application/json")
+                    if sim is None:
+                        return self._send(
+                            503, "farm mode: only /metrics, /events and "
+                                 "/healthz respond (no simulator attached)")
                     if self.path in ("", "/"):
                         shown = min(sim.cfg.n_groups, 64)
                         body = json.dumps(

@@ -1,0 +1,230 @@
+"""§21 streaming ops plane — host side (SEMANTICS.md §21).
+
+The device half of the ops plane lives in the monitor carry
+(utils/telemetry.py: the (W, K) series ring + the bounded event ring,
+bit-neutral reductions over state-transition pairs). This module is the
+HOST half:
+
+- `SLOSpec` — a declarative service-level objective over the §19/§20
+  farm metrics (read p99, downtime fraction, election p90, farm_util
+  floor), evaluated PER SEGMENT with error-budget burn accounting
+  (`SLOBurn`): a segment that misses any gated dimension consumes
+  budget; burn = violated_fraction / budget_frac, breach at burn >= 1.
+  `slo_status` is "clean" or "breach:<dim>@seg<k>" — the same
+  clean/non-clean shape every inv_status-style field uses, so
+  summarize_bench's INV_LEGS machinery gates it unchanged.
+- `prometheus_text` — render one farm snapshot as Prometheus text
+  exposition (the `GET /metrics` body).
+- `OpsPlane` — a thread-safe snapshot holder between the farm loop
+  (producer: api/fuzz.continuous_farm's per-segment `publish`) and the
+  HTTP scrape surface (consumer: api/http_api.py's /metrics, /events,
+  /healthz). The farm already materializes one host-side readback set
+  per segment; `update` stores THAT dict, so scrapes are pure host
+  reads — zero extra device syncs, however often Prometheus polls.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+from typing import Optional
+
+# The gated dimensions, in evaluation (and breach-report) order:
+# (spec field, snapshot key, cmp) — cmp "max" gates value <= bound,
+# "min" gates value >= bound.
+SLO_DIMS = (
+    ("read_p99_ticks", "read_p99", "max"),
+    ("downtime_frac_max", "downtime_frac", "max"),
+    ("election_p90_ticks", "election_p90", "max"),
+    ("farm_util_min", "farm_util", "min"),
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOSpec:
+    """A declarative SLO over per-segment farm metrics. None disables a
+    dimension (ungated). `budget_frac` is the error budget: the fraction
+    of segments allowed to miss before the SLO counts as breached —
+    burn-rate accounting, not instant failure, so one bad segment in a
+    long soak spends budget instead of tripping the farm."""
+
+    read_p99_ticks: Optional[int] = None
+    downtime_frac_max: Optional[float] = None
+    election_p90_ticks: Optional[int] = None
+    farm_util_min: Optional[float] = None
+    budget_frac: float = 0.1
+
+    def __post_init__(self):
+        if not (0.0 < self.budget_frac <= 1.0):
+            raise ValueError("budget_frac must be in (0, 1]")
+
+    @property
+    def gated_dims(self) -> tuple:
+        return tuple(f for f, _, _ in SLO_DIMS
+                     if getattr(self, f) is not None)
+
+    def violated_dims(self, metrics: dict) -> list:
+        """The gated dimensions this segment's metrics miss (snapshot-key
+        names, evaluation order). A metric absent from `metrics` (e.g.
+        read_p99 on a serving-off farm) cannot violate."""
+        out = []
+        for field, key, cmp in SLO_DIMS:
+            bound = getattr(self, field)
+            if bound is None or metrics.get(key) is None:
+                continue
+            v = metrics[key]
+            if (v > bound) if cmp == "max" else (v < bound):
+                out.append(key)
+        return out
+
+
+class SLOBurn:
+    """Error-budget burn accounting over a segment stream: feed each
+    segment's metrics, read burn / status. First-breach coordinate is
+    sticky (the latch idiom), burn itself keeps updating."""
+
+    def __init__(self, slo: SLOSpec):
+        self.slo = slo
+        self.segments = 0
+        self.violated_segments = 0
+        self.by_dim: dict = {}
+        self.first_breach: Optional[tuple] = None  # (dim, segment)
+
+    def observe(self, metrics: dict) -> list:
+        """Fold one segment; returns its violated dims."""
+        dims = self.slo.violated_dims(metrics)
+        seg = self.segments
+        self.segments += 1
+        if dims:
+            self.violated_segments += 1
+            for d in dims:
+                self.by_dim[d] = self.by_dim.get(d, 0) + 1
+        if self.first_breach is None and dims and self.burn >= 1.0:
+            self.first_breach = (dims[0], seg)
+        return dims
+
+    @property
+    def burn(self) -> float:
+        """violated_fraction / budget_frac — >= 1.0 means the error
+        budget is spent (breach)."""
+        if not self.segments:
+            return 0.0
+        frac = self.violated_segments / self.segments
+        return frac / self.slo.budget_frac
+
+    @property
+    def breached(self) -> bool:
+        return self.first_breach is not None
+
+    @property
+    def status(self) -> str:
+        """"clean" or "breach:<dim>@seg<k>" — plugs into the INV_LEGS
+        non-clean => exit-1 machinery by shape."""
+        if self.first_breach is None:
+            return "clean"
+        dim, seg = self.first_breach
+        return f"breach:{dim}@seg{seg}"
+
+    def as_dict(self) -> dict:
+        return {"status": self.status, "burn": self.burn,
+                "segments": self.segments,
+                "violated_segments": self.violated_segments,
+                "by_dim": dict(self.by_dim)}
+
+
+def _prom_val(v) -> str:
+    return repr(float(v)) if isinstance(v, float) else str(int(v))
+
+
+def prometheus_text(snap: dict) -> str:
+    """Render one farm snapshot dict as Prometheus text exposition
+    (version 0.0.4). Scalars become raft_<key>; the telemetry counter
+    dict becomes raft_tel_<counter>_total; the latest series window
+    becomes raft_series{channel="..."} gauges. Pure host formatting over
+    the snapshot the farm loop already materialized — never touches the
+    device."""
+    lines = []
+
+    def emit(name, v, kind="gauge", help_=None):
+        if help_:
+            lines.append(f"# HELP {name} {help_}")
+        lines.append(f"# TYPE {name} {kind}")
+        lines.append(f"{name} {_prom_val(v)}")
+
+    for key, kind in (("segment", "counter"), ("ticks_total", "counter"),
+                      ("universes_admitted", "counter"),
+                      ("universes_retired", "counter"),
+                      ("events_dropped", "counter"),
+                      ("farm_util", "gauge"), ("downtime_frac", "gauge"),
+                      ("election_p90", "gauge"), ("read_p99", "gauge"),
+                      ("slo_burn", "gauge")):
+        if snap.get(key) is not None:
+            emit(f"raft_{key}", snap[key], kind)
+    if "inv_status" in snap:
+        emit("raft_inv_clean", 0 if snap["inv_status"] != "clean" else 1,
+             help_="1 while the invariant monitor has never latched")
+    if "slo_status" in snap:
+        emit("raft_slo_breached", 1 if snap["slo_status"] != "clean" else 0)
+    tel = snap.get("telemetry") or {}
+    for k in sorted(tel):
+        emit(f"raft_tel_{k}_total", tel[k], "counter")
+    # Generic passthrough for producer-specific gauges (the Simulator's
+    # interactive snapshot uses this for leader coverage / §20 totals).
+    gauges = snap.get("gauges") or {}
+    for k in sorted(gauges):
+        emit(f"raft_{k}", gauges[k], "gauge")
+    series = snap.get("series")
+    if series and series.get("windows"):
+        last = series["windows"][-1]
+        lines.append("# TYPE raft_series gauge")
+        for ch in series["names"]:
+            lines.append('raft_series{channel="%s"} %s'
+                         % (ch, _prom_val(last[ch])))
+    return "\n".join(lines) + "\n"
+
+
+class OpsPlane:
+    """Thread-safe snapshot holder between the farm loop and the HTTP
+    scrape surface. The producer calls update(snapshot) once per segment
+    (api/fuzz.continuous_farm's `publish` hook does exactly this);
+    consumers read rendered views. All consumer paths are lock-guarded
+    host reads of the LAST published snapshot — no device handle ever
+    enters this object."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._snap: Optional[dict] = None
+
+    def update(self, snap: dict) -> None:
+        with self._lock:
+            self._snap = dict(snap)
+
+    def snapshot(self) -> Optional[dict]:
+        with self._lock:
+            return dict(self._snap) if self._snap is not None else None
+
+    def prometheus_text(self) -> str:
+        snap = self.snapshot()
+        return prometheus_text(snap) if snap else "# no snapshot yet\n"
+
+    def events_json(self) -> str:
+        snap = self.snapshot() or {}
+        return json.dumps({"events": snap.get("events") or [],
+                           "events_dropped": snap.get("events_dropped", 0),
+                           "segment": snap.get("segment")})
+
+    def healthz(self) -> tuple:
+        """(http_status, body): 200 while the monitor and the SLO are
+        clean, 503 on a latched invariant or a breached SLO, 200 with
+        "starting" before the first snapshot."""
+        snap = self.snapshot()
+        if snap is None:
+            return 200, {"status": "starting"}
+        bad = (snap.get("inv_status", "clean") != "clean"
+               or snap.get("slo_status", "clean") != "clean")
+        body = {"status": "unhealthy" if bad else "ok",
+                "inv_status": snap.get("inv_status", "clean"),
+                "slo_status": snap.get("slo_status", "clean"),
+                "segment": snap.get("segment")}
+        return (503 if bad else 200), body

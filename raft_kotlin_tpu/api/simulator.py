@@ -361,6 +361,38 @@ class Simulator:
         out["hist_read"] = [int(v) for v in out["hist_read"]]
         return out
 
+    def metrics_snapshot(self) -> dict:
+        """One host snapshot in the §21 scrape shape (api/opsplane.
+        prometheus_text renders it): tick counter, leader coverage, and —
+        on serving configs — the §20 totals/latency percentiles. This is
+        the INTERACTIVE twin of continuous_farm's per-segment publish
+        dict; absent farm keys (segment, farm_util, ...) simply don't
+        render."""
+        with self._lock:
+            roles = np.asarray(self._state.role)
+            ups = np.asarray(self._state.up)
+            tick = int(self._state.tick)
+            has_srv = self._srv is not None
+        lead = ((roles == LEADER) & (ups != 0)).any(axis=0)
+        snap = {
+            "ticks_total": tick,
+            "inv_status": "clean",
+            "gauges": {
+                "groups": self.cfg.n_groups,
+                "nodes_per_group": self.cfg.n_nodes,
+                "leader_groups": int(lead.sum()),
+                "leaderless_groups": int((~lead).sum()),
+            },
+        }
+        if has_srv:
+            s = self.serving_stats()
+            snap["inv_status"] = s["status"]
+            snap["read_p99"] = s["read_p99"]
+            snap["gauges"]["applied_total"] = s["applied_total"]
+            snap["gauges"]["reads_ok"] = s["reads_ok"]
+            snap["gauges"]["submit_commit_p99"] = s["submit_commit_p99"]
+        return snap
+
     # -- persistence (state arrays + the host-side vocabulary) ---------------
 
     def save(self, path: str) -> None:

@@ -268,12 +268,17 @@ def make_deep_scan(cfg, n_ticks: int, return_state: bool = False,
                     ov_t = None
                 if tel is not None:
                     tel = telemetry_mod.telemetry_step(w, s2, tel, ov=ov_t)
-                if mon is not None:
-                    mon = telemetry_mod.monitor_step(w, s2, mon)
+                srv_prev = srv
                 if srv is not None:
+                    # Serving advances BEFORE the monitor folds so the
+                    # §21 srv_* series columns see this tick's pair.
                     srv = serving_mod.serving_step(
                         cfg, serving_mod.serving_view(s2), srv, kw=srv_kw,
                         scen=scen_b)
+                if mon is not None:
+                    mon = telemetry_mod.monitor_step(w, s2, mon,
+                                                     srv_prev=srv_prev,
+                                                     srv_cur=srv)
                 acc = acc + jnp.sum(s2.log_cmd[:, 0, :].astype(_I32))
                 y = _trace_row(s2) if with_trace else None
                 nxt = pack_state(cfg, s2, ov=s.ov) if packed else s2
@@ -281,7 +286,8 @@ def make_deep_scan(cfg, n_ticks: int, return_state: bool = False,
 
             tel0 = telemetry_mod.telemetry_zeros() if telemetry else None
             mon0 = telemetry_mod.monitor_init(cfg.n_groups, n_ticks,
-                                              monitor)
+                                              monitor,
+                                              **telemetry_mod.ops_kw(cfg))
             srv0 = serving_mod.serving_init(cfg) if serving else None
             st0 = pack_state(cfg, st) if packed else st
             carry0 = (st0, fc, jnp.zeros((), _I32), jnp.zeros((), bool),
@@ -447,7 +453,8 @@ def _livepin_scan(tick, n_ticks, telemetry: bool = False,
             return (nxt, acc, tel, mon), y
 
         tel0 = telemetry_mod.telemetry_zeros() if telemetry else None
-        mon0 = telemetry_mod.monitor_init(n_groups, n_ticks, monitor)
+        mon0 = telemetry_mod.monitor_init(n_groups, n_ticks, monitor,
+                                          **telemetry_mod.ops_kw(cfg))
         st0 = pack_state(cfg, st) if packed else st
         (end, acc, tel, mon), ys = jax.lax.scan(
             body, (st0, jnp.zeros((), _I32), tel0, mon0), None,
@@ -736,7 +743,8 @@ def make_sharded_deep_scan(cfg, mesh, n_ticks: int,
             return (nxt, f2, acc, ova | ov_t, tel, mon), y
 
         tel0 = telemetry_mod.telemetry_zeros() if telemetry else None
-        mon0 = telemetry_mod.monitor_init(cfg.n_groups, n_ticks, monitor)
+        mon0 = telemetry_mod.monitor_init(cfg.n_groups, n_ticks, monitor,
+                                          **telemetry_mod.ops_kw(cfg))
         st0 = pack_state(cfg, st) if packed else st
         carry0 = (st0, fc0, jnp.zeros((), _I32), jnp.zeros((), bool),
                   tel0, mon0)

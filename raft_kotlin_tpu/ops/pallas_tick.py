@@ -256,6 +256,11 @@ def fused_snapshot_fields(cfg: RaftConfig, telemetry: bool = False,
         want += list(MONITOR_STATE_FIELDS)
         if cfg.uses_compaction:
             want += list(MONITOR_COMPACT_FIELDS)
+        if getattr(cfg, "uses_ops_plane", False):
+            # §21: the series/event channels also read election rounds —
+            # snapshot it so fused replay can fill them (the telemetry
+            # set already carries it; monitor-only needs it added).
+            want += ["rounds"]
     if serving:
         # §20: a strict subset of the monitor's set (the serving step's
         # replay reads role/up/commit/hb_armed/log_cmd + the §15 snapshot
@@ -1059,28 +1064,39 @@ def unpack_fused_outputs(outs, sfields, snap_fields, T: int):
     return s2, outs[ns], ticks
 
 
-def fused_observe(cfg: RaftConfig, prev_flat, tick_flats, tel, mon):
-    """Advance the flight recorder / monitor over the T per-tick
-    transitions of one fused launch, from the kernel's snapshot dicts —
-    the same telemetry_step_arrays / monitor_step_arrays calls the T=1
-    flat-carry runner makes between launches, so the counters and the
-    latch are bit-equal to the unfused run by construction. `prev_flat` is
-    the pre-launch flat state (all fields); each entry of `tick_flats`
-    holds the snapshot subset, which covers every field the views read."""
+def fused_observe(cfg: RaftConfig, prev_flat, tick_flats, tel, mon,
+                  srv=None, srv_kw=None, scen=None):
+    """Advance the flight recorder / monitor / §20 serving carry over the
+    T per-tick transitions of one fused launch, from the kernel's snapshot
+    dicts — the same telemetry_step_arrays / monitor_step_arrays /
+    serving_step calls the T=1 flat-carry runner makes between launches,
+    so the counters and the latch are bit-equal to the unfused run by
+    construction. `prev_flat` is the pre-launch flat state (all fields);
+    each entry of `tick_flats` holds the snapshot subset, which covers
+    every field the views read. Serving advances BEFORE the monitor each
+    tick so the §21 srv_* series columns see the tick's serving pair."""
     from raft_kotlin_tpu.utils import telemetry as telemetry_mod
 
     N = cfg.n_nodes
+    if srv is not None:
+        from raft_kotlin_tpu.ops import serving as serving_mod
     for cur in tick_flats:
         if tel is not None:
             tel = telemetry_mod.telemetry_step_arrays(
                 telemetry_mod.flat_view(prev_flat, N),
                 telemetry_mod.flat_view(cur, N), tel)
+        srv_prev = srv
+        if srv is not None:
+            srv = serving_mod.serving_step(
+                cfg, serving_mod.serving_flat_view(cur, N), srv,
+                kw=srv_kw, scen=scen)
         if mon is not None:
             mon = telemetry_mod.monitor_step_arrays(
                 telemetry_mod.monitor_flat_view(prev_flat, N),
-                telemetry_mod.monitor_flat_view(cur, N), mon)
+                telemetry_mod.monitor_flat_view(cur, N), mon,
+                srv_prev=srv_prev, srv_cur=srv)
         prev_flat = cur
-    return tel, mon
+    return tel, mon, srv
 
 
 def cast_aux_in(aux: dict, aux_names):
@@ -1930,19 +1946,22 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
                 tel = telemetry_mod.telemetry_step_arrays(
                     telemetry_mod.flat_view(s, N),
                     telemetry_mod.flat_view(s2, N), tel)
+            srv_prev = srv
+            if srv is not None:
+                # §20 serving on the flat carry: plain XLA on the post-
+                # launch kernel-form state, kernel untouched (same
+                # contract as the recorder/monitor). Advanced BEFORE the
+                # monitor so the §21 srv_* columns see this tick's pair.
+                srv = serving_mod.serving_step(
+                    cfg, serving_mod.serving_flat_view(s2, N), srv,
+                    kw=srv_kw, scen=scen)
             if mon is not None:
                 # Safety-invariant monitor (ISSUE 6): same contract — flat
                 # pre/post views between launches, kernel untouched.
                 mon = telemetry_mod.monitor_step_arrays(
                     telemetry_mod.monitor_flat_view(s, N),
-                    telemetry_mod.monitor_flat_view(s2, N), mon)
-            if srv is not None:
-                # §20 serving on the flat carry: plain XLA on the post-
-                # launch kernel-form state, kernel untouched (same
-                # contract as the recorder/monitor above).
-                srv = serving_mod.serving_step(
-                    cfg, serving_mod.serving_flat_view(s2, N), srv,
-                    kw=srv_kw, scen=scen)
+                    telemetry_mod.monitor_flat_view(s2, N), mon,
+                    srv_prev=srv_prev, srv_cur=srv)
             ys = ({f: s2[f] for f in FUSED_TRACE_FIELDS} if trace else None)
             return _carry_in(s2, ovc, t + 1, tel, mon, srv), ys
 
@@ -1997,15 +2016,8 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
                 outs, sfields_f, snaps, T_f)
             if pc:
                 s2 = packed_compute_to_flat(cfg, s2)
-            tel, mon = fused_observe(cfg, s, ticks_f, tel, mon)
-            if srv is not None:
-                # §20 serving replay over the T snapshots — the same
-                # serving_step the 1-tick body calls, so the carry is
-                # bit-equal to the unfused run by construction.
-                for cur in ticks_f:
-                    srv = serving_mod.serving_step(
-                        cfg, serving_mod.serving_flat_view(cur, N), srv,
-                        kw=srv_kw, scen=scen)
+            tel, mon, srv = fused_observe(cfg, s, ticks_f, tel, mon,
+                                          srv=srv, srv_kw=srv_kw, scen=scen)
             ys = {"ov": jnp.sum(ov)}
             if trace:
                 ys["trace"] = {f: jnp.stack([p[f] for p in ticks_f])
@@ -2013,7 +2025,8 @@ def make_pallas_scan(cfg: RaftConfig, n_ticks: int,
             return _carry_in(s2, ovc, t + T_f, tel, mon, srv), ys
 
         tel0 = telemetry_mod.telemetry_zeros() if telemetry else None
-        mon0 = telemetry_mod.monitor_init(G, n_ticks, monitor)
+        mon0 = telemetry_mod.monitor_init(G, n_ticks, monitor,
+                                          **telemetry_mod.ops_kw(cfg))
         srv0 = serving_mod.serving_init(cfg) if serving else None
         flat_t = _carry_in(flat, jnp.zeros((G,), bool), state.tick, tel0,
                            mon0, srv0)

@@ -108,6 +108,17 @@ SERVING_KEYS = (
 )
 
 
+# The carry keys scoped to ONE lane (universe) — last axis (G,), reset
+# when the §19 continuous farm folds a retired lane back to init
+# (api/fuzz.make_continuous_runner). Named explicitly, never by shape:
+# the (B,) histograms and a (G,) lane row can share an extent, and the
+# histograms/totals are farm-global accumulators that must survive lane
+# turnover.
+SERVING_LANE_KEYS = ("kv_val", "kv_ver", "applied", "apply_digest",
+                     "read_digest", "grp_read_q", "grp_read_age",
+                     "serve_viol")
+
+
 def serving_enabled(cfg: RaftConfig) -> bool:
     """Whether `cfg` compiles the serving path in (S > 0). S == 0 configs
     compile it OUT entirely — the migration-equality contract."""

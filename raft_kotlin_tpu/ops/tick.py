@@ -2760,14 +2760,21 @@ def make_run(cfg: RaftConfig, n_ticks: int, trace: bool = True, impl: str = "xla
                     else tick_fn(wide, rng=rng)
             if telemetry:
                 tel = telemetry_mod.telemetry_step(wide, st2, tel)
-            if monitor:
-                mon = telemetry_mod.monitor_step(wide, st2, mon)
+            srv_prev = srv
             if serving:
+                # Serving advances BEFORE the monitor folds: the §21
+                # srv_* series columns read the (prev, cur) serving pair
+                # of this same tick.
                 srv = serving_mod.serving_step(
                     cfg, serving_mod.serving_view(st2), srv, kw=kw,
                     scen=scen_b)
             elif serving_gen:
                 srv = dict(srv, tick=srv["tick"] + 1)
+            if monitor:
+                pair = (srv_prev, srv) if serving else (None, None)
+                mon = telemetry_mod.monitor_step(wide, st2, mon,
+                                                 srv_prev=pair[0],
+                                                 srv_cur=pair[1])
             nxt = pack_state(cfg, st2, ov=st.ov) if packed else st2
             return (nxt, tel, mon, srv), st2
 
@@ -2798,7 +2805,8 @@ def make_run(cfg: RaftConfig, n_ticks: int, trace: bool = True, impl: str = "xla
             return carry, out
 
         tel0 = telemetry_mod.telemetry_zeros() if telemetry else None
-        mon0 = telemetry_mod.monitor_init(cfg.n_groups, n_ticks, monitor)
+        mon0 = telemetry_mod.monitor_init(cfg.n_groups, n_ticks, monitor,
+                                          **telemetry_mod.ops_kw(cfg))
         if serving:
             srv0 = serving_mod.serving_init(cfg)
         elif serving_gen:

@@ -812,17 +812,23 @@ def make_sharded_run(cfg: RaftConfig, mesh: Mesh, n_ticks: int,
             s2 = tick_fn(w, rng)
             if tel is not None:
                 tel = telemetry_mod.telemetry_step(w, s2, tel)
-            if mon is not None:
-                mon = telemetry_mod.monitor_step(w, s2, mon)
+            srv_prev = srv
             if srv is not None:
+                # Serving advances BEFORE the monitor folds so the §21
+                # srv_* series columns see this tick's serving pair.
                 srv = serving_mod.serving_step(
                     cfg, serving_mod.serving_view(s2), srv, kw=srv_kw,
                     scen=scen_b)
+            if mon is not None:
+                mon = telemetry_mod.monitor_step(w, s2, mon,
+                                                 srv_prev=srv_prev,
+                                                 srv_cur=srv)
             nxt = pack_state(cfg, s2, ov=s.ov) if packed else s2
             return (nxt, tel, mon, srv), None
 
         tel0 = telemetry_mod.telemetry_zeros() if telemetry else None
-        mon0 = telemetry_mod.monitor_init(cfg.n_groups, n_ticks, monitor)
+        mon0 = telemetry_mod.monitor_init(cfg.n_groups, n_ticks, monitor,
+                                          **telemetry_mod.ops_kw(cfg))
         srv0 = serving_mod.serving_init(cfg) if serving else None
         if not metrics_every:
             (st, tel, mon, srv), _ = jax.lax.scan(
@@ -875,8 +881,8 @@ def make_sharded_run(cfg: RaftConfig, mesh: Mesh, n_ticks: int,
             w = _wide(s)
             s2, ov, ticks_f = fused_block(w, rng)
             if tel is not None or mon is not None:
-                tel, mon = fused_observe(cfg, flatten_state(cfg, w),
-                                         ticks_f, tel, mon)
+                tel, mon, _ = fused_observe(cfg, flatten_state(cfg, w),
+                                            ticks_f, tel, mon)
             nxt = pack_state(cfg, s2, ov=s.ov) if packed else s2
             return (nxt, tel, mon), ov
 
@@ -891,7 +897,8 @@ def make_sharded_run(cfg: RaftConfig, mesh: Mesh, n_ticks: int,
             return carry, ov
 
         tel0 = telemetry_mod.telemetry_zeros() if telemetry else None
-        mon0 = telemetry_mod.monitor_init(cfg.n_groups, n_ticks, monitor)
+        mon0 = telemetry_mod.monitor_init(cfg.n_groups, n_ticks, monitor,
+                                          **telemetry_mod.ops_kw(cfg))
         if packed:
             st = pack_state(cfg, st)
         if not metrics_every:

@@ -310,6 +310,21 @@ class RaftConfig:
     read_batch: int = 0
     read_path: str = "readindex"
 
+    # §21 streaming ops plane (SEMANTICS.md §21). series_windows W > 0
+    # enables the carry-resident multi-channel TIME-SERIES ring: a fixed
+    # (W, K) int32 block in the monitor carry sampled every series_stride
+    # ticks (0 = auto: the stride tiles the run exactly like the history
+    # ring), one column per telemetry.SERIES_CHANNELS entry. event_capacity
+    # E > 0 enables the bounded EVENT ring: the first E encoded
+    # (kind, tick, group, arg) events of the run, with a loud
+    # events_dropped counter once full. Both are pre/post-tick state
+    # reductions riding the monitor carry — bit-neutral and engine-
+    # independent by the same contract as the recorder/monitor, and 0
+    # (default) compiles them OUT: the pre-§21 carry, bit-identical.
+    series_windows: int = 0
+    series_stride: int = 0
+    event_capacity: int = 0
+
     seed: int = 0
 
     # Per-group scenario heterogeneity (the fuzzing-farm bank, SEMANTICS.md
@@ -366,6 +381,17 @@ class RaftConfig:
                 raise ValueError(
                     f"read_path must be readindex or lease, got "
                     f"{self.read_path!r}")
+        if self.series_windows < 0 or self.event_capacity < 0:
+            raise ValueError(
+                f"series_windows/event_capacity must be >= 0, got "
+                f"{self.series_windows}/{self.event_capacity}")
+        if self.series_stride < 0:
+            raise ValueError(
+                f"series_stride must be >= 0, got {self.series_stride}")
+        if self.series_stride > 0 and self.series_windows <= 0:
+            raise ValueError(
+                "series_stride needs series_windows > 0 — a stride "
+                "without a ring samples into nothing")
         s = self.scenario
         if s is not None and s.has_clients and self.serve_slots <= 0:
             raise ValueError(
@@ -404,6 +430,13 @@ class RaftConfig:
         bank carries client channels) the device-resident load generator.
         False (S = 0) compiles the bit-identical pre-§20 program."""
         return self.serve_slots > 0
+
+    @property
+    def uses_ops_plane(self) -> bool:
+        """Whether the §21 streaming ops plane rides the monitor carry:
+        the multi-channel series ring and/or the bounded event ring.
+        False (both 0) compiles the bit-identical pre-§21 carry."""
+        return self.series_windows > 0 or self.event_capacity > 0
 
     @property
     def known_delivery(self) -> bool:

@@ -386,9 +386,108 @@ def monitor_ring_stride(n_ticks: int, windows: int = MONITOR_WINDOWS) -> int:
     return max(1, -(-int(n_ticks) // int(windows)))
 
 
+# ---------------------------------------------------------------------------
+# §21 streaming ops plane — channel/kind tables (SEMANTICS.md §21).
+#
+# The SERIES ring generalizes the 5-signal history ring above into a
+# configurable multi-channel (W, K) int32 block: one column per channel,
+# one row per window of `series_stride` ticks, each cell folded per tick
+# with the channel's combine op from the channel's identity at window
+# entry. Channels are pre/post-tick state-transition reductions (plus, for
+# the srv_* columns, reductions over serving-CARRY pairs — observers of an
+# observer, one level up the same contract), so the block is bit-neutral
+# and engine-independent exactly like the recorder: integer sums/extrema
+# ⇒ sharded ≡ single-device bits, fused-T replay ≡ T=1 by construction.
+#
+# Column order is SERIES_CHANNELS order; each entry is
+# (name, combine, identity):
+# - sum channels mirror the flight-recorder counter DELTAS per window
+#   (elections = rounds deltas, leader_changes, commit_advances,
+#   fault_events, snapshot folds / InstallSnapshot deliveries) plus the
+#   monitor's per-tick violation count — the grp_* farm stress aggregates
+#   cross-group summed into the timeline;
+# - gauge channels window-extremize the frontier/health signals
+#   (group commit-frontier min/max, live-leader peak, leaderless-group
+#   peak, §10 in-flight peak);
+# - srv_* channels summarize the §20 serving carry per window: applied /
+#   served-read deltas, the applied-frontier peak, the read-queue peak,
+#   and the submit→apply / read latency histograms' running summaries
+#   (per-window count is srv_applied/srv_reads; sum and max derive from
+#   the width-1 histogram bin deltas — exact up to the hist's own
+#   last-bin overflow clamp). 0/identity when the runner carries no
+#   serving dict.
+SERIES_CHANNELS = (
+    ("elections", "sum", 0),
+    ("leader_changes", "sum", 0),
+    ("commit_advances", "sum", 0),
+    ("fault_events", "sum", 0),
+    ("violations", "sum", 0),
+    ("snapshot_folds", "sum", 0),
+    ("installsnap", "sum", 0),
+    ("srv_applied", "sum", 0),
+    ("srv_reads", "sum", 0),
+    ("srv_commit_lat_sum", "sum", 0),
+    ("srv_read_lat_sum", "sum", 0),
+    ("commit_max", "max", -1),
+    ("leaders_hw", "max", 0),
+    ("down_groups_hw", "max", 0),
+    ("inflight_hw", "max", 0),
+    ("srv_read_q_hw", "max", 0),
+    ("srv_commit_lat_max", "max", -1),
+    ("srv_read_lat_max", "max", -1),
+    ("srv_applied_frontier", "max", 0),
+    ("commit_min", "min", _RING_BIG),
+)
+SERIES_NAMES = tuple(c[0] for c in SERIES_CHANNELS)
+N_SERIES = len(SERIES_CHANNELS)
+
+# The EVENT ring: a bounded encoded event stream derived from the same
+# transition reductions — the FIRST `event_capacity` events of the run as
+# (kind, tick, group, arg) int32 rows, then a loud `events_dropped`
+# counter (the first-violation latch generalized: a latch IS an event
+# ring of capacity 1; the per-tick write order is the same lexicographic
+# (kind, group) key the latch's masked-min uses, realized as a cumsum
+# ordinal so multiple same-tick events land in deterministic order).
+# Per-kind args (all group-scoped; universe ADMIT events are host-side —
+# the admission loop appends them to the decoded stream from its
+# admit_log, api/fuzz.continuous_farm):
+#   leader_change     arg = lowest node index that newly became live leader
+#   election_start    arg = vote rounds started in the group this tick
+#   election_resolve  arg = max term among the restored live leaders
+#   snapshot_fold     arg = highest snap_index folded to this tick
+#   installsnap       arg = highest snap_index installed this tick
+#   cap_latch         arg = nodes newly capacity-latched (§15/§16 cap_ov;
+#                     the packed width latch is a host-side sibling —
+#                     engines surface it outside the carry)
+#   retire            arg = retirement age (§19 sched channel only)
+#   violation         arg = lowest violated invariant id this tick
+EVENT_KINDS = (
+    "leader_change",
+    "election_start",
+    "election_resolve",
+    "snapshot_fold",
+    "installsnap",
+    "cap_latch",
+    "retire",
+    "violation",
+)
+N_EVENT_KINDS = len(EVENT_KINDS)
+
+
+def ops_kw(cfg) -> dict:
+    """The §21 monitor_init kwargs of a RaftConfig — the one-liner every
+    engine's scan builder splices in (`**telemetry.ops_kw(cfg)`), so the
+    ops-plane channels ride whatever engine the plan routes without
+    engine-specific wiring."""
+    return {"series": int(getattr(cfg, "series_windows", 0) or 0),
+            "series_stride": int(getattr(cfg, "series_stride", 0) or 0),
+            "events": int(getattr(cfg, "event_capacity", 0) or 0)}
+
+
 def monitor_init(n_groups: int, n_ticks: int, enabled: bool = True,
                  per_group: bool = False, timing: bool = False,
-                 sched: bool = False, quiesce_ticks: int = 0
+                 sched: bool = False, quiesce_ticks: int = 0,
+                 series: int = 0, series_stride: int = 0, events: int = 0
                  ) -> Optional[Dict[str, jax.Array]]:
     """THE runner-side monitor-carry constructor: a fresh carry with the
     ring stride tiling an n_ticks run, or None when the runner's monitor
@@ -399,12 +498,19 @@ def monitor_init(n_groups: int, n_ticks: int, enabled: bool = True,
     history ring, zero per-tick host traffic). `timing=True` adds the §19
     downtime/election-latency histogram channel; `sched=True` the §19
     retirement-predicate channel with quiescence horizon `quiesce_ticks`
-    (both per-group — see monitor_zeros)."""
+    (both per-group — see monitor_zeros). `series`/`events` are the §21
+    ops-plane channels (SERIES_CHANNELS / EVENT_KINDS; 0 = off):
+    `series` windows of `series_stride` ticks (0 = auto-tile the run like
+    the history ring) and an event ring of capacity `events` — engines
+    splice both from the config via `**ops_kw(cfg)`."""
     if not enabled:
         return None
+    if series > 0 and series_stride <= 0:
+        series_stride = monitor_ring_stride(n_ticks, series)
     return monitor_zeros(n_groups, monitor_ring_stride(n_ticks),
                          per_group=per_group, timing=timing, sched=sched,
-                         quiesce_ticks=quiesce_ticks)
+                         quiesce_ticks=quiesce_ticks, series=series,
+                         series_stride=series_stride, events=events)
 
 
 # Per-group (universe) stress counters, carried when monitor_zeros(
@@ -447,10 +553,14 @@ def monitor_zeros(n_groups: int, ring_stride: int = 1,
                   windows: int = MONITOR_WINDOWS,
                   per_group: bool = False, timing: bool = False,
                   sched: bool = False, quiesce_ticks: int = 0,
-                  bins: int = TIMING_BINS) -> Dict[str, jax.Array]:
+                  bins: int = TIMING_BINS, series: int = 0,
+                  series_stride: int = 1, events: int = 0
+                  ) -> Dict[str, jax.Array]:
     """A fresh monitor carry. `ring_stride` is baked in as a () int32 so
     summarize_monitor can decode the ring without out-of-band metadata.
-    `timing`/`sched` add the §19 channels (see TIMING_KEYS/SCHED_KEYS)."""
+    `timing`/`sched` add the §19 channels (see TIMING_KEYS/SCHED_KEYS);
+    `series`/`events` the §21 ops-plane rings (strides baked in like
+    ring_stride, so the decoders need no out-of-band metadata either)."""
     neg1 = jnp.full((), -1, _I32)
     out = {
         "tick": jnp.zeros((), _I32),
@@ -481,6 +591,24 @@ def monitor_zeros(n_groups: int, ring_stride: int = 1,
         out["grp_calm"] = jnp.zeros((n_groups,), _I32)
         out["grp_retire_age"] = jnp.full((n_groups,), -1, _I32)
         out["sched_quiesce"] = jnp.full((), int(quiesce_ticks), _I32)
+    if series > 0:
+        # §21 series ring: every cell starts at its channel's identity so
+        # never-entered windows decode as "no data" without a used mask
+        # (the same convention as the history ring's identity slots).
+        idents = jnp.asarray([c[2] for c in SERIES_CHANNELS], _I32)
+        out["series_data"] = jnp.broadcast_to(
+            idents, (int(series), N_SERIES)).astype(_I32)
+        out["series_stride"] = jnp.full((), int(max(1, series_stride)),
+                                        _I32)
+    if events > 0:
+        # §21 event ring: kind -1 marks an unwritten row; ev_count is the
+        # total ATTEMPTED (the cursor), events_dropped the loud overflow.
+        out["ev_kind"] = jnp.full((int(events),), -1, _I32)
+        out["ev_tick"] = jnp.full((int(events),), -1, _I32)
+        out["ev_grp"] = jnp.full((int(events),), -1, _I32)
+        out["ev_arg"] = jnp.zeros((int(events),), _I32)
+        out["ev_count"] = jnp.zeros((), _I32)
+        out["events_dropped"] = jnp.zeros((), _I32)
     return out
 
 
@@ -726,11 +854,17 @@ def invariant_matrix(prev: dict, cur: dict, taint_restart: jax.Array,
     return V, taint_restart, taint_unsafe
 
 
-def monitor_step_arrays(prev: dict, cur: dict, mon: Dict[str, jax.Array]
+def monitor_step_arrays(prev: dict, cur: dict, mon: Dict[str, jax.Array],
+                        srv_prev: Optional[dict] = None,
+                        srv_cur: Optional[dict] = None
                         ) -> Dict[str, jax.Array]:
     """One monitor step from pre/post-tick state VIEWS: run the checks,
     fold the verdicts into latch/counters/taints, and advance the history
-    ring. Returns the advanced carry (a new dict; inputs untouched)."""
+    ring (and, when the carry holds them, the §21 series/event rings).
+    Returns the advanced carry (a new dict; inputs untouched).
+    `srv_prev`/`srv_cur` are the pre/post §20 serving-carry pair for this
+    tick — runners that advance serving pass them so the srv_* series
+    columns fill; None leaves those columns at their identities."""
     V, tr, tu = invariant_matrix(prev, cur, mon["taint_restart"],
                                  mon["taint_unsafe"])
     out = dict(mon)
@@ -853,6 +987,179 @@ def monitor_step_arrays(prev: dict, cur: dict, mon: Dict[str, jax.Array]
     ring("leaders", leaders, jnp.maximum, 0)
     ring("inflight_hw", infl, jnp.maximum, 0)
     ring("violations", vc, jnp.add, 0)
+
+    if "series_data" in mon or "ev_kind" in mon:
+        # §21 ops plane: shared per-tick reductions (SEMANTICS.md §21).
+        # Same bit-neutrality contract as everything above — pre/post
+        # state-transition reads only, phase_body untouched.
+        prev_up = prev["up"] != 0
+        cur_up = cur["up"] != 0
+        lead_p = (prev["role"] == LEADER) & prev_up
+        lead_c = (cur["role"] == LEADER) & cur_up
+        new_lead = lead_c & ~lead_p                       # (N, G)
+        led_p = jnp.any(lead_p, axis=0)                   # (G,)
+        led_c = jnp.any(lead_c, axis=0)
+        r_p, r_c = prev.get("rounds"), cur.get("rounds")
+        if r_p is None or r_c is None:
+            raise ValueError(
+                "the §21 ops-plane channels need `rounds` in the step "
+                "views (monitor_view/monitor_flat_view supply it; a "
+                "monitor-only fused snapshot set does not — fuse with "
+                "telemetry=True, whose snapshot set includes rounds)")
+        d_rounds = jnp.sum(r_c.astype(_I32) - r_p.astype(_I32), axis=0)
+        d_fault = jnp.sum((prev_up != cur_up).astype(_I32), axis=0)
+        d_commit = jnp.maximum(
+            cur["commit"].astype(_I32) - prev["commit"].astype(_I32), 0)
+        v_grp = jnp.any(V, axis=0)                        # (G,)
+        si_c = cur.get("snap_index")
+        if si_c is not None:
+            # The recorder's fold/install classifier, verbatim (see
+            # telemetry_step_arrays) — the series columns must equal the
+            # counter deltas bit-for-bit.
+            restarted = cur_up & ~prev_up
+            si_cc = si_c.astype(_I32)
+            si_p = jnp.where(restarted, 0, prev["snap_index"].astype(_I32))
+            li_p = jnp.where(restarted, 0,
+                             prev["last_index"].astype(_I32))
+            s_adv = si_cc > si_p
+            s_inst = (s_adv & (si_cc > li_p)
+                      & (si_cc <= cur["last_index"].astype(_I32)))
+            s_fold = s_adv & ~s_inst
+        else:
+            zf = jnp.zeros(lead_c.shape, bool)
+            si_cc, s_fold, s_inst = None, zf, zf
+        cap_c, cap_p = cur.get("cap_ov"), prev.get("cap_ov")
+        cap_new = ((cap_c != 0) & ~(cap_p != 0) if cap_c is not None
+                   else jnp.zeros(lead_c.shape, bool))
+
+    if "series_data" in mon:
+        # §21 multi-channel series ring: one (K,) value vector per tick,
+        # folded into the hot window with the per-channel combine from
+        # the per-channel identity at window entry (the history-ring
+        # idiom, vectorized over channels).
+        if srv_prev is not None:
+            d_hc = (srv_cur["hist_commit"].astype(_I32)
+                    - srv_prev["hist_commit"].astype(_I32))
+            d_hr = (srv_cur["hist_read"].astype(_I32)
+                    - srv_prev["hist_read"].astype(_I32))
+            bins_i = lax.iota(_I32, d_hc.shape[0])
+            srv_vals = {
+                "srv_applied": srv_cur["applied_total"].astype(_I32)
+                - srv_prev["applied_total"].astype(_I32),
+                "srv_reads": srv_cur["reads_ok"].astype(_I32)
+                - srv_prev["reads_ok"].astype(_I32),
+                "srv_commit_lat_sum": jnp.sum(d_hc * bins_i),
+                "srv_read_lat_sum": jnp.sum(d_hr * bins_i),
+                "srv_commit_lat_max": jnp.max(
+                    jnp.where(d_hc > 0, bins_i, -1)),
+                "srv_read_lat_max": jnp.max(
+                    jnp.where(d_hr > 0, bins_i, -1)),
+                "srv_read_q_hw": jnp.max(
+                    srv_cur["grp_read_q"].astype(_I32)),
+                "srv_applied_frontier": jnp.max(
+                    srv_cur["applied"].astype(_I32)),
+            }
+        else:
+            srv_vals = None
+        vals_by = {
+            "elections": jnp.sum(d_rounds),
+            "leader_changes": _s(new_lead),
+            "commit_advances": jnp.sum(d_commit),
+            "fault_events": jnp.sum(d_fault),
+            "violations": vc,
+            "snapshot_folds": _s(s_fold),
+            "installsnap": _s(s_inst),
+            "commit_max": jnp.max(fr),
+            "leaders_hw": leaders,
+            "down_groups_hw": _s(~led_c),
+            "inflight_hw": infl,
+            "commit_min": jnp.min(fr),
+        }
+        vals = []
+        for name, comb, ident in SERIES_CHANNELS:
+            if name.startswith("srv_"):
+                v = (srv_vals[name] if srv_vals is not None
+                     else jnp.asarray(0 if comb == "sum" else ident, _I32))
+            else:
+                v = vals_by[name]
+            vals.append(jnp.asarray(v, _I32))
+        vals = jnp.stack(vals)                            # (K,)
+        idents = jnp.asarray([c[2] for c in SERIES_CHANNELS], _I32)
+        sum_m = jnp.asarray([c[1] == "sum" for c in SERIES_CHANNELS])
+        max_m = jnp.asarray([c[1] == "max" for c in SERIES_CHANNELS])
+        sd = mon["series_data"]
+        ss = mon["series_stride"]
+        hot_s = (lax.iota(_I32, sd.shape[0])
+                 == (tick // ss) % sd.shape[0])[:, None]  # (W, 1)
+        base_s = jnp.where((tick % ss) == 0,
+                           jnp.broadcast_to(idents, sd.shape), sd)
+        comb_s = jnp.where(sum_m, base_s + vals,
+                           jnp.where(max_m, jnp.maximum(base_s, vals),
+                                     jnp.minimum(base_s, vals)))
+        out["series_data"] = jnp.where(hot_s, comb_s, sd)
+
+    if "ev_kind" in mon:
+        # §21 event ring: per-tick candidate events in lexicographic
+        # (kind, group) order — the latch's masked-min key, realized as a
+        # cumsum ordinal so every same-tick event gets a distinct slot —
+        # scattered at cursor ev_count; rows past capacity drop into the
+        # loud events_dropped counter.
+        E = mon["ev_kind"].shape[0]
+        G_ = fr.shape[0]
+        arg0 = jnp.zeros((G_,), _I32)
+        node_i = lax.broadcasted_iota(_I32, lead_c.shape, 0)
+        big = jnp.asarray(_RING_BIG, _I32)
+        masks, args = [], []
+
+        def ev(mask, arg):  # order MUST follow EVENT_KINDS
+            masks.append(mask)
+            args.append(arg.astype(_I32))
+
+        grp_new_lead = jnp.any(new_lead, axis=0)
+        ev(grp_new_lead,
+           jnp.min(jnp.where(new_lead, node_i, big), axis=0))
+        ev(d_rounds > 0, d_rounds)
+        ev(led_c & ~led_p,
+           jnp.max(jnp.where(lead_c, cur["term"].astype(_I32), -1),
+                   axis=0))
+        ev(jnp.any(s_fold, axis=0),
+           jnp.max(jnp.where(s_fold, si_cc, 0), axis=0)
+           if si_cc is not None else arg0)
+        ev(jnp.any(s_inst, axis=0),
+           jnp.max(jnp.where(s_inst, si_cc, 0), axis=0)
+           if si_cc is not None else arg0)
+        ev(jnp.any(cap_new, axis=0), jnp.sum(cap_new.astype(_I32), axis=0))
+        if "grp_retire_age" in mon:
+            newly_ret = ((out["grp_retire_age"] >= 0)
+                         & (mon["grp_retire_age"] < 0))
+            ev(newly_ret, jnp.maximum(out["grp_retire_age"], 0))
+        else:
+            ev(jnp.zeros((G_,), bool), arg0)
+        inv_i = lax.broadcasted_iota(_I32, V.shape, 0)
+        ev(v_grp, jnp.min(jnp.where(V, inv_i, big), axis=0))
+        assert len(masks) == N_EVENT_KINDS
+
+        fm = jnp.stack(masks).reshape(-1)                 # (KE * G,)
+        fa = jnp.stack(args).reshape(-1)
+        kid = lax.broadcasted_iota(
+            _I32, (N_EVENT_KINDS, G_), 0).reshape(-1)
+        gid = lax.broadcasted_iota(
+            _I32, (N_EVENT_KINDS, G_), 1).reshape(-1)
+        ordinal = jnp.cumsum(fm.astype(_I32))
+        cnt = mon["ev_count"]
+        # Unmasked rows (and rows past capacity) aim at index >= E, which
+        # mode="drop" discards — the scatter form of the masked-min latch.
+        dest = jnp.where(fm, cnt + ordinal - 1, E)
+        tick_v = jnp.broadcast_to(tick, dest.shape)
+        out["ev_kind"] = mon["ev_kind"].at[dest].set(kid, mode="drop")
+        out["ev_tick"] = mon["ev_tick"].at[dest].set(tick_v, mode="drop")
+        out["ev_grp"] = mon["ev_grp"].at[dest].set(gid, mode="drop")
+        out["ev_arg"] = mon["ev_arg"].at[dest].set(fa, mode="drop")
+        total = jnp.sum(fm.astype(_I32))
+        written = jnp.minimum(cnt + total, E) - jnp.minimum(cnt, E)
+        out["ev_count"] = cnt + total
+        out["events_dropped"] = mon["events_dropped"] + (total - written)
+
     out["tick"] = tick + 1
     return out
 
@@ -886,11 +1193,15 @@ def monitor_flat_view(flat: dict, n_nodes: int) -> dict:
     return v
 
 
-def monitor_step(prev_state, cur_state, mon: Dict[str, jax.Array]
-                 ) -> Dict[str, jax.Array]:
-    """monitor_step_arrays over two RaftStates (one tick apart)."""
+def monitor_step(prev_state, cur_state, mon: Dict[str, jax.Array],
+                 srv_prev: Optional[dict] = None,
+                 srv_cur: Optional[dict] = None) -> Dict[str, jax.Array]:
+    """monitor_step_arrays over two RaftStates (one tick apart). The
+    optional serving-carry pair (§20, one tick apart) feeds the §21
+    srv_* series columns."""
     return monitor_step_arrays(monitor_view(prev_state),
-                               monitor_view(cur_state), mon)
+                               monitor_view(cur_state), mon,
+                               srv_prev=srv_prev, srv_cur=srv_cur)
 
 
 def monitor_finalize(mon: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
@@ -965,12 +1276,230 @@ def status_from_scalars(stats: Optional[dict]) -> Optional[str]:
     return f"{name}@t{t}/g{int(stats['inv_latch_group'])}"
 
 
+def _series_window_order(ticks: int, stride: int, W: int) -> list:
+    """Chronological slot order for a stride-W ring after `ticks` ticks —
+    the summarize_monitor wrap decode, shared by the §21 series ring."""
+    total_w = -(-ticks // stride) if ticks else 0
+    if total_w <= W:
+        return list(range(total_w))
+    first = total_w % W
+    return [(first + i) % W for i in range(W)]
+
+
+def decode_series_host(host: dict) -> Optional[dict]:
+    """Decode a host copy of a §21 series carry (series_data (W, K) +
+    series_stride + tick) into a chronological time-series frame:
+    {"stride", "names", "windows": [{channel: int}...]}. None when the
+    carry ran series-off. Pure host math — call it on the ONE
+    summarize_monitor device_get, never separately."""
+    sd = host.get("series_data")
+    if sd is None:
+        return None
+    ticks = int(host["tick"])
+    stride = int(host["series_stride"])
+    order = _series_window_order(ticks, stride, int(sd.shape[0]))
+    return {
+        "stride": stride,
+        "names": list(SERIES_NAMES),
+        "windows": [{name: int(sd[w][k])
+                     for k, name in enumerate(SERIES_NAMES)}
+                    for w in order],
+    }
+
+
+def decode_events_host(host: dict) -> Optional[dict]:
+    """Decode a host copy of a §21 event carry (ev_* + ev_count +
+    events_dropped) into {"events": [{kind, tick, group, arg}...],
+    "count", "dropped"}. Events come back in write order (tick-major,
+    kind-major within a tick). None when the carry ran events-off."""
+    ek = host.get("ev_kind")
+    if ek is None:
+        return None
+    n = min(int(host["ev_count"]), int(ek.shape[0]))
+    return {
+        "events": [{"kind": EVENT_KINDS[int(ek[i])],
+                    "kind_id": int(ek[i]),
+                    "tick": int(host["ev_tick"][i]),
+                    "group": int(host["ev_grp"][i]),
+                    "arg": int(host["ev_arg"][i])}
+                   for i in range(n)],
+        "count": int(host["ev_count"]),
+        "dropped": int(host["events_dropped"]),
+    }
+
+
+def render_events(decoded: dict, group: Optional[int] = None) -> str:
+    """The §21 event ring as the reference repo's per-node narrative
+    (api/explain.py style): one `[t=....] g... KIND arg` line per event,
+    optionally filtered to one group, with a loud trailer when the ring
+    dropped."""
+    ev = decoded["events"]
+    if group is not None:
+        ev = [e for e in ev if e["group"] == group]
+    hdr = (f"# ops-plane event ring: {len(ev)} events"
+           + (f" (group {group})" if group is not None else "")
+           + (f", {decoded['dropped']} DROPPED (ring full)"
+              if decoded["dropped"] else ""))
+    verbs = {
+        "leader_change": lambda e: f"n{e['arg']} BECOMES LEADER",
+        "election_start": lambda e: f"{e['arg']} election round(s) START",
+        "election_resolve": lambda e: f"leadership RESTORED at term "
+                                      f"{e['arg']}",
+        "snapshot_fold": lambda e: f"snapshot FOLD to index {e['arg']}",
+        "installsnap": lambda e: f"InstallSnapshot DELIVERED to index "
+                                 f"{e['arg']}",
+        "cap_latch": lambda e: f"{e['arg']} node(s) LATCH capacity",
+        "retire": lambda e: f"universe RETIRES at age {e['arg']}",
+        "violation": lambda e: f"invariant VIOLATION "
+                               f"({INVARIANT_IDS[e['arg']]}"
+                               f")" if 0 <= e["arg"] < len(INVARIANT_IDS)
+                               else f"invariant VIOLATION (#{e['arg']})",
+    }
+    lines = [hdr]
+    # Hosts may append kinds the device ring never writes (e.g. the
+    # farm's "admit" rows) — render them generically instead of raising.
+    fallback = lambda e: f"{e['kind'].upper()} arg={e['arg']}"
+    for e in ev:
+        lines.append(f"[t={e['tick']:>5}] g{e['group']} "
+                     f"{verbs.get(e['kind'], fallback)(e)}")
+    return "\n".join(lines)
+
+
+# The §21 channels/kinds an independent host pass can recompute from the
+# differential (T, N, G) trace (role/term/commit/last_index/voted_for/
+# rounds/up) + the pre-run state. The remaining columns read state the
+# trace does not carry (mailbox in-flight, §15 snapshot fields, cap_ov,
+# the serving carry, the §19 scheduler) — tests pin THOSE by running
+# configs where they provably stay at identity, so the full frame is
+# still exactly recomputed (tests/test_opsplane.py).
+TRACE_SERIES_NAMES = ("elections", "leader_changes", "commit_advances",
+                      "fault_events", "commit_max", "leaders_hw",
+                      "down_groups_hw", "commit_min")
+TRACE_EVENT_KINDS = ("leader_change", "election_start", "election_resolve")
+
+
+def _trace_pairs(state0, trace):
+    """Yield (prev, cur) numpy view dicts per tick from a pre-run state +
+    a (T, N, G) trace — the §21 recompute helpers' shared walk."""
+    import numpy as np
+
+    fields = ("role", "up", "commit", "rounds", "term")
+    tr = {k: np.asarray(trace[k]) for k in fields}
+    prev = {k: np.asarray(getattr(state0, k)) for k in fields}
+    for t in range(tr["role"].shape[0]):
+        cur = {k: tr[k][t] for k in fields}
+        yield t, prev, cur
+        prev = cur
+
+
+def series_from_trace(state0, trace, windows: int, stride: int) -> dict:
+    """Independent numpy recomputation of the trace-derivable §21 series
+    columns (TRACE_SERIES_NAMES) from the pre-run state + a (T, N, G)
+    trace — same fold, same wrap, same decode order as the device ring.
+    Returns a decode_series_host-shaped frame restricted to those
+    columns."""
+    import numpy as np
+
+    idents = {c[0]: c[2] for c in SERIES_CHANNELS}
+    combs = {c[0]: c[1] for c in SERIES_CHANNELS}
+    W = int(windows)
+    sd = {n: np.full((W,), idents[n], np.int64) for n in TRACE_SERIES_NAMES}
+    T = 0
+    for t, prev, cur in _trace_pairs(state0, trace):
+        T = t + 1
+        p_up = prev["up"] != 0
+        c_up = cur["up"] != 0
+        lead_p = (prev["role"] == LEADER) & p_up
+        lead_c = (cur["role"] == LEADER) & c_up
+        fr = np.max(cur["commit"].astype(np.int64), axis=0)
+        vals = {
+            "elections": int(np.sum(cur["rounds"].astype(np.int64)
+                                    - prev["rounds"].astype(np.int64))),
+            "leader_changes": int(np.sum(lead_c & ~lead_p)),
+            "commit_advances": int(np.sum(np.maximum(
+                cur["commit"].astype(np.int64)
+                - prev["commit"].astype(np.int64), 0))),
+            "fault_events": int(np.sum(p_up != c_up)),
+            "commit_max": int(np.max(fr)),
+            "leaders_hw": int(np.sum(lead_c)),
+            "down_groups_hw": int(np.sum(~np.any(lead_c, axis=0))),
+            "commit_min": int(np.min(fr)),
+        }
+        slot = (t // stride) % W
+        if t % stride == 0:
+            for n in TRACE_SERIES_NAMES:
+                sd[n][slot] = idents[n]
+        for n in TRACE_SERIES_NAMES:
+            if combs[n] == "sum":
+                sd[n][slot] += vals[n]
+            elif combs[n] == "max":
+                sd[n][slot] = max(sd[n][slot], vals[n])
+            else:
+                sd[n][slot] = min(sd[n][slot], vals[n])
+    order = _series_window_order(T, stride, W)
+    return {
+        "stride": int(stride),
+        "names": list(TRACE_SERIES_NAMES),
+        "windows": [{n: int(sd[n][w]) for n in TRACE_SERIES_NAMES}
+                    for w in order],
+    }
+
+
+def events_from_trace(state0, trace, capacity: int) -> dict:
+    """Independent numpy recomputation of the trace-derivable §21 event
+    kinds (TRACE_EVENT_KINDS) from the pre-run state + a (T, N, G) trace
+    — same per-tick kind-major/group-major order, same cursor/drop
+    accounting as the device ring (over these kinds). Returns a
+    decode_events_host-shaped dict."""
+    import numpy as np
+
+    cap = int(capacity)
+    events, count = [], 0
+    for t, prev, cur in _trace_pairs(state0, trace):
+        p_up = prev["up"] != 0
+        c_up = cur["up"] != 0
+        lead_p = (prev["role"] == LEADER) & p_up
+        lead_c = (cur["role"] == LEADER) & c_up
+        new_lead = lead_c & ~lead_p
+        led_p = np.any(lead_p, axis=0)
+        led_c = np.any(lead_c, axis=0)
+        d_rounds = np.sum(cur["rounds"].astype(np.int64)
+                          - prev["rounds"].astype(np.int64), axis=0)
+        G = lead_c.shape[1]
+        node_i = np.arange(lead_c.shape[0])[:, None]
+        big = np.iinfo(np.int32).max
+        per_kind = {
+            "leader_change": (np.any(new_lead, axis=0),
+                              np.min(np.where(new_lead, node_i, big),
+                                     axis=0)),
+            "election_start": (d_rounds > 0, d_rounds),
+            "election_resolve": (led_c & ~led_p,
+                                 np.max(np.where(
+                                     lead_c,
+                                     cur["term"].astype(np.int64), -1),
+                                     axis=0)),
+        }
+        for kind in TRACE_EVENT_KINDS:
+            mask, arg = per_kind[kind]
+            for g in range(G):
+                if mask[g]:
+                    if count < cap:
+                        events.append({"kind": kind,
+                                       "kind_id": EVENT_KINDS.index(kind),
+                                       "tick": t, "group": g,
+                                       "arg": int(arg[g])})
+                    count += 1
+    return {"events": events, "count": count,
+            "dropped": max(0, count - cap)}
+
+
 def summarize_monitor(mon: Dict[str, jax.Array]) -> dict:
     """Host materialization of a monitor carry (finalized or not) — ONE
     batched device_get. Returns inv_status, the latch, per-invariant
     counts, taint coverage, and the history ring decoded into
     chronological windows (wrap-around handled: long runs keep the LAST
-    W windows)."""
+    W windows). When the carry ran with the §21 ops plane, also the
+    decoded series frame + event list — same single device_get."""
     host = jax.device_get(monitor_finalize(mon))
     ticks = int(host["tick"])
     stride = int(host["ring_stride"])
@@ -992,7 +1521,7 @@ def summarize_monitor(mon: Dict[str, jax.Array]) -> dict:
     }
     status = "clean" if latch is None else (
         f"{latch['invariant']}@t{latch['tick']}/g{latch['group']}")
-    return {
+    out = {
         "inv_status": status,
         "latch": latch,
         "ticks": ticks,
@@ -1004,6 +1533,15 @@ def summarize_monitor(mon: Dict[str, jax.Array]) -> dict:
         "ring_stride": stride,
         "ring": windows,
     }
+    series = decode_series_host(host)
+    if series is not None:
+        out["series"] = series
+    events = decode_events_host(host)
+    if events is not None:
+        out["events"] = events["events"]
+        out["events_count"] = events["count"]
+        out["events_dropped"] = events["dropped"]
+    return out
 
 
 # ---------------------------------------------------------------------------

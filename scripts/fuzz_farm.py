@@ -19,7 +19,8 @@ Examples:
       --farm-seed 3
 
 Exit status: 0 clean, 1 any violation latched (the corpus holds the
-artifacts), 2 usage/infrastructure error.
+artifacts) or — in --continuous mode with --slo-* bounds — a breached
+SLO error budget, 2 usage/infrastructure error.
 """
 
 from __future__ import annotations
@@ -109,6 +110,32 @@ def main() -> int:
                     help="retire a universe after TICKS calm ticks "
                     "(stable live leader, no round progress, no fault "
                     "transitions; 0 = off)")
+    ap.add_argument("--series", type=int, default=0, metavar="WINDOWS",
+                    help="§21 ops plane: carry-resident time-series ring "
+                    "of WINDOWS windows (continuous mode)")
+    ap.add_argument("--events", type=int, default=0, metavar="CAPACITY",
+                    help="§21 ops plane: bounded event ring of CAPACITY "
+                    "encoded events (continuous mode)")
+    ap.add_argument("--slo-read-p99", type=int, default=None,
+                    metavar="TICKS",
+                    help="§21 SLO: per-segment read p99 ceiling in ticks")
+    ap.add_argument("--slo-downtime-max", type=float, default=None,
+                    metavar="FRAC",
+                    help="§21 SLO: per-segment leaderless-tick fraction "
+                    "ceiling")
+    ap.add_argument("--slo-election-p90", type=int, default=None,
+                    metavar="TICKS",
+                    help="§21 SLO: per-segment election-outage p90 ceiling")
+    ap.add_argument("--slo-util-min", type=float, default=None,
+                    metavar="FRAC",
+                    help="§21 SLO: per-segment farm_util floor")
+    ap.add_argument("--slo-budget", type=float, default=0.1, metavar="FRAC",
+                    help="§21 SLO error budget: fraction of segments "
+                    "allowed to miss before the farm exits non-zero")
+    ap.add_argument("--http-port", type=int, default=None, metavar="PORT",
+                    help="§21 scrape surface: serve GET /metrics, /events "
+                    "and /healthz on PORT while the continuous farm runs "
+                    "(0 = ephemeral; the bound port is printed)")
     ap.add_argument("--out", default=None, help="JSONL corpus path")
     ap.add_argument("--json", action="store_true",
                     help="print the full summary as JSON")
@@ -148,6 +175,7 @@ def main() -> int:
         log_capacity=args.log_capacity, cmd_period=args.cmd_period,
         delay_lo=delay_lo, delay_hi=delay_hi, seed=args.seed,
         compact_watermark=cw, compact_chunk=cc,
+        series_windows=args.series, event_capacity=args.events,
         scenario=spec).stressed(args.stress)
 
     mesh = None
@@ -181,10 +209,40 @@ def main() -> int:
 
     if args.continuous:
         # §19 continuous scheduler: a standing batch, retired/re-admitted
-        # in place — every lane hot, one readback per segment.
-        res = fuzz.continuous_farm(
-            cfg, args.segment or args.ticks, args.continuous,
-            out_path=args.out, verbose=not args.json, mesh=mesh)
+        # in place — every lane hot, one readback per segment. §21 rides
+        # the same loop: SLO gating over the per-segment metrics, and an
+        # optional scrape surface fed by the readback set the loop
+        # already materializes (zero extra device syncs per segment).
+        from raft_kotlin_tpu.api import opsplane as ops_mod
+
+        slo = None
+        if any(v is not None for v in (args.slo_read_p99,
+                                       args.slo_downtime_max,
+                                       args.slo_election_p90,
+                                       args.slo_util_min)):
+            slo = ops_mod.SLOSpec(
+                read_p99_ticks=args.slo_read_p99,
+                downtime_frac_max=args.slo_downtime_max,
+                election_p90_ticks=args.slo_election_p90,
+                farm_util_min=args.slo_util_min,
+                budget_frac=args.slo_budget)
+        plane = http = None
+        if args.http_port is not None:
+            from raft_kotlin_tpu.api.http_api import RaftHTTPServer
+
+            plane = ops_mod.OpsPlane()
+            http = RaftHTTPServer(None, port=args.http_port,
+                                  ops=plane).start()
+            print(f"ops plane: http://127.0.0.1:{http.port}/metrics",
+                  file=sys.stderr)
+        try:
+            res = fuzz.continuous_farm(
+                cfg, args.segment or args.ticks, args.continuous,
+                out_path=args.out, verbose=not args.json, mesh=mesh,
+                slo=slo, publish=plane.update if plane else None)
+        finally:
+            if http is not None:
+                http.stop()
         if args.json:
             print(json.dumps(res, sort_keys=True))
         else:
@@ -192,16 +250,22 @@ def main() -> int:
                   f"{res['segment_ticks']} ticks x {res['groups']} lanes "
                   f"-> {res['universe_ticks']} universe-ticks")
             print(f"inv_status={res['inv_status']} "
+                  f"slo_status={res['slo_status']} "
                   f"violations={res['violations']} "
                   f"universes_retired={res['universes_retired']} "
                   f"universes_admitted={res['universes_admitted']} "
                   f"farm_util={res['farm_util']:.4f} "
+                  f"events_dropped={res['events_dropped']} "
                   f"corpus_hash={res['corpus_hash']}")
             print("coverage:", json.dumps(res["coverage"], sort_keys=True))
+            if res["slo_burn"] is not None:
+                print("slo_burn:", json.dumps(res["slo_burn"],
+                                              sort_keys=True))
             for r in res["records"]:
                 print(f"  artifact: {r['status']} "
                       f"universe={r['universe_id']} segment={r['segment']}")
-        return 0 if res["inv_status"] == "clean" else 1
+        return 0 if (res["inv_status"] == "clean"
+                     and res["slo_status"] == "clean") else 1
 
     res = fuzz.fuzz_farm(cfg, args.ticks, universes=args.universes,
                          batch_groups=batch, out_path=args.out,

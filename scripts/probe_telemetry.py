@@ -21,13 +21,21 @@ Two questions, answered on the CURRENT backend:
    raft/engine/<name> scopes appear in the Perfetto/TensorBoard trace,
    with a host-side TraceAnnotation span marking the run boundary.
 
+3. **What does the §21 ops plane cost?** (this PR) A second A/B:
+   recorder+monitor baseline vs the same carry with the series + event
+   rings threaded (cfg.series_windows / cfg.event_capacity is the only
+   delta). With --enforce it exits 2 when ops_overhead_frac >= --gate
+   (default 3%) — the ISSUE-20 acceptance hook.
+
 Usage:
     python scripts/probe_telemetry.py [--groups 4096] [--ticks 50]
         [--reps 3] [--impl auto|xla|pallas] [--mailbox]
         [--profile-dir /tmp/raft-trace]
+        [--ops-series 32] [--ops-events 256] [--gate 0.03] [--enforce]
 
-Prints one JSON line: ticks/s on/off, overhead_frac, and the recorder
-aggregates of the measured run.
+Prints one JSON line: ticks/s off/on/base/ops, overhead_frac,
+ops_overhead_frac, gate verdict, and the recorder aggregates of the
+measured run.
 """
 
 from __future__ import annotations
@@ -41,7 +49,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def main() -> None:
+def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--groups", type=int, default=4096)
     ap.add_argument("--ticks", type=int, default=50)
@@ -52,6 +60,14 @@ def main() -> None:
                     help="add §10 [1,3] delays (mailbox_inflight_hw live)")
     ap.add_argument("--profile-dir", default=None,
                     help="emit a jax.profiler trace of one recorder-on run")
+    ap.add_argument("--ops-series", type=int, default=32,
+                    help="§21 leg: series ring windows")
+    ap.add_argument("--ops-events", type=int, default=256,
+                    help="§21 leg: event ring capacity")
+    ap.add_argument("--gate", type=float, default=0.03,
+                    help="§21 ops_overhead_frac acceptance threshold")
+    ap.add_argument("--enforce", action="store_true",
+                    help="exit 2 when ops_overhead_frac >= --gate")
     args = ap.parse_args()
 
     import jax
@@ -72,7 +88,7 @@ def main() -> None:
         cfg = dataclasses.replace(cfg, delay_lo=1, delay_hi=3)
     impl = choose_impl(cfg) if args.impl == "auto" else args.impl
 
-    def candidates(telemetry):
+    def candidates(ccfg, telemetry, monitor=False):
         """The SAME builders bench.tick_candidates times, with the
         recorder switchable — measure() jits once with the reductions
         inside, so both legs pay identical harness costs. Both legs pin
@@ -83,26 +99,44 @@ def main() -> None:
         step reductions either way (fused_observe replays them), so the
         T=1 overhead measured here is the production figure."""
         if impl == "pallas":
-            yield (lambda n: make_pallas_scan(cfg, n, interpret=False,
+            yield (lambda n: make_pallas_scan(ccfg, n, interpret=False,
                                               jitted=False, fused_ticks=1,
-                                              telemetry=telemetry)), "pallas"
+                                              telemetry=telemetry,
+                                              monitor=monitor)), "pallas"
         else:
-            yield bench.scan_runner(make_tick(cfg),
-                                    telemetry=telemetry), "xla"
+            yield bench.scan_runner(make_tick(ccfg), telemetry=telemetry,
+                                    monitor=monitor, cfg=ccfg), "xla"
 
     t_off, _, _ = bench.measure(cfg, args.ticks, args.reps,
-                                lambda _cfg: candidates(False))
+                                lambda _cfg: candidates(cfg, False))
     t_on, stats_on, _ = bench.measure(cfg, args.ticks, args.reps,
-                                      lambda _cfg: candidates(True))
+                                      lambda _cfg: candidates(cfg, True))
     best_off, best_on = bench.median(t_off), bench.median(t_on)
     med = stats_on[t_on.index(best_on)]
     tel_sum = {k[len("tel_"):]: int(v) for k, v in med.items()
                if k.startswith("tel_")}
 
+    # §21 ops-plane leg: recorder+monitor baseline vs the SAME carry with
+    # the series + event rings threaded (the cfg switch is the only
+    # delta, so the A/B isolates exactly the ring reductions). The
+    # acceptance gate (< --gate, default 3%) is ops-plane-ON vs the
+    # pre-§21 observer stack, on the same timed production shape.
+    cfg_ops = dataclasses.replace(cfg, series_windows=args.ops_series,
+                                  event_capacity=args.ops_events)
+    t_base, _, _ = bench.measure(
+        cfg, args.ticks, args.reps,
+        lambda _cfg: candidates(cfg, True, monitor=True))
+    t_ops, _, _ = bench.measure(
+        cfg_ops, args.ticks, args.reps,
+        lambda _cfg: candidates(cfg_ops, True, monitor=True))
+    best_base, best_ops = bench.median(t_base), bench.median(t_ops)
+    ops_overhead = best_ops / best_base - 1.0
+    gate_ok = ops_overhead < args.gate
+
     if args.profile_dir:
         from raft_kotlin_tpu.utils.metrics import profile
 
-        run = jax.jit(next(iter(candidates(True)))[0](args.ticks))
+        run = jax.jit(next(iter(candidates(cfg, True)))[0](args.ticks))
         rng = make_rng(cfg)
         st0 = init_state(cfg)
         jax.block_until_ready(jax.tree_util.tree_leaves(run(st0, rng)))
@@ -119,10 +153,21 @@ def main() -> None:
         "ticks_per_sec_off": round(args.ticks / best_off, 2),
         "ticks_per_sec_on": round(args.ticks / best_on, 2),
         "overhead_frac": round(best_on / best_off - 1.0, 4),
+        "ops_series": args.ops_series,
+        "ops_events": args.ops_events,
+        "ticks_per_sec_base": round(args.ticks / best_base, 2),
+        "ticks_per_sec_ops": round(args.ticks / best_ops, 2),
+        "ops_overhead_frac": round(ops_overhead, 4),
+        "ops_gate_ok": gate_ok,
         "telemetry": tel_sum,
         "profile_dir": args.profile_dir,
     }))
+    if args.enforce and not gate_ok:
+        print(f"GATE FAIL: ops-plane overhead {ops_overhead:.2%} >= "
+              f"{args.gate:.0%}", file=sys.stderr)
+        return 2
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -1,0 +1,149 @@
+#!/usr/bin/env python
+"""raft_top: a polling terminal dashboard over the §21 scrape surface.
+
+Points at a running scrape endpoint — a continuous farm started with
+`scripts/fuzz_farm.py --continuous N --http-port P`, or any
+api/http_api.RaftHTTPServer — and renders /metrics, /events and /healthz
+as a compact refreshing view: health + SLO burn up top, the counter and
+gauge table, then the tail of the event-ring narrative. Pure stdlib and
+pure HTTP client: raft_top never imports jax and never touches the
+device — everything it shows is the host snapshot the farm already
+published (SEMANTICS.md §21 scrape contract).
+
+Examples:
+  python scripts/raft_top.py --port 7070             # refresh every 2 s
+  python scripts/raft_top.py --port 7070 --once      # one frame (tests)
+
+Exit status: 0 after --once or Ctrl-C, 2 when the endpoint never
+answered.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+
+
+def parse_prometheus(text: str) -> dict:
+    """Prometheus text exposition -> {metric_name: value} (labelled series
+    keep their label string: 'raft_series{channel="x"}')."""
+    out = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        name, _, val = line.rpartition(" ")
+        try:
+            v = float(val)
+        except ValueError:
+            continue
+        out[name] = int(v) if v == int(v) else v
+    return out
+
+
+def fetch(url: str, timeout: float = 2.0):
+    """(status, body) — never raises on HTTP error statuses (healthz 503
+    is a VALUE here, not a failure); None on transport errors."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+    except (urllib.error.URLError, OSError, TimeoutError):
+        return None, None
+
+
+def render(base: str, events_tail: int = 12) -> str:
+    code, metrics_txt = fetch(base + "/metrics")
+    if metrics_txt is None:
+        return None
+    m = parse_prometheus(metrics_txt)
+    hcode, hbody = fetch(base + "/healthz")
+    health = {}
+    if hbody:
+        try:
+            health = json.loads(hbody)
+        except ValueError:
+            pass
+    lines = []
+    mark = "OK" if hcode == 200 else f"UNHEALTHY ({hcode})"
+    lines.append(f"raft_top  {base}  [{mark}]  "
+                 f"{time.strftime('%H:%M:%S')}")
+    lines.append(f"  inv={health.get('inv_status', '?')} "
+                 f"slo={health.get('slo_status', '-')} "
+                 f"segment={health.get('segment', health.get('tick', '-'))}")
+    plain = {k: v for k, v in m.items() if "{" not in k}
+    if plain:
+        lines.append("  " + "-" * 64)
+        for k in sorted(plain):
+            lines.append(f"  {k[5:] if k.startswith('raft_') else k:<32} "
+                         f"{plain[k]}")
+    series = {k: v for k, v in m.items() if k.startswith("raft_series{")}
+    if series:
+        lines.append("  " + "-" * 64)
+        lines.append("  last series window:")
+        for k in sorted(series):
+            ch = k[len('raft_series{channel="'):-2]
+            lines.append(f"    {ch:<24} {series[k]}")
+    ecode, ebody = fetch(base + "/events")
+    if ecode == 200 and ebody:
+        try:
+            ev = json.loads(ebody)
+        except ValueError:
+            ev = {}
+        rows = ev.get("events") or []
+        if rows:
+            lines.append("  " + "-" * 64)
+            lines.append(f"  events (last {min(events_tail, len(rows))} "
+                         f"of {len(rows)}, dropped="
+                         f"{ev.get('events_dropped', 0)}):")
+            for e in rows[-events_tail:]:
+                lines.append(f"    [t={e['tick']:>5}] g{e['group']} "
+                             f"{e['kind']} arg={e['arg']}")
+    return "\n".join(lines)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="polling dashboard over the §21 /metrics surface")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="seconds between polls")
+    ap.add_argument("--once", action="store_true",
+                    help="print one frame and exit (no clear codes)")
+    args = ap.parse_args()
+    base = f"http://{args.host}:{args.port}"
+
+    if args.once:
+        frame = render(base)
+        if frame is None:
+            print(f"no answer from {base}", file=sys.stderr)
+            return 2
+        print(frame)
+        return 0
+    try:
+        misses = 0
+        while True:
+            frame = render(base)
+            if frame is None:
+                misses += 1
+                if misses >= 5:
+                    print(f"no answer from {base}", file=sys.stderr)
+                    return 2
+            else:
+                misses = 0
+                # ANSI clear + home, like top(1); one write per frame.
+                sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+                sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -109,6 +109,12 @@ INV_LEGS = (
     # apply fold adds on top of Figure 3, gated exactly like the
     # protocol legs.
     ("serving_inv_status", "serving inv", "suspect"),
+    # r21 (ISSUE 20): the §21 SLO verdict over the continuous leg's
+    # per-segment metrics (api/opsplane.SLOBurn) — "clean" or
+    # "breach:<dim>@seg<k>", the same clean/non-clean shape as every
+    # invariant leg, so a spent error budget gates the round exactly
+    # like a latched Figure-3 violation.
+    ("slo_status", "slo", "suspect"),
 )
 
 # Boolean audit fields (r13): pod_dryrun marks the virtual-device
@@ -226,7 +232,15 @@ def load_record(path: str) -> Optional[dict]:
                   # (trajectory evidence).
                   "client_commands_per_sec", "reads_per_sec",
                   "apply_bytes_per_tick", "submit_commit_p50",
-                  "submit_commit_p99", "submit_commit_p999"):
+                  "submit_commit_p99", "submit_commit_p999",
+                  # r21 (ISSUE 20): the §21 ops-plane figures — the
+                  # measured rings-on/rings-off overhead fraction on the
+                  # bit-identical continuous pair (trajectory evidence:
+                  # the <3% acceptance gate reads the accelerator run),
+                  # the series-ring sampling proof and the loud
+                  # event-drop counter.
+                  "ops_overhead_frac", "series_ring_nonzero",
+                  "events_dropped"):
         v = parsed.get(field)
         if not isinstance(v, (int, float)):
             v = _extract_field(tail, field)
@@ -256,6 +270,10 @@ def load_record(path: str) -> Optional[dict]:
         # The serving-throughput gate (ISSUE 19) vets the same way — it
         # arms once the first vetted serving round lands.
         vetted["client_commands_per_sec"] = gate_value("suspect")
+    if "ops_overhead_frac" in aux_num:
+        # The §21 ops-plane rows (ISSUE 20) vet on the headline suspect
+        # flag like every accounting figure riding the same record.
+        vetted["ops_overhead_frac"] = gate_value("suspect")
     aux_str: Dict[str, str] = {}
     for field in ("aux_source", "compute"):
         v = parsed.get(field)
@@ -609,7 +627,17 @@ def main(argv=None) -> int:
             ("reads_per_sec", "serving reads/s",
              "client_commands_per_sec", ",.1f"),
             ("submit_commit_p99", "submit-commit p99",
-             "client_commands_per_sec", ",.0f")):
+             "client_commands_per_sec", ",.0f"),
+            # r21 (ISSUE 20): the §21 ops-plane overhead trajectory —
+            # rings-on vs rings-off elapsed ratio on the bit-identical
+            # continuous pair (LOWER is better; the <3% acceptance gate
+            # reads the accelerator run, so on this CPU box the row is
+            # noise-band evidence) — and the loud event-drop counter
+            # (0 unless the ring was undersized for the fault mix).
+            ("ops_overhead_frac", "ops overhead frac",
+             "ops_overhead_frac", ",.4f"),
+            ("events_dropped", "events dropped",
+             "ops_overhead_frac", ",.0f")):
         if not any(field in r.get("aux_num", {}) for r in recs):
             continue
         row = [label.ljust(18)]

@@ -82,6 +82,10 @@ def _fake_record():
         "submit_commit_p99": 45,
         "submit_commit_p999": 48,
         "serving_inv_status": "clean",
+        "slo_status": "clean",
+        "series_ring_nonzero": 212,
+        "events_dropped": 0,
+        "ops_overhead_frac": 0.011,
         "suspect": False,
         # plus the long tail of fields that overflowed the driver window
         **{f"filler_{i}": [0.1234] * 8 for i in range(80)},
@@ -199,6 +203,14 @@ def test_compact_headline_is_last_line_and_complete():
               "apply_bytes_per_tick", "submit_commit_p50",
               "submit_commit_p99", "submit_commit_p999",
               "serving_inv_status"):
+        assert k in bench.COMPACT_EXTRA_FIELDS, k
+    # The r21 additions (ISSUE 20): the §21 ops plane's SLO verdict
+    # (gated like every inv_status), the series-ring sampling proof, the
+    # loud event-drop counter and the measured rings-on/off overhead —
+    # summarize_bench's SLO gate + ops-overhead trajectory row and the
+    # round's acceptance criteria read them from the authoritative tail.
+    for k in ("slo_status", "series_ring_nonzero", "events_dropped",
+              "ops_overhead_frac"):
         assert k in bench.COMPACT_EXTRA_FIELDS, k
     for k in bench.COMPACT_EXTRA_FIELDS:
         assert k in last, k

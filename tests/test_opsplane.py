@@ -1,0 +1,444 @@
+"""§21 streaming ops plane (ISSUE 20).
+
+The contracts that make the carry-resident time-series + event rings and
+the scrape/SLO surface trustworthy:
+
+- **Bit-neutrality** — rings-ON runs are bit-identical to rings-OFF on
+  per-tick traces, end states and every shared monitor key (the PR-5/
+  PR-6 observer contract: the rings only REDUCE over the state pairs the
+  scans already carry).
+- **Exact recomputability** — the trace-derivable series columns
+  (telemetry.TRACE_SERIES_NAMES) and event kinds (TRACE_EVENT_KINDS)
+  decoded from the device rings equal an independent numpy recomputation
+  from the (T, N, G) trace of the SAME run: same fold, same wrap, same
+  write order, same drop accounting. On-device accumulation adds no
+  approximation.
+- **Engine independence** — fused-T replay produces the same ring bits
+  as T=1; the sharded continuous farm produces the same series frame /
+  event stream / drop counter as single-device (slow tier).
+- **Loud drops** — an undersized event ring drops LOUDLY: the decoded
+  prefix equals the uncapped stream's first `capacity` events and
+  `events_dropped` counts exactly the overflow.
+- **SLO gates** — SLOSpec/SLOBurn unit math (cmp directions, absent
+  metric cannot violate, budget burn, sticky first breach), the
+  prometheus_text/OpsPlane/healthz rendering, and the farm-level
+  `slo_status` breach on a violated spec with the corpus hash unchanged
+  (the gate observes; it never perturbs the run).
+- **Scrape surface** — `GET /metrics` on a farm-mode HTTP server (no
+  Simulator) returns non-empty Prometheus text from the published
+  snapshot; /events and /healthz respond; Simulator.metrics_snapshot
+  renders through the same formatter.
+"""
+
+import dataclasses
+import json
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from conftest import assert_states_equal
+
+from raft_kotlin_tpu.api import fuzz as fuzz_mod
+from raft_kotlin_tpu.api import opsplane
+from raft_kotlin_tpu.api.http_api import RaftHTTPServer
+from raft_kotlin_tpu.models.state import init_state
+from raft_kotlin_tpu.ops.tick import make_run
+from raft_kotlin_tpu.utils import telemetry
+from raft_kotlin_tpu.utils.config import RaftConfig
+
+# The sync fault soup (test_invariants' config): elections, replication,
+# crashes/restarts, drops — enough churn that every trace-derivable
+# series column and event kind actually fires.
+SOUP = RaftConfig(n_groups=6, n_nodes=3, log_capacity=16, cmd_period=7,
+                  p_drop=0.1, p_crash=0.005, p_restart=0.05, seed=5
+                  ).stressed(10)
+T = 80
+
+
+def _rings(cfg, windows=8, events=64, stride=0):
+    return dataclasses.replace(cfg, series_windows=windows,
+                               event_capacity=events,
+                               series_stride=stride)
+
+
+def _np_trace(tr):
+    return {k: np.asarray(v) for k, v in tr.items()}
+
+
+# ---------------------------------------------------------------------------
+# Bit-neutrality: rings only read what the scan already carries.
+
+def test_rings_bit_neutral_and_shared_monitor_keys_equal():
+    cfg_on = _rings(SOUP)
+    end0, tr0, mon0 = make_run(SOUP, T, trace=True, monitor=True)(
+        init_state(SOUP))
+    end1, tr1, mon1 = make_run(cfg_on, T, trace=True, monitor=True)(
+        init_state(cfg_on))
+    tr0, tr1 = _np_trace(tr0), _np_trace(tr1)
+    for k in tr0:
+        assert np.array_equal(tr0[k], tr1[k]), (
+            f"field {k} trace differs with the §21 rings on")
+    assert_states_equal(end0, end1)
+    # Every pre-§21 monitor key is bit-equal; the rings only ADD keys.
+    h0, h1 = jax.device_get(mon0), jax.device_get(mon1)
+    for k in h0:
+        assert np.array_equal(np.asarray(h0[k]), np.asarray(h1[k])), k
+    extra = set(h1) - set(h0)
+    assert {"series_data", "series_stride", "ev_kind"} <= extra
+
+
+# ---------------------------------------------------------------------------
+# Exact recomputability from the (T, N, G) trace.
+
+def _traced_rings_run(windows=8, events=256, stride=0):
+    cfg = _rings(SOUP, windows=windows, events=events, stride=stride)
+    _, tr, mon = make_run(cfg, T, trace=True, monitor=True)(init_state(cfg))
+    return cfg, _np_trace(tr), telemetry.summarize_monitor(mon)
+
+
+def test_series_ring_recomputed_exactly_from_trace():
+    cfg, tr, summ = _traced_rings_run()
+    dev = summ["series"]
+    ref = telemetry.series_from_trace(init_state(cfg), tr,
+                                      cfg.series_windows, dev["stride"])
+    assert len(dev["windows"]) == len(ref["windows"])
+    for w_dev, w_ref in zip(dev["windows"], ref["windows"]):
+        for name in telemetry.TRACE_SERIES_NAMES:
+            assert w_dev[name] == w_ref[name], name
+    # The soup actually moved: not every cell sits at its identity.
+    idents = {c[0]: c[2] for c in telemetry.SERIES_CHANNELS}
+    assert any(w[n] != idents[n] for w in dev["windows"]
+               for n in telemetry.TRACE_SERIES_NAMES)
+
+
+def test_series_ring_wraps_like_the_recompute():
+    # windows*stride < T forces wrap (the auto-stride would tile the run,
+    # so pin an explicit stride) — the chronological decode (LAST W
+    # windows) must agree with the recompute's identical wrap handling.
+    cfg, tr, summ = _traced_rings_run(windows=3, stride=4)
+    dev = summ["series"]
+    assert dev["stride"] * 3 < T, "config no longer forces a wrap"
+    ref = telemetry.series_from_trace(init_state(cfg), tr, 3, dev["stride"])
+    assert [{n: w[n] for n in telemetry.TRACE_SERIES_NAMES}
+            for w in dev["windows"]] == ref["windows"]
+
+
+def test_event_ring_recomputed_exactly_from_trace():
+    cfg, tr, summ = _traced_rings_run()
+    dev_events = summ["events"]
+    # On this config only the trace-derivable kinds can fire (no
+    # compaction, no §15/§16 caps, no scheduler, no injected violation) —
+    # so the FULL device stream is the recompute's stream, order, args,
+    # cursor and all.
+    assert all(e["kind"] in telemetry.TRACE_EVENT_KINDS for e in dev_events)
+    ref = telemetry.events_from_trace(init_state(cfg), tr,
+                                      cfg.event_capacity)
+    assert dev_events == ref["events"]
+    assert summ["events_count"] == ref["count"]
+    assert summ["events_dropped"] == ref["dropped"] == 0
+    kinds = {e["kind"] for e in dev_events}
+    assert kinds == set(telemetry.TRACE_EVENT_KINDS), (
+        f"soup no longer exercises every trace kind: {kinds}")
+
+
+def test_event_ring_drop_is_loud_and_prefix_exact():
+    # Undersized ring: the kept prefix equals the uncapped stream's first
+    # `capacity` events and the drop counter equals exactly the overflow.
+    cfg_big, tr, summ_big = _traced_rings_run(events=256)
+    assert summ_big["events_dropped"] == 0, "256 no longer uncapped"
+    cap = 5
+    cfg_small = _rings(SOUP, events=cap)
+    _, _, mon = make_run(cfg_small, T, trace=True, monitor=True)(
+        init_state(cfg_small))
+    summ = telemetry.summarize_monitor(mon)
+    full = summ_big["events"]
+    assert len(full) > cap
+    assert summ["events"] == full[:cap]
+    assert summ["events_dropped"] == len(full) - cap > 0
+    assert summ["events_count"] == len(full)
+    # render_events flags the drop loudly and renders host-added kinds.
+    txt = telemetry.render_events(
+        {"events": summ["events"] + [{"kind": "admit", "kind_id": -1,
+                                      "tick": 1, "group": 0, "arg": 7}],
+         "dropped": summ["events_dropped"]})
+    assert "DROPPED" in txt and "ADMIT arg=7" in txt
+
+
+# ---------------------------------------------------------------------------
+# Engine independence.
+
+def test_fused_ring_bits_match_t1():
+    cfg = _rings(SOUP)
+    _, _, mon1 = make_run(cfg, T, trace=True, monitor=True,
+                          fused_ticks=1)(init_state(cfg))
+    _, _, mon4 = make_run(cfg, T, trace=True, monitor=True,
+                          fused_ticks=4)(init_state(cfg))
+    h1, h4 = jax.device_get(mon1), jax.device_get(mon4)
+    for k in ("series_data", "series_stride", "ev_kind", "ev_tick",
+              "ev_grp", "ev_arg", "ev_count", "events_dropped"):
+        assert np.array_equal(np.asarray(h1[k]), np.asarray(h4[k])), k
+
+
+RING_KEYS = ("series_data", "series_stride", "ev_kind", "ev_tick",
+             "ev_grp", "ev_arg", "ev_count", "events_dropped")
+
+
+def _assert_ring_keys_equal(mon_a, mon_b):
+    ha, hb = jax.device_get(mon_a), jax.device_get(mon_b)
+    for k in RING_KEYS:
+        assert np.array_equal(np.asarray(ha[k]), np.asarray(hb[k])), k
+
+
+@pytest.mark.slow
+def test_pallas_rings_match_xla_per_tick_and_fused():
+    # The megakernel's flat-carry observer (per-tick) and the fused-T
+    # snapshot replay both produce the XLA scan's exact ring bits.
+    from raft_kotlin_tpu.ops.pallas_tick import make_pallas_scan
+    from raft_kotlin_tpu.ops.tick import make_rng
+
+    cfg = _rings(dataclasses.replace(SOUP, n_groups=8))
+    rng = make_rng(cfg)
+    *_, mon_x = make_run(cfg, T, trace=False, monitor=True)(init_state(cfg))
+    _, mon_p = make_pallas_scan(cfg, T, monitor=True)(init_state(cfg), rng)
+    _assert_ring_keys_equal(mon_p, mon_x)
+    _, mon_f = make_pallas_scan(cfg, T, fused_ticks=4, monitor=True)(
+        init_state(cfg), rng)
+    _assert_ring_keys_equal(mon_f, mon_x)
+
+
+@pytest.mark.slow
+def test_deep_rings_match_xla():
+    # The frontier-cache deep engine threads the same rings (the engines
+    # are bit-identical, so the reductions see the same transitions).
+    from raft_kotlin_tpu.ops.deep_cache import make_deep_scan
+    from raft_kotlin_tpu.ops.tick import make_rng
+
+    cfg = _rings(RaftConfig(n_groups=8, n_nodes=3, log_capacity=256,
+                            cmd_period=3, p_drop=0.1, seed=7).stressed(10))
+    Td = 60
+    rng = make_rng(cfg)
+    *_, mon_d = make_deep_scan(cfg, Td, return_state=True, monitor=True)(
+        init_state(cfg), rng)
+    *_, mon_x = make_run(cfg, Td, trace=False, monitor=True)(
+        init_state(cfg))
+    _assert_ring_keys_equal(mon_d, mon_x)
+
+
+@pytest.mark.slow
+def test_sharded_farm_rings_match_single_device():
+    from raft_kotlin_tpu.parallel import mesh as mesh_mod
+
+    cfg = _rings(fuzz_mod.continuous_config(16, life_lo=8, life_hi=40),
+                 windows=4, events=128)
+    r8 = fuzz_mod.continuous_farm(cfg, 10, 3, mesh=mesh_mod.make_mesh())
+    r1 = fuzz_mod.continuous_farm(cfg, 10, 3)
+    assert r8["corpus_hash"] == r1["corpus_hash"]
+    assert r8["series"] == r1["series"] and r1["series"] is not None
+    assert r8["events"] == r1["events"]
+    assert r8["events_dropped"] == r1["events_dropped"]
+
+
+# ---------------------------------------------------------------------------
+# SLO spec / burn math.
+
+def test_slo_spec_validation_and_cmp_directions():
+    with pytest.raises(ValueError):
+        opsplane.SLOSpec(budget_frac=0.0)
+    with pytest.raises(ValueError):
+        opsplane.SLOSpec(budget_frac=1.5)
+    slo = opsplane.SLOSpec(read_p99_ticks=50, downtime_frac_max=0.2,
+                           election_p90_ticks=40, farm_util_min=0.9)
+    assert slo.gated_dims == ("read_p99_ticks", "downtime_frac_max",
+                              "election_p90_ticks", "farm_util_min")
+    ok = {"read_p99": 50, "downtime_frac": 0.2, "election_p90": 40,
+          "farm_util": 0.9}
+    assert slo.violated_dims(ok) == []
+    # max dims gate value <= bound, min dims value >= bound; report order
+    # is SLO_DIMS evaluation order.
+    bad = {"read_p99": 51, "downtime_frac": 0.21, "election_p90": 41,
+           "farm_util": 0.89}
+    assert slo.violated_dims(bad) == ["read_p99", "downtime_frac",
+                                      "election_p90", "farm_util"]
+    # An absent / None metric cannot violate (serving-off farm).
+    assert slo.violated_dims({"read_p99": None, "farm_util": 0.95}) == []
+    # An ungated dimension never violates.
+    assert opsplane.SLOSpec(farm_util_min=0.9).violated_dims(
+        {"read_p99": 10 ** 6, "farm_util": 0.95}) == []
+
+
+def test_slo_burn_budget_and_sticky_first_breach():
+    burn = opsplane.SLOBurn(opsplane.SLOSpec(farm_util_min=0.9,
+                                             budget_frac=0.5))
+    # seg0 clean, seg1 violated: burn = (1/2)/0.5 = 1.0 => breach latches
+    # at the violating segment.
+    assert burn.observe({"farm_util": 0.95}) == []
+    assert burn.observe({"farm_util": 0.5}) == ["farm_util"]
+    assert burn.burn == pytest.approx(1.0)
+    assert burn.breached and burn.status == "breach:farm_util@seg1"
+    # Burn keeps updating (clean segments refill the rate); the
+    # first-breach coordinate is sticky.
+    burn.observe({"farm_util": 0.95})
+    burn.observe({"farm_util": 0.95})
+    assert burn.burn == pytest.approx(0.5)  # (1/4) / 0.5
+    assert burn.status == "breach:farm_util@seg1"
+    d = burn.as_dict()
+    assert d == {"status": "breach:farm_util@seg1", "burn": 0.5,
+                 "segments": 4, "violated_segments": 1,
+                 "by_dim": {"farm_util": 1}}
+
+
+def test_slo_burn_under_budget_stays_clean():
+    # Burn is a RATE evaluated at each observation, so the violation must
+    # arrive once enough clean segments have accrued budget — one miss in
+    # five segments at budget 0.5 burns 0.4 < 1.
+    burn = opsplane.SLOBurn(opsplane.SLOSpec(farm_util_min=0.9,
+                                             budget_frac=0.5))
+    for util in (0.95, 0.95, 0.95, 0.95, 0.5):
+        burn.observe({"farm_util": util})
+    assert burn.burn == pytest.approx(0.4)
+    assert not burn.breached and burn.status == "clean"
+
+
+# ---------------------------------------------------------------------------
+# Prometheus rendering + the OpsPlane holder.
+
+SNAP = {
+    "segment": 3, "ticks_total": 640, "universes_admitted": 20,
+    "universes_retired": 4, "events_dropped": 2, "farm_util": 0.93,
+    "downtime_frac": 0.05, "election_p90": 17, "read_p99": None,
+    "inv_status": "clean", "slo_status": "clean", "slo_burn": 0.25,
+    "telemetry": {"elections_started": 41, "commit_advances": 390},
+    "gauges": {"leader_groups": 6},
+    "series": {"stride": 10, "names": ["elections", "commit_max"],
+               "windows": [{"elections": 1, "commit_max": 9},
+                           {"elections": 3, "commit_max": 12}]},
+    "events": [{"kind": "leader_change", "kind_id": 0, "tick": 4,
+                "group": 1, "arg": 2}],
+}
+
+
+def test_prometheus_text_renders_snapshot():
+    txt = opsplane.prometheus_text(SNAP)
+    assert txt.endswith("\n")
+    lines = txt.splitlines()
+    assert "raft_farm_util 0.93" in lines
+    assert "raft_inv_clean 1" in lines
+    assert "raft_slo_breached 0" in lines
+    assert "raft_tel_elections_started_total 41" in lines
+    assert "raft_leader_groups 6" in lines  # gauges passthrough
+    # None metrics are simply absent, never rendered as 0.
+    assert not any(line.startswith("raft_read_p99") for line in lines)
+    # The LATEST series window becomes labeled gauges.
+    assert 'raft_series{channel="elections"} 3' in lines
+    assert 'raft_series{channel="commit_max"} 12' in lines
+    bad = dict(SNAP, inv_status="election_safety@t4/g1",
+               slo_status="breach:farm_util@seg2")
+    lines = opsplane.prometheus_text(bad).splitlines()
+    assert "raft_inv_clean 0" in lines and "raft_slo_breached 1" in lines
+
+
+def test_opsplane_holder_and_healthz_transitions():
+    plane = opsplane.OpsPlane()
+    assert plane.snapshot() is None
+    code, body = plane.healthz()
+    assert code == 200 and body["status"] == "starting"
+    assert plane.prometheus_text() == "# no snapshot yet\n"
+    plane.update(SNAP)
+    assert plane.snapshot()["segment"] == 3
+    code, body = plane.healthz()
+    assert code == 200 and body["status"] == "ok"
+    ev = json.loads(plane.events_json())
+    assert ev["events"] == SNAP["events"] and ev["events_dropped"] == 2
+    plane.update(dict(SNAP, slo_status="breach:farm_util@seg2"))
+    code, body = plane.healthz()
+    assert code == 503 and body["status"] == "unhealthy"
+    assert body["slo_status"] == "breach:farm_util@seg2"
+
+
+# ---------------------------------------------------------------------------
+# The farm-level gate: SLO breach flips slo_status, never the bits.
+
+def test_farm_slo_breach_and_bit_neutral_corpus():
+    cfg = _rings(fuzz_mod.continuous_config(8, life_lo=8, life_hi=40),
+                 windows=4, events=64)
+    base = fuzz_mod.continuous_farm(cfg, 10, 3)
+    assert base["slo_status"] == "clean" and base["slo_burn"] is None
+    # farm_util_min=1.01 is unsatisfiable => every segment violates =>
+    # budget spent at seg0.
+    snaps = []
+    res = fuzz_mod.continuous_farm(
+        cfg, 10, 3, slo=opsplane.SLOSpec(farm_util_min=1.01,
+                                         budget_frac=0.1),
+        publish=snaps.append)
+    assert res["slo_status"] == "breach:farm_util@seg0"
+    assert res["slo_burn"]["burn"] >= 1.0
+    assert res["slo_burn"]["violated_segments"] == 3
+    # The gate observes; the run's bytes are untouched.
+    assert res["corpus_hash"] == base["corpus_hash"]
+    assert res["inv_status"] == "clean"
+    # publish fired once per segment with the scrape-shaped snapshot, and
+    # the last one renders to non-empty Prometheus text.
+    assert [s["segment"] for s in snaps] == [0, 1, 2]
+    assert snaps[-1]["slo_status"] == res["slo_status"]
+    assert snaps[-1]["series"] is not None
+    assert "raft_slo_breached 1" in opsplane.prometheus_text(snaps[-1])
+    # A satisfiable spec over the same run stays clean.
+    ok = fuzz_mod.continuous_farm(
+        cfg, 10, 3, slo=opsplane.SLOSpec(downtime_frac_max=1.0))
+    assert ok["slo_status"] == "clean"
+    assert ok["corpus_hash"] == base["corpus_hash"]
+
+
+# ---------------------------------------------------------------------------
+# The scrape surface.
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def test_http_farm_mode_scrape_surface():
+    plane = opsplane.OpsPlane()
+    plane.update(SNAP)
+    with RaftHTTPServer(None, port=0, tick_hz=0.0, ops=plane) as srv:
+        code, body = _get(srv.port, "/metrics")
+        assert code == 200 and "raft_farm_util 0.93" in body
+        code, body = _get(srv.port, "/events")
+        assert code == 200
+        assert json.loads(body)["events"] == SNAP["events"]
+        code, body = _get(srv.port, "/healthz")
+        assert code == 200 and json.loads(body)["status"] == "ok"
+        # Sim routes answer loudly in farm mode instead of crashing.
+        code, _ = _get(srv.port, "/0/1/status")
+        assert code == 503
+        plane.update(dict(SNAP, inv_status="election_safety@t4/g1"))
+        code, body = _get(srv.port, "/healthz")
+        assert code == 503 and json.loads(body)["status"] == "unhealthy"
+    with pytest.raises(ValueError):
+        RaftHTTPServer(None, port=0)
+
+
+def test_http_simulator_metrics_route():
+    from raft_kotlin_tpu.api import Simulator
+
+    cfg = RaftConfig(n_groups=2, n_nodes=3, log_capacity=16,
+                     seed=5).stressed(10)
+    sim = Simulator(cfg)
+    snap = sim.metrics_snapshot()
+    assert snap["ticks_total"] == 0
+    assert snap["gauges"]["groups"] == 2
+    with RaftHTTPServer(sim, port=0, tick_hz=0.0) as srv:
+        _get(srv.port, "/step/30")
+        code, body = _get(srv.port, "/metrics")
+        assert code == 200
+        assert "raft_ticks_total 30" in body.splitlines()
+        assert any(line.startswith("raft_leader_groups ")
+                   for line in body.splitlines())
+        code, body = _get(srv.port, "/healthz")
+        assert code == 200 and json.loads(body)["status"] == "ok"

@@ -283,6 +283,33 @@ def test_fuzz_violation_gate(tmp_path):
     assert sb.main([str(tmp_path / "BENCH_r*.json")]) == 0
 
 
+def test_slo_breach_gate(tmp_path):
+    # ISSUE 20 satellite: a breached §21 SLO error budget on the latest
+    # vetted round gates exit-1 exactly like a latched invariant, and the
+    # ops-overhead trajectory figure is extracted from the compact tail.
+    sb = _mod()
+    assert ("slo_status", "slo", "suspect") in sb.INV_LEGS
+
+    def art(n, slo_status):
+        tail = json.dumps({"ticks_per_sec": 400.0, "suspect": False,
+                           "inv_status": "clean",
+                           "slo_status": slo_status,
+                           "ops_overhead_frac": 0.012,
+                           "events_dropped": 0}) + "\n"
+        return {"n": n, "rc": 0, "tail": tail, "parsed": None}
+
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(art(1, "clean")))
+    (tmp_path / "BENCH_r02.json").write_text(
+        json.dumps(art(2, "breach:downtime_frac@seg12")))
+    recs = sb.load_all(str(tmp_path / "BENCH_r*.json"))
+    assert recs[-1]["aux_num"]["ops_overhead_frac"] == 0.012
+    assert sb.check_violations(recs) == [
+        ("slo", "breach:downtime_frac@seg12")]
+    assert sb.main([str(tmp_path / "BENCH_r*.json")]) == 1
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(art(2, "clean")))
+    assert sb.main([str(tmp_path / "BENCH_r*.json")]) == 0
+
+
 def test_pod_scaling_gate_and_drift_warning(tmp_path):
     # ISSUE 10 satellites: (a) a REAL pod (pod_dryrun false, n_devices>1)
     # whose scaling_efficiency falls below the 0.9 floor gates exit-1;
